@@ -1,0 +1,19 @@
+"""Test harness: force an 8-virtual-device CPU mesh — the analog of the
+reference's local[2] SparkSession test fixture (reference
+utils/.../test/TestSparkContext.scala:36-79). Same code paths as a real TPU
+slice, 8 host devices."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)

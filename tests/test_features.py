@@ -1,0 +1,93 @@
+"""Feature graph tests (model: reference FeatureLikeTest, FeatureBuilderTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Feature
+from transmogrifai_tpu.types import (
+    Real, RealNN, Integral, Text, Binary, OPVector, PickList)
+from transmogrifai_tpu.stages.base import (
+    UnaryTransformer, BinaryTransformer, FeatureGeneratorStage)
+
+
+def _raw():
+    age = FeatureBuilder.Real("age").extract(lambda r: r.get("age")).as_predictor()
+    fare = FeatureBuilder.Real("fare").extract(lambda r: r.get("fare")).as_predictor()
+    label = FeatureBuilder.RealNN("survived").extract(
+        lambda r: r.get("survived")).as_response()
+    return age, fare, label
+
+
+def test_raw_feature_properties():
+    age, fare, label = _raw()
+    assert age.is_raw and age.name == "age"
+    assert isinstance(age.origin_stage, FeatureGeneratorStage)
+    assert not age.is_response and label.is_response
+    assert age.feature_type is Real and label.feature_type is RealNN
+    assert age.uid != fare.uid
+    assert age.origin_stage.extract({"age": 3.0}) == 3.0
+
+
+def test_transform_with_builds_dag():
+    age, fare, _ = _raw()
+    doubler = UnaryTransformer("double", lambda v: None if v is None else v * 2, Real)
+    doubled = age.transform_with(doubler)
+    assert doubled.parents == (age,)
+    assert doubled.origin_stage is doubler
+    assert "double" in doubled.name
+    total = doubled.transform_with(
+        BinaryTransformer("plus", lambda a, b: (a or 0) + (b or 0), Real), fare)
+    raw = total.raw_features()
+    assert {f.name for f in raw} == {"age", "fare"}
+    stages = total.parent_stages()
+    dists = {type(s).__name__: d for s, d in stages.items()}
+    assert dists["BinaryTransformer"] == 0
+    assert dists["UnaryTransformer"] == 1
+
+
+def test_cycle_detection():
+    age, fare, _ = _raw()
+    stage = BinaryTransformer("plus", lambda a, b: a, Real)
+    out = age.transform_with(stage, fare)
+    # manufacture a cycle
+    stage.input_features = (out, fare)
+    out.parents = (out, fare)
+    with pytest.raises(ValueError, match="cycle"):
+        out.raw_features()
+
+
+def test_input_type_checking():
+    age, _, _ = _raw()
+    text_stage = UnaryTransformer("tok", lambda v: v, Text, input_type=Text)
+    with pytest.raises(TypeError):
+        age.transform_with(text_stage)
+
+
+def test_copy_with_new_stages():
+    age, fare, _ = _raw()
+    stage = BinaryTransformer("plus", lambda a, b: (a or 0) + (b or 0), Real)
+    out = age.transform_with(stage, fare)
+    replacement = BinaryTransformer("plus", lambda a, b: 42.0, Real, uid=stage.uid)
+    new_out = out.copy_with_new_stages({stage.uid: replacement})
+    assert new_out.uid == out.uid
+    assert new_out.origin_stage is replacement
+    assert out.origin_stage is stage  # original untouched
+
+
+def test_from_dataframe_schema_inference():
+    df = pd.DataFrame({
+        "label": [1.0, 0.0], "age": [1.5, 2.5], "count": [1, 2],
+        "name": ["a", "b"], "flag": [True, False]})
+    resp, feats = FeatureBuilder.from_dataframe(df, response="label")
+    assert resp.feature_type is RealNN and resp.is_response
+    types = {f.name: f.feature_type for f in feats}
+    assert types == {"age": Real, "count": Integral, "name": Text, "flag": Binary}
+    with pytest.raises(ValueError):
+        FeatureBuilder.from_dataframe(df, response="missing")
+
+
+def test_typed_factories_exist_for_all_types():
+    fb = FeatureBuilder.PickList("color")
+    assert fb.feature_type is PickList
+    f = fb.extract_field().as_predictor()
+    assert f.origin_stage.extract({"color": "red"}) == "red"

@@ -1,0 +1,96 @@
+"""Feature type system tests (model: reference FeatureTypeTest, Numerics/Text/Maps specs)."""
+import math
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+
+
+def test_registry_has_52_concrete_types():
+    # matches the reference registry FeatureType.scala:265-324
+    assert len(t.FEATURE_TYPES) == 52
+    for name in ("Real", "RealNN", "Binary", "Integral", "Date", "DateTime",
+                 "Currency", "Percent", "Text", "Email", "Base64", "Phone", "ID",
+                 "URL", "TextArea", "PickList", "ComboBox", "Country", "State",
+                 "City", "PostalCode", "Street", "OPVector", "TextList",
+                 "DateList", "DateTimeList", "Geolocation", "MultiPickList",
+                 "Prediction"):
+        assert name in t.FEATURE_TYPES
+    # all 23 companion map types
+    maps = [n for n in t.FEATURE_TYPES if n.endswith("Map")]
+    assert len(maps) == 23
+
+
+def test_real_nullability_and_equality():
+    assert t.Real(None).is_empty
+    assert t.Real(1.5).value == 1.5
+    assert t.Real(float("nan")).is_empty  # NaN normalizes to missing
+    assert t.Real(1.0) == t.Real(1.0)
+    assert t.Real(1.0) != t.Real(2.0)
+    with pytest.raises(ValueError):
+        t.RealNN(None)
+    assert t.RealNN(3).value == 3.0
+
+
+def test_binary_integral_date():
+    assert t.Binary(True).value is True
+    assert t.Binary(0).value is False
+    assert t.Binary(None).to_double() is None
+    assert t.Binary(True).to_double() == 1.0
+    assert t.Integral(7).value == 7
+    assert t.Integral(None).is_empty
+    assert t.Date(1700000000000).value == 1700000000000
+    assert issubclass(t.DateTime, t.Date)
+
+
+def test_text_subtypes():
+    assert t.Text("hi").value == "hi"
+    assert t.Text(None).is_empty
+    e = t.Email("joe@example.com")
+    assert e.prefix() == "joe" and e.domain() == "example.com"
+    assert t.Email("notanemail").prefix() is None
+    u = t.URL("https://example.com/x")
+    assert u.is_valid() and u.domain() == "example.com"
+    assert not t.URL("junk").is_valid()
+    for cls in (t.PickList, t.ComboBox, t.Country, t.State, t.City,
+                t.PostalCode, t.Street, t.ID, t.Phone, t.Base64, t.TextArea):
+        assert issubclass(cls, t.Text)
+
+
+def test_collections():
+    v = t.OPVector([1.0, 2.0])
+    assert np.allclose(v.value, [1, 2])
+    assert t.OPVector([1.0]) == t.OPVector([1.0])
+    assert t.TextList(["a", "b"]).value == ["a", "b"]
+    assert t.TextList(None).is_empty and t.TextList([]).is_empty
+    g = t.Geolocation([37.7, -122.4, 5.0])
+    assert g.lat == 37.7 and g.lon == -122.4 and g.accuracy == 5.0
+    x, y, z = g.to_unit_sphere()
+    assert math.isclose(x * x + y * y + z * z, 1.0, rel_tol=1e-9)
+    with pytest.raises(ValueError):
+        t.Geolocation([100.0, 0.0, 1.0])  # bad latitude
+    assert t.MultiPickList({"a", "b"}).value == {"a", "b"}
+
+
+def test_maps_and_prediction():
+    m = t.RealMap({"a": 1.0})
+    assert m.value == {"a": 1.0} and m.element_type is t.Real
+    assert t.TextMap(None).is_empty
+    p = t.Prediction.build(1.0, raw_prediction=[0.2, 0.8], probability=[0.3, 0.7])
+    assert p.prediction == 1.0
+    assert p.raw_prediction == [0.2, 0.8]
+    assert p.probability == [0.3, 0.7]
+    with pytest.raises(ValueError):
+        t.Prediction({"nope": 1.0})
+
+
+def test_factory_and_defaults():
+    f = t.FeatureTypeFactory.of(t.Real)
+    assert f.new_instance(2.0) == t.Real(2.0)
+    assert t.FeatureTypeDefaults.default(t.Real).is_empty
+    assert t.FeatureTypeDefaults.default(t.RealNN).value == 0.0
+    assert t.FeatureTypeDefaults.default(t.Prediction).prediction == 0.0
+    assert t.feature_type_by_name("PickList") is t.PickList
+    with pytest.raises(ValueError):
+        t.feature_type_by_name("Bogus")

@@ -1,0 +1,17 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A from-scratch JAX/XLA re-design of the capabilities of TransmogrifAI
+(Salesforce's Scala/Spark AutoML library): a typed feature algebra, a lazy
+transformer/estimator DAG, automated feature engineering (``transmogrify``),
+automated feature validation (SanityChecker, RawFeatureFilter) and automated
+model selection (ModelSelector with CV/TVS sweeps) — executed as jit-compiled
+columnar kernels on TPU instead of Spark jobs, with hyperparameter sweeps
+vmapped over the grid and sharded over a device mesh.
+"""
+
+from .types import *  # noqa: F401,F403
+from .features import Feature, FeatureBuilder
+from .table import Column, FeatureTable
+from .vector_metadata import VectorColumnMetadata, VectorMetadata
+
+__version__ = "0.1.0"

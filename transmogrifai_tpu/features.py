@@ -1,0 +1,280 @@
+"""Feature DAG nodes and builders.
+
+Mirrors the reference feature algebra (reference:
+features/src/main/scala/com/salesforce/op/features/FeatureLike.scala,
+Feature.scala, FeatureBuilder.scala, FeatureUID): a ``Feature`` is a typed,
+lazily-evaluated node in a DAG whose origin stage produced it and whose parents
+are the stage's inputs. Nothing computes at definition time — the workflow
+reconstructs the full stage DAG from result-feature lineage
+(``raw_features`` / ``parent_stages`` walks with cycle checking, reference
+FeatureLike.scala:309-380).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple, Type
+
+from .types import FeatureType, feature_type_by_name, FEATURE_TYPES
+
+_uid_counter = itertools.count(1)
+
+
+def make_uid(cls_name: str) -> str:
+    """Stage/feature uid: ``ClassName_000000000001`` (reference UID.scala)."""
+    return f"{cls_name}_{next(_uid_counter):012x}"
+
+
+def reset_uids() -> None:
+    """Reset the uid counter (tests only — keeps goldens deterministic)."""
+    global _uid_counter
+    _uid_counter = itertools.count(1)
+
+
+class Feature:
+    """A typed node in the feature DAG (reference FeatureLike.scala:48-103).
+
+    origin_stage: the stage that produces this feature (a FeatureGeneratorStage
+    for raw features); parents: the input features of that stage.
+    """
+
+    def __init__(self, name: str, feature_type: Type[FeatureType], is_response: bool,
+                 origin_stage: Any, parents: Sequence["Feature"], uid: Optional[str] = None,
+                 distributions: Sequence[Any] = ()):
+        self.name = name
+        self.feature_type = feature_type
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents = tuple(parents)
+        self.uid = uid or make_uid(feature_type.__name__)
+        self.distributions = tuple(distributions)
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def type_name(self) -> str:
+        return self.feature_type.__name__
+
+    @property
+    def is_raw(self) -> bool:
+        return len(self.parents) == 0
+
+    def __repr__(self) -> str:
+        return (f"Feature[{self.type_name}](name={self.name!r}, uid={self.uid!r}, "
+                f"isResponse={self.is_response})")
+
+    def __eq__(self, other):
+        return isinstance(other, Feature) and self.uid == other.uid
+
+    def __hash__(self):
+        return hash(self.uid)
+
+    # -- graph construction --------------------------------------------------
+    def transform_with(self, stage: Any, *others: "Feature") -> "Feature":
+        """Apply a stage to this feature (+ optional others) and return its
+        output feature (reference FeatureLike.transformWith:210-229)."""
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    # -- lineage walks (reference FeatureLike.scala:309-380) -----------------
+    def traverse(self, visit: Callable[["Feature"], None]) -> None:
+        """DFS over ancestry with cycle detection."""
+        in_path: Set[str] = set()
+        done: Set[str] = set()
+
+        def rec(f: "Feature"):
+            if f.uid in done:
+                return
+            if f.uid in in_path:
+                raise ValueError(f"Feature DAG contains a cycle at {f.name} ({f.uid})")
+            in_path.add(f.uid)
+            for p in f.parents:
+                rec(p)
+            in_path.discard(f.uid)
+            done.add(f.uid)
+            visit(f)
+
+        rec(self)
+
+    def all_features(self) -> List["Feature"]:
+        out: List[Feature] = []
+        self.traverse(out.append)
+        return out
+
+    def raw_features(self) -> List["Feature"]:
+        """All raw (origin) ancestors, de-duplicated, stable order
+        (reference FeatureLike.rawFeatures:338)."""
+        return [f for f in self.all_features() if f.is_raw]
+
+    def parent_stages(self) -> Dict[Any, int]:
+        """All ancestor stages mapped to their distance from this feature
+        (reference FeatureLike.parentStages:363). Distance = max over paths.
+
+        Linear-time: one cycle-checked traversal for the node list, then
+        longest-path relaxation in reverse post-order (diamond-heavy graphs —
+        every transmogrify DAG — would blow up an unmemoized walk)."""
+        ordered = self.all_features()  # post-order: ancestors before descendants
+        dist: Dict[str, int] = {self.uid: 0}
+        by_uid = {f.uid: f for f in ordered}
+        for f in reversed(ordered):  # root first, toward raw features
+            d = dist.get(f.uid, 0)
+            for p in f.parents:
+                dist[p.uid] = max(dist.get(p.uid, 0), d + 1)
+        out: Dict[Any, int] = {}
+        for uid, d in dist.items():
+            st = by_uid[uid].origin_stage
+            if st is not None:
+                out[st] = max(out.get(st, 0), d)
+        return out
+
+    def copy_with_new_stages(self, stage_map: Dict[str, Any]) -> "Feature":
+        """Rebuild this feature's ancestry substituting fitted stages by uid
+        (reference FeatureLike.copyWithNewStages:456)."""
+        cache: Dict[str, Feature] = {}
+
+        def rec(f: "Feature") -> "Feature":
+            if f.uid in cache:
+                return cache[f.uid]
+            new_parents = [rec(p) for p in f.parents]
+            replaced = f.origin_stage is not None and f.origin_stage.uid in stage_map
+            stage = stage_map[f.origin_stage.uid] if replaced else f.origin_stage
+            nf = Feature(f.name, f.feature_type, f.is_response, stage, new_parents,
+                         uid=f.uid, distributions=f.distributions)
+            # only stages swapped in (fitted models) get rewired to the clone;
+            # stages of the original graph must keep their own output feature
+            if replaced:
+                stage._output_feature = nf
+            cache[f.uid] = nf
+            return nf
+
+        return rec(self)
+
+    def pretty_parent_stages(self) -> str:
+        lines: List[str] = []
+        for stage, d in sorted(self.parent_stages().items(), key=lambda kv: -kv[1]):
+            lines.append("  " * 0 + f"[{d}] {type(stage).__name__} -> {stage.uid}")
+        return "\n".join(lines)
+
+    def history(self) -> Dict[str, Any]:
+        return {
+            "originFeatures": [f.name for f in self.raw_features()],
+            "stages": [s.uid for s in self.parent_stages()],
+        }
+
+    def as_raw(self, extract_fn: Optional[Callable[[Any], Any]] = None) -> "Feature":
+        """Detach: a raw feature with the same name/type (reference FeatureLike.asRaw)."""
+        return FeatureBuilder(self.name, self.feature_type).extract(
+            extract_fn or _field_extractor(self.name, self.feature_type)
+        ).as_response() if self.is_response else FeatureBuilder(
+            self.name, self.feature_type).extract(
+            extract_fn or _field_extractor(self.name, self.feature_type)).as_predictor()
+
+
+def _field_extractor(name: str, ft: Type[FeatureType]) -> Callable[[Any], Any]:
+    def extract(record: Any) -> Any:
+        if isinstance(record, dict):
+            return record.get(name)
+        return getattr(record, name, None)
+    extract.__name__ = f"extract_{name}"
+    return extract
+
+
+class FeatureBuilder:
+    """Typed factory for raw features (reference FeatureBuilder.scala:48-177).
+
+    Usage::
+
+        age = FeatureBuilder.Real("age").extract(lambda r: r.get("age")).as_predictor()
+        survived = FeatureBuilder.RealNN("survived").extract(...).as_response()
+    """
+
+    def __init__(self, name: str, feature_type: Type[FeatureType]):
+        self.name = name
+        self.feature_type = feature_type
+        self._extract_fn: Optional[Callable[[Any], Any]] = None
+        self._aggregator: Optional[Any] = None
+        self._aggregate_window: Optional[int] = None
+
+    def extract(self, fn: Callable[[Any], Any]) -> "FeatureBuilder":
+        self._extract_fn = fn
+        return self
+
+    def extract_field(self) -> "FeatureBuilder":
+        """Extract the record field with the feature's name (dict or attr)."""
+        return self.extract(_field_extractor(self.name, self.feature_type))
+
+    def aggregate(self, aggregator: Any) -> "FeatureBuilder":
+        """Set the monoid aggregator used by event-aggregating readers
+        (reference FeatureBuilder aggregate + MonoidAggregatorDefaults)."""
+        self._aggregator = aggregator
+        return self
+
+    def window(self, millis: int) -> "FeatureBuilder":
+        self._aggregate_window = millis
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        from .stages.base import FeatureGeneratorStage
+        extract = self._extract_fn or _field_extractor(self.name, self.feature_type)
+        stage = FeatureGeneratorStage(
+            extract_fn=extract, output_name=self.name,
+            output_type=self.feature_type, is_response=is_response,
+            aggregator=self._aggregator, aggregate_window=self._aggregate_window)
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+    # -- typed factories: FeatureBuilder.Real("x"), .Text("y"), … ------------
+    @classmethod
+    def _typed(cls, type_name: str):
+        ft = feature_type_by_name(type_name)
+
+        def factory(name: str) -> "FeatureBuilder":
+            return cls(name, ft)
+
+        return factory
+
+    # -- schema inference ----------------------------------------------------
+    @staticmethod
+    def from_dataframe(df, response: str,
+                       response_type: Optional[Type[FeatureType]] = None,
+                       nullable_numerics: bool = True,
+                       ) -> Tuple[Feature, List[Feature]]:
+        """Infer raw features from a pandas DataFrame schema (reference
+        FeatureBuilder.fromDataFrame:190-218). Returns (response, predictors)."""
+        from .types import (Real, RealNN, Integral, Binary, Text, Date, DateTime)
+        import numpy as np
+        import pandas as pd
+
+        if response not in df.columns:
+            raise ValueError(
+                f"response feature '{response}' is not present in the dataframe")
+        feats: List[Feature] = []
+        resp: Optional[Feature] = None
+        for col in df.columns:
+            dtype = df[col].dtype
+            if col == response:
+                rt = response_type or RealNN
+                resp = FeatureBuilder(col, rt).extract_field().as_response()
+                continue
+            if pd.api.types.is_bool_dtype(dtype):
+                ft = Binary
+            elif pd.api.types.is_integer_dtype(dtype):
+                ft = Integral
+            elif pd.api.types.is_float_dtype(dtype):
+                ft = Real
+            elif pd.api.types.is_datetime64_any_dtype(dtype):
+                ft = DateTime
+            else:
+                ft = Text
+            feats.append(FeatureBuilder(col, ft).extract_field().as_predictor())
+        assert resp is not None
+        return resp, feats
+
+
+# Attach one typed factory per concrete feature type:
+#   FeatureBuilder.Real, FeatureBuilder.PickList, FeatureBuilder.RealMap, …
+for _name in FEATURE_TYPES:
+    setattr(FeatureBuilder, _name, staticmethod(FeatureBuilder._typed(_name)))

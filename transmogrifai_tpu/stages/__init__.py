@@ -1,0 +1,7 @@
+from .base import (
+    OpPipelineStage, AllowLabelAsInput, Transformer, Estimator,
+    FeatureGeneratorStage,
+    UnaryTransformer, BinaryTransformer, TernaryTransformer,
+    QuaternaryTransformer, SequenceTransformer,
+    UnaryEstimator, BinaryEstimator, SequenceEstimator,
+)

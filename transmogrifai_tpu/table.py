@@ -1,0 +1,240 @@
+"""FeatureTable — the columnar, device-resident replacement for the reference's
+Spark DataFrame substrate.
+
+Where the reference materializes a row-oriented ``DataFrame`` and runs stages as
+row lambdas inside Catalyst (reference: readers/.../DataReader.scala:173,
+core/.../utils/stages/FitStagesUtil.scala:96-119), the TPU build keeps a dict of
+*columns*. Numeric columns live as device arrays (values + validity mask) that
+jitted kernels consume directly and that shard over the mesh row axis; string /
+list / map columns stay host-side (numpy object arrays) until a vectorizer
+encodes them into device arrays — strings never cross the host→device boundary.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from .types import (
+    FeatureType, OPVector, Prediction, Real, RealNN, feature_type_by_name,
+)
+
+#: column kinds whose values are numeric arrays eligible for device residency
+DEVICE_KINDS = frozenset({"real", "integral", "binary", "vector", "prediction"})
+#: column kinds kept host-side (object arrays) until vectorized
+HOST_KINDS = frozenset({"text", "text_list", "date_list", "geolocation",
+                        "multipicklist", "map", "date"})
+
+
+def _np(values) -> np.ndarray:
+    return np.asarray(values)
+
+
+@dataclass(frozen=True)
+class Column:
+    """One feature column.
+
+    values:
+      * kind 'real'/'binary': float32 (n,) — invalid slots hold 0.0
+      * kind 'integral': int32 (n,) — invalid slots hold 0
+      * kind 'date': int64 host array (n,) (epoch millis exceed int32/float32)
+      * kind 'vector': float32 (n, d) device array, no mask
+      * kind 'prediction': float32 (n, k) + ``keys`` metadata entry
+      * kind 'text'/'map'/lists: numpy object array (n,)
+    mask: bool (n,) validity mask; None means all-valid.
+    metadata: free-form provenance (e.g. vector metadata under 'vector_meta').
+    """
+    feature_type: Type[FeatureType]
+    values: Any
+    mask: Optional[Any] = None
+    metadata: Mapping[str, Any] = field(default_factory=dict)
+
+    @property
+    def kind(self) -> str:
+        return self.feature_type.column_kind
+
+    def __len__(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.values.shape[1]) if self.values.ndim > 1 else 1
+
+    def valid_mask(self) -> np.ndarray:
+        if self.mask is None:
+            return np.ones(len(self), dtype=bool)
+        return np.asarray(self.mask)
+
+    def with_metadata(self, **kv) -> "Column":
+        md = dict(self.metadata)
+        md.update(kv)
+        return replace(self, metadata=md)
+
+    def to_device(self) -> "Column":
+        """Move numeric storage onto the default device as jax arrays."""
+        if self.kind not in DEVICE_KINDS:
+            return self
+        import jax.numpy as jnp
+        vals = jnp.asarray(self.values)
+        mask = None if self.mask is None else jnp.asarray(self.mask)
+        return replace(self, values=vals, mask=mask)
+
+    def to_host(self) -> "Column":
+        vals = np.asarray(self.values)
+        mask = None if self.mask is None else np.asarray(self.mask)
+        return replace(self, values=vals, mask=mask)
+
+    def take(self, idx: np.ndarray) -> "Column":
+        vals = self.values[idx]
+        mask = None if self.mask is None else self.mask[idx]
+        return replace(self, values=vals, mask=mask)
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def of_values(feature_type: Type[FeatureType], raw: Sequence[Any]) -> "Column":
+        """Build a column from raw python values (None/NaN = missing)."""
+        kind = feature_type.column_kind
+        n = len(raw)
+        if kind in ("real", "binary", "integral", "date"):
+            missing = [_is_missing_scalar(v) for v in raw]
+            mask = np.array([not m for m in missing], dtype=bool)
+            if kind == "real":
+                vals = np.array([0.0 if m else float(v)
+                                 for v, m in zip(raw, missing)], dtype=np.float32)
+            elif kind == "binary":
+                vals = np.array([0.0 if m else float(bool(v))
+                                 for v, m in zip(raw, missing)], dtype=np.float32)
+            elif kind == "integral":
+                vals = np.array([0 if m else int(v)
+                                 for v, m in zip(raw, missing)], dtype=np.int32)
+            else:  # date: epoch millis exceed int32/float32 → host int64
+                vals = np.array([0 if m else int(v)
+                                 for v, m in zip(raw, missing)], dtype=np.int64)
+            return Column(feature_type, vals, mask)
+        if kind == "vector":
+            vals = np.stack([np.asarray(v, dtype=np.float32) for v in raw]) if n else \
+                np.zeros((0, 0), dtype=np.float32)
+            return Column(feature_type, vals, None)
+        if kind == "prediction":
+            keys = sorted({k for d in raw for k in d})
+            vals = np.array([[float(d.get(k, 0.0)) for k in keys] for d in raw],
+                            dtype=np.float32).reshape(n, len(keys))
+            return Column(feature_type, vals, None, {"keys": tuple(keys)})
+        # host kinds
+        arr = np.empty(n, dtype=object)
+        for i, v in enumerate(raw):
+            arr[i] = v
+        mask = np.array([not _is_missing(v) for v in raw], dtype=bool)
+        return Column(feature_type, arr, mask)
+
+
+def _is_missing_scalar(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    return False
+
+
+def _is_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    if isinstance(v, (list, set, dict, tuple)) and len(v) == 0:
+        return True
+    return False
+
+
+class FeatureTable:
+    """Immutable-ish columnar table: name → Column, plus an optional key column.
+
+    The TPU-native analog of the materialized raw DataFrame produced by
+    ``DataReader.generateDataFrame`` (reference DataReader.scala:173-197).
+    """
+
+    KEY = "key"
+
+    def __init__(self, columns: Dict[str, Column], num_rows: int,
+                 key: Optional[np.ndarray] = None):
+        self._columns = dict(columns)
+        self.num_rows = num_rows
+        self.key = key
+        for name, col in self._columns.items():
+            if len(col) != num_rows:
+                raise ValueError(
+                    f"column '{name}' has {len(col)} rows, table has {num_rows}")
+
+    # -- access --------------------------------------------------------------
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __getitem__(self, name: str) -> Column:
+        return self._columns[name]
+
+    def get(self, name: str) -> Optional[Column]:
+        return self._columns.get(name)
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    # -- functional updates --------------------------------------------------
+    def with_column(self, name: str, col: Column) -> "FeatureTable":
+        cols = dict(self._columns)
+        cols[name] = col
+        return FeatureTable(cols, self.num_rows, self.key)
+
+    def with_columns(self, new: Mapping[str, Column]) -> "FeatureTable":
+        cols = dict(self._columns)
+        cols.update(new)
+        return FeatureTable(cols, self.num_rows, self.key)
+
+    def select(self, names: Sequence[str]) -> "FeatureTable":
+        return FeatureTable({n: self._columns[n] for n in names}, self.num_rows, self.key)
+
+    def drop(self, names: Sequence[str]) -> "FeatureTable":
+        gone = set(names)
+        return FeatureTable(
+            {n: c for n, c in self._columns.items() if n not in gone},
+            self.num_rows, self.key)
+
+    def take(self, idx: np.ndarray) -> "FeatureTable":
+        idx = np.asarray(idx)
+        key = None if self.key is None else self.key[idx]
+        return FeatureTable({n: c.take(idx) for n, c in self._columns.items()},
+                            int(idx.shape[0]), key)
+
+    def to_device(self) -> "FeatureTable":
+        return FeatureTable({n: c.to_device() for n, c in self._columns.items()},
+                            self.num_rows, self.key)
+
+    # -- row view (local scoring / tests) ------------------------------------
+    def row(self, i: int) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, col in self._columns.items():
+            valid = col.mask is None or bool(np.asarray(col.mask)[i])
+            if not valid:
+                out[name] = None
+            else:
+                v = np.asarray(col.values)[i]
+                out[name] = v.tolist() if isinstance(v, np.ndarray) else (
+                    v.item() if isinstance(v, np.generic) else v)
+        return out
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for i in range(self.num_rows):
+            yield self.row(i)
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def from_columns(data: Mapping[str, Tuple[Type[FeatureType], Sequence[Any]]],
+                     key: Optional[Sequence[str]] = None) -> "FeatureTable":
+        cols = {name: Column.of_values(ft, vals) for name, (ft, vals) in data.items()}
+        n = len(next(iter(cols.values()))) if cols else 0
+        karr = None if key is None else np.asarray(key, dtype=object)
+        return FeatureTable(cols, n, karr)

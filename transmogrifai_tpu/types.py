@@ -1,0 +1,645 @@
+"""Typed feature value hierarchy.
+
+TPU-native re-design of the reference type system
+(reference: features/src/main/scala/com/salesforce/op/features/types/FeatureType.scala:44-171,
+Numerics.scala, Text.scala, Maps.scala, Lists.scala, Sets.scala, Geolocation.scala,
+OPVector.scala).
+
+Differences from the reference, by design:
+
+* In the reference every cell of data is boxed into a ``FeatureType`` instance and
+  rows flow through Spark. Here the *columnar* representation is primary: a whole
+  column of a type lives as one (or a few) device arrays plus a validity mask
+  (see ``transmogrifai_tpu.table``). The value classes below exist for
+  row-level local scoring, the testkit, and user-facing APIs; they are
+  intentionally tiny.
+* Each class carries a class-level ``column_kind`` describing its columnar
+  storage so readers/vectorizers can be generic over types.
+
+The concrete type registry matches the reference registry 1:1
+(FeatureType.scala:265-324): 52 concrete types.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+from typing import Any, ClassVar, Dict, Iterable, List, Mapping, Optional, Tuple, Type
+
+__all__ = [
+    # abstract
+    "FeatureType", "OPNumeric", "OPCollection", "OPList", "OPSet", "OPMap", "Location",
+    "NonNullable", "SingleResponse", "MultiResponse",
+    # vector
+    "OPVector",
+    # lists
+    "TextList", "DateList", "DateTimeList", "Geolocation",
+    # numerics
+    "Real", "RealNN", "Binary", "Integral", "Date", "DateTime", "Currency", "Percent",
+    # sets
+    "MultiPickList",
+    # text
+    "Text", "Email", "Base64", "Phone", "ID", "URL", "TextArea", "PickList", "ComboBox",
+    "Country", "State", "City", "PostalCode", "Street",
+    # maps
+    "TextMap", "EmailMap", "Base64Map", "PhoneMap", "IDMap", "URLMap", "TextAreaMap",
+    "PickListMap", "ComboBoxMap", "CountryMap", "StateMap", "CityMap", "PostalCodeMap",
+    "StreetMap", "GeolocationMap", "BinaryMap", "IntegralMap", "RealMap", "CurrencyMap",
+    "PercentMap", "DateMap", "DateTimeMap", "MultiPickListMap", "Prediction",
+    # registry / factory
+    "FEATURE_TYPES", "feature_type_by_name", "FeatureTypeFactory", "FeatureTypeDefaults",
+]
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, dict):
+        return frozenset((k, _hashable(x)) for k, x in v.items())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, set):
+        return frozenset(v)
+    return v
+
+
+class FeatureType:
+    """Base value container: holds an optional value, knows emptiness & equality.
+
+    Mirrors reference FeatureType.scala:44-171 (``type Value``, ``value``,
+    ``isEmpty``, ``isNullable``, equality on value).
+    """
+
+    #: can this type hold an empty value? (reference ``NonNullable`` trait)
+    is_nullable: ClassVar[bool] = True
+    #: columnar storage kind — drives FeatureTable representation:
+    #: one of 'real', 'integral', 'binary', 'date', 'text', 'vector',
+    #: 'text_list', 'date_list', 'geolocation', 'multipicklist', 'map', 'prediction'
+    column_kind: ClassVar[str] = "text"
+    #: abstract classes are not registered
+    is_abstract: ClassVar[bool] = True
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = self._convert(value)
+        if not self.is_nullable and self.is_empty:
+            raise ValueError(f"{type(self).__name__} cannot be empty")
+
+    @classmethod
+    def _convert(cls, value: Any) -> Any:
+        return value
+
+    @property
+    def is_empty(self) -> bool:
+        return self.value is None
+
+    @property
+    def non_empty(self) -> bool:
+        return not self.is_empty
+
+    def exists(self, pred) -> bool:
+        return self.non_empty and pred(self.value)
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.value == other.value
+
+    def __hash__(self) -> int:
+        v = self.value
+        if isinstance(v, dict):
+            v = frozenset((k, _hashable(x)) for k, x in v.items())
+        elif isinstance(v, list):
+            v = tuple(_hashable(x) for x in v)
+        elif isinstance(v, set):
+            v = frozenset(v)
+        return hash((type(self).__name__, v))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.value!r})"
+
+    # -- class-level helpers -------------------------------------------------
+    @classmethod
+    def type_name(cls) -> str:
+        return cls.__name__
+
+    @classmethod
+    def empty(cls) -> "FeatureType":
+        """Per-type empty default (reference FeatureTypeDefaults.scala)."""
+        return cls(None)
+
+
+class NonNullable:
+    """Marker mixin (reference FeatureType.scala NonNullable trait)."""
+    is_nullable = False
+
+
+class SingleResponse:
+    """Marker: type usable as a single response label."""
+
+
+class MultiResponse:
+    """Marker: type usable as a multi response label."""
+
+
+class Categorical:
+    """Marker: categorical-valued type."""
+
+
+class Location:
+    """Marker: geographic location type (reference Location trait)."""
+
+
+# ---------------------------------------------------------------------------
+# Numerics (reference types/Numerics.scala, OPNumeric.scala)
+# ---------------------------------------------------------------------------
+
+class OPNumeric(FeatureType):
+    """Abstract numeric feature (value is Optional[number])."""
+    is_abstract = True
+
+    def to_double(self) -> Optional[float]:
+        return None if self.value is None else float(self.value)
+
+
+class Real(OPNumeric):
+    """Optional real number (reference Numerics.scala Real)."""
+    is_abstract = False
+    column_kind = "real"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, bool):
+            return 1.0 if value else 0.0
+        if isinstance(value, numbers.Number):
+            v = float(value)
+            return None if math.isnan(v) else v
+        raise TypeError(f"cannot make {cls.__name__} from {type(value).__name__}")
+
+    @property
+    def v(self) -> Optional[float]:
+        return self.value
+
+
+class RealNN(NonNullable, Real, SingleResponse):
+    """Non-nullable real — the label type for regression & the input to models
+    (reference Numerics.scala RealNN)."""
+    is_abstract = False
+
+
+class Currency(Real):
+    is_abstract = False
+
+
+class Percent(Real):
+    is_abstract = False
+
+
+class Integral(OPNumeric):
+    """Optional long (reference Numerics.scala Integral)."""
+    is_abstract = False
+    column_kind = "integral"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, bool):
+            return int(value)
+        if isinstance(value, numbers.Integral):
+            return int(value)
+        if isinstance(value, float):
+            if math.isnan(value):
+                return None
+            if value.is_integer():
+                return int(value)
+        raise TypeError(f"cannot make {cls.__name__} from {value!r}")
+
+
+class Date(Integral):
+    """Epoch-millis date (reference Numerics.scala Date)."""
+    is_abstract = False
+    column_kind = "date"
+
+
+class DateTime(Date):
+    is_abstract = False
+
+
+class Binary(OPNumeric, SingleResponse):
+    """Optional boolean (reference Numerics.scala Binary)."""
+    is_abstract = False
+    column_kind = "binary"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, numbers.Number):
+            v = float(value)
+            if math.isnan(v):
+                return None
+            return v != 0.0
+        raise TypeError(f"cannot make {cls.__name__} from {value!r}")
+
+    def to_double(self) -> Optional[float]:
+        return None if self.value is None else (1.0 if self.value else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Text (reference types/Text.scala)
+# ---------------------------------------------------------------------------
+
+class Text(FeatureType):
+    """Optional string (reference Text.scala)."""
+    is_abstract = False
+    column_kind = "text"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, str):
+            return value
+        raise TypeError(f"cannot make {cls.__name__} from {type(value).__name__}")
+
+
+class Email(Text):
+    is_abstract = False
+
+    def prefix(self) -> Optional[str]:
+        parts = self._split()
+        return parts[0] if parts else None
+
+    def domain(self) -> Optional[str]:
+        parts = self._split()
+        return parts[1] if parts else None
+
+    def _split(self):
+        if self.value is None:
+            return None
+        at = self.value.count("@")
+        if at != 1:
+            return None
+        p, d = self.value.split("@")
+        return (p, d) if p and d else None
+
+
+class Base64(Text):
+    is_abstract = False
+
+
+class Phone(Text):
+    is_abstract = False
+
+
+class ID(Text):
+    is_abstract = False
+
+
+class URL(Text):
+    is_abstract = False
+
+    def domain(self) -> Optional[str]:
+        if self.value is None:
+            return None
+        from urllib.parse import urlparse
+        try:
+            return urlparse(self.value).hostname
+        except ValueError:
+            return None
+
+    def protocol(self) -> Optional[str]:
+        if self.value is None:
+            return None
+        from urllib.parse import urlparse
+        try:
+            return urlparse(self.value).scheme or None
+        except ValueError:
+            return None
+
+    def is_valid(self) -> bool:
+        """Valid http/https/ftp URL with a host (reference Text.scala URL.isValid)."""
+        if self.value is None:
+            return False
+        from urllib.parse import urlparse
+        try:
+            p = urlparse(self.value)
+        except ValueError:
+            return False
+        return p.scheme in ("http", "https", "ftp") and bool(p.hostname) and "." in (p.hostname or "")
+
+
+class TextArea(Text):
+    is_abstract = False
+
+
+class PickList(Text, Categorical, SingleResponse):
+    is_abstract = False
+
+
+class ComboBox(Text):
+    is_abstract = False
+
+
+class Country(Text, Location):
+    is_abstract = False
+
+
+class State(Text, Location):
+    is_abstract = False
+
+
+class City(Text, Location):
+    is_abstract = False
+
+
+class PostalCode(Text, Location):
+    is_abstract = False
+
+
+class Street(Text, Location):
+    is_abstract = False
+
+
+# ---------------------------------------------------------------------------
+# Collections (reference types/OPVector.scala, Lists.scala, Sets.scala,
+# Geolocation.scala)
+# ---------------------------------------------------------------------------
+
+class OPCollection(FeatureType):
+    """Abstract collection: empty collection == empty value."""
+    is_abstract = True
+
+    @property
+    def is_empty(self) -> bool:
+        return self.value is None or len(self.value) == 0
+
+
+class OPList(OPCollection):
+    is_abstract = True
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return []
+        return list(value)
+
+
+class OPVector(OPCollection, NonNullable):
+    """Dense numeric vector (reference OPVector.scala). Value is a list/array of
+    floats; columnar storage is a single (n, d) device array."""
+    is_abstract = False
+    column_kind = "vector"
+
+    @classmethod
+    def _convert(cls, value):
+        import numpy as np
+        if value is None:
+            return np.zeros((0,), dtype=np.float32)
+        return np.asarray(value, dtype=np.float32)
+
+    @property
+    def is_empty(self) -> bool:
+        return False  # vectors are non-nullable; zero-length is still a value
+
+    def __eq__(self, other):
+        import numpy as np
+        return type(self) is type(other) and np.array_equal(self.value, other.value)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.value.tobytes()))
+
+
+class TextList(OPList):
+    is_abstract = False
+    column_kind = "text_list"
+
+
+class DateList(OPList):
+    is_abstract = False
+    column_kind = "date_list"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return []
+        return [int(v) for v in value]
+
+
+class DateTimeList(DateList):
+    is_abstract = False
+
+
+class Geolocation(OPList, Location):
+    """(lat, lon, accuracy) triple (reference Geolocation.scala)."""
+    is_abstract = False
+    column_kind = "geolocation"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return []
+        vals = [float(v) for v in value]
+        if vals and len(vals) != 3:
+            raise ValueError("Geolocation must have lat, lon, accuracy")
+        if vals:
+            lat, lon, _ = vals
+            if not (-90.0 <= lat <= 90.0) or not (-180.0 <= lon <= 180.0):
+                raise ValueError(f"invalid geolocation {vals}")
+        return vals
+
+    @property
+    def lat(self) -> Optional[float]:
+        return self.value[0] if self.value else None
+
+    @property
+    def lon(self) -> Optional[float]:
+        return self.value[1] if self.value else None
+
+    @property
+    def accuracy(self) -> Optional[float]:
+        return self.value[2] if self.value else None
+
+    def to_unit_sphere(self) -> Optional[Tuple[float, float, float]]:
+        """3D unit-sphere embedding used by the geolocation vectorizer;
+        None for an empty geolocation."""
+        if self.is_empty:
+            return None
+        lat, lon = math.radians(self.lat), math.radians(self.lon)
+        return (math.cos(lat) * math.cos(lon), math.cos(lat) * math.sin(lon), math.sin(lat))
+
+
+class OPSet(OPCollection, MultiResponse):
+    is_abstract = True
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return set()
+        return set(value)
+
+
+class MultiPickList(OPSet, Categorical):
+    is_abstract = False
+    column_kind = "multipicklist"
+
+
+# ---------------------------------------------------------------------------
+# Maps (reference types/Maps.scala) — string-keyed maps mirroring scalar types
+# ---------------------------------------------------------------------------
+
+class OPMap(OPCollection):
+    """Abstract string-keyed map. ``element_type`` is the scalar type mirrored."""
+    is_abstract = True
+    element_type: ClassVar[Optional[Type[FeatureType]]] = None
+    column_kind = "map"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return {}
+        return dict(value)
+
+
+def _mk_map(name: str, element: Type[FeatureType], extra_bases: Tuple[type, ...] = ()) -> type:
+    return type(name, (OPMap,) + extra_bases, {
+        "is_abstract": False,
+        "element_type": element,
+        "__doc__": f"Map[str, {element.__name__}] (reference Maps.scala {name}).",
+    })
+
+
+TextMap = _mk_map("TextMap", Text)
+EmailMap = _mk_map("EmailMap", Email)
+Base64Map = _mk_map("Base64Map", Base64)
+PhoneMap = _mk_map("PhoneMap", Phone)
+IDMap = _mk_map("IDMap", ID)
+URLMap = _mk_map("URLMap", URL)
+TextAreaMap = _mk_map("TextAreaMap", TextArea)
+PickListMap = _mk_map("PickListMap", PickList)
+ComboBoxMap = _mk_map("ComboBoxMap", ComboBox)
+CountryMap = _mk_map("CountryMap", Country, (Location,))
+StateMap = _mk_map("StateMap", State, (Location,))
+CityMap = _mk_map("CityMap", City, (Location,))
+PostalCodeMap = _mk_map("PostalCodeMap", PostalCode, (Location,))
+StreetMap = _mk_map("StreetMap", Street, (Location,))
+GeolocationMap = _mk_map("GeolocationMap", Geolocation, (Location,))
+BinaryMap = _mk_map("BinaryMap", Binary)
+IntegralMap = _mk_map("IntegralMap", Integral)
+RealMap = _mk_map("RealMap", Real)
+CurrencyMap = _mk_map("CurrencyMap", Currency)
+PercentMap = _mk_map("PercentMap", Percent)
+DateMap = _mk_map("DateMap", Date)
+DateTimeMap = _mk_map("DateTimeMap", DateTime)
+MultiPickListMap = _mk_map("MultiPickListMap", MultiPickList)
+
+
+class Prediction(OPMap, NonNullable):
+    """Model output map with reserved keys (reference Maps.scala Prediction:
+    prediction / probability_* / rawPrediction_*)."""
+    is_abstract = False
+    element_type = Real
+    column_kind = "prediction"
+
+    PredictionName = "prediction"
+    RawPredictionName = "rawPrediction"
+    ProbabilityName = "probability"
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            raise ValueError("Prediction cannot be empty")
+        d = dict(value)
+        if cls.PredictionName not in d:
+            raise ValueError(f"Prediction must contain '{cls.PredictionName}' key")
+        return d
+
+    @property
+    def is_empty(self) -> bool:
+        return False
+
+    @property
+    def prediction(self) -> float:
+        return float(self.value[self.PredictionName])
+
+    @property
+    def raw_prediction(self) -> List[float]:
+        return self._keyed(self.RawPredictionName)
+
+    @property
+    def probability(self) -> List[float]:
+        return self._keyed(self.ProbabilityName)
+
+    def _keyed(self, prefix: str) -> List[float]:
+        ks = sorted(
+            (k for k in self.value if k == prefix or k.startswith(prefix + "_")),
+            key=lambda k: int(k.rsplit("_", 1)[1]) if "_" in k[len(prefix):] else 0,
+        )
+        return [float(self.value[k]) for k in ks]
+
+    @staticmethod
+    def build(prediction: float, raw_prediction: Iterable[float] = (),
+              probability: Iterable[float] = ()) -> "Prediction":
+        d: Dict[str, float] = {Prediction.PredictionName: float(prediction)}
+        for i, v in enumerate(raw_prediction):
+            d[f"{Prediction.RawPredictionName}_{i}"] = float(v)
+        for i, v in enumerate(probability):
+            d[f"{Prediction.ProbabilityName}_{i}"] = float(v)
+        return Prediction(d)
+
+
+# ---------------------------------------------------------------------------
+# Registry & factory (reference FeatureType.scala:265-324, FeatureTypeFactory)
+# ---------------------------------------------------------------------------
+
+def _collect_concrete(root: Type[FeatureType]) -> Dict[str, Type[FeatureType]]:
+    out: Dict[str, Type[FeatureType]] = {}
+    stack = [root]
+    seen = set()
+    while stack:
+        c = stack.pop()
+        if c in seen:
+            continue
+        seen.add(c)
+        if not c.__dict__.get("is_abstract", False) and c is not root:
+            out[c.__name__] = c
+        stack.extend(c.__subclasses__())
+    return out
+
+
+#: name → concrete feature type class (52 types, matching the reference registry)
+FEATURE_TYPES: Dict[str, Type[FeatureType]] = _collect_concrete(FeatureType)
+
+
+def feature_type_by_name(name: str) -> Type[FeatureType]:
+    try:
+        return FEATURE_TYPES[name]
+    except KeyError:
+        raise ValueError(f"Unknown feature type '{name}'") from None
+
+
+class FeatureTypeFactory:
+    """Runtime construction from raw value (reference FeatureTypeFactory.scala)."""
+
+    def __init__(self, feature_type: Type[FeatureType]):
+        self.feature_type = feature_type
+
+    def new_instance(self, value: Any) -> FeatureType:
+        if isinstance(value, self.feature_type):
+            return value
+        return self.feature_type(value)
+
+    @staticmethod
+    def of(feature_type: Type[FeatureType]) -> "FeatureTypeFactory":
+        return FeatureTypeFactory(feature_type)
+
+
+class FeatureTypeDefaults:
+    """Per-type empty defaults (reference FeatureTypeDefaults.scala)."""
+
+    @staticmethod
+    def default(feature_type: Type[FeatureType]) -> FeatureType:
+        if feature_type is Prediction:
+            return Prediction({Prediction.PredictionName: 0.0})
+        if not feature_type.is_nullable and issubclass(feature_type, RealNN):
+            return feature_type(0.0)
+        return feature_type(None)

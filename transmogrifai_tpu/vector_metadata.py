@@ -1,0 +1,113 @@
+"""Per-column provenance of feature vectors.
+
+Mirrors the reference's OpVectorMetadata / OpVectorColumnMetadata
+(reference: utils/src/main/scala/com/salesforce/op/utils/spark/OpVectorMetadata.scala,
+OpVectorColumnMetadata.scala): every slot of an ``OPVector`` column records which
+raw feature produced it, its type, an optional grouping (e.g. the pivot group or
+map key), an optional indicator value (the one-hot category), and whether it is
+a null-tracking indicator. SanityChecker uses this to propagate removals across
+a feature's indicator group; ModelInsights uses it to attribute contributions
+back to raw features.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional, Sequence
+
+NULL_INDICATOR = "NullIndicatorValue"
+OTHER_INDICATOR = "OTHER"
+
+
+@dataclass(frozen=True)
+class VectorColumnMetadata:
+    """Provenance of a single vector slot (reference OpVectorColumnMetadata.scala)."""
+    parent_feature_name: str
+    parent_feature_type: str
+    grouping: Optional[str] = None
+    indicator_value: Optional[str] = None
+    descriptor_value: Optional[str] = None
+    index: int = 0
+
+    @property
+    def is_null_indicator(self) -> bool:
+        return self.indicator_value == NULL_INDICATOR
+
+    @property
+    def is_other_indicator(self) -> bool:
+        return self.indicator_value == OTHER_INDICATOR
+
+    def column_name(self) -> str:
+        parts = [self.parent_feature_name]
+        if self.grouping and self.grouping != self.parent_feature_name:
+            parts.append(self.grouping)
+        if self.indicator_value is not None:
+            parts.append(self.indicator_value)
+        elif self.descriptor_value is not None:
+            parts.append(self.descriptor_value)
+        return "_".join(parts) + f"_{self.index}"
+
+    def feature_group(self) -> str:
+        """Key used to group sibling indicator columns of one raw feature/map-key
+        (reference OpVectorColumnMetadata.featureGroup)."""
+        return f"{self.parent_feature_name}::{self.grouping or ''}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "VectorColumnMetadata":
+        return VectorColumnMetadata(**d)
+
+
+@dataclass(frozen=True)
+class VectorMetadata:
+    """Provenance of a whole vector column (reference OpVectorMetadata.scala)."""
+    name: str
+    columns: tuple  # Tuple[VectorColumnMetadata, ...] with indices 0..n-1
+
+    @property
+    def size(self) -> int:
+        return len(self.columns)
+
+    def column_names(self) -> List[str]:
+        return [c.column_name() for c in self.columns]
+
+    def index_of_group(self) -> Dict[str, List[int]]:
+        groups: Dict[str, List[int]] = {}
+        for c in self.columns:
+            groups.setdefault(c.feature_group(), []).append(c.index)
+        return groups
+
+    def select(self, indices: Sequence[int]) -> "VectorMetadata":
+        keep = [self.columns[i] for i in indices]
+        return VectorMetadata(
+            self.name,
+            tuple(
+                VectorColumnMetadata(
+                    c.parent_feature_name, c.parent_feature_type, c.grouping,
+                    c.indicator_value, c.descriptor_value, i)
+                for i, c in enumerate(keep)))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "VectorMetadata":
+        return VectorMetadata(
+            d["name"], tuple(VectorColumnMetadata.from_json(c) for c in d["columns"]))
+
+    @staticmethod
+    def of(name: str, cols: Sequence[VectorColumnMetadata]) -> "VectorMetadata":
+        renumbered = tuple(
+            VectorColumnMetadata(
+                c.parent_feature_name, c.parent_feature_type, c.grouping,
+                c.indicator_value, c.descriptor_value, i)
+            for i, c in enumerate(cols))
+        return VectorMetadata(name, renumbered)
+
+    @staticmethod
+    def flatten(name: str, metas: Sequence["VectorMetadata"]) -> "VectorMetadata":
+        cols: List[VectorColumnMetadata] = []
+        for m in metas:
+            cols.extend(m.columns)
+        return VectorMetadata.of(name, cols)
