@@ -4,7 +4,7 @@ utils/.../test/TestSparkContext.scala:36-79). Same code paths as a real TPU
 slice, 8 host devices."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,6 +12,21 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import numpy as np
 import pytest
+
+# The axon sitecustomize registers the tunneled-TPU PJRT plugin in every
+# interpreter; jax's backends() initializes every registered factory, so a
+# slow/wedged tunnel would stall CPU-only tests. Deregister non-CPU factories
+# before any backend initialization.
+from jax._src import xla_bridge as _xb
+
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        _xb._backend_factories.pop(_name, None)
+
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize already read axon
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,177 @@
+"""Kernel tests: stats, metrics, linear model fits vs sklearn-style references
+computed with numpy."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.ops import stats, metrics
+
+
+def test_col_stats_masked():
+    x = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0], [999.0, 40.0]])
+    mask = jnp.asarray([True, True, True, False])
+    s = stats.col_stats(x, mask)
+    assert np.allclose(s.count, [3, 3])
+    assert np.allclose(s.mean, [2.0, 20.0])
+    assert np.allclose(s.variance, [1.0, 100.0])
+    assert np.allclose(s.min, [1.0, 10.0])
+    assert np.allclose(s.max, [3.0, 30.0])
+
+
+def test_pearson_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(500, 4).astype(np.float32)
+    y = (x[:, 0] * 2 + rng.randn(500) * 0.5).astype(np.float32)
+    got = np.asarray(stats.pearson_correlation(jnp.asarray(x), jnp.asarray(y)))
+    want = np.array([np.corrcoef(x[:, j], y)[0, 1] for j in range(4)])
+    assert np.allclose(got, want, atol=1e-4)
+    # constant column → nan
+    xc = x.copy()
+    xc[:, 2] = 1.0
+    got = np.asarray(stats.pearson_correlation(jnp.asarray(xc), jnp.asarray(y)))
+    assert np.isnan(got[2])
+
+
+def test_spearman_close_to_scipy_definition():
+    rng = np.random.RandomState(1)
+    x = rng.randn(300, 2).astype(np.float32)
+    y = (x[:, 0] ** 3).astype(np.float32)  # monotone → spearman ~ 1
+    got = np.asarray(stats.spearman_correlation(jnp.asarray(x), jnp.asarray(y)))
+    assert got[0] > 0.99
+
+
+def test_contingency_stats():
+    # feature perfectly predicts label → cramers V = 1
+    ind = jnp.asarray(np.eye(2)[np.array([0, 0, 1, 1] * 10)], dtype=jnp.float32)
+    label = jnp.asarray(np.array([0, 0, 1, 1] * 10), dtype=jnp.int32)
+    table = stats.contingency_table(ind, label, 2)
+    assert np.allclose(np.asarray(table), [[20, 0], [0, 20]])
+    cs = stats.contingency_stats(table)
+    assert np.isclose(float(cs.cramers_v), 1.0, atol=1e-5)
+    assert float(cs.max_rule_confidence.max()) == 1.0
+
+    # independent feature → cramers V ~ 0
+    rng = np.random.RandomState(2)
+    f = rng.randint(0, 2, 1000)
+    l = rng.randint(0, 2, 1000)
+    t2 = stats.contingency_table(
+        jnp.asarray(np.eye(2)[f], dtype=jnp.float32), jnp.asarray(l), 2)
+    cs2 = stats.contingency_stats(t2)
+    assert float(cs2.cramers_v) < 0.1
+
+
+def test_auroc_aupr_known_values():
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.6, 0.5, 0.4])
+    labels = jnp.asarray([1.0, 1.0, 0.0, 1.0, 0.0, 0.0])
+    # sklearn roc_auc_score = 0.8889; average_precision ~ 0.9028
+    assert np.isclose(float(metrics.auroc(scores, labels)), 8 / 9, atol=1e-5)
+    assert 0.85 <= float(metrics.aupr(scores, labels)) <= 0.95
+    # perfect separation
+    assert np.isclose(float(metrics.auroc(
+        jnp.asarray([0.9, 0.8, 0.2, 0.1]), jnp.asarray([1.0, 1.0, 0.0, 0.0]))), 1.0)
+
+
+def test_auroc_ties():
+    scores = jnp.asarray([0.5, 0.5, 0.5, 0.5])
+    labels = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    assert np.isclose(float(metrics.auroc(scores, labels)), 0.5)
+
+
+def test_masked_metrics_match_subset():
+    rng = np.random.RandomState(3)
+    scores = rng.rand(200).astype(np.float32)
+    labels = (rng.rand(200) < scores).astype(np.float32)
+    mask = rng.rand(200) < 0.6
+    sub_auc = float(metrics.auroc(jnp.asarray(scores[mask]), jnp.asarray(labels[mask])))
+    got_auc = float(metrics.auroc_masked(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(mask)))
+    assert np.isclose(got_auc, sub_auc, atol=1e-5)
+    sub_pr = float(metrics.aupr(jnp.asarray(scores[mask]), jnp.asarray(labels[mask])))
+    got_pr = float(metrics.aupr_masked(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(mask)))
+    assert np.isclose(got_pr, sub_pr, atol=1e-5)
+
+
+def test_multiclass_and_regression_metrics():
+    pred = jnp.asarray([0, 1, 2, 1, 0])
+    lab = jnp.asarray([0, 1, 2, 2, 0])
+    m = metrics.multiclass_metrics(pred, lab, 3)
+    assert np.isclose(float(m["Error"]), 0.2)
+    r = metrics.regression_metrics(jnp.asarray([1.0, 2.0, 3.0]),
+                                   jnp.asarray([1.5, 2.0, 2.5]))
+    assert np.isclose(float(r["MeanAbsoluteError"]), 1 / 3, atol=1e-6)
+    assert np.isclose(float(r["MeanSquaredError"]), (0.25 + 0.25) / 3, atol=1e-6)
+
+
+class TestLinearModels:
+    def _data(self, n=400, d=5, seed=0):
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, d).astype(np.float32)
+        w_true = np.array([1.5, -2.0, 0.0, 0.5, 1.0], dtype=np.float32)
+        margin = X @ w_true + 0.3
+        y = (1 / (1 + np.exp(-margin)) > rng.rand(n)).astype(np.float32)
+        return jnp.asarray(X), jnp.asarray(y), w_true
+
+    def test_logreg_recovers_signal(self):
+        from transmogrifai_tpu.models.linear import _fit_logreg
+        X, y, w_true = self._data()
+        w = jnp.ones(X.shape[0])
+        coef, bias = _fit_logreg(X, y, w, 0.01, 0.0)
+        coef = np.asarray(coef)
+        # signs and rough magnitudes recovered
+        assert coef[0] > 0.5 and coef[1] < -0.5 and abs(coef[2]) < 0.5
+
+    def test_logreg_l1_sparsifies(self):
+        from transmogrifai_tpu.models.linear import _fit_logreg
+        X, y, _ = self._data()
+        w = jnp.ones(X.shape[0])
+        coef_l2, _ = _fit_logreg(X, y, w, 0.01, 0.0)
+        coef_l1, _ = _fit_logreg(X, y, w, 0.2, 1.0)
+        assert np.abs(np.asarray(coef_l1)).sum() < np.abs(np.asarray(coef_l2)).sum()
+        assert np.isclose(np.asarray(coef_l1)[2], 0.0, atol=1e-3)
+
+    def test_logreg_batch_matches_single(self):
+        from transmogrifai_tpu.models.linear import _fit_logreg, _fit_logreg_batch
+        X, y, _ = self._data()
+        n = X.shape[0]
+        weights = jnp.stack([jnp.ones(n), jnp.ones(n).at[:100].set(0.0)])
+        regs = jnp.asarray([0.01, 0.1])
+        ens = jnp.asarray([0.0, 0.0])
+        coefs, biases = _fit_logreg_batch(X, y, weights, regs, ens)
+        c0, b0 = _fit_logreg(X, y, weights[0], 0.01, 0.0)
+        c1, b1 = _fit_logreg(X, y, weights[1], 0.1, 0.0)
+        assert np.allclose(np.asarray(coefs[0]), np.asarray(c0), atol=1e-4)
+        assert np.allclose(np.asarray(coefs[1]), np.asarray(c1), atol=1e-4)
+
+    def test_linreg_closed_form(self):
+        from transmogrifai_tpu.models.linear import _fit_linreg
+        rng = np.random.RandomState(5)
+        X = rng.randn(300, 3).astype(np.float32)
+        y = X @ np.array([2.0, -1.0, 0.5], dtype=np.float32) + 4.0
+        coef, bias = _fit_linreg(jnp.asarray(X), jnp.asarray(y),
+                                 jnp.ones(300), 1e-6, 0.0)
+        assert np.allclose(np.asarray(coef), [2.0, -1.0, 0.5], atol=1e-2)
+        assert np.isclose(float(bias), 4.0, atol=1e-2)
+
+    def test_svc_separates(self):
+        from transmogrifai_tpu.models.linear import _fit_svc
+        X, y, _ = self._data(seed=7)
+        coef, bias = _fit_svc(X, y, jnp.ones(X.shape[0]), 0.01)
+        margin = np.asarray(X) @ np.asarray(coef) + float(bias)
+        acc = ((margin > 0) == (np.asarray(y) > 0.5)).mean()
+        assert acc > 0.8  # Bayes-optimal on this noisy data is ~0.83
+
+    def test_naive_bayes(self):
+        from transmogrifai_tpu.models.linear import _fit_nb
+        rng = np.random.RandomState(9)
+        n = 600
+        y = rng.randint(0, 2, n)
+        X = np.zeros((n, 4), dtype=np.float32)
+        X[:, 0] = rng.poisson(5, n) * (y == 0) + rng.poisson(1, n) * (y == 1)
+        X[:, 1] = rng.poisson(1, n) * (y == 0) + rng.poisson(5, n) * (y == 1)
+        X[:, 2:] = rng.poisson(2, (n, 2))
+        lp, prior = _fit_nb(jnp.asarray(X), jnp.asarray(y), jnp.ones(n),
+                            jnp.asarray(1.0), 2)
+        logits = np.asarray(X @ np.asarray(lp).T + np.asarray(prior))
+        acc = (logits.argmax(1) == y).mean()
+        assert acc > 0.8

@@ -1,0 +1,104 @@
+"""SanityChecker tests (model: reference SanityCheckerTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, FeatureTable, Column
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.vector_metadata import (
+    VectorColumnMetadata, VectorMetadata, NULL_INDICATOR)
+from transmogrifai_tpu.impl.preparators import SanityChecker
+
+
+def _make_table(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    good = (y + rng.randn(n) * 1.0).astype(np.float32)      # correlated, ok
+    leaky = (y * 2.0 - 1.0 + rng.randn(n) * 0.01).astype(np.float32)  # |corr|~1
+    const = np.full(n, 3.0, dtype=np.float32)               # zero variance
+    noise = rng.randn(n).astype(np.float32)
+    X = np.stack([good, leaky, const, noise], axis=1)
+    vm = VectorMetadata.of("features", [
+        VectorColumnMetadata("good", "Real", "good", None),
+        VectorColumnMetadata("leaky", "Real", "leaky", None),
+        VectorColumnMetadata("const", "Real", "const", None),
+        VectorColumnMetadata("noise", "Real", "noise", None),
+    ])
+    cols = {
+        "label": Column(RealNN, y, None),
+        "features": Column(OPVector, X, None, {"vector_meta": vm}),
+    }
+    return FeatureTable(cols, n)
+
+
+def _wire(checker):
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = FeatureBuilder.OPVector("features").extract_field().as_predictor()
+    checker.set_input(label, feats)
+    return checker
+
+
+def test_sanity_checker_removes_leaky_and_constant():
+    tbl = _make_table()
+    checker = _wire(SanityChecker())
+    model = checker.fit(tbl)
+    out = model.transform_column(tbl)
+    # removes leaky (corr ~ 1) and const (variance ~ 0); keeps good + noise
+    assert out.width == 2
+    kept = [c.parent_feature_name for c in out.metadata["vector_meta"].columns]
+    assert kept == ["good", "noise"]
+    s = model.summary
+    assert "leaky" in s["reasons"]["leaky_1"][0] or "correlation" in s["reasons"]["leaky_1"][0]
+    assert any("variance" in r for r in s["reasons"]["const_2"])
+    # output feature not marked response despite label input
+    assert not checker.get_output().is_response
+
+
+def test_sanity_checker_output_row_dual():
+    tbl = _make_table()
+    model = _wire(SanityChecker()).fit(tbl)
+    row = {"features": [1.0, 2.0, 3.0, 4.0], "label": 1.0}
+    assert model.transform_row(row) == [1.0, 4.0]
+
+
+def test_sanity_checker_categorical_cramers_v():
+    # categorical indicator group that perfectly predicts the label
+    n = 300
+    rng = np.random.RandomState(1)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    cat = np.stack([(y == 0).astype(np.float32), (y == 1).astype(np.float32),
+                    np.zeros(n, np.float32)], axis=1)  # [a, b, null]
+    ok = rng.randn(n).astype(np.float32)
+    X = np.concatenate([cat, ok[:, None]], axis=1)
+    vm = VectorMetadata.of("features", [
+        VectorColumnMetadata("cat", "PickList", "cat", "a"),
+        VectorColumnMetadata("cat", "PickList", "cat", "b"),
+        VectorColumnMetadata("cat", "PickList", "cat", NULL_INDICATOR),
+        VectorColumnMetadata("ok", "Real", "ok", None),
+    ])
+    tbl = FeatureTable({
+        "label": Column(RealNN, y, None),
+        "features": Column(OPVector, X, None, {"vector_meta": vm})}, n)
+    model = _wire(SanityChecker()).fit(tbl)
+    out = model.transform_column(tbl)
+    # whole cat group removed (Cramér's V = 1 → leakage), ok kept
+    kept = [c.parent_feature_name for c in out.metadata["vector_meta"].columns]
+    assert kept == ["ok"]
+    assert model.summary["cramersV"]
+    assert max(model.summary["cramersV"].values()) > 0.95
+
+
+def test_sanity_checker_keeps_all_when_disabled():
+    tbl = _make_table()
+    model = _wire(SanityChecker(remove_bad_features=False)).fit(tbl)
+    assert model.transform_column(tbl).width == 4
+
+
+def test_sanity_checker_refuses_to_remove_everything():
+    n = 100
+    y = np.arange(n, dtype=np.float32) % 2
+    X = np.ones((n, 2), dtype=np.float32)  # all constant
+    tbl = FeatureTable({
+        "label": Column(RealNN, y, None),
+        "features": Column(OPVector, X, None)}, n)
+    with pytest.raises(ValueError, match="ALL feature columns"):
+        _wire(SanityChecker()).fit(tbl)

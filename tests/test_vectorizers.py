@@ -1,0 +1,151 @@
+"""Vectorizer tests (model: reference RealVectorizerTest, OpOneHotVectorizerTest,
+SmartTextVectorizerTest, VectorsCombinerTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, FeatureTable
+from transmogrifai_tpu.types import (
+    Real, RealNN, Integral, Binary, PickList, Text, TextList, MultiPickList)
+from transmogrifai_tpu.impl.feature import (
+    RealVectorizer, IntegralVectorizer, BinaryVectorizer, OneHotVectorizer,
+    SmartTextVectorizer, HashingVectorizer, TextTokenizer, VectorsCombiner,
+    transmogrify)
+from transmogrifai_tpu.vector_metadata import NULL_INDICATOR, OTHER_INDICATOR
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+def test_real_vectorizer_mean_fill_and_null_track():
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    fare = FeatureBuilder.Real("fare").extract_field().as_predictor()
+    tbl = FeatureTable.from_columns({
+        "age": (Real, [10.0, None, 30.0]),
+        "fare": (Real, [1.0, 2.0, 3.0])})
+    st = RealVectorizer()
+    st.set_input(age, fare)
+    model = st.fit(tbl)
+    col = model.transform_column(tbl)
+    vals = np.asarray(col.values)
+    # age: filled mean=20, null indicators [0,1,0]; fare: no nulls
+    assert np.allclose(vals[:, 0], [10, 20, 30])
+    assert np.allclose(vals[:, 1], [0, 1, 0])
+    assert np.allclose(vals[:, 2], [1, 2, 3])
+    vm = col.metadata["vector_meta"]
+    assert vm.columns[1].indicator_value == NULL_INDICATOR
+    assert vm.columns[0].parent_feature_name == "age"
+    # row dual parity
+    assert model.transform_row({"age": None, "fare": 5.0}) == [20.0, 1.0, 5.0, 0.0]
+
+
+def test_integral_vectorizer_mode_fill():
+    x = FeatureBuilder.Integral("x").extract_field().as_predictor()
+    tbl = FeatureTable.from_columns({"x": (Integral, [1, 2, 2, None, 3])})
+    st = IntegralVectorizer()
+    st.set_input(x)
+    model = st.fit(tbl)
+    vals = np.asarray(model.transform_column(tbl).values)
+    assert np.allclose(vals[:, 0], [1, 2, 2, 2, 3])  # mode=2
+    assert np.allclose(vals[:, 1], [0, 0, 0, 1, 0])
+
+
+def test_one_hot_vectorizer():
+    color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    data = ["red"] * 5 + ["blue"] * 3 + ["green"] * 1 + [None]
+    tbl = FeatureTable.from_columns({"color": (PickList, data)})
+    st = OneHotVectorizer(top_k=2, min_support=2)
+    st.set_input(color)
+    model = st.fit(tbl)
+    col = model.transform_column(tbl)
+    vals = np.asarray(col.values)
+    vm = col.metadata["vector_meta"]
+    # columns: red, blue, OTHER, null
+    assert [c.indicator_value for c in vm.columns] == \
+        ["red", "blue", OTHER_INDICATOR, NULL_INDICATOR]
+    assert vals.shape == (10, 4)
+    assert vals[0].tolist() == [1, 0, 0, 0]
+    assert vals[5].tolist() == [0, 1, 0, 0]
+    assert vals[8].tolist() == [0, 0, 1, 0]   # green below minSupport → OTHER
+    assert vals[9].tolist() == [0, 0, 0, 1]   # null
+
+
+def test_one_hot_multipicklist():
+    tags = FeatureBuilder.MultiPickList("tags").extract_field().as_predictor()
+    data = [{"a", "b"}, {"a"}, set(), None]
+    tbl = FeatureTable.from_columns({"tags": (MultiPickList, data)})
+    st = OneHotVectorizer(top_k=5, min_support=1)
+    st.set_input(tags)
+    model = st.fit(tbl)
+    col = model.transform_column(tbl)
+    vm = col.metadata["vector_meta"]
+    vals = np.asarray(col.values)
+    idx = {c.indicator_value: c.index for c in vm.columns}
+    assert vals[0, idx["a"]] == 1 and vals[0, idx["b"]] == 1
+    assert vals[3, idx[NULL_INDICATOR]] == 1
+
+
+def test_smart_text_pivot_vs_hash():
+    lowcard = FeatureBuilder.Text("lo").extract_field().as_predictor()
+    highcard = FeatureBuilder.Text("hi").extract_field().as_predictor()
+    n = 60
+    lo_vals = ["a" if i % 2 else "b" for i in range(n)]
+    hi_vals = [f"word{i} text{i%7}" for i in range(n)]
+    tbl = FeatureTable.from_columns({"lo": (Text, lo_vals), "hi": (Text, hi_vals)})
+    st = SmartTextVectorizer(max_cardinality=10, min_support=1, num_hashes=16)
+    st.set_input(lowcard, highcard)
+    model = st.fit(tbl)
+    col = model.transform_column(tbl)
+    vm = col.metadata["vector_meta"]
+    # lo → pivot (2 vals + OTHER + null), hi → hash (16 + null)
+    assert col.width == (2 + 1 + 1) + (16 + 1)
+    lo_cols = [c for c in vm.columns if c.parent_feature_name == "lo"]
+    assert {c.indicator_value for c in lo_cols} >= {"a", "b"}
+
+
+def test_hashing_vectorizer_shared_space():
+    t1 = FeatureBuilder.TextList("t1").extract_field().as_predictor()
+    t2 = FeatureBuilder.TextList("t2").extract_field().as_predictor()
+    tbl = FeatureTable.from_columns({
+        "t1": (TextList, [["x", "y"], ["x"]]),
+        "t2": (TextList, [["z"], []])})
+    shared = HashingVectorizer(num_hashes=8, shared_hash_space=True)
+    shared.set_input(t1, t2)
+    vals = np.asarray(shared.transform_column(tbl).values)
+    assert vals.shape == (2, 8)
+    assert vals[0].sum() == 3.0  # x, y, z
+    sep = HashingVectorizer(num_hashes=8, shared_hash_space=False)
+    sep.set_input(t1, t2)
+    assert np.asarray(sep.transform_column(tbl).values).shape == (2, 16)
+
+
+def test_tokenizer():
+    txt = FeatureBuilder.Text("t").extract_field().as_predictor()
+    tok = TextTokenizer()
+    out = txt.transform_with(tok)
+    assert tok.transform_fn("Hello, World! 123") == ["hello", "world", "123"]
+    assert tok.transform_fn(None) == []
+
+
+def test_transmogrify_end_to_end():
+    import pandas as pd
+    df = pd.DataFrame({
+        "age": [20.0, None, 40.0, 35.0] * 5,
+        "cnt": [1, 2, 2, None] * 5,
+        "vip": [True, False, None, True] * 5,
+        "color": ["red", "blue", "red", None] * 5,
+        "label": [0.0, 1.0, 1.0, 0.0] * 5,
+    })
+    resp, feats = FeatureBuilder.from_dataframe(df, response="label")
+    from transmogrifai_tpu.types import PickList
+    # re-type color as PickList for pivoting
+    feats = [f for f in feats if f.name != "color"]
+    color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    feats.append(color)
+    fv = transmogrify(feats)
+    model = OpWorkflow().set_input_dataset(df).set_result_features(fv).train()
+    scored = model.score(df=df)
+    col = scored[fv.name]
+    vm = col.metadata["vector_meta"]
+    assert col.width == vm.size
+    parents = {c.parent_feature_name for c in vm.columns}
+    assert parents == {"age", "cnt", "vip", "color"}
+    # deterministic order: groups sorted, features sorted within group
+    assert np.asarray(col.values).shape[0] == 20

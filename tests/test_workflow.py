@@ -1,0 +1,120 @@
+"""Workflow engine tests (model: reference OpWorkflowTest, FitStagesUtilTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, FeatureTable, Column
+from transmogrifai_tpu.types import Real, RealNN, Text, Integral
+from transmogrifai_tpu.stages.base import (
+    UnaryTransformer, BinaryTransformer, UnaryEstimator)
+from transmogrifai_tpu.dag import compute_dag, fit_and_transform_dag
+from transmogrifai_tpu.workflow import OpWorkflow
+from transmogrifai_tpu.readers import DataReaders
+
+
+def _df():
+    return pd.DataFrame({
+        "age": [20.0, None, 40.0, 60.0],
+        "fare": [1.0, 2.0, 3.0, 4.0],
+        "survived": [0.0, 1.0, 1.0, 0.0],
+    })
+
+
+def _mean_fill_estimator():
+    """Tiny estimator: learns the column mean, fills missing with it."""
+    def fit_fn(col):
+        vals = np.asarray(col.values, dtype=np.float64)
+        m = col.valid_mask()
+        mean = float(vals[m].mean()) if m.any() else 0.0
+
+        def columnar(c):
+            v = np.asarray(c.values, dtype=np.float32)
+            out = np.where(c.valid_mask(), v, np.float32(mean))
+            return Column(Real, out.astype(np.float32), None)
+
+        return {"mean": mean, "columnar": columnar}
+
+    def make_model(state):
+        return UnaryTransformer(
+            "meanFill",
+            lambda v: state["mean"] if v is None else v,
+            Real, columnar_fn=state["columnar"])
+
+    return UnaryEstimator("meanFill", fit_fn, Real, make_model, input_type=Real)
+
+
+def test_compute_dag_layers():
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    fare = FeatureBuilder.Real("fare").extract_field().as_predictor()
+    filled = age.transform_with(_mean_fill_estimator())
+    total = filled.transform_with(
+        BinaryTransformer("plus", lambda a, b: (a or 0) + (b or 0), Real), fare)
+    layers = compute_dag([total])
+    assert len(layers) == 2
+    assert [type(s).__name__ for s, _ in layers[0]] == ["UnaryEstimator"]
+    assert [type(s).__name__ for s, _ in layers[1]] == ["BinaryTransformer"]
+
+
+def test_workflow_train_and_score():
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    fare = FeatureBuilder.Real("fare").extract_field().as_predictor()
+    filled = age.transform_with(_mean_fill_estimator())
+    total = filled.transform_with(
+        BinaryTransformer("plus", lambda a, b: (a or 0) + (b or 0), Real), fare)
+
+    wf = OpWorkflow().set_input_dataset(_df()).set_result_features(total)
+    model = wf.train()
+    # mean of [20, 40, 60] = 40 → filled row1 = 40 → +fare
+    scored = model.score(df=_df())
+    out = np.asarray(scored[total.name].values)
+    assert np.allclose(out, [21.0, 42.0, 43.0, 64.0])
+
+    # the model's stages are fitted: re-score without refit
+    assert model.get_stage(filled.origin_stage.uid) is not filled.origin_stage
+
+
+def test_workflow_rejects_empty_results():
+    with pytest.raises(ValueError):
+        OpWorkflow().set_result_features()
+
+
+def test_score_column_pruning():
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    doubled = age.transform_with(
+        UnaryTransformer("x2", lambda v: None if v is None else 2 * v, Real))
+    model = OpWorkflow().set_input_dataset(_df()).set_result_features(doubled).train()
+    only_result = model.score(df=_df(), keep_raw_features=False,
+                              keep_intermediate_features=False)
+    assert only_result.column_names == [doubled.name]
+
+
+def test_csv_reader_roundtrip(tmp_path):
+    p = tmp_path / "data.csv"
+    _df().to_csv(p, index=False)
+    reader = DataReaders.Simple.csv_auto(str(p))
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    survived = FeatureBuilder.RealNN("survived").extract_field().as_response()
+    tbl = reader.generate_table([age, survived])
+    assert tbl.num_rows == 4
+    assert tbl["age"].valid_mask().tolist() == [True, False, True, True]
+    assert np.allclose(np.asarray(tbl["survived"].values), [0, 1, 1, 0])
+
+
+def test_custom_extract_fn_slow_path():
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": [10.0, 20.0]})
+    combo = FeatureBuilder.Real("combo").extract(
+        lambda r: r["a"] + r["b"]).as_predictor()
+    tbl = DataReaders.Simple.dataframe(df).generate_table([combo])
+    assert np.allclose(np.asarray(tbl["combo"].values), [11.0, 22.0])
+
+
+def test_stage_param_injection():
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    st = UnaryTransformer("x2", lambda v: None if v is None else 2 * v, Real)
+    st.scale = 1.0  # a param
+    doubled = age.transform_with(st)
+    wf = (OpWorkflow().set_input_dataset(_df())
+          .set_result_features(doubled)
+          .set_parameters({"stageParams": {"UnaryTransformer": {"scale": 3.0}}}))
+    wf.train()
+    assert st.scale == 3.0

@@ -1,0 +1,5 @@
+from .base import OpEvaluatorBase, prediction_parts
+from .binary import OpBinaryClassificationEvaluator, OpBinScoreEvaluator
+from .multi import OpMultiClassificationEvaluator
+from .regression import OpRegressionEvaluator
+from .factory import Evaluators

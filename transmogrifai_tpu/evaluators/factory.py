@@ -1,0 +1,98 @@
+"""Evaluator factory (reference: core/.../evaluators/Evaluators.scala)."""
+from __future__ import annotations
+
+from .binary import OpBinScoreEvaluator, OpBinaryClassificationEvaluator
+from .multi import OpMultiClassificationEvaluator
+from .regression import OpRegressionEvaluator
+
+
+class Evaluators:
+    class BinaryClassification:
+        @staticmethod
+        def auPR() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "AuPR"
+            return ev
+
+        @staticmethod
+        def auROC() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "AuROC"
+            return ev
+
+        @staticmethod
+        def precision() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Precision"
+            return ev
+
+        @staticmethod
+        def recall() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Recall"
+            return ev
+
+        @staticmethod
+        def f1() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "F1"
+            return ev
+
+        @staticmethod
+        def error() -> OpBinaryClassificationEvaluator:
+            ev = OpBinaryClassificationEvaluator()
+            ev.default_metric = "Error"
+            ev.larger_better = False
+            return ev
+
+        @staticmethod
+        def brier_score() -> OpBinScoreEvaluator:
+            return OpBinScoreEvaluator()
+
+    class MultiClassification:
+        @staticmethod
+        def f1() -> OpMultiClassificationEvaluator:
+            return OpMultiClassificationEvaluator()
+
+        @staticmethod
+        def error() -> OpMultiClassificationEvaluator:
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Error"
+            ev.larger_better = False
+            return ev
+
+        @staticmethod
+        def precision() -> OpMultiClassificationEvaluator:
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Precision"
+            return ev
+
+        @staticmethod
+        def recall() -> OpMultiClassificationEvaluator:
+            ev = OpMultiClassificationEvaluator()
+            ev.default_metric = "Recall"
+            return ev
+
+    class Regression:
+        @staticmethod
+        def rmse() -> OpRegressionEvaluator:
+            return OpRegressionEvaluator()
+
+        @staticmethod
+        def mse() -> OpRegressionEvaluator:
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "MeanSquaredError"
+            return ev
+
+        @staticmethod
+        def mae() -> OpRegressionEvaluator:
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "MeanAbsoluteError"
+            return ev
+
+        @staticmethod
+        def r2() -> OpRegressionEvaluator:
+            ev = OpRegressionEvaluator()
+            ev.default_metric = "R2"
+            ev.larger_better = True
+            return ev

@@ -1,0 +1,50 @@
+"""Multiclass evaluator (reference:
+core/.../evaluators/OpMultiClassificationEvaluator.scala)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.metrics import multiclass_log_loss, multiclass_metrics
+from ..table import FeatureTable
+from .base import OpEvaluatorBase
+
+
+class OpMultiClassificationEvaluator(OpEvaluatorBase):
+    """Error / weighted Precision / Recall / F1, plus top-N threshold metrics
+    (reference OpMultiClassificationEvaluator.scala; calculateThresholdMetrics
+    :154-232 reduced to topK correctness curves)."""
+
+    default_metric = "F1"
+    larger_better = True
+
+    def __init__(self, top_ns=(1, 3), **kw):
+        super().__init__(**kw)
+        self.top_ns = tuple(top_ns)
+
+    def evaluate_all(self, table: FeatureTable) -> Dict[str, float]:
+        label, parts = self._extract(table)
+        pred = np.asarray(parts["prediction"], dtype=np.int32)
+        label_idx = label.astype(np.int32)
+        num_classes = int(max(pred.max(initial=0), label_idx.max(initial=0))) + 1
+        out = {k: float(v) for k, v in multiclass_metrics(
+            jnp.asarray(pred), jnp.asarray(label_idx), num_classes).items()}
+        prob = parts.get("probability")
+        if prob is not None:
+            out["LogLoss"] = float(multiclass_log_loss(
+                jnp.asarray(prob), jnp.asarray(label_idx)))
+            order = np.argsort(-prob, axis=1)
+            for n in self.top_ns:
+                topn = order[:, :n]
+                hit = (topn == label_idx[:, None]).any(axis=1)
+                out[f"TopN_{n}_Accuracy"] = float(hit.mean())
+        return out
+
+    def evaluate_arrays(self, label, scores, probability=None) -> float:
+        pred = np.asarray(scores, dtype=np.int32)
+        label_idx = np.asarray(label, dtype=np.int32)
+        num_classes = int(max(pred.max(initial=0), label_idx.max(initial=0))) + 1
+        return float(multiclass_metrics(
+            jnp.asarray(pred), jnp.asarray(label_idx), num_classes)["F1"])

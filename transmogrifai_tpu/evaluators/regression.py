@@ -1,0 +1,29 @@
+"""Regression evaluator (reference:
+core/.../evaluators/OpRegressionEvaluator.scala)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.metrics import regression_metrics
+from ..table import FeatureTable
+from .base import OpEvaluatorBase
+
+
+class OpRegressionEvaluator(OpEvaluatorBase):
+    """RMSE/MSE/MAE/R² (reference OpRegressionEvaluator.scala:107)."""
+
+    default_metric = "RootMeanSquaredError"
+    larger_better = False
+
+    def evaluate_all(self, table: FeatureTable) -> Dict[str, float]:
+        label, parts = self._extract(table)
+        pred = parts["prediction"]
+        return {k: float(v) for k, v in regression_metrics(
+            jnp.asarray(pred), jnp.asarray(label)).items()}
+
+    def evaluate_arrays(self, label, scores, probability=None) -> float:
+        return float(regression_metrics(
+            jnp.asarray(scores), jnp.asarray(label))["RootMeanSquaredError"])

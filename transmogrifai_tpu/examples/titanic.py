@@ -1,0 +1,76 @@
+"""Titanic survival — the canonical end-to-end flow.
+
+Mirrors the reference helloworld app (reference:
+helloworld/src/main/scala/com/salesforce/hw/OpTitanicSimple.scala): typed
+FeatureBuilders → derived features → ``transmogrify`` → SanityChecker →
+BinaryClassificationModelSelector → OpWorkflow.train → summary/score.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..features import Feature, FeatureBuilder
+from ..impl.feature import transmogrify
+from ..impl.preparators import SanityChecker
+from ..impl.selector import BinaryClassificationModelSelector
+from ..readers import DataReaders
+from ..workflow import OpWorkflow, OpWorkflowModel
+
+TITANIC_SCHEMA = ["PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
+                  "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked"]
+DEFAULT_PATH = ("/root/reference/helloworld/src/main/resources/"
+                "TitanicDataset/TitanicPassengersTrainData.csv")
+
+
+def titanic_features() -> Tuple[Feature, Feature]:
+    """(survived, featureVector) — the reference's feature definitions
+    (OpTitanicSimple.scala: pClass/name/sex/age/sibSp/parCh/ticket/cabin/
+    embarked + derived familySize/estimatedCostOfTickets/pivotedSex/ageGroup)."""
+    survived = FeatureBuilder.RealNN("Survived").extract_field().as_response()
+    p_class = FeatureBuilder.PickList("Pclass").extract(
+        lambda r: None if r.get("Pclass") is None else str(r.get("Pclass"))
+    ).as_predictor()
+    name = FeatureBuilder.Text("Name").extract_field().as_predictor()
+    sex = FeatureBuilder.PickList("Sex").extract_field().as_predictor()
+    age = FeatureBuilder.Real("Age").extract_field().as_predictor()
+    sib_sp = FeatureBuilder.Integral("SibSp").extract_field().as_predictor()
+    par_ch = FeatureBuilder.Integral("Parch").extract_field().as_predictor()
+    ticket = FeatureBuilder.PickList("Ticket").extract_field().as_predictor()
+    fare = FeatureBuilder.Real("Fare").extract_field().as_predictor()
+    cabin = FeatureBuilder.PickList("Cabin").extract_field().as_predictor()
+    embarked = FeatureBuilder.PickList("Embarked").extract_field().as_predictor()
+
+    # derived features (reference OpTitanicSimple.scala familySize etc.)
+    from ..stages.base import BinaryTransformer
+    from ..types import Real
+    family_size = sib_sp.transform_with(
+        BinaryTransformer("familySize",
+                          lambda s, p: (s or 0) + (p or 0) + 1, Real), par_ch)
+    estimated_cost = family_size.transform_with(
+        BinaryTransformer("estCost",
+                          lambda f, fare_v: (f or 0) * (fare_v or 0.0), Real), fare)
+
+    feature_vector = transmogrify([
+        p_class, name, sex, age, sib_sp, par_ch, ticket, fare, cabin, embarked,
+        family_size, estimated_cost])
+    return survived, feature_vector
+
+
+def build_workflow(csv_path: str = DEFAULT_PATH,
+                   seed: int = 42) -> Tuple[OpWorkflow, Feature, Feature]:
+    survived, feature_vector = titanic_features()
+    checked = survived.transform_with(SanityChecker(seed=seed), feature_vector)
+    prediction = survived.transform_with(
+        BinaryClassificationModelSelector.with_cross_validation(seed=seed), checked)
+    reader = DataReaders.Simple.csv(csv_path, schema=TITANIC_SCHEMA, header=False,
+                                    key_field="PassengerId")
+    wf = (OpWorkflow()
+          .set_reader(reader)
+          .set_result_features(prediction, checked))
+    return wf, survived, prediction
+
+
+def run(csv_path: str = DEFAULT_PATH, seed: int = 42) -> OpWorkflowModel:
+    wf, survived, prediction = build_workflow(csv_path, seed)
+    model = wf.train()
+    return model

@@ -161,11 +161,9 @@ class Feature:
 
     def as_raw(self, extract_fn: Optional[Callable[[Any], Any]] = None) -> "Feature":
         """Detach: a raw feature with the same name/type (reference FeatureLike.asRaw)."""
-        return FeatureBuilder(self.name, self.feature_type).extract(
-            extract_fn or _field_extractor(self.name, self.feature_type)
-        ).as_response() if self.is_response else FeatureBuilder(
-            self.name, self.feature_type).extract(
-            extract_fn or _field_extractor(self.name, self.feature_type)).as_predictor()
+        builder = FeatureBuilder(self.name, self.feature_type).extract(
+            extract_fn or _field_extractor(self.name, self.feature_type))
+        return builder.as_response() if self.is_response else builder.as_predictor()
 
 
 def _field_extractor(name: str, ft: Type[FeatureType]) -> Callable[[Any], Any]:
@@ -240,7 +238,6 @@ class FeatureBuilder:
     @staticmethod
     def from_dataframe(df, response: str,
                        response_type: Optional[Type[FeatureType]] = None,
-                       nullable_numerics: bool = True,
                        ) -> Tuple[Feature, List[Feature]]:
         """Infer raw features from a pandas DataFrame schema (reference
         FeatureBuilder.fromDataFrame:190-218). Returns (response, predictors)."""

@@ -1,0 +1,6 @@
+from .vectorizers import (
+    TransmogrifierDefaults, RealVectorizer, IntegralVectorizer,
+    BinaryVectorizer, RealNNVectorizer, OneHotVectorizer, TextTokenizer,
+    HashingVectorizer, SmartTextVectorizer, VectorsCombiner,
+)
+from .transmogrifier import transmogrify

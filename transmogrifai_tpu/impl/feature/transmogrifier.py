@@ -1,0 +1,96 @@
+"""Transmogrifier — automated feature engineering dispatch.
+
+Mirrors reference Transmogrifier.transmogrify
+(core/.../impl/feature/Transmogrifier.scala:102-348): group features by type,
+apply each group's default vectorizer, combine everything into a single
+OPVector feature.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Type
+
+from ...features import Feature
+from ...types import (
+    Binary, City, ComboBox, Country, Currency, Date, DateTime, FeatureType, ID,
+    Integral, MultiPickList, OPVector, Percent, PickList, PostalCode, Real,
+    RealNN, State, Street, Text, TextArea, TextList, Email, URL, Base64, Phone,
+)
+from .vectorizers import (
+    BinaryVectorizer, HashingVectorizer, IntegralVectorizer, OneHotVectorizer,
+    RealNNVectorizer, RealVectorizer, SmartTextVectorizer, VectorsCombiner,
+)
+
+#: type groups → vectorizer builder (reference Transmogrifier case match :102-348)
+_CATEGORICAL_TYPES = (PickList, ComboBox, ID, Country, State, City, PostalCode,
+                      Street, Phone)
+_FREE_TEXT_TYPES = (TextArea, Base64, URL, Email)
+
+
+def transmogrify(features: Sequence[Feature]) -> Feature:
+    """Auto-vectorize a heterogeneous feature set into one OPVector feature
+    (the ``.transmogrify()`` / ``.vectorize()`` entry of the reference DSL,
+    RichFeaturesCollection.scala:69)."""
+    if not features:
+        raise ValueError("transmogrify needs at least one feature")
+    groups: Dict[str, List[Feature]] = {}
+    for f in features:
+        groups.setdefault(_group_of(f), []).append(f)
+    vectorized: List[Feature] = []
+    for group in sorted(groups):
+        feats = sorted(groups[group], key=lambda f: f.name)
+        stage = _vectorizer_for(group)
+        stage.set_input(*feats)
+        vectorized.append(stage.get_output())
+    if len(vectorized) == 1:
+        return vectorized[0]
+    combiner = VectorsCombiner()
+    combiner.set_input(*vectorized)
+    return combiner.get_output()
+
+
+def _group_of(f: Feature) -> str:
+    ft = f.feature_type
+    if issubclass(ft, RealNN):
+        return "realnn"
+    if issubclass(ft, (Real, Currency, Percent)):
+        return "real"
+    if issubclass(ft, Binary):
+        return "binary"
+    if issubclass(ft, (Date, DateTime)):
+        return "date"
+    if issubclass(ft, Integral):
+        return "integral"
+    if issubclass(ft, MultiPickList):
+        return "multipicklist"
+    if issubclass(ft, _CATEGORICAL_TYPES):
+        return "categorical"
+    if issubclass(ft, _FREE_TEXT_TYPES) or ft is Text:
+        return "text"
+    if issubclass(ft, TextList):
+        return "textlist"
+    if issubclass(ft, OPVector):
+        return "vector"
+    raise NotImplementedError(
+        f"transmogrify has no default vectorizer for {ft.__name__} "
+        f"(feature '{f.name}') yet")
+
+
+def _vectorizer_for(group: str):
+    if group == "realnn":
+        return RealNNVectorizer()
+    if group == "real":
+        return RealVectorizer()
+    if group in ("integral", "date"):
+        # dates as integral until the unit-circle date vectorizer lands
+        return IntegralVectorizer()
+    if group == "binary":
+        return BinaryVectorizer()
+    if group in ("categorical", "multipicklist"):
+        return OneHotVectorizer()
+    if group == "text":
+        return SmartTextVectorizer()
+    if group == "textlist":
+        return HashingVectorizer()
+    if group == "vector":
+        return VectorsCombiner()
+    raise AssertionError(group)

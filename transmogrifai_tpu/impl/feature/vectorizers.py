@@ -1,0 +1,508 @@
+"""Feature vectorizers: typed columns → OPVector columns with provenance.
+
+TPU re-design of the reference vectorizer zoo (reference:
+core/.../impl/feature/RealVectorizer.scala, IntegralVectorizer.scala,
+BinaryVectorizer.scala, OpOneHotVectorizer.scala, SmartTextVectorizer.scala,
+OPCollectionHashingVectorizer.scala, TextTokenizer.scala,
+VectorsCombiner.scala, TransmogrifierDefaults Transmogrifier.scala:52-90).
+
+Execution split: statistics and string handling (vocab counts, tokenizing,
+hashing) run host-side in vectorized numpy — they are string work the TPU
+cannot express — and emit dense float32 blocks; everything downstream (models,
+stats, scoring) consumes the resulting device arrays. Null semantics match the
+reference: mean/mode fill + a tracked null-indicator column per feature.
+"""
+from __future__ import annotations
+
+import zlib
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...features import Feature
+from ...stages.base import Estimator, SequenceTransformer, Transformer, UnaryTransformer
+from ...table import Column, FeatureTable
+from ...types import (
+    Binary, FeatureType, Integral, MultiPickList, OPVector, Real, RealNN, Text,
+    TextList,
+)
+from ...vector_metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+
+
+class TransmogrifierDefaults:
+    """Default knobs (reference Transmogrifier.scala:52-90)."""
+    TopK = 20
+    MinSupport = 10
+    FillValue = 0.0
+    BinaryFillValue = False
+    NumHashes = 512
+    MaxNumOfFeatures = 16384
+    MaxCardinality = 30          # SmartTextVectorizer pivot-vs-hash cutoff
+    MinTokenLength = 1
+    TrackNulls = True
+    FillWithMean = True
+    FillWithMode = True
+
+
+def _meta_cols(feature: Feature, names_vals: Sequence[Tuple[Optional[str], Optional[str]]]
+               ) -> List[VectorColumnMetadata]:
+    return [VectorColumnMetadata(
+        parent_feature_name=feature.name,
+        parent_feature_type=feature.type_name,
+        grouping=grouping, indicator_value=indicator)
+        for grouping, indicator in names_vals]
+
+
+class _VectorModelBase(Transformer):
+    """Shared: produce an OPVector Column with attached VectorMetadata."""
+
+    output_type = OPVector
+
+    def _emit(self, mat: np.ndarray, meta_cols: List[VectorColumnMetadata]) -> Column:
+        vm = VectorMetadata.of(self.get_output().name, meta_cols)
+        return Column(OPVector, np.ascontiguousarray(mat, dtype=np.float32),
+                      None, {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        one = FeatureTable(
+            {f.name: Column.of_values(f.feature_type, [row.get(f.name)])
+             for f in self.input_features}, 1)
+        return np.asarray(self.transform_column(one).values)[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# Numeric vectorizers
+# ---------------------------------------------------------------------------
+
+class RealVectorizer(Estimator):
+    """Seq[Real] → OPVector: mean-fill + null indicators (reference
+    RealVectorizer.scala:121 — fills with mean, tracks nulls)."""
+
+    output_type = OPVector
+
+    def __init__(self, fill_with_mean: bool = TransmogrifierDefaults.FillWithMean,
+                 fill_value: float = TransmogrifierDefaults.FillValue,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("vecReal", uid)
+        self.fill_with_mean = fill_with_mean
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        fills = []
+        for f in self.input_features:
+            col = table[f.name]
+            vals = np.asarray(col.values, dtype=np.float64)
+            m = col.valid_mask()
+            if self.fill_with_mean:
+                fills.append(float(vals[m].mean()) if m.any() else self.fill_value)
+            else:
+                fills.append(self.fill_value)
+        model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class RealVectorizerModel(_VectorModelBase):
+    def __init__(self, fills: List[float], track_nulls: bool, uid=None):
+        super().__init__("vecReal", uid)
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        blocks, meta = [], []
+        for f, fill in zip(self.input_features, self.fills):
+            col = table[f.name]
+            vals = np.asarray(col.values, dtype=np.float32).reshape(-1)
+            m = col.valid_mask()
+            filled = np.where(m, vals, np.float32(fill))
+            blocks.append(filled)
+            meta.extend(_meta_cols(f, [(f.name, None)]))
+            if self.track_nulls:
+                blocks.append((~m).astype(np.float32))
+                meta.extend(_meta_cols(f, [(f.name, NULL_INDICATOR)]))
+        return self._emit(np.stack(blocks, axis=1), meta)
+
+
+class IntegralVectorizer(Estimator):
+    """Seq[Integral] → OPVector: mode-fill + null indicators (reference
+    IntegralVectorizer.scala — fills with mode)."""
+
+    output_type = OPVector
+
+    def __init__(self, fill_with_mode: bool = TransmogrifierDefaults.FillWithMode,
+                 fill_value: int = 0,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("vecIntegral", uid)
+        self.fill_with_mode = fill_with_mode
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        fills = []
+        for f in self.input_features:
+            col = table[f.name]
+            vals = np.asarray(col.values).reshape(-1)
+            m = col.valid_mask()
+            if self.fill_with_mode and m.any():
+                vv, cc = np.unique(vals[m], return_counts=True)
+                # ties → smallest value (deterministic, matches modeFn min)
+                fills.append(float(vv[np.argmax(cc)]))
+            else:
+                fills.append(float(self.fill_value))
+        model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+        model.operation_name = "vecIntegral"
+        return self._finalize_model(model)
+
+
+class BinaryVectorizer(SequenceTransformer):
+    """Seq[Binary] → OPVector: false-fill + null indicator (reference
+    BinaryVectorizer.scala)."""
+
+    output_type = OPVector
+
+    def __init__(self, fill_value: bool = TransmogrifierDefaults.BinaryFillValue,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("vecBinary", transform_fn=None, output_type=OPVector, uid=uid)
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        blocks, meta = [], []
+        for f in self.input_features:
+            col = table[f.name]
+            vals = np.asarray(col.values, dtype=np.float32).reshape(-1)
+            m = col.valid_mask()
+            blocks.append(np.where(m, vals, np.float32(float(self.fill_value))))
+            meta.extend(_meta_cols(f, [(f.name, None)]))
+            if self.track_nulls:
+                blocks.append((~m).astype(np.float32))
+                meta.extend(_meta_cols(f, [(f.name, NULL_INDICATOR)]))
+        vm = VectorMetadata.of(self.get_output().name, meta)
+        return Column(OPVector, np.stack(blocks, axis=1).astype(np.float32),
+                      None, {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        one = FeatureTable(
+            {f.name: Column.of_values(f.feature_type, [row.get(f.name)])
+             for f in self.input_features}, 1)
+        return np.asarray(self.transform_column(one).values)[0].tolist()
+
+
+class RealNNVectorizer(SequenceTransformer):
+    """Seq[RealNN] → OPVector passthrough concat (reference RealNNVectorizer)."""
+
+    output_type = OPVector
+
+    def __init__(self, uid=None):
+        super().__init__("vecRealNN", transform_fn=None, output_type=OPVector, uid=uid)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        blocks, meta = [], []
+        for f in self.input_features:
+            col = table[f.name]
+            blocks.append(np.asarray(col.values, dtype=np.float32).reshape(-1))
+            meta.append(VectorColumnMetadata(f.name, f.type_name, f.name, None))
+        vm = VectorMetadata.of(self.get_output().name, meta)
+        return Column(OPVector, np.stack(blocks, axis=1), None, {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        return [float(row.get(f.name) or 0.0) for f in self.input_features]
+
+
+# ---------------------------------------------------------------------------
+# Categorical pivot (one-hot) vectorizer
+# ---------------------------------------------------------------------------
+
+class OneHotVectorizer(Estimator):
+    """Seq[Text-ish] → OPVector: top-K pivot with OTHER + null indicator
+    (reference OpOneHotVectorizer.scala / OpTextPivotVectorizer — TopK by
+    count with MinSupport, OTHER column, null-indicator column)."""
+
+    output_type = OPVector
+
+    def __init__(self, top_k: int = TransmogrifierDefaults.TopK,
+                 min_support: int = TransmogrifierDefaults.MinSupport,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("pivot", uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        vocabs: List[List[str]] = []
+        for f in self.input_features:
+            col = table[f.name]
+            vals = np.asarray(col.values)
+            m = col.valid_mask()
+            if col.kind == "multipicklist":
+                cnt = Counter(v for vs, ok in zip(vals, m) if ok for v in (vs or ()))
+            else:
+                cnt = Counter(str(v) for v, ok in zip(vals, m) if ok)
+            top = [v for v, c in cnt.most_common() if c >= self.min_support]
+            # deterministic: count desc then value asc
+            top = sorted(top, key=lambda v: (-cnt[v], v))[: self.top_k]
+            vocabs.append(top)
+        model = OneHotVectorizerModel(vocabs=vocabs, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class OneHotVectorizerModel(_VectorModelBase):
+    def __init__(self, vocabs: List[List[str]], track_nulls: bool, uid=None):
+        super().__init__("pivot", uid)
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks, meta = [], []
+        for f, vocab in zip(self.input_features, self.vocabs):
+            col = table[f.name]
+            vals = np.asarray(col.values)
+            m = col.valid_mask()
+            k = len(vocab)
+            block = np.zeros((n, k + 1 + (1 if self.track_nulls else 0)),
+                             dtype=np.float32)
+            index = {v: i for i, v in enumerate(vocab)}
+            if col.kind == "multipicklist":
+                for i, (vs, ok) in enumerate(zip(vals, m)):
+                    if not ok:
+                        continue
+                    for v in (vs or ()):
+                        j = index.get(v)
+                        if j is None:
+                            block[i, k] = 1.0
+                        else:
+                            block[i, j] = 1.0
+            else:
+                codes = np.full(n, -2, dtype=np.int64)  # -2 null, -1 OTHER
+                svals = np.array([str(v) if ok else "" for v, ok in zip(vals, m)],
+                                 dtype=object)
+                for i_ok in np.nonzero(m)[0]:
+                    codes[i_ok] = index.get(svals[i_ok], -1)
+                rows = np.arange(n)
+                hit = codes >= 0
+                block[rows[hit], codes[hit]] = 1.0
+                block[rows[codes == -1], k] = 1.0
+            if self.track_nulls:
+                block[~m, k + 1] = 1.0
+            blocks.append(block)
+            mc = [(f.name, v) for v in vocab] + [(f.name, OTHER_INDICATOR)]
+            if self.track_nulls:
+                mc.append((f.name, NULL_INDICATOR))
+            meta.extend(_meta_cols(f, mc))
+        return self._emit(np.concatenate(blocks, axis=1), meta)
+
+
+# ---------------------------------------------------------------------------
+# Text: tokenizer, hashing, smart vectorizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_SPLIT = None
+
+
+def tokenize_text(s: Optional[str], min_token_length: int = 1) -> List[str]:
+    """Lowercase, split on non-alphanumeric (reference TextTokenizer.scala —
+    Lucene analyzer approximated host-side; language detection is a later
+    stage)."""
+    global _TOKEN_SPLIT
+    if s is None:
+        return []
+    if _TOKEN_SPLIT is None:
+        import re
+        _TOKEN_SPLIT = re.compile(r"[^\w]+", re.UNICODE)
+    return [t for t in _TOKEN_SPLIT.split(s.lower()) if len(t) >= min_token_length]
+
+
+class TextTokenizer(UnaryTransformer):
+    """Text → TextList (reference TextTokenizer.scala:196)."""
+
+    def __init__(self, min_token_length: int = TransmogrifierDefaults.MinTokenLength,
+                 uid=None):
+        super().__init__(
+            "tokenize",
+            transform_fn=lambda v: tokenize_text(v, min_token_length),
+            output_type=TextList, input_type=Text, uid=uid)
+        self.min_token_length = min_token_length
+
+
+def _hash_token(tok: str, num_hashes: int) -> int:
+    """Stable token → bin (crc32; the reference uses MurmurHash3 via Spark's
+    HashingTF — any stable uniform hash serves)."""
+    return zlib.crc32(tok.encode("utf-8")) % num_hashes
+
+
+def hash_token_lists(token_lists: Sequence[Sequence[str]], num_hashes: int,
+                     binary: bool = False) -> np.ndarray:
+    out = np.zeros((len(token_lists), num_hashes), dtype=np.float32)
+    for i, toks in enumerate(token_lists):
+        for t in toks or ():
+            out[i, _hash_token(t, num_hashes)] += 1.0
+    if binary:
+        np.minimum(out, 1.0, out=out)
+    return out
+
+
+class HashingVectorizer(SequenceTransformer):
+    """Seq[TextList] → OPVector via the hashing trick (reference
+    OPCollectionHashingVectorizer.scala:398 — shared or separate hash space)."""
+
+    output_type = OPVector
+
+    def __init__(self, num_hashes: int = TransmogrifierDefaults.NumHashes,
+                 shared_hash_space: bool = False, binary_freq: bool = False,
+                 uid=None):
+        super().__init__("vecHash", transform_fn=None, output_type=OPVector, uid=uid)
+        self.num_hashes = num_hashes
+        self.shared_hash_space = shared_hash_space
+        self.binary_freq = binary_freq
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        blocks, meta = [], []
+        if self.shared_hash_space:
+            n = table.num_rows
+            block = np.zeros((n, self.num_hashes), dtype=np.float32)
+            for f in self.input_features:
+                vals = np.asarray(table[f.name].values)
+                block += hash_token_lists(vals, self.num_hashes, self.binary_freq)
+            blocks.append(block)
+            meta.extend([VectorColumnMetadata(
+                "+".join(fe.name for fe in self.input_features), "TextList",
+                None, None, descriptor_value=f"hash_{j}")
+                for j in range(self.num_hashes)])
+        else:
+            for f in self.input_features:
+                vals = np.asarray(table[f.name].values)
+                blocks.append(hash_token_lists(vals, self.num_hashes, self.binary_freq))
+                meta.extend([VectorColumnMetadata(
+                    f.name, f.type_name, f.name, None,
+                    descriptor_value=f"hash_{j}") for j in range(self.num_hashes)])
+        vm = VectorMetadata.of(self.get_output().name, meta)
+        return Column(OPVector, np.concatenate(blocks, axis=1), None,
+                      {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        one = FeatureTable(
+            {f.name: Column.of_values(f.feature_type, [row.get(f.name)])
+             for f in self.input_features}, 1)
+        return np.asarray(self.transform_column(one).values)[0].tolist()
+
+
+class SmartTextVectorizer(Estimator):
+    """Seq[Text] → OPVector: per-feature cardinality decides pivot vs hashing
+    (reference SmartTextVectorizer.scala:260 — cardinality stats then ≤maxCard
+    → one-hot pivot else hashing trick; tracks nulls either way)."""
+
+    output_type = OPVector
+
+    def __init__(self, max_cardinality: int = TransmogrifierDefaults.MaxCardinality,
+                 top_k: int = TransmogrifierDefaults.TopK,
+                 min_support: int = TransmogrifierDefaults.MinSupport,
+                 num_hashes: int = TransmogrifierDefaults.NumHashes,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls,
+                 uid=None):
+        super().__init__("smartTxtVec", uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        plans: List[Dict[str, Any]] = []
+        for f in self.input_features:
+            col = table[f.name]
+            vals = np.asarray(col.values)
+            m = col.valid_mask()
+            cnt = Counter(str(v) for v, ok in zip(vals, m) if ok)
+            if len(cnt) <= self.max_cardinality:
+                top = [v for v, c in cnt.most_common() if c >= self.min_support]
+                top = sorted(top, key=lambda v: (-cnt[v], v))[: self.top_k]
+                plans.append({"kind": "pivot", "vocab": top})
+            else:
+                plans.append({"kind": "hash"})
+        model = SmartTextVectorizerModel(
+            plans=plans, num_hashes=self.num_hashes, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class SmartTextVectorizerModel(_VectorModelBase):
+    def __init__(self, plans: List[Dict[str, Any]], num_hashes: int,
+                 track_nulls: bool, uid=None):
+        super().__init__("smartTxtVec", uid)
+        self.plans = plans
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks, meta = [], []
+        for f, plan in zip(self.input_features, self.plans):
+            col = table[f.name]
+            vals = np.asarray(col.values)
+            m = col.valid_mask()
+            if plan["kind"] == "pivot":
+                vocab = plan["vocab"]
+                k = len(vocab)
+                block = np.zeros((n, k + 1), dtype=np.float32)
+                index = {v: i for i, v in enumerate(vocab)}
+                for i in np.nonzero(m)[0]:
+                    j = index.get(str(vals[i]), -1)
+                    block[i, j if j >= 0 else k] = 1.0
+                blocks.append(block)
+                meta.extend(_meta_cols(
+                    f, [(f.name, v) for v in vocab] + [(f.name, OTHER_INDICATOR)]))
+            else:
+                toks = [tokenize_text(v if ok else None)
+                        for v, ok in zip(vals, m)]
+                blocks.append(hash_token_lists(toks, self.num_hashes))
+                meta.extend([VectorColumnMetadata(
+                    f.name, f.type_name, f.name, None,
+                    descriptor_value=f"hash_{j}") for j in range(self.num_hashes)])
+            if self.track_nulls:
+                blocks.append((~m).astype(np.float32)[:, None])
+                meta.extend(_meta_cols(f, [(f.name, NULL_INDICATOR)]))
+        return self._emit(np.concatenate(blocks, axis=1), meta)
+
+
+# ---------------------------------------------------------------------------
+# Combiner
+# ---------------------------------------------------------------------------
+
+class VectorsCombiner(SequenceTransformer):
+    """Seq[OPVector] → OPVector concatenation with metadata flattening
+    (reference VectorsCombiner.scala:89)."""
+
+    output_type = OPVector
+
+    def __init__(self, uid=None):
+        super().__init__("combined", transform_fn=None, output_type=OPVector, uid=uid)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        blocks, metas = [], []
+        for f in self.input_features:
+            col = table[f.name]
+            arr = np.asarray(col.values, dtype=np.float32)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            blocks.append(arr)
+            vm = col.metadata.get("vector_meta")
+            if vm is None:
+                vm = VectorMetadata.of(f.name, [
+                    VectorColumnMetadata(f.name, f.type_name, None, None,
+                                         descriptor_value=f"col_{j}")
+                    for j in range(arr.shape[1])])
+            metas.append(vm)
+        vm = VectorMetadata.flatten(self.get_output().name, metas)
+        mat = np.concatenate(blocks, axis=1)
+        assert vm.size == mat.shape[1], (vm.size, mat.shape)
+        return Column(OPVector, mat, None, {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        out: List[float] = []
+        for f in self.input_features:
+            v = row.get(f.name) or []
+            out.extend(float(x) for x in (v if isinstance(v, (list, tuple)) else [v]))
+        return out

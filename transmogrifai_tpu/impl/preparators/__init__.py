@@ -1,0 +1,1 @@
+from .sanity_checker import SanityChecker, SanityCheckerModel

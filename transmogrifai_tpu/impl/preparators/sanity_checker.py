@@ -1,0 +1,238 @@
+"""SanityChecker — automated feature validation.
+
+TPU re-design of the reference SanityChecker
+(reference: core/.../impl/preparators/SanityChecker.scala — sampling :524-529 &
+limits :720-739, colStats :574-576, correlations :634-638, categorical
+association stats categoricalTests :420-516, removal reasons
+ColumnStatistics.reasonsToRemove :783-832, index-keep model transformFn
+:707-717, summary metadata :678).
+
+Everything numeric happens in a handful of jitted kernels over the feature
+matrix: one fused stats pass (count/mean/var/min/max), one correlation kernel
+(Pearson or Spearman vs label), and one MXU matmul per categorical group for
+contingency tables — replacing Spark's colStats/corr/reduceByKey jobs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.stats import (
+    col_stats, contingency_stats, contingency_table, pearson_correlation,
+    spearman_correlation,
+)
+from ...stages.base import AllowLabelAsInput, Estimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import OPVector, RealNN
+from ...vector_metadata import VectorMetadata
+
+
+class SanityCheckerDefaults:
+    """(reference SanityCheckerParams defaults :59-226)"""
+    CheckSample = 1.0
+    SampleLowerLimit = 1_000
+    SampleUpperLimit = 1_000_000
+    MaxCorrelation = 0.95
+    MinCorrelation = 0.0
+    MaxCramersV = 0.95
+    MinVariance = 1e-5
+    MinRequiredRuleSupport = 1.0
+    MaxRuleConfidence = 1.0
+    RemoveFeatureGroup = True
+    ProtectTextSharedHash = True
+    RemoveBadFeatures = True
+    CorrelationTypeSpearman = False
+
+
+class SanityChecker(AllowLabelAsInput, Estimator):
+    """BinaryEstimator[RealNN, OPVector] → OPVector: drops features whose
+    statistics flag leakage or uselessness."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+
+    def __init__(self,
+                 check_sample: float = SanityCheckerDefaults.CheckSample,
+                 sample_upper_limit: int = SanityCheckerDefaults.SampleUpperLimit,
+                 max_correlation: float = SanityCheckerDefaults.MaxCorrelation,
+                 min_correlation: float = SanityCheckerDefaults.MinCorrelation,
+                 max_cramers_v: float = SanityCheckerDefaults.MaxCramersV,
+                 min_variance: float = SanityCheckerDefaults.MinVariance,
+                 max_rule_confidence: float = SanityCheckerDefaults.MaxRuleConfidence,
+                 min_required_rule_support: float = SanityCheckerDefaults.MinRequiredRuleSupport,
+                 remove_bad_features: bool = SanityCheckerDefaults.RemoveBadFeatures,
+                 remove_feature_group: bool = SanityCheckerDefaults.RemoveFeatureGroup,
+                 correlation_type_spearman: bool = SanityCheckerDefaults.CorrelationTypeSpearman,
+                 seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__("sanityCheck", uid)
+        self.check_sample = check_sample
+        self.sample_upper_limit = sample_upper_limit
+        self.max_correlation = max_correlation
+        self.min_correlation = min_correlation
+        self.max_cramers_v = max_cramers_v
+        self.min_variance = min_variance
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.remove_bad_features = remove_bad_features
+        self.remove_feature_group = remove_feature_group
+        self.correlation_type_spearman = correlation_type_spearman
+        self.seed = seed
+
+    # -- fit ------------------------------------------------------------------
+    def fit(self, table: FeatureTable) -> Transformer:
+        label_f, vec_f = self.input_features
+        y = np.asarray(table[label_f.name].values, dtype=np.float32).reshape(-1)
+        col = table[vec_f.name]
+        X = np.asarray(col.values, dtype=np.float32)
+        vm: Optional[VectorMetadata] = col.metadata.get("vector_meta")
+        n, d = X.shape
+
+        # sampling (reference :524-529, capped :720-739)
+        target = min(int(n * self.check_sample) if self.check_sample < 1.0 else n,
+                     self.sample_upper_limit)
+        if target < n:
+            rng = np.random.RandomState(self.seed)
+            idx = rng.choice(n, size=target, replace=False)
+            Xs, ys = X[idx], y[idx]
+        else:
+            Xs, ys = X, y
+
+        Xd, yd = jnp.asarray(Xs), jnp.asarray(ys)
+        stats = col_stats(Xd)
+        if self.correlation_type_spearman:
+            corr = spearman_correlation(Xd, yd)
+        else:
+            corr = pearson_correlation(Xd, yd)
+        stats = {k: np.asarray(v) for k, v in stats._asdict().items()}
+        corr = np.asarray(corr)
+
+        # categorical association stats per feature group (reference :420-516)
+        cramers_by_col = np.full(d, np.nan)
+        rule_conf_by_col = np.full(d, np.nan)
+        support_by_col = np.full(d, np.nan)
+        group_cramers: Dict[str, float] = {}
+        if vm is not None:
+            labels = np.unique(ys)
+            is_binary_like = len(labels) <= 20 and np.allclose(labels, labels.astype(int))
+            if is_binary_like:
+                label_idx = jnp.asarray(ys.astype(np.int32))
+                num_labels = int(ys.max()) + 1
+                for group, idxs in vm.index_of_group().items():
+                    cols_meta = [vm.columns[i] for i in idxs]
+                    # only indicator (0/1 pivot) groups get contingency stats
+                    if not all(c.indicator_value is not None for c in cols_meta):
+                        continue
+                    ind = Xd[:, np.asarray(idxs)]
+                    tbl = contingency_table(ind, label_idx, num_labels)
+                    cs = contingency_stats(tbl)
+                    group_cramers[group] = float(cs.cramers_v)
+                    mrc = np.asarray(cs.max_rule_confidence)
+                    sup = np.asarray(cs.support)
+                    for j, i_col in enumerate(idxs):
+                        cramers_by_col[i_col] = float(cs.cramers_v)
+                        rule_conf_by_col[i_col] = mrc[j]
+                        support_by_col[i_col] = sup[j]
+
+        # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
+        reasons: Dict[int, List[str]] = {}
+
+        def flag(i: int, why: str):
+            reasons.setdefault(i, []).append(why)
+
+        for i in range(d):
+            if stats["variance"][i] < self.min_variance:
+                flag(i, f"variance {stats['variance'][i]:.3g} below min {self.min_variance}")
+            c = corr[i]
+            if not np.isnan(c):
+                if abs(c) > self.max_correlation:
+                    flag(i, f"label correlation {c:.3f} above max {self.max_correlation} (leakage)")
+                elif abs(c) < self.min_correlation:
+                    flag(i, f"label correlation {c:.3f} below min {self.min_correlation}")
+            if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
+                flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
+            if (not np.isnan(rule_conf_by_col[i])
+                    and rule_conf_by_col[i] > self.max_rule_confidence
+                    and support_by_col[i] >= 0
+                    and support_by_col[i] * len(ys) >= self.min_required_rule_support):
+                flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
+                        f"above max {self.max_rule_confidence}")
+
+        # feature-group propagation (reference: if one indicator of a pivot
+        # group leaks, the whole group goes)
+        if self.remove_feature_group and vm is not None and reasons:
+            groups = vm.index_of_group()
+            leak = {i for i, why in reasons.items()
+                    if any("leakage" in w or "Cramér" in w for w in why)}
+            for group, idxs in groups.items():
+                if leak.intersection(idxs):
+                    for i in idxs:
+                        if i not in reasons:
+                            flag(i, f"sibling column in group '{group}' flagged for leakage")
+
+        to_remove = sorted(reasons) if self.remove_bad_features else []
+        keep = [i for i in range(d) if i not in set(to_remove)]
+        if not keep:
+            raise ValueError(
+                "SanityChecker would remove ALL feature columns — loosen thresholds")
+
+        names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
+        summary = {
+            "names": names,
+            "count": stats["count"].tolist(),
+            "mean": stats["mean"].tolist(),
+            "variance": stats["variance"].tolist(),
+            "min": stats["min"].tolist(),
+            "max": stats["max"].tolist(),
+            "correlationsWithLabel": [None if np.isnan(c) else float(c) for c in corr],
+            "correlationType": "spearman" if self.correlation_type_spearman else "pearson",
+            "cramersV": {g: v for g, v in group_cramers.items()},
+            "dropped": [names[i] for i in to_remove],
+            "reasons": {names[i]: why for i, why in reasons.items()},
+            "sampleSize": int(len(ys)),
+        }
+        model = SanityCheckerModel(keep_indices=keep, summary=summary)
+        model.summary_metadata = summary
+        return self._finalize_model(model)
+
+
+class SanityCheckerModel(AllowLabelAsInput, Transformer):
+    """Index-keep filter (reference SanityCheckerModel.transformFn :707-717)."""
+
+    output_type = OPVector
+
+    def __init__(self, keep_indices: List[int], summary: Dict[str, Any], uid=None):
+        super().__init__("sanityCheck", uid)
+        self.keep_indices = list(keep_indices)
+        self.summary = summary
+        self.summary_metadata = summary
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        _, vec_f = self.input_features
+        col = table[vec_f.name]
+        X = np.asarray(col.values)
+        keep = np.asarray(self.keep_indices)
+        vm: Optional[VectorMetadata] = col.metadata.get("vector_meta")
+        new_meta = {}
+        if vm is not None:
+            new_meta["vector_meta"] = VectorMetadata(
+                self.get_output().name, vm.select(self.keep_indices).columns)
+        return Column(OPVector, np.ascontiguousarray(X[:, keep]), None, new_meta)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        _, vec_f = self.input_features
+        v = row.get(vec_f.name) or []
+        return [float(v[i]) for i in self.keep_indices]
+
+    def summary_pretty(self) -> str:
+        s = self.summary
+        lines = [f"-- SanityChecker ({self.uid}) --",
+                 f"sample size: {s['sampleSize']}",
+                 f"columns kept: {len(self.keep_indices)} / {len(s['names'])}"]
+        if s["dropped"]:
+            lines.append("dropped:")
+            for name in s["dropped"]:
+                lines.append(f"  {name}: " + "; ".join(s["reasons"][name]))
+        return "\n".join(lines)

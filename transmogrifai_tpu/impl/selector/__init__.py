@@ -1,0 +1,5 @@
+from .model_selector import ModelSelector, SelectedModel, ModelSelectorSummary
+from .factories import (
+    BinaryClassificationModelSelector, MultiClassificationModelSelector,
+    RegressionModelSelector,
+)

@@ -1,0 +1,254 @@
+"""ModelSelector — automated model selection.
+
+TPU re-design of the reference ModelSelector
+(reference: core/.../impl/selector/ModelSelector.scala:135-196 fit flow,
+:216-255 SelectedModel; ModelSelectorSummary.scala): splitter prepares the
+train data (balance/cut), the validator sweeps families × grids × folds as
+vmapped device batches, the winner refits on the full prepared train set, and
+the fitted SelectedModel emits a Prediction column.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.api import MODEL_REGISTRY, FittedParams, ModelFamily
+from ...stages.base import AllowLabelAsInput, Estimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import OPVector, Prediction, RealNN
+from ..tuning.splitters import DataSplitter, PreparedData, Splitter
+from ..tuning.validators import BestEstimator, OpCrossValidation, OpValidator
+
+
+@dataclass
+class ModelSelectorSummary:
+    """(reference ModelSelectorSummary.scala:308)"""
+    validation_type: str
+    validation_metric: str
+    problem: str
+    best_model_type: str
+    best_hyper: Dict[str, Any]
+    best_metric_value: float
+    validation_results: List[Any] = field(default_factory=list)
+    train_evaluation: Dict[str, Any] = field(default_factory=dict)
+    holdout_evaluation: Dict[str, Any] = field(default_factory=dict)
+    splitter_summary: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "validationType": self.validation_type,
+            "validationMetric": self.validation_metric,
+            "problem": self.problem,
+            "bestModelType": self.best_model_type,
+            "bestHyperparameters": self.best_hyper,
+            "bestMetricValue": self.best_metric_value,
+            "validationResults": [r.to_json() for r in self.validation_results],
+            "trainEvaluation": self.train_evaluation,
+            "holdoutEvaluation": self.holdout_evaluation,
+            "splitterSummary": self.splitter_summary,
+        }
+
+
+class ModelSelector(AllowLabelAsInput, Estimator):
+    """Estimator[(RealNN label, OPVector features)] → Prediction."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+
+    def __init__(self, problem: str,
+                 validator: Optional[OpValidator] = None,
+                 splitter: Optional[Splitter] = None,
+                 models: Optional[Sequence[Tuple[Any, Optional[List[Dict[str, Any]]]]]] = None,
+                 evaluator=None,
+                 uid: Optional[str] = None):
+        super().__init__("modelSelector", uid)
+        if problem not in ("binary", "multiclass", "regression"):
+            raise ValueError(f"unknown problem kind '{problem}'")
+        self.problem = problem
+        self.validator = validator or OpCrossValidation()
+        self.splitter = splitter if splitter is not None else DataSplitter()
+        self.evaluator = evaluator
+        self.models = self._resolve_models(models)
+
+    def _resolve_models(self, models):
+        resolved: List[Tuple[ModelFamily, List[Dict[str, Any]]]] = []
+        if models is None:
+            from ...models.api import MODEL_REGISTRY
+            defaults = {
+                "binary": ["OpLogisticRegression", "OpLinearSVC", "OpNaiveBayes"],
+                "multiclass": ["OpLogisticRegression", "OpNaiveBayes"],
+                "regression": ["OpLinearRegression"],
+            }[self.problem]
+            models = [(MODEL_REGISTRY[name], None) for name in defaults]
+        for fam, grid in models:
+            if isinstance(fam, str):
+                fam = MODEL_REGISTRY[fam]
+            if self.problem not in fam.supports:
+                raise ValueError(
+                    f"{fam.name} does not support problem kind '{self.problem}'")
+            resolved.append((fam, grid if grid is not None
+                             else fam.default_grid(self.problem)))
+        return resolved
+
+    @property
+    def validation_metric(self) -> Tuple[str, bool]:
+        if self.evaluator is not None:
+            return self.evaluator.default_metric, self.evaluator.larger_better
+        return {"binary": ("AuPR", True),
+                "multiclass": ("F1", True),
+                "regression": ("RootMeanSquaredError", False)}[self.problem]
+
+    # -- fit (reference ModelSelector.fit :135-196) --------------------------
+    def fit(self, table: FeatureTable) -> Transformer:
+        label_f, vec_f = self.input_features
+        y_all = np.asarray(table[label_f.name].values, dtype=np.float32).reshape(-1)
+        X_all = np.asarray(table[vec_f.name].values, dtype=np.float32)
+        n = len(y_all)
+
+        # reserve holdout (reference splitter.split in workflow fitStages)
+        if self.splitter is not None and self.splitter.reserve_test_fraction > 0:
+            train_idx, test_idx = self.splitter.split(n)
+        else:
+            train_idx, test_idx = np.arange(n), np.array([], dtype=np.int64)
+
+        y_train_raw = y_all[train_idx]
+        prep = (self.splitter.pre_validation_prepare(y_train_raw)
+                if self.splitter is not None
+                else PreparedData(indices=np.arange(len(y_train_raw))))
+        sel = train_idx[prep.indices]
+        X, y = X_all[sel], y_all[sel]
+        if prep.label_mapping:
+            y = np.vectorize(lambda v: prep.label_mapping.get(int(v), -1))(y).astype(np.float32)
+        num_classes = int(y.max()) + 1 if self.problem != "regression" else 1
+        if self.problem == "binary":
+            num_classes = 2
+
+        metric_name, larger_better = self.validation_metric
+        Xd, yd = jnp.asarray(X), jnp.asarray(y)
+        best = self.validator.validate(
+            self.models, Xd, yd, self.problem, metric_name, larger_better,
+            num_classes)
+
+        # refit winner on full prepared train (reference :158-159)
+        family = MODEL_REGISTRY[best.family_name]
+        garr = family.grid_to_arrays([best.hyper])
+        W = jnp.ones((1, len(y)), dtype=jnp.float32)
+        params_b = family.fit_batch(Xd, yd, W, garr, num_classes)
+        fitted = FittedParams(
+            family=family.name, params=family.select_params(params_b, 0),
+            hyper=dict(best.hyper), num_classes=num_classes)
+
+        summary = ModelSelectorSummary(
+            validation_type=type(self.validator).__name__,
+            validation_metric=metric_name,
+            problem=self.problem,
+            best_model_type=best.family_name,
+            best_hyper=dict(best.hyper),
+            best_metric_value=best.metric_value,
+            validation_results=best.results,
+            splitter_summary=dict(getattr(self.splitter, "summary", {}) or {}),
+        )
+        model = SelectedModel(fitted=fitted, summary=summary,
+                              label_mapping=prep.label_mapping)
+        model = self._finalize_model(model)
+
+        # train/holdout evaluation (reference :168-188)
+        if self.evaluator is not None or True:
+            ev = self._default_evaluator()
+            if ev is not None:
+                ev.set_label_col(label_f.name)
+                ev.set_prediction_col(model.get_output().name)
+                train_tbl = table.take(train_idx)
+                summary.train_evaluation = _scalar_metrics(
+                    ev.evaluate_all(model.transform(train_tbl)))
+                if len(test_idx):
+                    test_tbl = table.take(test_idx)
+                    summary.holdout_evaluation = _scalar_metrics(
+                        ev.evaluate_all(model.transform(test_tbl)))
+        model.summary_metadata = summary.to_json()
+        return model
+
+    def _default_evaluator(self):
+        if self.evaluator is not None:
+            return self.evaluator
+        from ...evaluators import (
+            OpBinaryClassificationEvaluator, OpMultiClassificationEvaluator,
+            OpRegressionEvaluator)
+        return {"binary": OpBinaryClassificationEvaluator,
+                "multiclass": OpMultiClassificationEvaluator,
+                "regression": OpRegressionEvaluator}[self.problem]()
+
+
+def _scalar_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
+    return {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
+
+
+class SelectedModel(AllowLabelAsInput, Transformer):
+    """The fitted winner (reference SelectedModel :216-255): emits a
+    Prediction column (n, k) with keys prediction / probability_i /
+    rawPrediction_i."""
+
+    output_type = Prediction
+
+    def __init__(self, fitted: FittedParams, summary: ModelSelectorSummary,
+                 label_mapping: Optional[Dict[int, int]] = None, uid=None):
+        super().__init__("modelSelector", uid)
+        self.fitted = fitted
+        self.summary = summary
+        self.label_mapping = label_mapping
+        self.summary_metadata: Dict[str, Any] = {}
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        _, vec_f = self.input_features
+        X = jnp.asarray(np.asarray(table[vec_f.name].values, dtype=np.float32))
+        family = MODEL_REGISTRY[self.fitted.family]
+        parts = family.predict_one(self.fitted, X)
+        return prediction_column(parts)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        _, vec_f = self.input_features
+        v = np.asarray(row.get(vec_f.name) or [], dtype=np.float32)[None, :]
+        family = MODEL_REGISTRY[self.fitted.family]
+        parts = family.predict_one(self.fitted, jnp.asarray(v))
+        out = {"prediction": float(parts["prediction"][0])}
+        for name in ("probability", "rawPrediction"):
+            if name in parts:
+                for i, x in enumerate(np.asarray(parts[name][0]).reshape(-1)):
+                    out[f"{name}_{i}"] = float(x)
+        return out
+
+    def summary_pretty(self) -> str:
+        s = self.summary
+        lines = [f"-- ModelSelector ({self.uid}) --",
+                 f"Evaluated {len(s.validation_results)} model type(s) with "
+                 f"{s.validation_type} on metric {s.validation_metric}",
+                 f"Best model: {s.best_model_type} "
+                 f"{s.best_hyper} → {s.validation_metric}={s.best_metric_value:.4f}"]
+        for r in s.validation_results:
+            lines.append(f"  {r.family}: best {np.max(r.mean_metrics):.4f} "
+                         f"worst {np.min(r.mean_metrics):.4f} over {len(r.grid)} configs")
+        if s.holdout_evaluation:
+            keys = ("AuPR", "AuROC", "F1", "Error", "RootMeanSquaredError", "R2")
+            show = {k: round(v, 4) for k, v in s.holdout_evaluation.items() if k in keys}
+            lines.append(f"Holdout: {show}")
+        return "\n".join(lines)
+
+
+def prediction_column(parts: Dict[str, np.ndarray]) -> Column:
+    """Pack predict_one parts into a Prediction column."""
+    n = len(parts["prediction"])
+    keys: List[str] = [Prediction.PredictionName]
+    cols: List[np.ndarray] = [np.asarray(parts["prediction"], dtype=np.float32).reshape(-1)]
+    for name in (Prediction.RawPredictionName, Prediction.ProbabilityName):
+        if name in parts:
+            arr = np.asarray(parts[name], dtype=np.float32)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            for i in range(arr.shape[1]):
+                keys.append(f"{name}_{i}")
+                cols.append(arr[:, i])
+    mat = np.stack(cols, axis=1)
+    return Column(Prediction, mat, None, {"keys": tuple(keys)})

@@ -1,0 +1,2 @@
+from .splitters import Splitter, DataSplitter, DataBalancer, DataCutter
+from .validators import OpCrossValidation, OpTrainValidationSplit, OpValidator

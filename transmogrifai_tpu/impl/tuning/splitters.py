@@ -1,0 +1,136 @@
+"""Data splitters: test reservation + class balancing / cutting.
+
+(reference: core/.../impl/tuning/Splitter.scala:62-100, DataSplitter.scala,
+DataBalancer.scala, DataCutter.scala)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PreparedData:
+    """Outcome of pre-validation preparation: row indices into the original
+    arrays (resampling expressed as indices, possibly repeated for upsampling)
+    plus metadata about what was done."""
+    indices: np.ndarray
+    summary: Dict[str, Any] = field(default_factory=dict)
+    label_mapping: Optional[Dict[int, int]] = None  # DataCutter re-indexing
+
+
+class Splitter:
+    """Base: reserve a test fraction, prepare train data
+    (reference Splitter.scala:62-100)."""
+
+    def __init__(self, reserve_test_fraction: float = 0.1, seed: int = 42):
+        if not 0.0 <= reserve_test_fraction < 1.0:
+            raise ValueError("reserve_test_fraction must be in [0, 1)")
+        self.reserve_test_fraction = reserve_test_fraction
+        self.seed = seed
+        self.summary: Dict[str, Any] = {}
+
+    def split(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(train_idx, test_idx) random split."""
+        rng = np.random.RandomState(self.seed)
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        return np.sort(perm[n_test:]), np.sort(perm[:n_test])
+
+    def pre_validation_prepare(self, y: np.ndarray) -> PreparedData:
+        """Estimate and apply balancing/cutting on the train split
+        (reference preValidationPrepare). Default: identity."""
+        return PreparedData(indices=np.arange(len(y)))
+
+    def validation_prepare(self, y: np.ndarray) -> PreparedData:
+        """Preparation applied before the final refit on full train data
+        (reference validationPrepare). Default: same as pre-validation."""
+        return self.pre_validation_prepare(y)
+
+
+class DataSplitter(Splitter):
+    """Plain random split, regression problems (reference DataSplitter.scala:62-85)."""
+
+
+class DataBalancer(Splitter):
+    """Binary classification balancer (reference DataBalancer.scala:125-163,
+    estimate :208): if the positive fraction is below ``sample_fraction``,
+    down-sample the majority class (and optionally up-sample the minority) so
+    positives make up ~sample_fraction of the result, capped at
+    ``max_training_sample`` rows."""
+
+    def __init__(self, sample_fraction: float = 0.1,
+                 max_training_sample: int = 1_000_000,
+                 already_balanced_fraction_cutoff: float = 0.3, **kw):
+        super().__init__(**kw)
+        self.sample_fraction = sample_fraction
+        self.max_training_sample = max_training_sample
+        self.already_balanced_fraction_cutoff = already_balanced_fraction_cutoff
+
+    def pre_validation_prepare(self, y: np.ndarray) -> PreparedData:
+        rng = np.random.RandomState(self.seed)
+        pos_idx = np.nonzero(y > 0.5)[0]
+        neg_idx = np.nonzero(y <= 0.5)[0]
+        n_pos, n_neg = len(pos_idx), len(neg_idx)
+        n = n_pos + n_neg
+        small, big = (pos_idx, neg_idx) if n_pos <= n_neg else (neg_idx, pos_idx)
+        frac = len(small) / max(n, 1)
+        summary: Dict[str, Any] = {
+            "positiveCount": int(n_pos), "negativeCount": int(n_neg),
+            "minorityFraction": frac, "balanced": False,
+        }
+        if frac >= min(self.sample_fraction, self.already_balanced_fraction_cutoff) \
+                or len(small) == 0:
+            idx = np.arange(n)
+            if n > self.max_training_sample:
+                idx = np.sort(rng.choice(n, self.max_training_sample, replace=False))
+                summary["downsampledTo"] = self.max_training_sample
+            self.summary = summary
+            return PreparedData(indices=idx, summary=summary)
+        # downsample majority so minority fraction ≈ sample_fraction
+        target_big = int(len(small) * (1.0 - self.sample_fraction) / self.sample_fraction)
+        target_big = max(min(target_big, len(big)), len(small))
+        big_keep = rng.choice(big, target_big, replace=False)
+        idx = np.sort(np.concatenate([small, big_keep]))
+        if len(idx) > self.max_training_sample:
+            idx = np.sort(rng.choice(idx, self.max_training_sample, replace=False))
+        summary.update({"balanced": True,
+                        "downsampledMajorityTo": int(target_big),
+                        "resultSize": int(len(idx))})
+        self.summary = summary
+        return PreparedData(indices=idx, summary=summary)
+
+
+class DataCutter(Splitter):
+    """Multiclass label cutter (reference DataCutter.scala:85,170): keep at
+    most ``max_label_categories`` labels and only labels with at least
+    ``min_label_fraction``; drop rows with other labels and re-index labels
+    to a dense 0..K-1 range."""
+
+    def __init__(self, max_label_categories: int = 100,
+                 min_label_fraction: float = 0.0, **kw):
+        super().__init__(**kw)
+        if min_label_fraction >= 0.5:
+            raise ValueError("min_label_fraction must be < 0.5")
+        self.max_label_categories = max_label_categories
+        self.min_label_fraction = min_label_fraction
+
+    def pre_validation_prepare(self, y: np.ndarray) -> PreparedData:
+        labels, counts = np.unique(y.astype(np.int64), return_counts=True)
+        frac = counts / counts.sum()
+        order = np.argsort(-counts)
+        kept = [labels[i] for i in order[: self.max_label_categories]
+                if frac[i] >= self.min_label_fraction]
+        kept_set = set(int(k) for k in kept)
+        if not kept_set:
+            raise ValueError("DataCutter dropped all labels")
+        mask = np.isin(y.astype(np.int64), list(kept_set))
+        mapping = {int(lab): i for i, lab in enumerate(sorted(kept_set))}
+        summary = {"labelsKept": sorted(kept_set),
+                   "labelsDropped": sorted(set(int(l) for l in labels) - kept_set),
+                   "rowsKept": int(mask.sum())}
+        self.summary = summary
+        return PreparedData(indices=np.nonzero(mask)[0], summary=summary,
+                            label_mapping=mapping)

@@ -1,0 +1,185 @@
+"""Validators: cross-validation and train/validation split over vmapped grids.
+
+The TPU re-design of the reference's thread-pool validator
+(reference: core/.../impl/tuning/OpValidator.scala:270-322 — one Scala Future
+per model × fold, pool of 8; OpCrossValidation.scala:139-181 kFold;
+OpTrainValidationSplit.scala:40-80): here folds become static 0/1 row-mask
+vectors, and the whole |folds| × |grid| sweep for a model family is ONE
+``fit_batch`` call — a single jitted, vmapped XLA program whose inner matmuls
+tile onto the MXU. Parallelism is not 8 threads; it is the full batch dimension
+on device, shardable across chips over the 'model' mesh axis.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.api import FittedParams, ModelFamily
+from ...ops.metrics import (
+    aupr_masked, auroc_masked, multiclass_f1_masked, regression_metrics_masked,
+)
+
+
+@dataclass
+class ValidationResult:
+    """Per-(family, grid-point) averaged validation metric
+    (reference ModelSelectorSummary validation results)."""
+    family: str
+    grid: List[Dict[str, Any]]
+    metric_name: str
+    fold_metrics: np.ndarray        # (F, G)
+    mean_metrics: np.ndarray        # (G,)
+
+    def to_json(self):
+        return {
+            "modelType": self.family,
+            "metricName": self.metric_name,
+            "grid": self.grid,
+            "foldMetrics": self.fold_metrics.tolist(),
+            "meanMetrics": self.mean_metrics.tolist(),
+        }
+
+
+@dataclass
+class BestEstimator:
+    """Winner of validation (reference OpValidator.wrapBestEstimator :147)."""
+    family_name: str
+    hyper: Dict[str, Any]
+    metric_value: float
+    results: List[ValidationResult] = field(default_factory=list)
+
+
+def _metric_fn(problem: str, metric: str):
+    """Jitted batched metric over (B, n) scores with (B?, n) val masks."""
+    if problem == "binary":
+        base = {"AuPR": aupr_masked, "AuROC": auroc_masked}[metric]
+        return jax.jit(jax.vmap(base, in_axes=(0, None, 0)))
+    if problem == "multiclass":
+        def one(probs, y, mask, num_classes):
+            pred = probs.argmax(axis=-1).astype(jnp.int32)
+            return multiclass_f1_masked(pred, y.astype(jnp.int32), mask, num_classes)
+        return jax.jit(jax.vmap(one, in_axes=(0, None, 0, None)),
+                       static_argnums=(3,))
+    if problem == "regression":
+        def one_r(pred, y, mask):
+            return regression_metrics_masked(pred, y, mask)["RootMeanSquaredError"]
+        return jax.jit(jax.vmap(one_r, in_axes=(0, None, 0)))
+    raise ValueError(problem)
+
+
+class OpValidator:
+    """Shared validation machinery (reference OpValidator.scala)."""
+
+    def __init__(self, seed: int = 42, stratify: bool = False):
+        self.seed = seed
+        self.stratify = stratify
+
+    # -- fold construction ---------------------------------------------------
+    def make_splits(self, y: np.ndarray) -> np.ndarray:
+        """(F, n) boolean VALIDATION masks; train mask = ~val."""
+        raise NotImplementedError
+
+    def _kfold_masks(self, y: np.ndarray, k: int) -> np.ndarray:
+        n = len(y)
+        rng = np.random.RandomState(self.seed)
+        masks = np.zeros((k, n), dtype=bool)
+        if self.stratify:
+            # per-class round-robin folds (reference stratified kFold union
+            # OpCrossValidation.scala:139-181)
+            for lab in np.unique(y):
+                idx = np.nonzero(y == lab)[0]
+                idx = rng.permutation(idx)
+                for f in range(k):
+                    masks[f, idx[f::k]] = True
+        else:
+            perm = rng.permutation(n)
+            for f in range(k):
+                masks[f, perm[f::k]] = True
+        return masks
+
+    # -- the sweep -----------------------------------------------------------
+    def validate(self, models: Sequence[Tuple[ModelFamily, List[Dict[str, Any]]]],
+                 X: jnp.ndarray, y: jnp.ndarray, problem: str,
+                 metric_name: str, larger_better: bool, num_classes: int,
+                 ) -> BestEstimator:
+        """Run the full |families| × |grid| × |folds| sweep. Each family is one
+        vmapped fit_batch + predict_batch + batched-metric program."""
+        val_masks = self.make_splits(np.asarray(y))  # (F, n)
+        F, n = val_masks.shape
+        train_w = jnp.asarray(~val_masks, dtype=jnp.float32)    # (F, n)
+        val_m = jnp.asarray(val_masks)                          # (F, n)
+        metric = _metric_fn(problem, metric_name)
+
+        results: List[ValidationResult] = []
+        best: Optional[BestEstimator] = None
+        for family, grid in models:
+            G = len(grid)
+            garr = family.grid_to_arrays(grid)                   # each (G,)
+            # tile: config b = fold f * G + g
+            W = jnp.repeat(train_w, G, axis=0)                   # (F*G, n)
+            tiled = {k: jnp.tile(v, F) for k, v in garr.items()}  # (F*G,)
+            params = family.fit_batch(X, y, W, tiled, num_classes)
+            scores = family.predict_batch(params, X, num_classes)  # (F*G, n[, C])
+            VM = jnp.repeat(val_m, G, axis=0)                    # (F*G, n)
+            if problem == "multiclass":
+                m = metric(scores, y, VM, num_classes)
+            else:
+                m = metric(scores, y, VM)
+            fold_metrics = np.asarray(m).reshape(F, G)
+            mean_metrics = fold_metrics.mean(axis=0)
+            results.append(ValidationResult(
+                family=family.name, grid=list(grid), metric_name=metric_name,
+                fold_metrics=fold_metrics, mean_metrics=mean_metrics))
+            g_best = int(np.argmax(mean_metrics) if larger_better
+                         else np.argmin(mean_metrics))
+            value = float(mean_metrics[g_best])
+            better = best is None or (
+                (value > best.metric_value) if larger_better
+                else (value < best.metric_value))
+            if better:
+                best = BestEstimator(family.name, dict(grid[g_best]), value)
+        assert best is not None, "no models to validate"
+        best.results = results
+        return best
+
+
+class OpCrossValidation(OpValidator):
+    """k-fold CV (reference OpCrossValidation.scala, default 3 folds)."""
+
+    def __init__(self, num_folds: int = 3, **kw):
+        super().__init__(**kw)
+        if num_folds < 2:
+            raise ValueError("num_folds must be >= 2")
+        self.num_folds = num_folds
+
+    def make_splits(self, y: np.ndarray) -> np.ndarray:
+        return self._kfold_masks(y, self.num_folds)
+
+
+class OpTrainValidationSplit(OpValidator):
+    """Single train/validation split (reference OpTrainValidationSplit.scala,
+    default ratio 0.75)."""
+
+    def __init__(self, train_ratio: float = 0.75, **kw):
+        super().__init__(**kw)
+        if not 0.0 < train_ratio < 1.0:
+            raise ValueError("train_ratio must be in (0, 1)")
+        self.train_ratio = train_ratio
+
+    def make_splits(self, y: np.ndarray) -> np.ndarray:
+        n = len(y)
+        rng = np.random.RandomState(self.seed)
+        val = np.zeros((1, n), dtype=bool)
+        if self.stratify:
+            for lab in np.unique(y):
+                idx = rng.permutation(np.nonzero(y == lab)[0])
+                n_val = int(round(len(idx) * (1.0 - self.train_ratio)))
+                val[0, idx[:n_val]] = True
+        else:
+            perm = rng.permutation(n)
+            val[0, perm[: int(round(n * (1.0 - self.train_ratio)))]] = True
+        return val

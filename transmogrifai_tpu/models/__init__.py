@@ -1,0 +1,2 @@
+from .api import ModelFamily, FittedParams, MODEL_REGISTRY, register_family
+from . import linear  # noqa: F401  (registers linear families)

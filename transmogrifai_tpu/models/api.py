@@ -1,0 +1,78 @@
+"""Model-family API — the TPU re-design of the reference's Spark model wrappers.
+
+The reference wraps SparkML ``Predictor``s (reference:
+core/.../sparkwrappers/specific/OpPredictorWrapper.scala:67-122) and fits one
+JVM job per (model, paramMap, fold). Here a *family* exposes batched, jitted
+fits: ``fit_batch`` consumes stacked hyperparameters plus per-configuration
+row weights and returns stacked parameters — so ModelSelector's whole
+``|grid| × |folds|`` sweep compiles to ONE XLA program of MXU matmuls instead
+of thousands of Spark jobs (the SURVEY §2.10 P2 axis, the north-star metric).
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class FittedParams:
+    """One fitted configuration's parameters (a pytree of arrays) plus the
+    hyperparameters that produced it."""
+    family: str
+    params: Any
+    hyper: Dict[str, Any]
+    num_classes: int = 2
+
+
+class ModelFamily(abc.ABC):
+    """A homogeneous model family whose hyperparameter grid can be vmapped."""
+
+    #: family name, e.g. "OpLogisticRegression"
+    name: str = ""
+    #: problem kinds: subset of {"binary", "multiclass", "regression"}
+    supports: frozenset = frozenset()
+
+    @abc.abstractmethod
+    def default_grid(self, problem: str) -> List[Dict[str, Any]]:
+        """Default hyperparameter grid (reference DefaultSelectorParams)."""
+
+    @abc.abstractmethod
+    def fit_batch(self, X: jnp.ndarray, y: jnp.ndarray,
+                  weights: jnp.ndarray, grid: Dict[str, jnp.ndarray],
+                  num_classes: int) -> Any:
+        """Fit B configurations at once.
+
+        X: (n, d); y: (n,); weights: (B, n) row weights (0 = excluded);
+        grid: dict of (B,) hyperparameter arrays. Returns stacked params with
+        leading axis B.
+        """
+
+    @abc.abstractmethod
+    def predict_batch(self, params: Any, X: jnp.ndarray,
+                      num_classes: int) -> jnp.ndarray:
+        """Scores for stacked params: (B, n) margins / (B, n, C) probabilities."""
+
+    @abc.abstractmethod
+    def predict_one(self, fitted: FittedParams, X: jnp.ndarray) -> Dict[str, np.ndarray]:
+        """Single-model prediction parts: {'prediction', 'probability'?, 'rawPrediction'?}."""
+
+    def select_params(self, batched: Any, idx: int) -> Any:
+        """Extract configuration ``idx`` from stacked params."""
+        import jax
+        return jax.tree_util.tree_map(lambda a: np.asarray(a[idx]), batched)
+
+    def grid_to_arrays(self, grid: Sequence[Dict[str, Any]]) -> Dict[str, jnp.ndarray]:
+        keys = sorted({k for g in grid for k in g})
+        return {k: jnp.asarray([g[k] for g in grid], dtype=jnp.float32) for k in keys}
+
+
+MODEL_REGISTRY: Dict[str, ModelFamily] = {}
+
+
+def register_family(family: ModelFamily) -> ModelFamily:
+    MODEL_REGISTRY[family.name] = family
+    return family

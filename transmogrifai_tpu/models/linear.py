@@ -1,0 +1,371 @@
+"""Linear model families: logistic regression, linear/ridge regression,
+linear SVC, naive Bayes.
+
+TPU-native replacements for the reference's SparkML wrappers
+(reference: core/.../impl/classification/OpLogisticRegression.scala,
+OpLinearSVC.scala, OpNaiveBayes.scala, impl/regression/OpLinearRegression.scala).
+Each family fits its whole hyperparameter × fold batch in ONE jitted, vmapped
+XLA program: the inner loop is prox-Newton / closed-form solves built from
+(n,d)ᵀ(n,d) MXU matmuls, and per-configuration 0/1 row-weight vectors express
+CV folds without reshaping data.
+
+Conventions (matching Spark ML so reference grids transfer):
+* objective = mean loss + regParam * (α·‖w‖₁ + (1-α)/2·‖w‖₂²), bias unpenalized
+* features are standardized internally (Spark standardization=true default);
+  coefficients are reported in the original scale.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .api import FittedParams, ModelFamily, register_family
+
+_PREC = jax.lax.Precision.HIGHEST
+
+
+def _standardize(X: jnp.ndarray, w: jnp.ndarray):
+    """Weighted feature standardization; returns (Xs, mean, scale)."""
+    cnt = jnp.maximum(w.sum(), 1.0)
+    mean = (X * w[:, None]).sum(0) / cnt
+    var = ((X - mean) ** 2 * w[:, None]).sum(0) / cnt
+    scale = jnp.sqrt(jnp.maximum(var, 1e-12))
+    return (X - mean) / scale, mean, scale
+
+
+def _unscale(coef_s: jnp.ndarray, bias_s: jnp.ndarray, mean: jnp.ndarray,
+             scale: jnp.ndarray):
+    coef = coef_s / scale
+    bias = bias_s - (coef * mean).sum()
+    return coef, bias
+
+
+# ---------------------------------------------------------------------------
+# Binary logistic regression — prox-Newton (IRLS + coordinate-wise soft
+# thresholding for the L1 part)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("iters",))
+def _fit_logreg(X, y, w, reg, elastic_net, iters=25):
+    n, d = X.shape
+    Xs, mean, scale = _standardize(X, w)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+
+    def step(carry, _):
+        coef, bias = carry
+        z = Xs @ coef + bias
+        p = jax.nn.sigmoid(z)
+        s = jnp.maximum(p * (1 - p), 1e-6) * w
+        g_coef = (Xs * (w * (p - y))[:, None]).sum(0) / cnt + l2 * coef
+        g_bias = (w * (p - y)).sum() / cnt
+        H = jnp.einsum("ni,nj->ij", Xs * s[:, None], Xs, precision=_PREC) / cnt
+        H = H + (l2 + 1e-8) * jnp.eye(d, dtype=X.dtype)
+        h_bias = s.sum() / cnt + 1e-8
+        Hx_b = (Xs * s[:, None]).sum(0) / cnt
+        # full (d+1) system with bias row/col
+        Ha = jnp.zeros((d + 1, d + 1), X.dtype)
+        Ha = Ha.at[:d, :d].set(H).at[d, d].set(h_bias)
+        Ha = Ha.at[:d, d].set(Hx_b).at[d, :d].set(Hx_b)
+        g = jnp.concatenate([g_coef, jnp.array([g_bias], X.dtype)])
+        delta = jnp.linalg.solve(Ha, g)
+        coef = coef - delta[:d]
+        bias = bias - delta[d]
+        # prox step for L1 in the diagonal-Hessian metric
+        thresh = l1 / jnp.maximum(jnp.diag(H), 1e-8)
+        coef = jnp.where(l1 > 0,
+                         jnp.sign(coef) * jnp.maximum(jnp.abs(coef) - thresh, 0.0),
+                         coef)
+        return (coef, bias), None
+
+    init = (jnp.zeros((d,), X.dtype), jnp.asarray(0.0, X.dtype))
+    (coef_s, bias_s), _ = jax.lax.scan(step, init, None, length=iters)
+    coef, bias = _unscale(coef_s, bias_s, mean, scale)
+    return coef, bias
+
+
+_fit_logreg_batch = jax.jit(
+    jax.vmap(_fit_logreg, in_axes=(None, None, 0, 0, 0)),
+    static_argnames=())
+
+
+class LogisticRegressionFamily(ModelFamily):
+    """reference OpLogisticRegression (defaults: regParam [0.01,0.1,0.2],
+    elasticNetParam [0,0.5] — DefaultSelectorParams.scala)."""
+
+    name = "OpLogisticRegression"
+    supports = frozenset({"binary", "multiclass"})
+
+    def default_grid(self, problem: str) -> List[Dict[str, Any]]:
+        return [{"regParam": r, "elasticNetParam": e}
+                for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]
+
+    def fit_batch(self, X, y, weights, grid, num_classes):
+        if num_classes <= 2:
+            coef, bias = _fit_logreg_batch(
+                X, y, weights, grid["regParam"], grid["elasticNetParam"])
+            return {"coef": coef, "bias": bias}
+        W, b = _fit_softmax_batch(X, y.astype(jnp.int32), weights,
+                                  grid["regParam"], num_classes)
+        return {"W": W, "b": b}
+
+    def predict_batch(self, params, X, num_classes):
+        if num_classes <= 2:
+            return jax.nn.sigmoid(
+                jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC)
+                + params["bias"][:, None])
+        logits = jnp.einsum("bdc,nd->bnc", params["W"], X, precision=_PREC) \
+            + params["b"][:, None, :]
+        return jax.nn.softmax(logits, axis=-1)
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        if fitted.num_classes <= 2:
+            margin = X @ fitted.params["coef"] + fitted.params["bias"]
+            p1 = jax.nn.sigmoid(margin)
+            prob = jnp.stack([1 - p1, p1], axis=1)
+            raw = jnp.stack([-margin, margin], axis=1)
+        else:
+            raw = X @ fitted.params["W"] + fitted.params["b"]
+            prob = jax.nn.softmax(raw, axis=-1)
+        pred = prob.argmax(axis=1).astype(jnp.float32)
+        return {"prediction": np.asarray(pred),
+                "probability": np.asarray(prob),
+                "rawPrediction": np.asarray(raw)}
+
+
+@partial(jax.jit, static_argnames=("num_classes", "iters"))
+def _fit_softmax(X, y_idx, w, reg, num_classes, iters=200):
+    """Multinomial logistic regression via full-batch Adam (fixed-length scan)."""
+    n, d = X.shape
+    Xs, mean, scale = _standardize(X, w)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    Y = jax.nn.one_hot(y_idx, num_classes, dtype=X.dtype)
+
+    def loss_fn(params):
+        W, b = params
+        logits = Xs @ W + b
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -(Y * lp).sum(axis=1) * w
+        return nll.sum() / cnt + 0.5 * reg * (W ** 2).sum()
+
+    # hand-rolled Adam (optax pulls jax.experimental.checkify, which clashes
+    # with the axon platform-registry rewrite in this environment)
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+    params = (jnp.zeros((d, num_classes), X.dtype),
+              jnp.zeros((num_classes,), X.dtype))
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def step(carry, i):
+        params, m, v = carry
+        g = jax.grad(loss_fn)(params)
+        m = jax.tree_util.tree_map(lambda a, b: b1 * a + (1 - b1) * b, m, g)
+        v = jax.tree_util.tree_map(lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+        t = i + 1.0
+        params = jax.tree_util.tree_map(
+            lambda p, mm, vv: p - lr * (mm / (1 - b1 ** t)) /
+            (jnp.sqrt(vv / (1 - b2 ** t)) + eps), params, m, v)
+        return (params, m, v), None
+
+    (params, _, _), _ = jax.lax.scan(
+        step, (params, zeros, zeros), jnp.arange(iters, dtype=X.dtype))
+    W_s, b_s = params
+    W = W_s / scale[:, None]
+    b = b_s - (W * mean[:, None]).sum(0)
+    return W, b
+
+
+_fit_softmax_batch = jax.jit(
+    jax.vmap(_fit_softmax, in_axes=(None, None, 0, 0, None)),
+    static_argnames=("num_classes", "iters"))
+
+
+# ---------------------------------------------------------------------------
+# Linear / ridge regression — closed form + ISTA refinement for L1
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("l1_iters",))
+def _fit_linreg(X, y, w, reg, elastic_net, l1_iters=60):
+    n, d = X.shape
+    Xs, mean, scale = _standardize(X, w)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+    Xa = jnp.concatenate([Xs, jnp.ones((n, 1), X.dtype)], axis=1)
+    A = jnp.einsum("ni,nj->ij", Xa * w[:, None], Xa, precision=_PREC) / cnt
+    A = A + jnp.diag(jnp.concatenate([jnp.full((d,), l2), jnp.zeros((1,))])) \
+        + 1e-8 * jnp.eye(d + 1, dtype=X.dtype)
+    rhs = (Xa * (w * y)[:, None]).sum(0) / cnt
+    theta = jnp.linalg.solve(A, rhs)
+
+    # ISTA refinement handles the L1 part (no-op when l1 == 0)
+    lips = jnp.trace(A)  # cheap Lipschitz upper bound for the quadratic part
+    step_sz = 1.0 / jnp.maximum(lips, 1e-6)
+
+    def ista(theta, _):
+        grad = A @ theta - rhs
+        t = theta - step_sz * grad
+        coef = jnp.sign(t[:d]) * jnp.maximum(jnp.abs(t[:d]) - step_sz * l1, 0.0)
+        return jnp.concatenate([coef, t[d:]]), None
+
+    theta = jax.lax.cond(
+        l1 > 0,
+        lambda th: jax.lax.scan(ista, th, None, length=l1_iters)[0],
+        lambda th: th, theta)
+    coef, bias = _unscale(theta[:d], theta[d], mean, scale)
+    return coef, bias
+
+
+_fit_linreg_batch = jax.jit(jax.vmap(_fit_linreg, in_axes=(None, None, 0, 0, 0)))
+
+
+class LinearRegressionFamily(ModelFamily):
+    """reference OpLinearRegression (defaults: regParam [0.001,0.01,0.1],
+    elasticNetParam [0,0.5])."""
+
+    name = "OpLinearRegression"
+    supports = frozenset({"regression"})
+
+    def default_grid(self, problem: str) -> List[Dict[str, Any]]:
+        return [{"regParam": r, "elasticNetParam": e}
+                for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]
+
+    def fit_batch(self, X, y, weights, grid, num_classes):
+        coef, bias = _fit_linreg_batch(
+            X, y, weights, grid["regParam"], grid["elasticNetParam"])
+        return {"coef": coef, "bias": bias}
+
+    def predict_batch(self, params, X, num_classes):
+        return jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC) \
+            + params["bias"][:, None]
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        pred = X @ fitted.params["coef"] + fitted.params["bias"]
+        return {"prediction": np.asarray(pred)}
+
+
+# ---------------------------------------------------------------------------
+# Linear SVC — squared hinge + L2, Nesterov accelerated GD
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("iters",))
+def _fit_svc(X, y, w, reg, iters=150):
+    n, d = X.shape
+    Xs, mean, scale = _standardize(X, w)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    ypm = 2.0 * y - 1.0  # {0,1} → {-1,+1}
+
+    def loss_grad(theta):
+        coef, bias = theta[:d], theta[d]
+        m = ypm * (Xs @ coef + bias)
+        act = jnp.maximum(1.0 - m, 0.0)
+        g_m = -2.0 * act * ypm * w
+        g_coef = (Xs * g_m[:, None]).sum(0) / cnt + reg * coef
+        g_bias = g_m.sum() / cnt
+        return jnp.concatenate([g_coef, jnp.array([g_bias], X.dtype)])
+
+    # Lipschitz ≈ 2·mean row-norm² (+ reg); standardized rows → ‖x‖² ≈ d
+    lr = 1.0 / (2.0 * d / 4.0 + reg + 1.0)
+
+    def step(carry, _):
+        theta, theta_prev, t = carry
+        mom = theta + (t - 1.0) / (t + 2.0) * (theta - theta_prev)
+        nxt = mom - lr * loss_grad(mom)
+        return (nxt, theta, t + 1.0), None
+
+    z = jnp.zeros((d + 1,), X.dtype)
+    (theta, _, _), _ = jax.lax.scan(step, (z, z, jnp.asarray(1.0, X.dtype)),
+                                    None, length=iters)
+    coef, bias = _unscale(theta[:d], theta[d], mean, scale)
+    return coef, bias
+
+
+_fit_svc_batch = jax.jit(jax.vmap(_fit_svc, in_axes=(None, None, 0, 0)))
+
+
+class LinearSVCFamily(ModelFamily):
+    """reference OpLinearSVC (defaults: regParam [0.01,0.1,0.2])."""
+
+    name = "OpLinearSVC"
+    supports = frozenset({"binary"})
+
+    def default_grid(self, problem: str) -> List[Dict[str, Any]]:
+        return [{"regParam": r} for r in (0.01, 0.1, 0.2)]
+
+    def fit_batch(self, X, y, weights, grid, num_classes):
+        coef, bias = _fit_svc_batch(X, y, weights, grid["regParam"])
+        return {"coef": coef, "bias": bias}
+
+    def predict_batch(self, params, X, num_classes):
+        # margins; rank-based metrics (AuROC/AuPR) work on margins directly
+        return jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC) \
+            + params["bias"][:, None]
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        margin = X @ fitted.params["coef"] + fitted.params["bias"]
+        pred = (margin > 0).astype(jnp.float32)
+        raw = jnp.stack([-margin, margin], axis=1)
+        return {"prediction": np.asarray(pred), "rawPrediction": np.asarray(raw)}
+
+
+# ---------------------------------------------------------------------------
+# Naive Bayes — multinomial with Laplace smoothing (closed-form counting)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _fit_nb(X, y_idx, w, smoothing, num_classes):
+    Xp = jnp.maximum(X, 0.0)  # multinomial NB needs nonnegative counts
+    Y = jax.nn.one_hot(y_idx, num_classes, dtype=X.dtype) * w[:, None]
+    class_cnt = Y.sum(0)
+    feat_cnt = jnp.einsum("nc,nd->cd", Y, Xp, precision=_PREC)
+    d = X.shape[1]
+    log_prob = jnp.log(feat_cnt + smoothing) - \
+        jnp.log(feat_cnt.sum(1, keepdims=True) + smoothing * d)
+    log_prior = jnp.log(jnp.maximum(class_cnt, 1e-12) /
+                        jnp.maximum(class_cnt.sum(), 1e-12))
+    return log_prob, log_prior
+
+
+_fit_nb_batch = jax.jit(jax.vmap(_fit_nb, in_axes=(None, None, 0, 0, None)),
+                        static_argnames=("num_classes",))
+
+
+class NaiveBayesFamily(ModelFamily):
+    """reference OpNaiveBayes (default smoothing 1.0)."""
+
+    name = "OpNaiveBayes"
+    supports = frozenset({"binary", "multiclass"})
+
+    def default_grid(self, problem: str) -> List[Dict[str, Any]]:
+        return [{"smoothing": s} for s in (0.5, 1.0, 2.0)]
+
+    def fit_batch(self, X, y, weights, grid, num_classes):
+        lp, prior = _fit_nb_batch(X, y.astype(jnp.int32), weights,
+                                  grid["smoothing"], max(num_classes, 2))
+        return {"log_prob": lp, "log_prior": prior}
+
+    def predict_batch(self, params, X, num_classes):
+        Xp = jnp.maximum(X, 0.0)
+        logits = jnp.einsum("bcd,nd->bnc", params["log_prob"], Xp,
+                            precision=_PREC) + params["log_prior"][:, None, :]
+        if num_classes <= 2:
+            return jax.nn.softmax(logits, axis=-1)[:, :, 1]
+        return jax.nn.softmax(logits, axis=-1)
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        Xp = jnp.maximum(X, 0.0)
+        raw = Xp @ fitted.params["log_prob"].T + fitted.params["log_prior"]
+        prob = jax.nn.softmax(raw, axis=-1)
+        pred = prob.argmax(axis=1).astype(jnp.float32)
+        return {"prediction": np.asarray(pred), "probability": np.asarray(prob),
+                "rawPrediction": np.asarray(raw)}
+
+
+register_family(LogisticRegressionFamily())
+register_family(LinearRegressionFamily())
+register_family(LinearSVCFamily())
+register_family(NaiveBayesFamily())

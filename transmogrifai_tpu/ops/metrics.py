@@ -1,0 +1,227 @@
+"""Jitted evaluation-metric kernels.
+
+TPU replacements for Spark MLlib's BinaryClassificationMetrics /
+MulticlassMetrics / RegressionMetrics used by the reference evaluators
+(reference: core/.../evaluators/OpBinaryClassificationEvaluator.scala:68,
+OpMultiClassificationEvaluator.scala, OpRegressionEvaluator.scala): sort-based
+scans on device instead of RDD aggregations.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .stats import _rank
+
+
+@jax.jit
+def binary_confusion(scores: jnp.ndarray, labels: jnp.ndarray,
+                     threshold: float = 0.5):
+    """(tp, tn, fp, fn) at a score threshold."""
+    pred = (scores >= threshold).astype(jnp.float32)
+    pos = (labels > 0.5).astype(jnp.float32)
+    tp = (pred * pos).sum()
+    fp = (pred * (1 - pos)).sum()
+    fn = ((1 - pred) * pos).sum()
+    tn = ((1 - pred) * (1 - pos)).sum()
+    return tp, tn, fp, fn
+
+
+@jax.jit
+def auroc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Exact AuROC via the Mann-Whitney rank formula (tie-correct)."""
+    pos = (labels > 0.5).astype(scores.dtype)
+    n_pos = pos.sum()
+    n_neg = pos.shape[0] - n_pos
+    ranks = _rank(scores)
+    pos_rank_sum = (ranks * pos).sum()
+    u = pos_rank_sum - n_pos * (n_pos + 1) / 2.0
+    return jnp.where((n_pos > 0) & (n_neg > 0), u / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
+
+
+@jax.jit
+def aupr(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Area under the precision-recall curve, linear interpolation over
+    distinct-threshold boundary points (matches Spark's areaUnderPR up to its
+    first-point convention)."""
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    y = (labels[order] > 0.5).astype(scores.dtype)
+    cum_tp = jnp.cumsum(y)
+    cum_fp = jnp.cumsum(1.0 - y)
+    n_pos = jnp.maximum(cum_tp[-1], 1.0)
+    # points valid only at tie-group boundaries (last index of equal scores)
+    boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
+    recall = cum_tp / n_pos
+    precision = cum_tp / jnp.maximum(cum_tp + cum_fp, 1.0)
+    # previous boundary's (recall, precision) for each boundary point
+    idx = jnp.arange(n)
+    b_idx = jnp.where(boundary, idx, -1)
+    prev_b = jnp.concatenate([jnp.array([-1]), jax.lax.cummax(b_idx)[:-1]])
+    r_prev = jnp.where(prev_b >= 0, recall[jnp.maximum(prev_b, 0)], 0.0)
+    p_prev = jnp.where(prev_b >= 0, precision[jnp.maximum(prev_b, 0)], 1.0)
+    seg = (recall - r_prev) * (precision + p_prev) / 2.0
+    return jnp.where(boundary, seg, 0.0).sum()
+
+
+@jax.jit
+def auroc_masked(scores: jnp.ndarray, labels: jnp.ndarray,
+                 mask: jnp.ndarray) -> jnp.ndarray:
+    """AuROC over the masked subset. Masked rows get +inf scores (ranking above
+    all valid rows, so valid ranks 1..n_valid are unchanged) and are excluded
+    from the positive/negative counts — used inside vmapped CV where every fold
+    shares one static shape."""
+    s = jnp.where(mask, scores, jnp.inf)
+    pos = (labels > 0.5) & mask
+    n_pos = pos.sum().astype(scores.dtype)
+    n_neg = mask.sum().astype(scores.dtype) - n_pos
+    ranks = _rank(s)
+    pos_rank_sum = (ranks * pos.astype(scores.dtype)).sum()
+    u = pos_rank_sum - n_pos * (n_pos + 1) / 2.0
+    return jnp.where((n_pos > 0) & (n_neg > 0), u / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
+
+
+@jax.jit
+def aupr_masked(scores: jnp.ndarray, labels: jnp.ndarray,
+                mask: jnp.ndarray) -> jnp.ndarray:
+    """AuPR over the masked subset (masked rows sink to -inf and contribute
+    nothing to cumulative TP/FP, so curve deltas in their range are zero)."""
+    n = scores.shape[0]
+    s_in = jnp.where(mask, scores, -jnp.inf)
+    order = jnp.argsort(-s_in)
+    s = s_in[order]
+    valid = mask[order].astype(scores.dtype)
+    y = (labels[order] > 0.5).astype(scores.dtype) * valid
+    cum_tp = jnp.cumsum(y)
+    cum_fp = jnp.cumsum(valid - y)
+    n_pos = jnp.maximum(cum_tp[-1], 1.0)
+    boundary = jnp.concatenate([s[1:] != s[:-1], jnp.array([True])])
+    recall = cum_tp / n_pos
+    precision = cum_tp / jnp.maximum(cum_tp + cum_fp, 1.0)
+    idx = jnp.arange(n)
+    b_idx = jnp.where(boundary, idx, -1)
+    prev_b = jnp.concatenate([jnp.array([-1]), jax.lax.cummax(b_idx)[:-1]])
+    r_prev = jnp.where(prev_b >= 0, recall[jnp.maximum(prev_b, 0)], 0.0)
+    p_prev = jnp.where(prev_b >= 0, precision[jnp.maximum(prev_b, 0)], 1.0)
+    seg = (recall - r_prev) * (precision + p_prev) / 2.0
+    return jnp.where(boundary, seg, 0.0).sum()
+
+
+@jax.jit
+def regression_metrics_masked(pred: jnp.ndarray, label: jnp.ndarray,
+                              mask: jnp.ndarray):
+    w = mask.astype(pred.dtype)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    err = (pred - label) * w
+    mse = (err ** 2).sum() / cnt
+    label_mean = (label * w).sum() / cnt
+    ss_tot = (((label - label_mean) * w) ** 2).sum()
+    r2 = jnp.where(ss_tot > 0, 1.0 - (err ** 2).sum() / jnp.maximum(ss_tot, 1e-30), 0.0)
+    return {"RootMeanSquaredError": jnp.sqrt(mse), "MeanSquaredError": mse,
+            "MeanAbsoluteError": jnp.abs(err).sum() / cnt, "R2": r2}
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def multiclass_f1_masked(pred_idx: jnp.ndarray, label_idx: jnp.ndarray,
+                         mask: jnp.ndarray, num_classes: int) -> jnp.ndarray:
+    """Weighted F1 over the masked subset (vmapped-CV fast path)."""
+    w = mask.astype(jnp.float32)
+    p = jax.nn.one_hot(pred_idx, num_classes, dtype=jnp.float32) * w[:, None]
+    l = jax.nn.one_hot(label_idx, num_classes, dtype=jnp.float32) * w[:, None]
+    cm = l.T @ p
+    n = jnp.maximum(cm.sum(), 1.0)
+    support = cm.sum(axis=1)
+    pred_cnt = cm.sum(axis=0)
+    tp = jnp.diag(cm)
+    prec_c = tp / jnp.maximum(pred_cnt, 1.0)
+    rec_c = tp / jnp.maximum(support, 1.0)
+    f1_c = jnp.where(prec_c + rec_c > 0,
+                     2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, 1e-30), 0.0)
+    return (f1_c * support / n).sum()
+
+
+@partial(jax.jit, static_argnames=("num_bins",))
+def threshold_metrics(scores: jnp.ndarray, labels: jnp.ndarray,
+                      num_bins: int = 100):
+    """Precision/recall/F1 over evenly spaced thresholds (reference
+    threshold curves in BinaryClassificationMetrics)."""
+    thresholds = jnp.linspace(0.0, 1.0, num_bins)
+    pos = (labels > 0.5).astype(scores.dtype)
+    n_pos = jnp.maximum(pos.sum(), 1.0)
+
+    def at(t):
+        pred = (scores >= t).astype(scores.dtype)
+        tp = (pred * pos).sum()
+        fp = (pred * (1 - pos)).sum()
+        prec = tp / jnp.maximum(tp + fp, 1.0)
+        rec = tp / n_pos
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-30), 0.0)
+        return prec, rec, f1
+
+    prec, rec, f1 = jax.vmap(at)(thresholds)
+    return thresholds, prec, rec, f1
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def multiclass_confusion(pred_idx: jnp.ndarray, label_idx: jnp.ndarray,
+                         num_classes: int) -> jnp.ndarray:
+    """(C, C) confusion matrix rows=label, cols=pred — one-hot matmul."""
+    p = jax.nn.one_hot(pred_idx, num_classes, dtype=jnp.float32)
+    l = jax.nn.one_hot(label_idx, num_classes, dtype=jnp.float32)
+    return l.T @ p
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def multiclass_metrics(pred_idx: jnp.ndarray, label_idx: jnp.ndarray,
+                       num_classes: int):
+    """error, weighted precision/recall/F1 (reference
+    OpMultiClassificationEvaluator default metrics)."""
+    cm = multiclass_confusion(pred_idx, label_idx, num_classes)
+    n = jnp.maximum(cm.sum(), 1.0)
+    correct = jnp.trace(cm)
+    support = cm.sum(axis=1)                   # per true class
+    pred_cnt = cm.sum(axis=0)
+    tp = jnp.diag(cm)
+    prec_c = tp / jnp.maximum(pred_cnt, 1.0)
+    rec_c = tp / jnp.maximum(support, 1.0)
+    f1_c = jnp.where(prec_c + rec_c > 0,
+                     2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, 1e-30), 0.0)
+    w = support / n
+    return {
+        "Error": 1.0 - correct / n,
+        "Precision": (prec_c * w).sum(),
+        "Recall": (rec_c * w).sum(),
+        "F1": (f1_c * w).sum(),
+    }
+
+
+@jax.jit
+def regression_metrics(pred: jnp.ndarray, label: jnp.ndarray):
+    """RMSE/MSE/MAE/R² (reference OpRegressionEvaluator.scala)."""
+    err = pred - label
+    mse = (err ** 2).mean()
+    mae = jnp.abs(err).mean()
+    ss_res = (err ** 2).sum()
+    ss_tot = ((label - label.mean()) ** 2).sum()
+    r2 = jnp.where(ss_tot > 0, 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30), 0.0)
+    return {"RootMeanSquaredError": jnp.sqrt(mse), "MeanSquaredError": mse,
+            "MeanAbsoluteError": mae, "R2": r2}
+
+
+@jax.jit
+def log_loss(prob_pos: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Binary log loss (reference impl/evaluator/OPLogLoss.scala)."""
+    p = jnp.clip(prob_pos, 1e-15, 1 - 1e-15)
+    y = (labels > 0.5).astype(prob_pos.dtype)
+    return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)).mean()
+
+
+@jax.jit
+def multiclass_log_loss(probs: jnp.ndarray, label_idx: jnp.ndarray) -> jnp.ndarray:
+    p = jnp.clip(probs, 1e-15, 1.0)
+    picked = jnp.take_along_axis(p, label_idx[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return -jnp.log(picked).mean()

@@ -1,0 +1,178 @@
+"""Jitted statistics kernels over masked columnar data.
+
+The TPU replacements for Spark MLlib's distributed statistics
+(reference: mllib.stat.Statistics.colStats/corr used by SanityChecker.scala:574-638,
+utils/.../stats/OpStatistics.scala): one pass of fused XLA reductions instead of
+``treeAggregate`` over RDD partitions. All kernels take an explicit validity
+mask so null semantics match the reference's Option-valued columns, and all are
+``shard_map``-friendly: they reduce over the row axis only, so under a mesh the
+row-sharded version just wraps them in psum (see transmogrifai_tpu.parallel).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ColStats(NamedTuple):
+    """Per-column moments (analog of mllib MultivariateStatisticalSummary)."""
+    count: jnp.ndarray      # valid count per column
+    mean: jnp.ndarray
+    variance: jnp.ndarray   # unbiased (n-1), matching Spark colStats
+    min: jnp.ndarray
+    max: jnp.ndarray
+    num_nonzeros: jnp.ndarray
+
+
+@jax.jit
+def col_stats(x: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> ColStats:
+    """Masked per-column stats of an (n, d) matrix in one fused pass."""
+    n, d = x.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    m = mask[:, None].astype(x.dtype) if mask.ndim == 1 else mask.astype(x.dtype)
+    cnt = m.sum(axis=0)
+    safe_cnt = jnp.maximum(cnt, 1.0)
+    xm = x * m
+    mean = xm.sum(axis=0) / safe_cnt
+    sq = (x - mean[None, :]) ** 2 * m
+    var = sq.sum(axis=0) / jnp.maximum(cnt - 1.0, 1.0)
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    mn = jnp.where(m > 0, x, big).min(axis=0)
+    mx = jnp.where(m > 0, x, -big).max(axis=0)
+    nz = ((xm != 0) & (m > 0)).sum(axis=0)
+    return ColStats(cnt, mean, var,
+                    jnp.where(cnt > 0, mn, 0.0), jnp.where(cnt > 0, mx, 0.0),
+                    nz)
+
+
+@jax.jit
+def pearson_correlation(x: jnp.ndarray, y: jnp.ndarray,
+                        mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Masked Pearson correlation of each column of (n, d) x against y (n,).
+
+    Analog of ``Statistics.corr(labelAndSample)`` label-column mode used by
+    SanityChecker.scala:634-638. NaN where a column is constant (matching
+    Spark's NaN correlation for zero variance).
+    """
+    n, d = x.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    m = mask.astype(x.dtype)
+    cnt = jnp.maximum(m.sum(), 1.0)
+    ym = y * m
+    y_mean = ym.sum() / cnt
+    yc = (y - y_mean) * m
+    x_mean = (x * m[:, None]).sum(axis=0) / cnt
+    xc = (x - x_mean[None, :]) * m[:, None]
+    cov = (xc * yc[:, None]).sum(axis=0)
+    xvar = (xc ** 2).sum(axis=0)
+    yvar = (yc ** 2).sum()
+    denom = jnp.sqrt(xvar * yvar)
+    return jnp.where(denom > 0, cov / jnp.maximum(denom, 1e-30), jnp.nan)
+
+
+@jax.jit
+def pearson_correlation_matrix(x: jnp.ndarray,
+                               mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Full (d, d) correlation matrix (SanityChecker correlationType full mode)."""
+    n, d = x.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    m = mask.astype(x.dtype)
+    cnt = jnp.maximum(m.sum(), 1.0)
+    mean = (x * m[:, None]).sum(axis=0) / cnt
+    xc = (x - mean[None, :]) * m[:, None]
+    cov = xc.T @ xc                      # MXU matmul
+    std = jnp.sqrt(jnp.diag(cov))
+    denom = std[:, None] * std[None, :]
+    return jnp.where(denom > 0, cov / jnp.maximum(denom, 1e-30), jnp.nan)
+
+
+def _rank(v: jnp.ndarray) -> jnp.ndarray:
+    """Average-tie ranks, jit-friendly (for Spearman)."""
+    n = v.shape[0]
+    order = jnp.argsort(v)
+    sorted_v = v[order]
+    ranks_ord = jnp.arange(1, n + 1, dtype=v.dtype)
+    # average ranks over ties: segment by value
+    is_new = jnp.concatenate([jnp.array([True]), sorted_v[1:] != sorted_v[:-1]])
+    seg = jnp.cumsum(is_new) - 1
+    seg_sum = jax.ops.segment_sum(ranks_ord, seg, num_segments=n)
+    seg_cnt = jax.ops.segment_sum(jnp.ones_like(ranks_ord), seg, num_segments=n)
+    avg = seg_sum / jnp.maximum(seg_cnt, 1.0)
+    ranks_sorted = avg[seg]
+    return jnp.zeros_like(ranks_sorted).at[order].set(ranks_sorted)
+
+
+@jax.jit
+def spearman_correlation(x: jnp.ndarray, y: jnp.ndarray,
+                         mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Masked Spearman correlation per column: Pearson over ranks.
+
+    Invalid rows are ranked but excluded from the correlation via the mask
+    (rank distortion from masked rows is bounded and matches sampling noise;
+    exact masked ranking would need per-column sorts of varying length, which
+    breaks static shapes)."""
+    ranks_x = jax.vmap(_rank, in_axes=1, out_axes=1)(x)
+    rank_y = _rank(y)
+    return pearson_correlation(ranks_x, rank_y, mask)
+
+
+class ContingencyStats(NamedTuple):
+    """Per-categorical-group association stats (reference
+    OpStatistics.contingencyStats:300 — chi², Cramér's V, PMI, mutual info,
+    max rule confidence/support)."""
+    chi2: jnp.ndarray
+    cramers_v: jnp.ndarray
+    mutual_info: jnp.ndarray
+    pointwise_mutual_info: jnp.ndarray   # (k, L) PMI per cell
+    max_rule_confidence: jnp.ndarray     # max over labels of P(label|feature value)
+    support: jnp.ndarray                 # P(feature value)
+
+
+@partial(jax.jit, static_argnames=("num_labels",))
+def contingency_table(indicators: jnp.ndarray, label_idx: jnp.ndarray,
+                      num_labels: int, mask: Optional[jnp.ndarray] = None
+                      ) -> jnp.ndarray:
+    """(k, L) contingency counts from (n, k) 0/1 indicator columns and integer
+    labels — the SanityChecker ``reduceByKey`` replacement
+    (SanityChecker.scala:433-440): one one-hot matmul on the MXU."""
+    n, k = indicators.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    label_onehot = jax.nn.one_hot(label_idx, num_labels, dtype=indicators.dtype)
+    label_onehot = label_onehot * mask[:, None].astype(indicators.dtype)
+    return indicators.T @ label_onehot
+
+
+@partial(jax.jit, static_argnames=("total_is_rows",))
+def contingency_stats(table: jnp.ndarray, total_is_rows: bool = True
+                      ) -> ContingencyStats:
+    """Association statistics from a (k, L) contingency table (reference
+    OpStatistics.contingencyStats:300)."""
+    t = table.astype(jnp.float64) if jax.config.jax_enable_x64 else table.astype(jnp.float32)
+    n = jnp.maximum(t.sum(), 1.0)
+    row = t.sum(axis=1)            # per feature-value counts
+    col = t.sum(axis=0)            # per label counts
+    expected = row[:, None] * col[None, :] / n
+    chi2 = jnp.where(expected > 0, (t - expected) ** 2 / jnp.maximum(expected, 1e-30), 0.0).sum()
+    k = (row > 0).sum()
+    l = (col > 0).sum()
+    min_dim = jnp.maximum(jnp.minimum(k, l) - 1, 1)
+    cramers_v = jnp.sqrt(chi2 / (n * min_dim))
+    p = t / n
+    p_row = row / n
+    p_col = col / n
+    denom = p_row[:, None] * p_col[None, :]
+    pmi = jnp.where((p > 0) & (denom > 0),
+                    jnp.log2(jnp.maximum(p, 1e-30) / jnp.maximum(denom, 1e-30)), 0.0)
+    mi = (p * pmi).sum()
+    conf = jnp.where(row[:, None] > 0, t / jnp.maximum(row[:, None], 1e-30), 0.0)
+    max_conf = conf.max(axis=1)
+    support = row / n
+    return ContingencyStats(chi2, cramers_v, mi, pmi, max_conf, support)

@@ -1,0 +1,4 @@
+from .readers import (
+    DataReader, CSVReader, CSVAutoReader, ParquetReader, DataFrameReader,
+    DataReaders,
+)

@@ -1,0 +1,188 @@
+"""Data readers: records → FeatureTable.
+
+Mirrors the reference reader layer (reference:
+readers/src/main/scala/com/salesforce/op/readers/DataReader.scala:57-198,
+DataReaders.scala:44-278, CSVAutoReaders.scala) re-designed columnar: instead of
+mapping every record through every raw feature's ``extractFn`` into Spark Rows
+(DataReader.generateDataFrame:173-197), readers ingest whole columns (pandas /
+pyarrow on host) and only fall back to the row loop for features with custom
+extract functions. Field-name extractors — the overwhelmingly common case — hit
+a vectorized numpy path, so a 1M-row CSV ingests in milliseconds rather than
+through a million Python calls per feature.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Type
+
+import numpy as np
+
+from ..features import Feature
+from ..table import Column, FeatureTable
+from ..types import (
+    Binary, Date, DateTime, FeatureType, Integral, Real, Text,
+)
+
+
+class Reader(abc.ABC):
+    """Base reader (reference Reader.scala)."""
+
+    def __init__(self, key_fn: Optional[Callable[[Any], str]] = None,
+                 key_field: Optional[str] = None):
+        self.key_fn = key_fn
+        self.key_field = key_field
+
+    @abc.abstractmethod
+    def read(self, params: Optional[dict] = None):
+        """Return the raw data as a pandas DataFrame (host-side)."""
+
+    def generate_table(self, raw_features: Sequence[Feature],
+                       params: Optional[dict] = None) -> FeatureTable:
+        """Materialize the raw FeatureTable for these features (the analog of
+        reference DataReader.generateDataFrame:173)."""
+        df = self.read(params)
+        return dataframe_to_table(df, raw_features, key_field=self.key_field,
+                                  key_fn=self.key_fn)
+
+
+class DataReader(Reader):
+    """Simple (non-aggregating) reader over a record source."""
+
+
+def _field_name_of(extract_fn: Callable) -> Optional[str]:
+    """Detect the builder's field extractor so ingestion can vectorize."""
+    name = getattr(extract_fn, "__name__", "")
+    if name.startswith("extract_"):
+        return name[len("extract_"):]
+    return None
+
+
+def series_to_column(feature_type: Type[FeatureType], series) -> Column:
+    """Vectorized pandas Series → Column conversion (hot ingestion path)."""
+    import pandas as pd
+
+    kind = feature_type.column_kind
+    if kind in ("real", "binary", "integral", "date"):
+        num = pd.to_numeric(series, errors="coerce")
+        arr = num.to_numpy(dtype=np.float64, na_value=np.nan)
+        mask = ~np.isnan(arr)
+        filled = np.where(mask, arr, 0.0)
+        if kind == "real":
+            return Column(feature_type, filled.astype(np.float32), mask)
+        if kind == "binary":
+            return Column(feature_type, (filled != 0.0).astype(np.float32), mask)
+        return Column(feature_type, filled.astype(np.int64), mask)
+    if kind == "text":
+        vals = series.to_numpy(dtype=object)
+        mask = np.array([isinstance(v, str) and v != "" for v in vals], dtype=bool)
+        out = np.empty(len(vals), dtype=object)
+        for i, (v, m) in enumerate(zip(vals, mask)):
+            out[i] = v if m else None
+        return Column(feature_type, out, mask)
+    # lists/maps/geolocation arrive as python objects in the frame
+    return Column.of_values(feature_type, list(series))
+
+
+def dataframe_to_table(df, raw_features: Sequence[Feature],
+                       key_field: Optional[str] = None,
+                       key_fn: Optional[Callable[[Any], str]] = None,
+                       ) -> FeatureTable:
+    """pandas DataFrame → FeatureTable, vectorizing field extractors and
+    falling back to the record loop for custom extract functions."""
+    cols: Dict[str, Column] = {}
+    slow_feats: List[Feature] = []
+    missing: List[str] = []
+    for f in raw_features:
+        stage = f.origin_stage
+        field = _field_name_of(stage.extract_fn)
+        if field is not None:
+            if field in df.columns:
+                cols[f.name] = series_to_column(f.feature_type, df[field])
+            else:
+                missing.append(field)  # silent all-null columns poison scoring
+        else:
+            slow_feats.append(f)
+    if missing:
+        raise ValueError(
+            f"raw feature field(s) {missing} not present in the data "
+            f"(columns: {list(df.columns)})")
+    if slow_feats:
+        records = df.to_dict("records")
+        for f in slow_feats:
+            stage = f.origin_stage
+            vals = [stage.extract(r) for r in records]
+            cols[f.name] = Column.of_values(f.feature_type, vals)
+    key = None
+    if key_field is not None and key_field in df.columns:
+        key = df[key_field].astype(str).to_numpy(dtype=object)
+    elif key_fn is not None:
+        key = np.array([key_fn(r) for r in df.to_dict("records")], dtype=object)
+    return FeatureTable(cols, len(df), key)
+
+
+class DataFrameReader(DataReader):
+    """Reader over an in-memory pandas DataFrame (the analog of
+    setInputDataset, reference OpWorkflowCore.scala:146-170)."""
+
+    def __init__(self, df, **kw):
+        super().__init__(**kw)
+        self.df = df
+
+    def read(self, params: Optional[dict] = None):
+        return self.df
+
+
+class CSVReader(DataReader):
+    """CSV with an explicit schema (reference CSVReaders.scala)."""
+
+    def __init__(self, path: str, schema: Optional[Sequence[str]] = None,
+                 header: bool = True, **kw):
+        super().__init__(**kw)
+        self.path = path
+        self.schema = list(schema) if schema else None
+        self.header = header
+
+    def read(self, params: Optional[dict] = None):
+        import pandas as pd
+        path = (params or {}).get("path", self.path)
+        if self.header:
+            return pd.read_csv(path)
+        return pd.read_csv(path, header=None, names=self.schema)
+
+
+class CSVAutoReader(CSVReader):
+    """CSV with inferred schema (reference CSVAutoReaders.scala:142)."""
+
+
+class ParquetReader(DataReader):
+    """Parquet files (reference ParquetProductReader.scala)."""
+
+    def __init__(self, path: str, **kw):
+        super().__init__(**kw)
+        self.path = path
+
+    def read(self, params: Optional[dict] = None):
+        import pandas as pd
+        return pd.read_parquet((params or {}).get("path", self.path))
+
+
+class DataReaders:
+    """Factory namespace (reference DataReaders.scala:44-278)."""
+
+    class Simple:
+        @staticmethod
+        def csv(path: str, schema: Optional[Sequence[str]] = None,
+                header: bool = True, key_field: Optional[str] = None) -> CSVReader:
+            return CSVReader(path, schema=schema, header=header, key_field=key_field)
+
+        @staticmethod
+        def csv_auto(path: str, key_field: Optional[str] = None) -> CSVAutoReader:
+            return CSVAutoReader(path, key_field=key_field)
+
+        @staticmethod
+        def parquet(path: str, key_field: Optional[str] = None) -> ParquetReader:
+            return ParquetReader(path, key_field=key_field)
+
+        @staticmethod
+        def dataframe(df, key_field: Optional[str] = None) -> DataFrameReader:
+            return DataFrameReader(df, key_field=key_field)

@@ -16,15 +16,15 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .types import (
-    FeatureType, OPVector, Prediction, Real, RealNN, feature_type_by_name,
-)
+from .types import FeatureType
 
 #: column kinds whose values are numeric arrays eligible for device residency
-DEVICE_KINDS = frozenset({"real", "integral", "binary", "vector", "prediction"})
-#: column kinds kept host-side (object arrays) until vectorized
+#: (integral/date stay host-side int64 — TPU x64 is off and vectorizers emit
+#: float32 blocks from them anyway)
+DEVICE_KINDS = frozenset({"real", "binary", "vector", "prediction"})
+#: column kinds kept host-side (object arrays / int64) until vectorized
 HOST_KINDS = frozenset({"text", "text_list", "date_list", "geolocation",
-                        "multipicklist", "map", "date"})
+                        "multipicklist", "map", "date", "integral"})
 
 
 def _np(values) -> np.ndarray:
@@ -105,21 +105,19 @@ class Column:
             elif kind == "binary":
                 vals = np.array([0.0 if m else float(bool(v))
                                  for v, m in zip(raw, missing)], dtype=np.float32)
-            elif kind == "integral":
-                vals = np.array([0 if m else int(v)
-                                 for v, m in zip(raw, missing)], dtype=np.int32)
-            else:  # date: epoch millis exceed int32/float32 → host int64
+            else:  # integral/date: reference semantics are Long → host int64
                 vals = np.array([0 if m else int(v)
                                  for v, m in zip(raw, missing)], dtype=np.int64)
             return Column(feature_type, vals, mask)
         if kind == "vector":
-            vals = np.stack([np.asarray(v, dtype=np.float32) for v in raw]) if n else \
-                np.zeros((0, 0), dtype=np.float32)
+            vals = np.stack([np.asarray([] if v is None else v, dtype=np.float32)
+                             for v in raw]) if n else np.zeros((0, 0), dtype=np.float32)
             return Column(feature_type, vals, None)
         if kind == "prediction":
-            keys = sorted({k for d in raw for k in d})
-            vals = np.array([[float(d.get(k, 0.0)) for k in keys] for d in raw],
-                            dtype=np.float32).reshape(n, len(keys))
+            keys = sorted({k for d in raw if d is not None for k in d})
+            vals = np.array([[float(d.get(k, 0.0)) for k in keys]
+                             if d is not None else [0.0] * len(keys)
+                             for d in raw], dtype=np.float32).reshape(n, len(keys))
             return Column(feature_type, vals, None, {"keys": tuple(keys)})
         # host kinds
         arr = np.empty(n, dtype=object)

@@ -551,6 +551,14 @@ class Prediction(OPMap, NonNullable):
         d = dict(value)
         if cls.PredictionName not in d:
             raise ValueError(f"Prediction must contain '{cls.PredictionName}' key")
+        for k in d:
+            if k != cls.PredictionName and not (
+                    (k.startswith(cls.RawPredictionName + "_")
+                     or k.startswith(cls.ProbabilityName + "_"))
+                    and k.rsplit("_", 1)[1].isdigit()):
+                raise ValueError(
+                    f"Prediction key '{k}' is not one of the reserved keys "
+                    f"(prediction, rawPrediction_i, probability_i)")
         return d
 
     @property
@@ -571,8 +579,9 @@ class Prediction(OPMap, NonNullable):
 
     def _keyed(self, prefix: str) -> List[float]:
         ks = sorted(
-            (k for k in self.value if k == prefix or k.startswith(prefix + "_")),
-            key=lambda k: int(k.rsplit("_", 1)[1]) if "_" in k[len(prefix):] else 0,
+            (k for k in self.value
+             if k.startswith(prefix + "_") and k.rsplit("_", 1)[1].isdigit()),
+            key=lambda k: int(k.rsplit("_", 1)[1]),
         )
         return [float(self.value[k]) for k in ks]
 

@@ -79,14 +79,7 @@ class VectorMetadata:
         return groups
 
     def select(self, indices: Sequence[int]) -> "VectorMetadata":
-        keep = [self.columns[i] for i in indices]
-        return VectorMetadata(
-            self.name,
-            tuple(
-                VectorColumnMetadata(
-                    c.parent_feature_name, c.parent_feature_type, c.grouping,
-                    c.indicator_value, c.descriptor_value, i)
-                for i, c in enumerate(keep)))
+        return VectorMetadata.of(self.name, [self.columns[i] for i in indices])
 
     def to_json(self) -> Dict[str, Any]:
         return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
@@ -98,12 +91,9 @@ class VectorMetadata:
 
     @staticmethod
     def of(name: str, cols: Sequence[VectorColumnMetadata]) -> "VectorMetadata":
-        renumbered = tuple(
-            VectorColumnMetadata(
-                c.parent_feature_name, c.parent_feature_type, c.grouping,
-                c.indicator_value, c.descriptor_value, i)
-            for i, c in enumerate(cols))
-        return VectorMetadata(name, renumbered)
+        from dataclasses import replace
+        return VectorMetadata(
+            name, tuple(replace(c, index=i) for i, c in enumerate(cols)))
 
     @staticmethod
     def flatten(name: str, metas: Sequence["VectorMetadata"]) -> "VectorMetadata":

@@ -1,0 +1,265 @@
+"""OpWorkflow / OpWorkflowModel — the user-facing engine.
+
+Mirrors the reference workflow layer (reference:
+core/src/main/scala/com/salesforce/op/OpWorkflow.scala,
+OpWorkflowCore.scala, OpWorkflowModel.scala): the workflow reconstructs the
+stage DAG from result-feature lineage, materializes the raw FeatureTable
+through a reader, fits the DAG layer-by-layer, and returns a fitted model that
+scores (batched, on device) and reports summaries.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dag import (
+    apply_transformations_dag, compute_dag, fit_and_transform_dag, validate_dag,
+)
+from .features import Feature
+from .readers.readers import DataFrameReader, Reader, dataframe_to_table
+from .stages.base import Estimator, FeatureGeneratorStage
+from .table import Column, FeatureTable
+
+
+class _WorkflowCore:
+    """Shared state between workflow and model (reference OpWorkflowCore.scala:60-84)."""
+
+    def __init__(self):
+        self.reader: Optional[Reader] = None
+        self.result_features: Tuple[Feature, ...] = ()
+        self.raw_features: Tuple[Feature, ...] = ()
+        self.blacklisted_features: Tuple[Feature, ...] = ()
+        self.parameters: Dict[str, Any] = {}
+        self._input_table: Optional[FeatureTable] = None
+
+    # -- input wiring (reference OpWorkflowCore.setInputDataset:146-170) -----
+    def set_reader(self, reader: Reader):
+        self.reader = reader
+        return self
+
+    def set_input_dataset(self, df, key_field: Optional[str] = None):
+        self.reader = DataFrameReader(df, key_field=key_field)
+        return self
+
+    def set_input_table(self, table: FeatureTable):
+        self._input_table = table
+        return self
+
+    def set_parameters(self, params: Dict[str, Any]):
+        """Workflow-level param injection by stage class name or uid
+        (reference OpWorkflow.setStageParameters:166-188)."""
+        self.parameters = dict(params)
+        return self
+
+    def _generate_raw_table(self) -> FeatureTable:
+        if self._input_table is not None:
+            return self._input_table
+        if self.reader is None:
+            raise ValueError(
+                "no data source: call set_reader / set_input_dataset / set_input_table")
+        return self.reader.generate_table(self.raw_features)
+
+    def _inject_stage_params(self, stages: Sequence[Any]) -> None:
+        per_stage = self.parameters.get("stageParams", {})
+        if not per_stage:
+            return
+        for stage in stages:
+            for key in (stage.uid, type(stage).__name__):
+                if key in per_stage:
+                    stage.set_params(**per_stage[key])
+
+
+class OpWorkflow(_WorkflowCore):
+    """Defines the DAG from result features and trains it
+    (reference OpWorkflow.scala:85-444)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = None
+        self._raw_feature_filter = None
+
+    def set_result_features(self, *features: Feature) -> "OpWorkflow":
+        """Reconstruct the stage DAG from lineage (reference
+        OpWorkflow.setResultFeatures:85-105)."""
+        if not features:
+            raise ValueError("result features cannot be empty")
+        self.result_features = tuple(features)
+        validate_dag(self.result_features)
+        raw: Dict[str, Feature] = {}
+        for f in features:
+            for r in f.raw_features():
+                raw[r.uid] = r
+        self.raw_features = tuple(sorted(raw.values(), key=lambda f: f.name))
+        self._layers = compute_dag(self.result_features)
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "OpWorkflow":
+        """Attach a RawFeatureFilter applied before fitting (reference
+        OpWorkflow.withRawFeatureFilter:524-563)."""
+        self._raw_feature_filter = rff
+        return self
+
+    @property
+    def stages(self) -> List[Any]:
+        return [s for layer in (self._layers or []) for s, _ in layer]
+
+    def train(self) -> "OpWorkflowModel":
+        """Materialize raw data, fit the DAG, return the fitted model
+        (reference OpWorkflow.train:332-357)."""
+        if not self.result_features:
+            raise ValueError("call set_result_features before train")
+        table = self._generate_raw_table()
+        layers = self._layers
+        result_features = self.result_features
+        blacklisted: Tuple[Feature, ...] = ()
+        rff_results = None
+        if self._raw_feature_filter is not None:
+            table, blacklist, rff_results = self._raw_feature_filter.filter_raw(
+                table, self.raw_features)
+            if blacklist:
+                result_features, layers = self._apply_blacklist(blacklist)
+                blacklisted = tuple(blacklist)
+        self._inject_stage_params([s for layer in layers for s, _ in layer])
+        table, fitted = fit_and_transform_dag(table, layers)
+        new_results = tuple(
+            f.copy_with_new_stages(fitted) for f in result_features)
+        model = OpWorkflowModel()
+        model.reader = self.reader
+        model.parameters = self.parameters
+        model.result_features = new_results
+        model.raw_features = self.raw_features
+        model.blacklisted_features = blacklisted
+        model.rff_results = rff_results
+        model.train_table = table
+        model._layers = compute_dag(new_results)
+        return model
+
+    def _apply_blacklist(self, blacklist: Sequence[Feature]):
+        """DAG surgery removing blacklisted raw features (reference
+        OpWorkflow.setBlacklist:112-154). Stages whose inputs are all
+        blacklisted are dropped; vectorizers drop the blacklisted inputs."""
+        gone = {f.uid for f in blacklist}
+
+        def rebuild(f: Feature, cache: Dict[str, Optional[Feature]]) -> Optional[Feature]:
+            if f.uid in cache:
+                return cache[f.uid]
+            if f.is_raw:
+                out = None if f.uid in gone else f
+                cache[f.uid] = out
+                return out
+            kept_parents = []
+            for p in f.parents:
+                np_ = rebuild(p, cache)
+                if np_ is not None:
+                    kept_parents.append(np_)
+            if not kept_parents:
+                cache[f.uid] = None
+                return None
+            stage = f.origin_stage
+            if len(kept_parents) != len(f.parents):
+                import copy as _copy
+                stage = _copy.copy(stage)
+                stage.input_features = tuple(kept_parents)
+                stage._output_feature = None
+                out = stage.get_output()
+                # keep original identity so downstream wiring still matches
+                out.name = f.name
+                out.uid = f.uid
+                stage._output_feature = out
+            else:
+                stage.input_features = tuple(kept_parents)
+                out = Feature(f.name, f.feature_type, f.is_response, stage,
+                              kept_parents, uid=f.uid)
+                stage._output_feature = out
+            cache[f.uid] = out
+            return out
+
+        cache: Dict[str, Optional[Feature]] = {}
+        new_results = []
+        for f in self.result_features:
+            nf = rebuild(f, cache)
+            if nf is None:
+                raise ValueError(
+                    f"result feature '{f.name}' lost all inputs to the raw feature filter")
+            new_results.append(nf)
+        return tuple(new_results), compute_dag(new_results)
+
+
+class OpWorkflowModel(_WorkflowCore):
+    """Fitted workflow (reference OpWorkflowModel.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = None
+        self.train_table: Optional[FeatureTable] = None
+        self.rff_results = None
+
+    @property
+    def stages(self) -> List[Any]:
+        return [s for layer in (self._layers or []) for s, _ in layer]
+
+    def get_stage(self, uid: str) -> Any:
+        for s in self.stages:
+            if s.uid == uid:
+                return s
+        raise KeyError(uid)
+
+    # -- scoring (reference OpWorkflowModel.score:254-324) -------------------
+    def score(self, table: Optional[FeatureTable] = None, df=None,
+              keep_raw_features: bool = True,
+              keep_intermediate_features: bool = True) -> FeatureTable:
+        if df is not None:
+            table = dataframe_to_table(df, self.raw_features)
+        if table is None:
+            table = self._generate_raw_table()
+        scored = apply_transformations_dag(table, self._layers)
+        if keep_raw_features and keep_intermediate_features:
+            return scored
+        keep = [f.name for f in self.result_features if f.name in scored.column_names]
+        if keep_raw_features:
+            keep = [f.name for f in self.raw_features] + keep
+        return scored.select(keep)
+
+    def score_and_evaluate(self, evaluator, table: Optional[FeatureTable] = None,
+                           df=None) -> Tuple[FeatureTable, Dict[str, float]]:
+        scored = self.score(table=table, df=df)
+        return scored, evaluator.evaluate_all(scored)
+
+    def evaluate(self, evaluator, table: Optional[FeatureTable] = None) -> Dict[str, float]:
+        return self.score_and_evaluate(evaluator, table=table)[1]
+
+    # -- summaries (reference OpWorkflowModel.summary:183-211) ---------------
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for stage in self.stages:
+            md = getattr(stage, "summary_metadata", None)
+            if md:
+                out[stage.uid] = md
+        return out
+
+    def summary_json(self) -> str:
+        return json.dumps(self.summary(), indent=2, default=_json_default)
+
+    def summary_pretty(self) -> str:
+        lines: List[str] = ["Workflow summary:"]
+        for stage in self.stages:
+            pretty = getattr(stage, "summary_pretty", None)
+            if callable(pretty):
+                lines.append(pretty())
+            elif getattr(stage, "summary_metadata", None):
+                lines.append(f"-- {type(stage).__name__} ({stage.uid})")
+        return "\n".join(lines)
+
+
+def _json_default(o):
+    if isinstance(o, (np.floating, np.integer)):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if hasattr(o, "to_json"):
+        return o.to_json()
+    if hasattr(o, "__dict__"):
+        return {k: v for k, v in vars(o).items() if not k.startswith("_")}
+    return str(o)
