@@ -1,0 +1,78 @@
+"""North-star benchmark: ModelSelector model×fold fits/sec.
+
+The reference's hot loop is |models| × |paramMaps| × |folds| sequential Spark
+fits throttled by an 8-thread pool (reference: OpValidator.scala:270-322,
+OpCrossValidation.scala). BASELINE.md sets the target: >= 100 model×fold fits
+per second on a 1M-row tabular dataset. Here the whole sweep is one vmapped,
+jitted XLA program (logistic-regression prox-Newton batch), so the metric is
+(configurations × folds) / wall-clock of fit + predict + metric.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is value / 100 (the BASELINE.json north-star target; the
+reference publishes no wall-clock numbers of its own).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    from transmogrifai_tpu.ops.metrics import auroc_masked
+
+    platform = jax.devices()[0].platform
+    n = int(os.environ.get("BENCH_ROWS", 1_000_000 if platform == "tpu" else 20_000))
+    d = int(os.environ.get("BENCH_FEATURES", 64))
+    folds = 3
+    grid = [{"regParam": r, "elasticNetParam": e}
+            for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
+            for e in (0.0, 0.25, 0.5, 0.75, 1.0)]          # 40 configs
+    B = folds * len(grid)                                   # 120 model×fold fits
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+
+    family = MODEL_REGISTRY["OpLogisticRegression"]
+    garr = family.grid_to_arrays(grid)
+    val = np.zeros((folds, n), dtype=bool)
+    perm = rng.permutation(n)
+    for f in range(folds):
+        val[f, perm[f::folds]] = True
+    train_w = jnp.asarray(np.repeat(~val, len(grid), axis=0), jnp.float32)
+    val_m = jnp.asarray(np.repeat(val, len(grid), axis=0))
+    tiled = {k: jnp.tile(v, folds) for k, v in garr.items()}
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    metric = jax.jit(jax.vmap(auroc_masked, in_axes=(0, None, 0)))
+
+    def sweep():
+        params = family.fit_batch(Xd, yd, train_w, tiled, 2)
+        scores = family.predict_batch(params, Xd, 2)
+        return metric(scores, yd, val_m)
+
+    np.asarray(sweep())                     # compile warmup
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        m = np.asarray(sweep())             # host materialization: honest
+    dt = (time.perf_counter() - t0) / reps  # timing even where async sync
+    assert np.all(np.isfinite(m))           # is a no-op (tunneled backends)
+
+    fits_per_sec = B / dt
+    print(json.dumps({
+        "metric": f"model_fold_fits_per_sec_{n}rows_{d}feat_{platform}",
+        "value": round(fits_per_sec, 2),
+        "unit": "fits/sec",
+        "vs_baseline": round(fits_per_sec / 100.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+import numpy as np
+import jax.numpy as jnp
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY, FittedParams
+import transmogrifai_tpu.models.mlp  # noqa: F401
+
+
+def _blobs(n=300, seed=0, classes=2):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, 4) * 3
+    y = rng.randint(0, classes, n)
+    X = centers[y] + rng.randn(n, 4).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def test_mlp_binary_learns():
+    X, y = _blobs()
+    fam = MODEL_REGISTRY["OpMultilayerPerceptronClassifier"]
+    grid = fam.default_grid("binary")
+    garr = fam.grid_to_arrays(grid)
+    W = jnp.ones((len(grid), X.shape[0]), jnp.float32)
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), W, garr, 2)
+    scores = np.asarray(fam.predict_batch(params, jnp.asarray(X), 2))
+    assert scores.shape == (len(grid), X.shape[0])
+    acc = ((scores > 0.5) == y[None, :]).mean(axis=1)
+    assert (acc > 0.9).all(), acc
+
+
+def test_mlp_multiclass_and_predict_one():
+    X, y = _blobs(classes=3, seed=1)
+    fam = MODEL_REGISTRY["OpMultilayerPerceptronClassifier"]
+    grid = [{"hiddenLayer1": 16, "hiddenLayer2": 8, "stepSize": 0.05}]
+    garr = fam.grid_to_arrays(grid)
+    W = jnp.ones((1, X.shape[0]), jnp.float32)
+    batched = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), W, garr, 3)
+    probs = np.asarray(fam.predict_batch(batched, jnp.asarray(X), 3))
+    assert probs.shape == (1, X.shape[0], 3)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+    one = fam.select_params(batched, 0)
+    fitted = FittedParams(fam.name, one, grid[0], num_classes=3)
+    parts = fam.predict_one(fitted, X)
+    acc = (parts["prediction"] == y).mean()
+    assert acc > 0.9
+    assert parts["probability"].shape == (X.shape[0], 3)
+
+
+def test_mlp_masked_widths_differ():
+    # different widths in one batch must produce genuinely different models
+    X, y = _blobs(seed=2)
+    fam = MODEL_REGISTRY["OpMultilayerPerceptronClassifier"]
+    grid = [{"hiddenLayer1": 2, "hiddenLayer2": 2, "stepSize": 0.05},
+            {"hiddenLayer1": 32, "hiddenLayer2": 32, "stepSize": 0.05}]
+    garr = fam.grid_to_arrays(grid)
+    W = jnp.ones((2, X.shape[0]), jnp.float32)
+    batched = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), W, garr, 2)
+    m1 = np.asarray(batched["masks"][0])
+    assert m1[0].sum() == 2 and m1[1].sum() == 32

@@ -1,0 +1,95 @@
+"""Regression tests for validator metric dispatch, label unmapping, and
+SanityChecker rule-confidence leakage flagging."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.impl.tuning.validators import _metric_fn
+
+
+def test_regression_metric_honors_name():
+    pred = jnp.asarray(np.array([[1.0, 2.0, 3.0, 4.0]]))
+    y = jnp.asarray(np.array([1.0, 2.0, 3.0, 5.0]))
+    mask = jnp.ones((1, 4), bool)
+    rmse = float(_metric_fn("regression", "RootMeanSquaredError")(pred, y, mask)[0])
+    mae = float(_metric_fn("regression", "MeanAbsoluteError")(pred, y, mask)[0])
+    r2 = float(_metric_fn("regression", "R2")(pred, y, mask)[0])
+    assert rmse == pytest.approx(0.5)
+    assert mae == pytest.approx(0.25)
+    assert r2 == pytest.approx(1.0 - 1.0 / 8.75, abs=1e-4)
+    with pytest.raises(ValueError):
+        _metric_fn("regression", "AuPR")
+
+
+def test_binary_threshold_and_logloss_metrics():
+    scores = jnp.asarray(np.array([[0.9, 0.8, 0.2, 0.1]]))
+    y = jnp.asarray(np.array([1.0, 0.0, 1.0, 0.0]))
+    mask = jnp.ones((1, 4), bool)
+    prec = float(_metric_fn("binary", "Precision")(scores, y, mask)[0])
+    err = float(_metric_fn("binary", "Error")(scores, y, mask)[0])
+    ll = float(_metric_fn("binary", "LogLoss")(scores, y, mask)[0])
+    assert prec == pytest.approx(0.5)
+    assert err == pytest.approx(0.5)
+    assert ll > 0
+    with pytest.raises(ValueError):
+        _metric_fn("binary", "Bogus")
+
+
+def test_multiclass_error_direction():
+    # perfect predictor: F1=1, Error=0 — names must map to the right kernels
+    probs = jnp.asarray(np.eye(3)[None, :, :].repeat(1, axis=0).astype(np.float32))
+    y = jnp.asarray(np.array([0.0, 1.0, 2.0]))
+    mask = jnp.ones((1, 3), bool)
+    f1 = float(_metric_fn("multiclass", "F1")(probs, y, mask, 3)[0])
+    err = float(_metric_fn("multiclass", "Error")(probs, y, mask, 3)[0])
+    assert f1 == pytest.approx(1.0)
+    assert err == pytest.approx(0.0)
+
+
+def test_selected_model_unmaps_datacutter_labels():
+    from transmogrifai_tpu.impl.selector.model_selector import SelectedModel, \
+        ModelSelectorSummary
+    from transmogrifai_tpu.models.api import FittedParams
+
+    sm = SelectedModel.__new__(SelectedModel)
+    sm.label_mapping = {0: 0, 2: 1, 3: 2}
+    out = sm._unmap_prediction(np.array([0.0, 1.0, 2.0, 1.0]))
+    np.testing.assert_array_equal(out, [0.0, 2.0, 3.0, 2.0])
+    sm.label_mapping = None
+    np.testing.assert_array_equal(sm._unmap_prediction(np.array([1.0])), [1.0])
+
+
+def test_sanity_checker_flags_perfect_rule_confidence():
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.feature import transmogrify
+    from transmogrifai_tpu.workflow import OpWorkflow
+    from transmogrifai_tpu.table import FeatureTable
+    from transmogrifai_tpu.types import PickList, RealNN
+
+    rng = np.random.RandomState(0)
+    n = 400
+    y = rng.randint(0, 2, n)
+    leak = np.where(y == 1, "yes", "no")          # perfectly predictive
+    noise = rng.choice(["a", "b", "c"], n)
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    leak_f = FeatureBuilder.PickList("leak").extract_field().as_predictor()
+    noise_f = FeatureBuilder.PickList("noise").extract_field().as_predictor()
+    vec = transmogrify([leak_f, noise_f])
+    checked = label.transform_with(SanityChecker(seed=1), vec)
+
+    table = FeatureTable.from_columns({
+        "label": (RealNN, y.astype(float).tolist()),
+        "leak": (PickList, leak.tolist()),
+        "noise": (PickList, noise.tolist()),
+    })
+    wf = OpWorkflow().set_input_table(table).set_result_features(checked)
+    model = wf.train()
+    sc = next(st for st in model.stages
+              if type(st).__name__ == "SanityCheckerModel")
+    dropped_names = " ".join(sc.summary["dropped"])
+    assert "leak" in dropped_names
+    rule_flags = [w for ws in sc.summary["reasons"].values() for w in ws
+                  if "rule confidence" in w]
+    assert rule_flags, "perfect rule confidence (==1.0) must be flagged"

@@ -154,11 +154,11 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
                 flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
             if (not np.isnan(rule_conf_by_col[i])
-                    and rule_conf_by_col[i] > self.max_rule_confidence
+                    and rule_conf_by_col[i] >= self.max_rule_confidence
                     and support_by_col[i] >= 0
                     and support_by_col[i] * len(ys) >= self.min_required_rule_support):
                 flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
-                        f"above max {self.max_rule_confidence}")
+                        f"at/above max {self.max_rule_confidence} (leakage)")
 
         # feature-group propagation (reference: if one indicator of a pivot
         # group leaks, the whole group goes)

@@ -32,6 +32,7 @@ class ModelSelectorSummary:
     best_model_type: str
     best_hyper: Dict[str, Any]
     best_metric_value: float
+    larger_better: bool = True
     validation_results: List[Any] = field(default_factory=list)
     train_evaluation: Dict[str, Any] = field(default_factory=dict)
     holdout_evaluation: Dict[str, Any] = field(default_factory=dict)
@@ -148,6 +149,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             best_model_type=best.family_name,
             best_hyper=dict(best.hyper),
             best_metric_value=best.metric_value,
+            larger_better=larger_better,
             validation_results=best.results,
             splitter_summary=dict(getattr(self.splitter, "summary", {}) or {}),
         )
@@ -156,18 +158,16 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         model = self._finalize_model(model)
 
         # train/holdout evaluation (reference :168-188)
-        if self.evaluator is not None or True:
-            ev = self._default_evaluator()
-            if ev is not None:
-                ev.set_label_col(label_f.name)
-                ev.set_prediction_col(model.get_output().name)
-                train_tbl = table.take(train_idx)
-                summary.train_evaluation = _scalar_metrics(
-                    ev.evaluate_all(model.transform(train_tbl)))
-                if len(test_idx):
-                    test_tbl = table.take(test_idx)
-                    summary.holdout_evaluation = _scalar_metrics(
-                        ev.evaluate_all(model.transform(test_tbl)))
+        ev = self._default_evaluator()
+        ev.set_label_col(label_f.name)
+        ev.set_prediction_col(model.get_output().name)
+        train_tbl = table.take(train_idx)
+        summary.train_evaluation = _scalar_metrics(
+            ev.evaluate_all(model.transform(train_tbl)))
+        if len(test_idx):
+            test_tbl = table.take(test_idx)
+            summary.holdout_evaluation = _scalar_metrics(
+                ev.evaluate_all(model.transform(test_tbl)))
         model.summary_metadata = summary.to_json()
         return model
 
@@ -201,11 +201,22 @@ class SelectedModel(AllowLabelAsInput, Transformer):
         self.label_mapping = label_mapping
         self.summary_metadata: Dict[str, Any] = {}
 
+    def _unmap_prediction(self, pred: np.ndarray) -> np.ndarray:
+        """Map dense class indices back to the original labels dropped/remapped
+        by DataCutter (reference PredictionDeIndexer semantics)."""
+        if not self.label_mapping:
+            return pred
+        inverse = {dense: orig for orig, dense in self.label_mapping.items()}
+        return np.vectorize(lambda v: inverse.get(int(v), int(v)))(
+            pred).astype(np.float32)
+
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features
         X = jnp.asarray(np.asarray(table[vec_f.name].values, dtype=np.float32))
         family = MODEL_REGISTRY[self.fitted.family]
         parts = family.predict_one(self.fitted, X)
+        parts = dict(parts,
+                     prediction=self._unmap_prediction(parts["prediction"]))
         return prediction_column(parts)
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
@@ -213,7 +224,7 @@ class SelectedModel(AllowLabelAsInput, Transformer):
         v = np.asarray(row.get(vec_f.name) or [], dtype=np.float32)[None, :]
         family = MODEL_REGISTRY[self.fitted.family]
         parts = family.predict_one(self.fitted, jnp.asarray(v))
-        out = {"prediction": float(parts["prediction"][0])}
+        out = {"prediction": float(self._unmap_prediction(parts["prediction"])[0])}
         for name in ("probability", "rawPrediction"):
             if name in parts:
                 for i, x in enumerate(np.asarray(parts[name][0]).reshape(-1)):
@@ -228,8 +239,10 @@ class SelectedModel(AllowLabelAsInput, Transformer):
                  f"Best model: {s.best_model_type} "
                  f"{s.best_hyper} → {s.validation_metric}={s.best_metric_value:.4f}"]
         for r in s.validation_results:
-            lines.append(f"  {r.family}: best {np.max(r.mean_metrics):.4f} "
-                         f"worst {np.min(r.mean_metrics):.4f} over {len(r.grid)} configs")
+            hi, lo = np.max(r.mean_metrics), np.min(r.mean_metrics)
+            b, w = (hi, lo) if s.larger_better else (lo, hi)
+            lines.append(f"  {r.family}: best {b:.4f} "
+                         f"worst {w:.4f} over {len(r.grid)} configs")
         if s.holdout_evaluation:
             keys = ("AuPR", "AuROC", "F1", "Error", "RootMeanSquaredError", "R2")
             show = {k: round(v, 4) for k, v in s.holdout_evaluation.items() if k in keys}

@@ -20,7 +20,8 @@ import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
 from ...ops.metrics import (
-    aupr_masked, auroc_masked, multiclass_f1_masked, regression_metrics_masked,
+    aupr_masked, auroc_masked, binary_threshold_metrics_masked,
+    multiclass_metrics_masked, regression_metrics_masked,
 )
 
 
@@ -54,19 +55,43 @@ class BestEstimator:
 
 
 def _metric_fn(problem: str, metric: str):
-    """Jitted batched metric over (B, n) scores with (B?, n) val masks."""
+    """Jitted batched metric over (B, n) scores with (B, n) val masks,
+    honoring the evaluator's requested metric name (reference: the validator
+    optimizes whatever evaluator the selector was configured with)."""
     if problem == "binary":
-        base = {"AuPR": aupr_masked, "AuROC": auroc_masked}[metric]
-        return jax.jit(jax.vmap(base, in_axes=(0, None, 0)))
+        if metric in ("AuPR", "AuROC"):
+            base = {"AuPR": aupr_masked, "AuROC": auroc_masked}[metric]
+            return jax.jit(jax.vmap(base, in_axes=(0, None, 0)))
+        if metric in ("Precision", "Recall", "F1", "Error"):
+            def one_b(scores, y, mask):
+                return binary_threshold_metrics_masked(scores, y, mask)[metric]
+            return jax.jit(jax.vmap(one_b, in_axes=(0, None, 0)))
+        if metric == "LogLoss":
+            def one_ll(scores, y, mask):
+                p = jnp.clip(scores, 1e-15, 1 - 1e-15)
+                yy = (y > 0.5).astype(scores.dtype)
+                w = mask.astype(scores.dtype)
+                ll = -(yy * jnp.log(p) + (1 - yy) * jnp.log(1 - p)) * w
+                return ll.sum() / jnp.maximum(w.sum(), 1.0)
+            return jax.jit(jax.vmap(one_ll, in_axes=(0, None, 0)))
+        raise ValueError(f"unknown binary validation metric '{metric}'")
     if problem == "multiclass":
+        if metric not in ("F1", "Precision", "Recall", "Error"):
+            raise ValueError(f"unknown multiclass validation metric '{metric}'")
+
         def one(probs, y, mask, num_classes):
             pred = probs.argmax(axis=-1).astype(jnp.int32)
-            return multiclass_f1_masked(pred, y.astype(jnp.int32), mask, num_classes)
+            return multiclass_metrics_masked(
+                pred, y.astype(jnp.int32), mask, num_classes)[metric]
         return jax.jit(jax.vmap(one, in_axes=(0, None, 0, None)),
                        static_argnums=(3,))
     if problem == "regression":
+        if metric not in ("RootMeanSquaredError", "MeanSquaredError",
+                          "MeanAbsoluteError", "R2"):
+            raise ValueError(f"unknown regression validation metric '{metric}'")
+
         def one_r(pred, y, mask):
-            return regression_metrics_masked(pred, y, mask)["RootMeanSquaredError"]
+            return regression_metrics_masked(pred, y, mask)[metric]
         return jax.jit(jax.vmap(one_r, in_axes=(0, None, 0)))
     raise ValueError(problem)
 

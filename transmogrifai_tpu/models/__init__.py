@@ -1,2 +1,3 @@
 from .api import ModelFamily, FittedParams, MODEL_REGISTRY, register_family
 from . import linear  # noqa: F401  (registers linear families)
+from . import mlp  # noqa: F401  (registers the MLP family)

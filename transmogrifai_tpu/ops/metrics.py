@@ -112,6 +112,49 @@ def aupr_masked(scores: jnp.ndarray, labels: jnp.ndarray,
 
 
 @jax.jit
+def binary_threshold_metrics_masked(scores: jnp.ndarray, labels: jnp.ndarray,
+                                    mask: jnp.ndarray, threshold: float = 0.5):
+    """Precision/Recall/F1/Error at a probability threshold over the masked
+    subset (vmapped-CV fast path; assumes probability-like scores)."""
+    w = mask.astype(scores.dtype)
+    pred = (scores >= threshold).astype(scores.dtype) * w
+    pos = (labels > 0.5).astype(scores.dtype) * w
+    tp = (pred * pos).sum()
+    fp = (pred * (w - pos)).sum()
+    fn = ((w - pred) * pos).sum()
+    cnt = jnp.maximum(w.sum(), 1.0)
+    prec = tp / jnp.maximum(tp + fp, 1.0)
+    rec = tp / jnp.maximum(pos.sum(), 1.0)
+    f1 = jnp.where(prec + rec > 0,
+                   2 * prec * rec / jnp.maximum(prec + rec, 1e-30), 0.0)
+    err = (fp + fn) / cnt
+    return {"Precision": prec, "Recall": rec, "F1": f1, "Error": err}
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def multiclass_metrics_masked(pred_idx: jnp.ndarray, label_idx: jnp.ndarray,
+                              mask: jnp.ndarray, num_classes: int):
+    """Weighted Precision/Recall/F1 + Error over the masked subset."""
+    w = mask.astype(jnp.float32)
+    p = jax.nn.one_hot(pred_idx, num_classes, dtype=jnp.float32) * w[:, None]
+    l = jax.nn.one_hot(label_idx, num_classes, dtype=jnp.float32) * w[:, None]
+    cm = l.T @ p
+    n = jnp.maximum(cm.sum(), 1.0)
+    support = cm.sum(axis=1)
+    pred_cnt = cm.sum(axis=0)
+    tp = jnp.diag(cm)
+    prec_c = tp / jnp.maximum(pred_cnt, 1.0)
+    rec_c = tp / jnp.maximum(support, 1.0)
+    f1_c = jnp.where(prec_c + rec_c > 0,
+                     2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, 1e-30), 0.0)
+    wgt = support / n
+    return {"Error": 1.0 - jnp.trace(cm) / n,
+            "Precision": (prec_c * wgt).sum(),
+            "Recall": (rec_c * wgt).sum(),
+            "F1": (f1_c * wgt).sum()}
+
+
+@jax.jit
 def regression_metrics_masked(pred: jnp.ndarray, label: jnp.ndarray,
                               mask: jnp.ndarray):
     w = mask.astype(pred.dtype)

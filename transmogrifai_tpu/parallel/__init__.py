@@ -1,0 +1,19 @@
+"""Multi-chip parallelism: mesh construction, collectives, sharded sweeps.
+
+The TPU re-expression of the reference's parallelism axes (SURVEY §2.10):
+row data-parallelism (Spark RDD partitions) becomes row-axis sharding over the
+'data' mesh axis; model×fold task-parallelism (Scala Futures, pool of 8)
+becomes batch-axis sharding over the 'model' mesh axis. XLA inserts the
+collectives (psum over ICI) that Spark's shuffle/treeAggregate did.
+"""
+from .mesh import MeshSpec, make_mesh, default_mesh, data_parallel_sharding
+from .collectives import (
+    psum, pmean, pmax, all_gather, reduce_scatter, host_gather,
+)
+from .sharded import shard_table, sharded_fit_batch, sharded_col_stats
+
+__all__ = [
+    "MeshSpec", "make_mesh", "default_mesh", "data_parallel_sharding",
+    "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "host_gather",
+    "shard_table", "sharded_fit_batch", "sharded_col_stats",
+]

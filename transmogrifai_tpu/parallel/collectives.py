@@ -1,0 +1,46 @@
+"""Thin collectives layer over XLA's ICI/DCN primitives.
+
+The reference's distributed-communication backend is Spark shuffle + netty RPC
++ Kryo broadcast (reference: utils/.../kryo/OpKryoRegistrator.scala; monoid
+``reduce``/``reduceByKey`` calls throughout, e.g. SanityChecker.scala:433-440).
+Here every cross-row reduction is an XLA collective over the named mesh —
+psum/all_gather ride ICI within a slice, DCN across slices — and "collect to
+driver" becomes a host_gather of an already-small device array.
+
+These wrappers are for use inside ``jax.shard_map``-mapped functions; under
+plain ``pjit`` XLA inserts equivalent collectives automatically from sharding
+annotations, which is the preferred path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def psum(x, axis_name: str = "data"):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str = "data"):
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name: str = "data"):
+    return jax.lax.pmax(x, axis_name)
+
+
+def all_gather(x, axis_name: str = "data", axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str = "data", scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def host_gather(x) -> np.ndarray:
+    """Fully replicate/gather a (small) device array back to the host — the
+    analog of Spark ``collect()`` for summaries/vocabularies."""
+    return np.asarray(jax.device_get(x))

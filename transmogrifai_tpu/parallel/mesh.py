@@ -1,0 +1,61 @@
+"""Device-mesh construction.
+
+Replaces the reference's Spark cluster topology (driver + executors, reference:
+core/.../OpWorkflowRunner.scala, utils/.../spark/) with a named
+``jax.sharding.Mesh``. Axis conventions:
+
+* ``data``  — row axis of the FeatureTable (P1 in SURVEY §2.10): every
+  per-row map and monoid reduce shards here; XLA turns reduces into psum
+  over ICI.
+* ``model`` — the hyperparameter × fold batch axis of ModelSelector sweeps
+  (P2): each chip fits its slice of configurations independently.
+
+Multi-host: under ``jax.distributed`` the same code sees the global device
+list, ICI within a slice and DCN across slices — nothing here changes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; axes sized -1 absorb remaining devices."""
+    data: int = -1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int]:
+        data, model = self.data, self.model
+        if data == -1 and model == -1:
+            raise ValueError("only one mesh axis may be -1")
+        if model == -1:
+            model = n_devices // max(data, 1)
+        if data == -1:
+            data = n_devices // max(model, 1)
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} does not cover {n_devices} devices")
+        return data, model
+
+
+def make_mesh(spec: MeshSpec = MeshSpec(),
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def default_mesh() -> Mesh:
+    """All visible devices on the data axis (pure data parallelism)."""
+    return make_mesh(MeshSpec(data=-1, model=1))
+
+
+def data_parallel_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard axis 0 (rows) over 'data', replicate the rest."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))

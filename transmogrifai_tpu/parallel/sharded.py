@@ -1,0 +1,105 @@
+"""Sharded execution of FeatureTable stats and ModelSelector sweeps.
+
+The hot path (SURVEY §3.3): a ``|families| × |grid| × |folds|`` sweep. On one
+chip it is a vmapped fit; across chips the batch axis shards over 'model' and
+the row axis over 'data'. We annotate shardings with ``NamedSharding`` and let
+pjit/XLA insert the psum collectives the reference got from Spark shuffles.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def shard_table(table, mesh: Mesh):
+    """Re-place every device-resident column row-sharded over 'data'.
+
+    Rows are padded (with invalid/masked slots) to a multiple of the data-axis
+    size so shards are equal — the analog of Spark repartitioning.
+    """
+    from ..table import Column, FeatureTable
+    n_data = mesh.shape["data"]
+    n = table.num_rows
+    n_pad = _pad_to(max(n, n_data), n_data)
+    pad = n_pad - n
+    cols = {}
+    for name in table.column_names:
+        col = table[name]
+        vals, mask = col.values, col.mask
+        if col.kind in ("real", "binary", "vector", "prediction"):
+            v = np.asarray(vals)
+            if pad:
+                v = np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                m = np.zeros(n_pad, bool)
+                m[:n] = True if mask is None else np.asarray(mask)
+                mask = m
+            sh = NamedSharding(mesh, P("data", *([None] * (v.ndim - 1))))
+            vals = jax.device_put(jnp.asarray(v), sh)
+            if mask is not None:
+                mask = jax.device_put(jnp.asarray(mask),
+                                      NamedSharding(mesh, P("data")))
+        elif pad:
+            v = np.asarray(vals)
+            filler = np.zeros((pad,) + v.shape[1:], v.dtype) \
+                if v.dtype != object else np.full(pad, None, dtype=object)
+            vals = np.concatenate([v, filler])
+            m = np.zeros(n_pad, bool)
+            m[:n] = True if mask is None else np.asarray(mask)
+            mask = m
+        cols[name] = Column(col.feature_type, vals, mask, col.metadata)
+    key = table.key
+    if key is not None and pad:
+        key = np.concatenate([key, np.full(pad, None, dtype=object)])
+    return FeatureTable(cols, num_rows=n_pad, key=key)
+
+
+def sharded_fit_batch(family, X, y, weights, grid: Dict[str, jnp.ndarray],
+                      num_classes: int, mesh: Mesh):
+    """Run ``family.fit_batch`` with the config batch sharded over 'model' and
+    rows over 'data'. Returns (params, scores) both model-sharded.
+
+    The B axis is padded to a multiple of the model-axis size with repeated
+    configurations (harmless: they are discarded by the caller's argmax over
+    the original B prefix)."""
+    n_model = mesh.shape["model"]
+    B, n = weights.shape
+    B_pad = _pad_to(B, n_model)
+    if B_pad != B:
+        idx = jnp.arange(B_pad) % B  # wrap-around repeat covers reps > B
+        weights = weights[idx]
+        grid = {k: v[idx] for k, v in grid.items()}
+
+    x_sh = NamedSharding(mesh, P("data", None))
+    row_sh = NamedSharding(mesh, P("data"))
+    w_sh = NamedSharding(mesh, P("model", "data"))
+    g_sh = NamedSharding(mesh, P("model"))
+    X = jax.device_put(X, x_sh)
+    y = jax.device_put(y, row_sh)
+    weights = jax.device_put(weights, w_sh)
+    grid = {k: jax.device_put(v, g_sh) for k, v in grid.items()}
+
+    params = family.fit_batch(X, y, weights, grid, num_classes)
+    scores = family.predict_batch(params, X, num_classes)
+    return params, scores, B  # B = original (unpadded) batch size
+
+
+def sharded_col_stats(X, mask, mesh: Mesh):
+    """colStats over row-sharded data — the reference's
+    ``mllib.stat.Statistics.colStats`` (SanityChecker.scala:574-576) as one
+    pjit program whose sums psum over ICI."""
+    from ..ops.stats import col_stats
+    x_sh = NamedSharding(mesh, P("data", None))
+    X = jax.device_put(jnp.asarray(X), x_sh)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        spec = P("data", *([None] * (mask.ndim - 1)))
+        mask = jax.device_put(mask, NamedSharding(mesh, spec))
+    return col_stats(X, mask)
