@@ -1,0 +1,184 @@
+"""Tree family tests: DT / RF / GBT / XGBoost, classification + regression.
+
+Mirrors the reference contract specs for its tree wrappers
+(reference: core/src/test/.../OpRandomForestClassifierTest.scala,
+OpGBTClassifierTest.scala, OpXGBoostClassifierTest.scala etc.): fit on
+synthetic data, check predictions beat chance, check batch/one parity.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.trees  # noqa: F401 (registers families)
+
+
+def _binary_data(n=400, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    # nonlinear decision rule trees can learn but linear models can't fully
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0.5)).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _regression_data(n=400, d=6, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (np.where(X[:, 0] > 0, 3.0, -1.0) + 0.5 * np.abs(X[:, 1])
+         + 0.05 * rng.randn(n)).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _multiclass_data(n=450, d=6, seed=2, C=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0).astype(int))
+    y = np.minimum(y, C - 1).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _acc(scores, y, num_classes):
+    s = np.asarray(scores)
+    if s.ndim == 2 and num_classes > 2:
+        pred = s.argmax(-1)
+    else:
+        pred = (s > 0.5).astype(int)
+    return (pred == np.asarray(y)).mean()
+
+
+GRID_TREE = [{"maxDepth": 4, "minInstancesPerNode": 5, "minInfoGain": 0.001}]
+GRID_RF = [{**GRID_TREE[0], "numTrees": 10, "subsamplingRate": 1.0}]
+GRID_GBT = [{**GRID_TREE[0], "maxIter": 10, "stepSize": 0.3}]
+GRID_XGB = [{"maxDepth": 4, "maxIter": 15, "stepSize": 0.3,
+             "minChildWeight": 1.0, "lambda": 1.0, "minInfoGain": 0.0,
+             "minInstancesPerNode": 0.0}]
+
+
+@pytest.mark.parametrize("fam_name,grid", [
+    ("OpDecisionTreeClassifier", GRID_TREE),
+    ("OpRandomForestClassifier", GRID_RF),
+    ("OpGBTClassifier", GRID_GBT),
+    ("OpXGBoostClassifier", GRID_XGB),
+])
+def test_binary_classifiers_learn_xor(fam_name, grid):
+    X, y = _binary_data()
+    fam = MODEL_REGISTRY[fam_name]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((len(grid), X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=2)
+    scores = fam.predict_batch(params, X, 2)
+    assert scores.shape == (len(grid), X.shape[0])
+    acc = _acc(scores[0], y, 2)
+    assert acc > 0.9, f"{fam_name} train accuracy {acc}"
+
+
+@pytest.mark.parametrize("fam_name,grid", [
+    ("OpDecisionTreeRegressor", GRID_TREE),
+    ("OpRandomForestRegressor", GRID_RF),
+    ("OpGBTRegressor", GRID_GBT),
+    ("OpXGBoostRegressor", GRID_XGB),
+])
+def test_regressors_fit_step_function(fam_name, grid):
+    X, y = _regression_data()
+    fam = MODEL_REGISTRY[fam_name]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((len(grid), X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=2)
+    pred = np.asarray(fam.predict_batch(params, X, 2))[0]
+    base = float(np.var(np.asarray(y)))
+    mse = float(np.mean((pred - np.asarray(y)) ** 2))
+    assert mse < 0.3 * base, f"{fam_name} mse {mse} vs var {base}"
+
+
+@pytest.mark.parametrize("fam_name,grid", [
+    ("OpDecisionTreeClassifier", GRID_TREE),
+    ("OpRandomForestClassifier", GRID_RF),
+    ("OpXGBoostClassifier", GRID_XGB),
+])
+def test_multiclass(fam_name, grid):
+    X, y = _multiclass_data()
+    fam = MODEL_REGISTRY[fam_name]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((len(grid), X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=3)
+    scores = fam.predict_batch(params, X, 3)
+    assert scores.shape == (len(grid), X.shape[0], 3)
+    acc = _acc(scores[0], y, 3)
+    assert acc > 0.85, f"{fam_name} multiclass accuracy {acc}"
+
+
+def test_fold_weights_exclude_rows():
+    """Rows with weight 0 must not influence the fit: two configs whose
+    train halves are disjoint give different trees."""
+    X, y = _binary_data(n=300)
+    fam = MODEL_REGISTRY["OpDecisionTreeClassifier"]
+    garr = fam.grid_to_arrays(GRID_TREE * 2)
+    n = X.shape[0]
+    w = np.ones((2, n), np.float32)
+    w[0, : n // 2] = 0.0
+    w[1, n // 2:] = 0.0
+    params = fam.fit_batch(X, y, jnp.asarray(w), garr, num_classes=2)
+    leaves = np.asarray(params["leaf"])
+    assert not np.allclose(leaves[0], leaves[1])
+
+
+def test_predict_one_matches_batch():
+    X, y = _binary_data(n=200)
+    fam = MODEL_REGISTRY["OpGBTClassifier"]
+    garr = fam.grid_to_arrays(GRID_GBT)
+    w = jnp.ones((1, X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=2)
+    batch_scores = np.asarray(fam.predict_batch(params, X, 2))[0]
+    from transmogrifai_tpu.models.api import FittedParams
+    fitted = FittedParams(family=fam.name, params=fam.select_params(params, 0),
+                          hyper=GRID_GBT[0], num_classes=2)
+    parts = fam.predict_one(fitted, np.asarray(X))
+    np.testing.assert_allclose(parts["probability"][:, 1], batch_scores,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_min_instances_prunes_splits():
+    """A huge minInstancesPerNode must force a stump-ish tree."""
+    X, y = _binary_data(n=200)
+    fam = MODEL_REGISTRY["OpDecisionTreeClassifier"]
+    grid = [{"maxDepth": 4, "minInstancesPerNode": 1000, "minInfoGain": 0.0}]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((1, X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=2)
+    thr = np.asarray(params["thresh"])[0]
+    assert np.all(np.isinf(thr)), "no split should satisfy minInstances=1000"
+
+
+def test_max_depth_respected():
+    """maxDepth=1 config inside a deeper static build: only root splits."""
+    X, y = _binary_data(n=300)
+    fam = MODEL_REGISTRY["OpDecisionTreeClassifier"]
+    grid = [{"maxDepth": 1, "minInstancesPerNode": 1, "minInfoGain": 0.0},
+            {"maxDepth": 4, "minInstancesPerNode": 1, "minInfoGain": 0.0}]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((2, X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, num_classes=2)
+    thr = np.asarray(params["thresh"])
+    # config 0: heap nodes below the root (index >= 1) must all be +inf leaves
+    assert np.isfinite(thr[0, 0])
+    assert np.all(np.isinf(thr[0, 1:]))
+    # config 1 actually uses the depth
+    assert np.isfinite(thr[1, 1:3]).any()
+
+
+def test_validator_sweep_with_trees():
+    """Trees slot into the CV sweep exactly like linear families."""
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    X, _ = _binary_data(n=300)
+    y = (np.asarray(X)[:, 0] > 0).astype(np.float32)  # axis-aligned rule
+    fam = MODEL_REGISTRY["OpRandomForestClassifier"]
+    grid = [{"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+             "numTrees": 8, "subsamplingRate": 1.0},
+            {"maxDepth": 4, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+             "numTrees": 8, "subsamplingRate": 1.0}]
+    cv = OpCrossValidation(num_folds=2, seed=0)
+    best = cv.validate([(fam, grid)], X, y, problem="binary",
+                       metric_name="AuROC", larger_better=True, num_classes=2)
+    assert best.family_name == "OpRandomForestClassifier"
+    assert best.metric_value > 0.8
+    assert best.results[0].fold_metrics.shape == (2, 2)

@@ -15,3 +15,25 @@ from .table import Column, FeatureTable
 from .vector_metadata import VectorColumnMetadata, VectorMetadata
 
 __version__ = "0.1.0"
+
+#: lazily-imported public API (importing these eagerly would pull in jax
+#: before the user has a chance to set platform flags)
+_LAZY = {
+    "OpWorkflow": ".workflow",
+    "OpWorkflowModel": ".workflow",
+    "SanityChecker": ".impl.preparators.sanity_checker",
+    "BinaryClassificationModelSelector": ".impl.selector.factories",
+    "MultiClassificationModelSelector": ".impl.selector.factories",
+    "RegressionModelSelector": ".impl.selector.factories",
+    "transmogrify": ".impl.feature.transmogrifier",
+    "DataReaders": ".readers.readers",
+    "Evaluators": ".evaluators.factory",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -46,6 +46,7 @@ class ModelSelectorSummary:
             "bestModelType": self.best_model_type,
             "bestHyperparameters": self.best_hyper,
             "bestMetricValue": self.best_metric_value,
+            "largerBetter": self.larger_better,
             "validationResults": [r.to_json() for r in self.validation_results],
             "trainEvaluation": self.train_evaluation,
             "holdoutEvaluation": self.holdout_evaluation,
@@ -76,12 +77,18 @@ class ModelSelector(AllowLabelAsInput, Estimator):
 
     def _resolve_models(self, models):
         resolved: List[Tuple[ModelFamily, List[Dict[str, Any]]]] = []
+        from ...models import trees  # noqa: F401 (registers tree families)
         if models is None:
-            from ...models.api import MODEL_REGISTRY
+            # reference default model types (BinaryClassificationModelSelector
+            # Defaults.modelTypesToUse :59-61, MultiClassification :59-61,
+            # RegressionModelSelector :59-61; NB/DT/XGB off by default)
             defaults = {
-                "binary": ["OpLogisticRegression", "OpLinearSVC", "OpNaiveBayes"],
-                "multiclass": ["OpLogisticRegression", "OpNaiveBayes"],
-                "regression": ["OpLinearRegression"],
+                "binary": ["OpLogisticRegression", "OpRandomForestClassifier",
+                           "OpGBTClassifier", "OpLinearSVC"],
+                "multiclass": ["OpLogisticRegression",
+                               "OpRandomForestClassifier"],
+                "regression": ["OpLinearRegression", "OpRandomForestRegressor",
+                               "OpGBTRegressor"],
             }[self.problem]
             models = [(MODEL_REGISTRY[name], None) for name in defaults]
         for fam, grid in models:
@@ -204,11 +211,11 @@ class SelectedModel(AllowLabelAsInput, Transformer):
     def _unmap_prediction(self, pred: np.ndarray) -> np.ndarray:
         """Map dense class indices back to the original labels dropped/remapped
         by DataCutter (reference PredictionDeIndexer semantics)."""
-        if not self.label_mapping:
+        if not self.label_mapping or pred.size == 0:
             return pred
         inverse = {dense: orig for orig, dense in self.label_mapping.items()}
-        return np.vectorize(lambda v: inverse.get(int(v), int(v)))(
-            pred).astype(np.float32)
+        return np.vectorize(lambda v: inverse.get(int(v), int(v)),
+                            otypes=[np.float32])(pred)
 
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features

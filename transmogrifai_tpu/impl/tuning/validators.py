@@ -21,7 +21,7 @@ import numpy as np
 from ...models.api import FittedParams, ModelFamily
 from ...ops.metrics import (
     aupr_masked, auroc_masked, binary_threshold_metrics_masked,
-    multiclass_metrics_masked, regression_metrics_masked,
+    log_loss_masked, multiclass_metrics_masked, regression_metrics_masked,
 )
 
 
@@ -67,13 +67,7 @@ def _metric_fn(problem: str, metric: str):
                 return binary_threshold_metrics_masked(scores, y, mask)[metric]
             return jax.jit(jax.vmap(one_b, in_axes=(0, None, 0)))
         if metric == "LogLoss":
-            def one_ll(scores, y, mask):
-                p = jnp.clip(scores, 1e-15, 1 - 1e-15)
-                yy = (y > 0.5).astype(scores.dtype)
-                w = mask.astype(scores.dtype)
-                ll = -(yy * jnp.log(p) + (1 - yy) * jnp.log(1 - p)) * w
-                return ll.sum() / jnp.maximum(w.sum(), 1.0)
-            return jax.jit(jax.vmap(one_ll, in_axes=(0, None, 0)))
+            return jax.jit(jax.vmap(log_loss_masked, in_axes=(0, None, 0)))
         raise ValueError(f"unknown binary validation metric '{metric}'")
     if problem == "multiclass":
         if metric not in ("F1", "Precision", "Recall", "Error"):

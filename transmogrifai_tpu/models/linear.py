@@ -301,9 +301,12 @@ class LinearSVCFamily(ModelFamily):
         return {"coef": coef, "bias": bias}
 
     def predict_batch(self, params, X, num_classes):
-        # margins; rank-based metrics (AuROC/AuPR) work on margins directly
-        return jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC) \
+        # squash margins so threshold-style validation metrics (which cut at
+        # 0.5) and LogLoss see [0,1] scores; rank metrics are unaffected by
+        # the monotone map, and sigmoid(m) > 0.5 ⇔ margin > 0
+        margins = jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC) \
             + params["bias"][:, None]
+        return jax.nn.sigmoid(margins)
 
     def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
         margin = X @ fitted.params["coef"] + fitted.params["bias"]

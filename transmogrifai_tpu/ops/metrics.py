@@ -168,23 +168,15 @@ def regression_metrics_masked(pred: jnp.ndarray, label: jnp.ndarray,
             "MeanAbsoluteError": jnp.abs(err).sum() / cnt, "R2": r2}
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
-def multiclass_f1_masked(pred_idx: jnp.ndarray, label_idx: jnp.ndarray,
-                         mask: jnp.ndarray, num_classes: int) -> jnp.ndarray:
-    """Weighted F1 over the masked subset (vmapped-CV fast path)."""
-    w = mask.astype(jnp.float32)
-    p = jax.nn.one_hot(pred_idx, num_classes, dtype=jnp.float32) * w[:, None]
-    l = jax.nn.one_hot(label_idx, num_classes, dtype=jnp.float32) * w[:, None]
-    cm = l.T @ p
-    n = jnp.maximum(cm.sum(), 1.0)
-    support = cm.sum(axis=1)
-    pred_cnt = cm.sum(axis=0)
-    tp = jnp.diag(cm)
-    prec_c = tp / jnp.maximum(pred_cnt, 1.0)
-    rec_c = tp / jnp.maximum(support, 1.0)
-    f1_c = jnp.where(prec_c + rec_c > 0,
-                     2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, 1e-30), 0.0)
-    return (f1_c * support / n).sum()
+def log_loss_masked(scores: jnp.ndarray, labels: jnp.ndarray,
+                    mask: jnp.ndarray) -> jnp.ndarray:
+    """Binary log loss over the masked subset (validation-sweep variant of
+    ``log_loss``)."""
+    p = jnp.clip(scores, 1e-15, 1 - 1e-15)
+    y = (labels > 0.5).astype(scores.dtype)
+    w = mask.astype(scores.dtype)
+    ll = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)) * w
+    return ll.sum() / jnp.maximum(w.sum(), 1.0)
 
 
 @partial(jax.jit, static_argnames=("num_bins",))
