@@ -1,0 +1,100 @@
+"""GLM family + isotonic calibrator tests (model: reference
+OpGeneralizedLinearRegressionTest, IsotonicRegressionCalibratorTest)."""
+import numpy as np
+import jax.numpy as jnp
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY, FittedParams
+import transmogrifai_tpu.models.glm  # noqa: F401
+from transmogrifai_tpu.impl.regression import IsotonicRegressionCalibrator
+from transmogrifai_tpu.impl.regression.isotonic import pav_fit
+from transmogrifai_tpu.table import Column, FeatureTable
+from transmogrifai_tpu.types import RealNN
+from transmogrifai_tpu.features import FeatureBuilder
+
+
+def test_glm_gaussian_matches_linear():
+    rng = np.random.RandomState(0)
+    n, d = 500, 4
+    X = rng.randn(n, d).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5, 0.0], np.float32)
+    y = X @ beta + 2.0 + 0.05 * rng.randn(n).astype(np.float32)
+    fam = MODEL_REGISTRY["OpGeneralizedLinearRegression"]
+    garr = fam.grid_to_arrays([{"family": "gaussian", "regParam": 0.0}])
+    w = jnp.ones((1, n), jnp.float32)
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), w, garr, 2)
+    np.testing.assert_allclose(np.asarray(params["coef"])[0], beta, atol=0.05)
+    np.testing.assert_allclose(np.asarray(params["bias"])[0], 2.0, atol=0.05)
+
+
+def test_glm_poisson_recovers_log_link():
+    rng = np.random.RandomState(1)
+    n, d = 2000, 3
+    X = rng.randn(n, d).astype(np.float32) * 0.5
+    beta = np.array([0.8, -0.4, 0.2], np.float32)
+    mu = np.exp(X @ beta + 0.5)
+    y = rng.poisson(mu).astype(np.float32)
+    fam = MODEL_REGISTRY["OpGeneralizedLinearRegression"]
+    garr = fam.grid_to_arrays([{"family": "poisson", "regParam": 0.0}])
+    w = jnp.ones((1, n), jnp.float32)
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), w, garr, 2)
+    np.testing.assert_allclose(np.asarray(params["coef"])[0], beta, atol=0.1)
+    # predictions are on the mean scale (exp of margin)
+    pred = np.asarray(fam.predict_batch(params, jnp.asarray(X), 2))[0]
+    assert np.all(pred > 0)
+    corr = np.corrcoef(pred, mu)[0, 1]
+    assert corr > 0.97
+
+
+def test_glm_mixed_grid_families():
+    """gaussian and poisson configs fit in ONE batch."""
+    rng = np.random.RandomState(2)
+    n = 400
+    X = rng.randn(n, 2).astype(np.float32)
+    y = np.maximum(X[:, 0] * 2 + 3, 0.1).astype(np.float32)
+    fam = MODEL_REGISTRY["OpGeneralizedLinearRegression"]
+    grid = [{"family": "gaussian", "regParam": 0.01},
+            {"family": "poisson", "regParam": 0.01}]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((2, n), jnp.float32)
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y), w, garr, 2)
+    pred = np.asarray(fam.predict_batch(params, jnp.asarray(X), 2))
+    assert np.isfinite(pred).all()
+    assert np.all(pred[1] > 0)  # poisson mean is positive
+    # predict_one parity
+    fitted = FittedParams(fam.name, fam.select_params(params, 1), grid[1])
+    one = fam.predict_one(fitted, np.asarray(X))
+    np.testing.assert_allclose(one["prediction"], pred[1], rtol=1e-4, atol=1e-4)
+
+
+def test_pav_monotone():
+    rng = np.random.RandomState(3)
+    s = rng.rand(200).astype(np.float32)
+    y = (rng.rand(200) < s).astype(np.float32)   # calibrated by construction
+    b, v = pav_fit(s, y)
+    assert np.all(np.diff(b) > 0)
+    assert np.all(np.diff(v) >= -1e-7)
+    assert v.min() >= 0.0 and v.max() <= 1.0
+
+
+def test_isotonic_calibrator_stage():
+    rng = np.random.RandomState(4)
+    n = 300
+    s = rng.rand(n).astype(np.float32)
+    y = (rng.rand(n) < s ** 2).astype(np.float32)  # miscalibrated scores
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    score = FeatureBuilder.RealNN("score").extract_field().as_predictor()
+    est = IsotonicRegressionCalibrator()
+    out = est.set_input(label, score).get_output()
+    assert out.feature_type is RealNN
+    assert not out.is_response    # AllowLabelAsInput
+    tbl = FeatureTable({"label": Column(RealNN, y, None),
+                        "score": Column(RealNN, s, None)}, n)
+    model = est.fit(tbl)
+    cal = np.asarray(model.transform_column(tbl).values)
+    # calibrated values closer to the true probability s**2 than raw scores
+    err_raw = np.abs(s - s ** 2).mean()
+    err_cal = np.abs(cal - s ** 2).mean()
+    assert err_cal < err_raw
+    # row dual parity
+    r = model.transform_row({"label": None, "score": float(s[0])})
+    assert np.isclose(r, cal[0], atol=1e-6)

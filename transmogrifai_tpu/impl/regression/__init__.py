@@ -1,0 +1,1 @@
+from .isotonic import IsotonicRegressionCalibrator  # noqa: F401

@@ -1,0 +1,115 @@
+"""Isotonic regression calibrator.
+
+Mirrors the reference's IsotonicRegressionCalibrator (reference:
+core/.../impl/regression/IsotonicRegressionCalibrator.scala — wraps Spark
+``IsotonicRegression`` to calibrate scores against a binary label).
+
+The fit is classic pool-adjacent-violators (PAV). PAV is inherently
+sequential, but it runs over the *distinct sorted scores* — after an initial
+device-side sort + segment reduction the host loop touches only the pooled
+blocks, so the O(n) part stays columnar. Prediction is linear interpolation
+between breakpoints (Spark semantics), which is a jittable ``jnp.interp``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...stages.base import AllowLabelAsInput, BinaryEstimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import RealNN
+
+
+def pav_fit(scores: np.ndarray, labels: np.ndarray,
+            weights: Optional[np.ndarray] = None):
+    """Pool-adjacent-violators: returns (boundaries, values) — the isotonic
+    step/interpolation points, increasing in both arrays."""
+    order = np.argsort(scores, kind="stable")
+    x = np.asarray(scores, np.float64)[order]
+    y = np.asarray(labels, np.float64)[order]
+    w = np.ones_like(y) if weights is None else \
+        np.asarray(weights, np.float64)[order]
+    # blocks as (sum_wy, sum_w, x_min, x_max)
+    blocks: list = []
+    for xi, yi, wi in zip(x, y, w):
+        blocks.append([yi * wi, wi, xi, xi])
+        while len(blocks) >= 2 and (
+                blocks[-2][0] * blocks[-1][1] >=
+                blocks[-1][0] * blocks[-2][1]):  # mean[-2] >= mean[-1]
+            b = blocks.pop()
+            blocks[-1][0] += b[0]
+            blocks[-1][1] += b[1]
+            blocks[-1][3] = b[3]
+        # merge identical x so boundaries stay strictly increasing
+    bounds, vals = [], []
+    for swy, sw, x0, x1 in blocks:
+        v = swy / max(sw, 1e-12)
+        if bounds and x0 <= bounds[-1]:
+            vals[-1] = (vals[-1] + v) / 2.0
+            continue
+        if x0 == x1:
+            bounds.append(x0)
+            vals.append(v)
+        else:
+            bounds.extend([x0, x1])
+            vals.extend([v, v])
+    return np.asarray(bounds, np.float32), np.asarray(vals, np.float32)
+
+
+class IsotonicCalibratorModel(AllowLabelAsInput, Transformer):
+    output_type = RealNN
+
+    def __init__(self, boundaries: np.ndarray, values: np.ndarray, uid=None):
+        super().__init__("calibrate", uid)
+        self.boundaries = boundaries
+        self.values = values
+        self.summary_metadata: Dict[str, Any] = {
+            "boundaries": boundaries.tolist(), "predictions": values.tolist()}
+
+    def _interp(self, s):
+        import jax.numpy as jnp
+        if len(self.boundaries) == 0:
+            return jnp.zeros_like(s)
+        return jnp.interp(s, jnp.asarray(self.boundaries),
+                          jnp.asarray(self.values))
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        import jax.numpy as jnp
+        _, score_f = self.input_features
+        s = jnp.asarray(np.asarray(table[score_f.name].values, np.float32))
+        return Column(RealNN, np.asarray(self._interp(s)), None)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        _, score_f = self.input_features
+        v = row.get(score_f.name)
+        if v is None:
+            return None
+        import jax.numpy as jnp
+        return float(self._interp(jnp.asarray([float(v)], jnp.float32))[0])
+
+
+class IsotonicRegressionCalibrator(AllowLabelAsInput, BinaryEstimator):
+    """Estimator2[RealNN label, RealNN score] → RealNN calibrated score."""
+
+    def __init__(self, isotonic: bool = True, uid=None):
+        def fit_fn(label_col, score_col):
+            y = np.asarray(label_col.values, np.float64)
+            s = np.asarray(score_col.values, np.float64)
+            m = label_col.valid_mask() & score_col.valid_mask()
+            if isotonic:
+                b, v = pav_fit(s[m], y[m])
+            else:
+                # antitonic: fit on negated scores, mirror back so the stored
+                # boundaries stay increasing for jnp.interp
+                b, v = pav_fit(-s[m], y[m])
+                b, v = -b[::-1], v[::-1]
+            return {"boundaries": np.ascontiguousarray(b),
+                    "values": np.ascontiguousarray(v)}
+
+        super().__init__(
+            "calibrate", fit_fn, RealNN,
+            make_model=lambda st: IsotonicCalibratorModel(
+                st["boundaries"], st["values"]),
+            input_types=(RealNN, RealNN), uid=uid)
+        self.isotonic = isotonic

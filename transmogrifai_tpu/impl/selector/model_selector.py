@@ -77,7 +77,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
 
     def _resolve_models(self, models):
         resolved: List[Tuple[ModelFamily, List[Dict[str, Any]]]] = []
-        from ...models import trees  # noqa: F401 (registers tree families)
+        from ...models import glm, trees  # noqa: F401 (registers families)
         if models is None:
             # reference default model types (BinaryClassificationModelSelector
             # Defaults.modelTypesToUse :59-61, MultiClassification :59-61,
@@ -88,7 +88,8 @@ class ModelSelector(AllowLabelAsInput, Estimator):
                 "multiclass": ["OpLogisticRegression",
                                "OpRandomForestClassifier"],
                 "regression": ["OpLinearRegression", "OpRandomForestRegressor",
-                               "OpGBTRegressor"],
+                               "OpGBTRegressor",
+                               "OpGeneralizedLinearRegression"],
             }[self.problem]
             models = [(MODEL_REGISTRY[name], None) for name in defaults]
         for fam, grid in models:
