@@ -1,0 +1,173 @@
+"""Model persistence round-trip + local scoring parity (model: reference
+OpWorkflowModelReaderWriterTest + OpWorkflowModelLocalTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.impl.selector.factories import BinaryClassificationModelSelector
+from transmogrifai_tpu.local import micro_batch_score_function, score_function
+from transmogrifai_tpu.workflow import OpWorkflow, OpWorkflowModel
+
+
+def _make_df(n=240, seed=7):
+    rng = np.random.RandomState(seed)
+    x1 = rng.randn(n)
+    x2 = rng.randn(n)
+    color = rng.choice(["red", "green", "blue"], size=n)
+    y = ((x1 + (color == "red") * 1.5 + 0.3 * rng.randn(n)) > 0).astype(float)
+    x1[rng.rand(n) < 0.1] = np.nan
+    return pd.DataFrame({"x1": x1, "x2": x2, "color": color, "y": y})
+
+
+def _build_workflow(df):
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    x2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+    color = FeatureBuilder.PickList("color").extract_field().as_predictor()
+    vec = transmogrify([x1, x2, color])
+    checked = SanityChecker().set_input(y, vec).get_output()
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, checked).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    return wf, y, pred
+
+
+def test_save_load_round_trip(tmp_path):
+    df = _make_df()
+    wf, y, pred = _build_workflow(df)
+    model = wf.train()
+    scored = model.score(df=df)
+    before = np.asarray(scored[pred.name].values)
+
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = OpWorkflowModel.load(path)
+
+    assert [f.name for f in loaded.result_features] == [f.name for f in model.result_features]
+    rescored = loaded.score(df=df)
+    after = np.asarray(rescored[pred.name].values)
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+    # summaries survive the round trip
+    sel = loaded.get_stage(pred.origin_stage.uid)
+    assert sel.summary.best_model_type == "OpLogisticRegression"
+
+
+def test_load_resolves_lambdas_from_workflow(tmp_path):
+    df = _make_df()
+    y = FeatureBuilder.RealNN("y").extract(lambda r: r["y"]).as_response()
+    x1 = FeatureBuilder.Real("x1").extract(lambda r: r.get("x1")).as_predictor()
+    vec = transmogrify([x1])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, vec).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    model = wf.train()
+    path = str(tmp_path / "model")
+    model.save(path)
+    # lambdas can't serialize; resolving against the original workflow works
+    loaded = OpWorkflowModel.load(path, workflow=wf)
+    raw_gen = loaded.raw_features[0].origin_stage
+    assert callable(raw_gen.extract_fn)
+
+
+def test_local_scoring_parity(tmp_path):
+    df = _make_df()
+    wf, y, pred = _build_workflow(df)
+    model = wf.train()
+
+    scored = model.score(df=df)
+    batch_pred = np.asarray(scored[pred.name].values)
+    keys = scored[pred.name].metadata["keys"]
+    pred_idx = keys.index("prediction")
+
+    score_row = score_function(model)
+    rows = df.to_dict("records")
+    for i in [0, 5, 17, 100]:
+        out = score_row(rows[i])
+        assert out[pred.name]["prediction"] == pytest.approx(
+            float(batch_pred[i, pred_idx]), abs=1e-5)
+
+    score_batch = micro_batch_score_function(model)
+    outs = score_batch(rows[:16])
+    for i, rec in enumerate(outs):
+        assert rec[pred.name]["prediction"] == pytest.approx(
+            float(batch_pred[i, pred_idx]), abs=1e-5)
+
+
+def test_save_load_with_raw_feature_filter(tmp_path):
+    # regression: blacklisted raw features must round-trip (they are outside
+    # the post-surgery result ancestry)
+    from transmogrifai_tpu.filters import RawFeatureFilter
+    df = _make_df()
+    df["dead"] = np.nan
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    dead = FeatureBuilder.Real("dead").extract_field().as_predictor()
+    vec = transmogrify([x1, dead])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, vec).get_output())
+    wf = (OpWorkflow().set_input_dataset(df).set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.02)))
+    model = wf.train()
+    assert [f.name for f in model.blacklisted_features] == ["dead"]
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = OpWorkflowModel.load(path)
+    assert [f.name for f in loaded.blacklisted_features] == ["dead"]
+    s1 = np.asarray(model.score(df=df)[pred.name].values)
+    s2 = np.asarray(loaded.score(df=df)[pred.name].values)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-6)
+
+
+def test_local_scoring_applies_custom_extract():
+    # regression: serve-time scoring must run extract_fn, not raw field lookup
+    df = _make_df()
+    df["a"] = df["x1"].fillna(0.0)
+    df["b"] = df["x2"]
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    absum = FeatureBuilder.Real("absum").extract(
+        lambda r: (r.get("a") or 0.0) + (r.get("b") or 0.0)).as_predictor()
+    vec = transmogrify([absum])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, vec).get_output())
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(pred).train())
+    scored = model.score(df=df)
+    batch = np.asarray(scored[pred.name].values)
+    keys = scored[pred.name].metadata["keys"]
+    pi = keys.index("prediction")
+    rows = df.to_dict("records")
+    srow = score_function(model)
+    sbatch = micro_batch_score_function(model)
+    for i in (0, 7, 42):
+        assert srow(rows[i])[pred.name]["prediction"] == pytest.approx(
+            float(batch[i, pi]), abs=1e-5)
+    outs = sbatch(rows[:8])
+    for i, rec in enumerate(outs):
+        assert rec[pred.name]["prediction"] == pytest.approx(
+            float(batch[i, pi]), abs=1e-5)
+
+
+def test_partial_retrain_with_model_stages():
+    df = _make_df()
+    wf, y, pred = _build_workflow(df)
+    model = wf.train()
+    # a second workflow over the same features reuses fitted stages
+    wf2 = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    wf2.with_model_stages(model)
+    from transmogrifai_tpu.stages.base import Estimator
+    fitted_uids = {s.uid for s in model.stages}
+    reused = [s for s in wf2.stages if s.uid in fitted_uids]
+    # the swapped-in stages must be fitted Transformers, not unfitted Estimators
+    assert reused and all(not isinstance(s, Estimator) for s in reused)
+    model2 = wf2.train()
+    s1 = np.asarray(model.score(df=df)[pred.name].values)
+    s2 = np.asarray(model2.score(df=df)[pred.name].values)
+    np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,140 @@
+"""RawFeatureFilter + streaming histogram tests (model: reference
+RawFeatureFilterTest, FeatureDistributionTest, StreamingHistogramTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.filters import RawFeatureFilter
+from transmogrifai_tpu.readers.readers import dataframe_to_table
+from transmogrifai_tpu.utils.streaming_histogram import (
+    StreamingHistogram, native_available,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+class TestStreamingHistogram:
+    def test_quantiles_close_to_exact(self):
+        rng = np.random.RandomState(3)
+        xs = rng.randn(50000)
+        h = StreamingHistogram(64).update(xs)
+        assert h.total == 50000
+        for q in (0.05, 0.25, 0.5, 0.75, 0.95):
+            assert h.quantile(q) == pytest.approx(np.quantile(xs, q), abs=0.05)
+
+    def test_merge_matches_single_pass(self):
+        rng = np.random.RandomState(4)
+        xs = rng.exponential(size=20000)
+        h1 = StreamingHistogram(64).update(xs[:10000])
+        h2 = StreamingHistogram(64).update(xs[10000:])
+        h1.merge(h2)
+        h = StreamingHistogram(64).update(xs)
+        assert h1.total == h.total == 20000
+        assert h1.quantile(0.5) == pytest.approx(h.quantile(0.5), abs=0.05)
+
+    def test_native_builds(self):
+        # the C++ path must be live in CI (g++ is baked into the image)
+        assert native_available()
+
+    def test_density_sums_to_total(self):
+        xs = np.linspace(0, 10, 1000)
+        h = StreamingHistogram(32).update(xs)
+        edges = np.linspace(-1, 11, 21)
+        d = h.density(edges)
+        assert d.sum() == pytest.approx(1000, rel=1e-3)
+
+
+def _features():
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    good = FeatureBuilder.Real("good").extract_field().as_predictor()
+    empty = FeatureBuilder.Real("empty").extract_field().as_predictor()
+    shifted = FeatureBuilder.Real("shifted").extract_field().as_predictor()
+    leaky = FeatureBuilder.Real("leaky").extract_field().as_predictor()
+    m = FeatureBuilder.RealMap("m").extract_field().as_predictor()
+    return y, good, empty, shifted, leaky, m
+
+
+def _train_df(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    y = (rng.rand(n) > 0.5).astype(float)
+    leaky = rng.randn(n)
+    leaky[y > 0.5] = np.nan  # null pattern == label
+    return pd.DataFrame({
+        "y": y,
+        "good": rng.randn(n),
+        "empty": np.full(n, np.nan),
+        "shifted": rng.randn(n),
+        "leaky": leaky,
+        "m": [{"a": rng.randn(), "b": None if rng.rand() < 0.995 else 1.0}
+              for _ in range(n)],
+    })
+
+
+def _score_df(n=400, seed=1):
+    rng = np.random.RandomState(seed)
+    return pd.DataFrame({
+        "good": rng.randn(n),
+        "empty": np.full(n, np.nan),
+        "shifted": rng.randn(n) + 50.0,  # massive distribution shift
+        "leaky": rng.randn(n),
+        "m": [{"a": rng.randn()} for _ in range(n)],
+    })
+
+
+def test_filters_bad_features():
+    y, good, empty, shifted, leaky, m = _features()
+    feats = [y, good, empty, shifted, leaky, m]
+    train = dataframe_to_table(_train_df(), feats)
+    score = dataframe_to_table(_score_df(), [f for f in feats if not f.is_response])
+
+    rff = RawFeatureFilter(score_table=score, max_js_divergence=0.5,
+                           max_correlation=0.8, min_fill_rate=0.02)
+    cleaned, blacklist, results = rff.filter_raw(train, feats)
+
+    excluded = set(results.excluded_features)
+    assert "empty" in excluded            # all null
+    assert "shifted" in excluded          # train/score JS divergence
+    assert "leaky" in excluded            # null-label correlation
+    assert "good" not in excluded
+    assert "good" in cleaned.column_names
+    assert "empty" not in cleaned.column_names
+    # map key 'b' is almost always missing -> key-level exclusion
+    assert "b" in results.excluded_map_keys.get("m", [])
+    assert all("b" not in (v or {}) for v in cleaned["m"].values)
+
+    by_name = {m_.full_name: m_ for m_ in results.metrics}
+    assert by_name["leaky"].null_label_correlation == pytest.approx(1.0, abs=0.05)
+    assert by_name["shifted"].js_divergence > 0.5
+
+
+def test_protected_features_survive():
+    y, good, empty, shifted, leaky, m = _features()
+    feats = [y, empty, good]
+    train = dataframe_to_table(_train_df(), feats)
+    rff = RawFeatureFilter(min_fill_rate=0.02, protected_features=["empty"])
+    cleaned, blacklist, results = rff.filter_raw(train, feats)
+    assert "empty" in cleaned.column_names
+    assert results.excluded_features == []
+
+
+def test_workflow_integration_blacklist_surgery():
+    y, good, empty, shifted, leaky, m = _features()
+    from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+    vec = transmogrify([good, empty, leaky])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, vec).get_output())
+    wf = (OpWorkflow()
+          .set_input_dataset(_train_df())
+          .set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.02,
+                                                    max_correlation=0.8)))
+    model = wf.train()
+    gone = {f.name for f in model.blacklisted_features}
+    assert "empty" in gone and "leaky" in gone
+    assert model.rff_results is not None
+    scored = model.score(df=_train_df())
+    assert pred.name in scored.column_names

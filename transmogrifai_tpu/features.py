@@ -166,13 +166,25 @@ class Feature:
         return builder.as_response() if self.is_response else builder.as_predictor()
 
 
-def _field_extractor(name: str, ft: Type[FeatureType]) -> Callable[[Any], Any]:
-    def extract(record: Any) -> Any:
+class FieldExtractor:
+    """Default extract function: pull the record field with the feature's name.
+
+    A class (not a closure) so model persistence can round-trip it — the analog
+    of the reference serializing extract lambdas by class name
+    (FeatureGeneratorStage + OpPipelineStageReader ctor reflection)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.__name__ = f"extract_{name}"
+
+    def __call__(self, record: Any) -> Any:
         if isinstance(record, dict):
-            return record.get(name)
-        return getattr(record, name, None)
-    extract.__name__ = f"extract_{name}"
-    return extract
+            return record.get(self.name)
+        return getattr(record, self.name, None)
+
+
+def _field_extractor(name: str, ft: Type[FeatureType]) -> Callable[[Any], Any]:
+    return FieldExtractor(name)
 
 
 class FeatureBuilder:

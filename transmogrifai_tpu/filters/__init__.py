@@ -1,0 +1,4 @@
+from .distribution import FeatureDistribution, Summary  # noqa: F401
+from .raw_feature_filter import (  # noqa: F401
+    RawFeatureFilter, RawFeatureFilterResults,
+)

@@ -1,0 +1,212 @@
+"""Feature distribution sketches for the RawFeatureFilter.
+
+Mirrors the reference distribution machinery (reference:
+core/src/main/scala/com/salesforce/op/filters/FeatureDistribution.scala —
+histogram/text-hash bins + JS divergence; Summary.scala; PreparedFeatures.scala)
+re-based on the native streaming-histogram sketch
+(native/streaming_histogram.cpp): numeric features stream through the C++
+SPDT sketch in one host pass, text-ish features hash into a fixed bin space —
+both mergeable monoids, so multi-host readers reduce them the same way the
+reference monoid-reduces over RDD partitions (RawFeatureFilter.scala:135-196).
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..table import Column
+from ..utils.streaming_histogram import StreamingHistogram
+
+#: numeric column kinds sketched with the streaming histogram
+_NUMERIC_KINDS = frozenset({"real", "binary", "integral", "date"})
+
+
+@dataclass
+class Summary:
+    """Per-feature value summary (reference filters/Summary.scala)."""
+    min: float = np.inf
+    max: float = -np.inf
+    sum: float = 0.0
+    count: float = 0.0
+
+    @staticmethod
+    def of(values: np.ndarray) -> "Summary":
+        if values.size == 0:
+            return Summary()
+        return Summary(float(np.min(values)), float(np.max(values)),
+                       float(np.sum(values)), float(values.size))
+
+
+def _hash_bin(token: str, bins: int) -> int:
+    # stable across processes (zlib.crc32, not PYTHONHASHSEED-dependent)
+    return zlib.crc32(token.encode("utf-8", "ignore")) % bins
+
+
+@dataclass
+class FeatureDistribution:
+    """Binned distribution of one feature (or one map key).
+
+    For numeric features ``sketch`` is a streaming histogram and
+    ``distribution`` its mass over shared boundaries; for text-ish features
+    ``distribution`` is direct hash-bin counts (reference
+    FeatureDistribution.scala text path).
+    """
+    name: str
+    key: Optional[str] = None          # map key, if this is a map sub-feature
+    count: float = 0.0                 # total rows seen
+    nulls: float = 0.0                 # rows where the value is missing
+    distribution: np.ndarray = field(default_factory=lambda: np.zeros(0))
+    summary: Summary = field(default_factory=Summary)
+    is_numeric: bool = True
+    sketch: Optional[StreamingHistogram] = None
+
+    @property
+    def full_name(self) -> str:
+        return self.name if self.key is None else f"{self.name}[{self.key}]"
+
+    def fill_fraction(self) -> float:
+        return 0.0 if self.count == 0 else 1.0 - self.nulls / self.count
+
+    # -- comparisons (reference FeatureDistribution relativeFillRate etc.) ---
+    def relative_fill_delta(self, other: "FeatureDistribution") -> float:
+        return abs(self.fill_fraction() - other.fill_fraction())
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        a, b = self.fill_fraction(), other.fill_fraction()
+        lo, hi = min(a, b), max(a, b)
+        return np.inf if lo == 0 else hi / lo
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        """Jensen-Shannon divergence in [0, 1] (log base 2), reference
+        FeatureDistribution.jsDivergence."""
+        p, q = np.asarray(self.distribution, float), np.asarray(other.distribution, float)
+        if p.size == 0 or q.size == 0 or p.size != q.size:
+            return 0.0
+        ps, qs = p.sum(), q.sum()
+        if ps == 0 or qs == 0:
+            return 0.0
+        p, q = p / ps, q / qs
+        m = (p + q) / 2.0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            kl_pm = np.where(p > 0, p * np.log2(p / m), 0.0).sum()
+            kl_qm = np.where(q > 0, q * np.log2(q / m), 0.0).sum()
+        return float((kl_pm + kl_qm) / 2.0)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "key": self.key, "count": self.count,
+            "nulls": self.nulls, "fillFraction": self.fill_fraction(),
+            "distribution": np.asarray(self.distribution).tolist(),
+            "min": self.summary.min, "max": self.summary.max,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Sketch computation
+# ---------------------------------------------------------------------------
+
+def numeric_distribution(name: str, values: np.ndarray, valid: np.ndarray,
+                         max_bins: int, key: Optional[str] = None,
+                         ) -> FeatureDistribution:
+    vals = np.asarray(values, dtype=np.float64)[valid]
+    sketch = StreamingHistogram(max_bins).update(vals)
+    return FeatureDistribution(
+        name=name, key=key, count=float(valid.size),
+        nulls=float(valid.size - vals.size), summary=Summary.of(vals),
+        is_numeric=True, sketch=sketch)
+
+
+def text_distribution(name: str, tokens_per_row: Sequence[Optional[Sequence[str]]],
+                      text_bins: int, key: Optional[str] = None,
+                      ) -> FeatureDistribution:
+    counts = np.zeros(text_bins, dtype=np.float64)
+    nulls = 0
+    card = 0.0
+    for toks in tokens_per_row:
+        if toks is None:
+            nulls += 1
+            continue
+        for t in toks:
+            counts[_hash_bin(str(t), text_bins)] += 1.0
+            card += 1.0
+    return FeatureDistribution(
+        name=name, key=key, count=float(len(tokens_per_row)),
+        nulls=float(nulls), distribution=counts,
+        summary=Summary(0.0, float(text_bins), card, card), is_numeric=False)
+
+
+def fill_numeric_bins(train: FeatureDistribution,
+                      score: Optional[FeatureDistribution],
+                      max_bins: int) -> None:
+    """Bin both sketches over boundaries derived from the TRAIN summary
+    (reference: score distributions are binned against train Summary bins)."""
+    lo = train.summary.min
+    hi = train.summary.max
+    if score is not None and score.summary.count:
+        lo, hi = min(lo, score.summary.min), max(hi, score.summary.max)
+    if not np.isfinite(lo) or not np.isfinite(hi):
+        return
+    if hi <= lo:
+        hi = lo + 1.0
+    edges = np.linspace(lo, hi, max_bins + 1)
+    edges[0], edges[-1] = -np.inf, np.inf
+    finite_edges = np.concatenate([[lo - 1.0], edges[1:-1], [hi + 1.0]])
+    for dist in (train, score):
+        if dist is None or dist.sketch is None:
+            continue
+        dist.distribution = dist.sketch.density(finite_edges)
+
+
+def column_distributions(name: str, col: Column, max_bins: int, text_bins: int,
+                         ) -> List[FeatureDistribution]:
+    """Distribution(s) for one raw column; maps explode per key (reference
+    PreparedFeatures: map features tracked per key)."""
+    valid = col.valid_mask()
+    if col.kind in _NUMERIC_KINDS:
+        return [numeric_distribution(name, np.asarray(col.values, dtype=np.float64),
+                                     valid, max_bins)]
+    if col.kind == "map":
+        by_key: Dict[str, List[Tuple[int, Any]]] = {}
+        vals = col.values
+        n = len(col)
+        for i in range(n):
+            if not valid[i] or vals[i] is None:
+                continue
+            for k, v in vals[i].items():
+                by_key.setdefault(str(k), []).append((i, v))
+        out: List[FeatureDistribution] = []
+        for k, pairs in sorted(by_key.items()):
+            present = {i for i, _ in pairs}
+            sample = next((v for _, v in pairs if v is not None), None)
+            if isinstance(sample, (int, float, bool, np.floating, np.integer)):
+                kv = np.zeros(n, dtype=np.float64)
+                km = np.zeros(n, dtype=bool)
+                for i, v in pairs:
+                    if v is not None:
+                        try:
+                            kv[i] = float(v)
+                            km[i] = True
+                        except (TypeError, ValueError):
+                            pass
+                out.append(numeric_distribution(name, kv, km, max_bins, key=k))
+            else:
+                toks: List[Optional[List[str]]] = [None] * n
+                for i, v in pairs:
+                    if v is not None:
+                        toks[i] = [str(v)]
+                out.append(text_distribution(name, toks, text_bins, key=k))
+        return out
+    # text-ish host kinds
+    vals = col.values
+    toks: List[Optional[List[str]]] = []
+    for i in range(len(col)):
+        if not valid[i] or vals[i] is None:
+            toks.append(None)
+        elif isinstance(vals[i], (list, tuple, set)):
+            toks.append([str(x) for x in vals[i]])
+        else:
+            toks.append([str(vals[i])])
+    return [text_distribution(name, toks, text_bins)]

@@ -1,0 +1,281 @@
+"""RawFeatureFilter — pre-DAG screening of raw features.
+
+Mirrors the reference (reference:
+core/src/main/scala/com/salesforce/op/filters/RawFeatureFilter.scala): before
+any stage fits, compare each raw feature's training distribution against the
+scoring distribution and the label, and blacklist features (or individual map
+keys) that are too empty, too shifted, or leak the label through their null
+pattern. Metrics (getRawFeatureFilterMetrics:207-291): fill rates, fill
+rate delta/ratio between train and score, Jensen-Shannon divergence, and
+null-indicator↔label correlation (leakage). Exclusion reasons (:302+)
+drive the blacklists; the cleaned table plus
+``RawFeatureFilterResults`` feed the workflow (OpWorkflow.scala:524-563).
+
+The null-label correlations for ALL features are computed in one jitted
+device pass (a (n, F) null-indicator matrix against the label — the TPU
+re-expression of the reference's per-partition monoid reduce).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features import Feature
+from ..table import Column, FeatureTable
+from .distribution import (
+    FeatureDistribution, column_distributions, fill_numeric_bins,
+)
+
+
+@dataclass
+class FeatureMetrics:
+    """Per-feature (or per map key) filter metrics (reference
+    RawFeatureFilterMetrics)."""
+    name: str
+    key: Optional[str]
+    train_fill_rate: float
+    score_fill_rate: Optional[float] = None
+    fill_rate_delta: Optional[float] = None
+    fill_ratio_diff: Optional[float] = None
+    js_divergence: Optional[float] = None
+    null_label_correlation: Optional[float] = None
+    exclusion_reasons: List[str] = field(default_factory=list)
+
+    @property
+    def full_name(self) -> str:
+        return self.name if self.key is None else f"{self.name}[{self.key}]"
+
+
+@dataclass
+class RawFeatureFilterResults:
+    """Config + metrics + decisions (reference RawFeatureFilterResults.scala)."""
+    config: Dict[str, Any]
+    metrics: List[FeatureMetrics]
+    excluded_features: List[str]
+    excluded_map_keys: Dict[str, List[str]]
+
+    def to_json(self) -> Dict[str, Any]:
+        def clean(d: Dict[str, Any]) -> Dict[str, Any]:
+            return {k: (None if isinstance(v, float) and not np.isfinite(v) else v)
+                    for k, v in d.items()}
+        return {
+            "config": self.config,
+            "metrics": [clean(vars(m)) for m in self.metrics],
+            "excludedFeatures": self.excluded_features,
+            "excludedMapKeys": self.excluded_map_keys,
+        }
+
+
+class RawFeatureFilter:
+    """Screens raw features before the DAG fits (reference
+    RawFeatureFilter.scala ctor params :60-108)."""
+
+    def __init__(self,
+                 score_reader=None,
+                 score_table: Optional[FeatureTable] = None,
+                 bins: int = 100,
+                 min_fill_rate: float = 0.001,
+                 max_fill_difference: float = 0.90,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.90,
+                 max_correlation: float = 0.90,
+                 correlation_type: str = "pearson",
+                 protected_features: Sequence[str] = (),
+                 text_bins: int = 255):
+        self.score_reader = score_reader
+        self.score_table = score_table
+        self.bins = bins
+        self.min_fill_rate = min_fill_rate
+        self.max_fill_difference = max_fill_difference
+        self.max_fill_ratio_diff = max_fill_ratio_diff
+        self.max_js_divergence = max_js_divergence
+        self.max_correlation = max_correlation
+        self.correlation_type = correlation_type
+        self.protected_features = set(protected_features)
+        self.text_bins = text_bins
+
+    # -- distribution computation (reference computeFeatureStats:135-196) ----
+    def _distributions(self, table: FeatureTable, features: Sequence[Feature],
+                       ) -> Dict[str, List[FeatureDistribution]]:
+        out: Dict[str, List[FeatureDistribution]] = {}
+        for f in features:
+            if f.is_response:
+                continue
+            col = table.get(f.name)
+            if col is None:
+                continue
+            out[f.name] = column_distributions(
+                f.name, col, self.bins, self.text_bins)
+        return out
+
+    def _null_label_correlations(self, table: FeatureTable,
+                                 features: Sequence[Feature],
+                                 label: Optional[Column],
+                                 dists: Dict[str, List[FeatureDistribution]],
+                                 ) -> Dict[str, float]:
+        """One device pass: corr(null indicator, label) for every feature/key
+        (reference PreparedFeatures null-label vectors + Pearson)."""
+        if label is None:
+            return {}
+        import jax.numpy as jnp
+        from ..ops.stats import pearson_correlation
+
+        y = np.asarray(label.values, dtype=np.float32)
+        cols: List[np.ndarray] = []
+        names: List[str] = []
+        for f in features:
+            if f.is_response or f.name not in dists:
+                continue
+            col = table[f.name]
+            if col.kind == "map":
+                valid = col.valid_mask()
+                # one key-set per row, shared across all of the feature's keys
+                row_keys = [
+                    {str(k) for k in col.values[i]}
+                    if valid[i] and col.values[i] is not None else frozenset()
+                    for i in range(len(col))]
+                for d in dists[f.name]:
+                    ind = np.array([0.0 if d.key in ks else 1.0
+                                    for ks in row_keys], dtype=np.float32)
+                    cols.append(ind)
+                    names.append(d.full_name)
+            else:
+                ind = (~col.valid_mask()).astype(np.float32)
+                cols.append(ind)
+                names.append(f.name)
+        if not cols:
+            return {}
+        X = jnp.asarray(np.stack(cols, axis=1))
+        corrs = np.asarray(pearson_correlation(X, jnp.asarray(y)))
+        return {n: float(c) for n, c in zip(names, corrs)}
+
+    # -- main entry (reference generateFilteredRaw) --------------------------
+    def filter_raw(self, table: FeatureTable, raw_features: Sequence[Feature],
+                   ) -> Tuple[FeatureTable, List[Feature], RawFeatureFilterResults]:
+        train_dists = self._distributions(table, raw_features)
+
+        score_table = self.score_table
+        if score_table is None and self.score_reader is not None:
+            score_table = self.score_reader.generate_table(
+                [f for f in raw_features if not f.is_response])
+        score_dists = (self._distributions(score_table, raw_features)
+                       if score_table is not None else None)
+
+        label_col = next((table[f.name] for f in raw_features
+                          if f.is_response and f.name in table), None)
+        null_corr = self._null_label_correlations(
+            table, raw_features, label_col, train_dists)
+
+        metrics: List[FeatureMetrics] = []
+        excluded_features: List[str] = []
+        excluded_map_keys: Dict[str, List[str]] = {}
+
+        for f in raw_features:
+            if f.is_response or f.name not in train_dists:
+                continue
+            f_metrics: List[FeatureMetrics] = []
+            for d in train_dists[f.name]:
+                sd = None
+                if score_dists is not None:
+                    sd = next((s for s in score_dists.get(f.name, [])
+                               if s.key == d.key), None)
+                if d.is_numeric:
+                    fill_numeric_bins(d, sd, self.bins)
+                m = FeatureMetrics(
+                    name=f.name, key=d.key,
+                    train_fill_rate=d.fill_fraction(),
+                    null_label_correlation=null_corr.get(d.full_name))
+                if sd is not None:
+                    m.score_fill_rate = sd.fill_fraction()
+                    m.fill_rate_delta = d.relative_fill_delta(sd)
+                    # inf (one side completely empty) must EXCEED the threshold,
+                    # matching the reference's Double.PositiveInfinity compare
+                    m.fill_ratio_diff = float(d.relative_fill_ratio(sd))
+                    m.js_divergence = d.js_divergence(sd)
+                self._apply_exclusions(m, sd is not None)
+                f_metrics.append(m)
+                metrics.append(m)
+
+            if f.name in self.protected_features:
+                for m in f_metrics:
+                    if m.exclusion_reasons:
+                        m.exclusion_reasons = [
+                            r + " (protected, kept)" for r in m.exclusion_reasons]
+                continue
+            is_map = table[f.name].kind == "map"
+            if is_map and len(f_metrics) > 0:
+                bad_keys = [m.key for m in f_metrics
+                            if m.exclusion_reasons and m.key is not None]
+                all_bad = bad_keys and len(bad_keys) == len(f_metrics)
+                if all_bad:
+                    excluded_features.append(f.name)
+                elif bad_keys:
+                    excluded_map_keys[f.name] = bad_keys
+            elif any(m.exclusion_reasons for m in f_metrics):
+                excluded_features.append(f.name)
+
+        results = RawFeatureFilterResults(
+            config={
+                "bins": self.bins, "minFillRate": self.min_fill_rate,
+                "maxFillDifference": self.max_fill_difference,
+                "maxFillRatioDiff": self.max_fill_ratio_diff,
+                "maxJSDivergence": self.max_js_divergence,
+                "maxCorrelation": self.max_correlation,
+            },
+            metrics=metrics,
+            excluded_features=sorted(excluded_features),
+            excluded_map_keys=excluded_map_keys,
+        )
+
+        cleaned = self._clean_table(table, excluded_features, excluded_map_keys)
+        blacklist = [f for f in raw_features if f.name in set(excluded_features)]
+        return cleaned, blacklist, results
+
+    def _apply_exclusions(self, m: FeatureMetrics, has_score: bool) -> None:
+        """Reference ColumnStatistics/ExclusionReasons logic (:302+)."""
+        if m.train_fill_rate < self.min_fill_rate:
+            m.exclusion_reasons.append(
+                f"train fill rate {m.train_fill_rate:.4f} below "
+                f"{self.min_fill_rate}")
+        if has_score:
+            if m.score_fill_rate is not None and m.score_fill_rate < self.min_fill_rate:
+                m.exclusion_reasons.append(
+                    f"score fill rate {m.score_fill_rate:.4f} below "
+                    f"{self.min_fill_rate}")
+            if m.fill_rate_delta is not None and m.fill_rate_delta > self.max_fill_difference:
+                m.exclusion_reasons.append(
+                    f"fill rate delta {m.fill_rate_delta:.4f} above "
+                    f"{self.max_fill_difference}")
+            if m.fill_ratio_diff is not None and m.fill_ratio_diff > self.max_fill_ratio_diff:
+                m.exclusion_reasons.append(
+                    f"fill ratio diff {m.fill_ratio_diff:.2f} above "
+                    f"{self.max_fill_ratio_diff}")
+            if m.js_divergence is not None and m.js_divergence > self.max_js_divergence:
+                m.exclusion_reasons.append(
+                    f"JS divergence {m.js_divergence:.4f} above "
+                    f"{self.max_js_divergence}")
+        if (m.null_label_correlation is not None
+                and abs(m.null_label_correlation) > self.max_correlation):
+            m.exclusion_reasons.append(
+                f"null-label correlation {m.null_label_correlation:.4f} above "
+                f"{self.max_correlation} (leakage)")
+
+    @staticmethod
+    def _clean_table(table: FeatureTable, excluded: List[str],
+                     excluded_keys: Dict[str, List[str]]) -> FeatureTable:
+        out = table.drop([n for n in excluded if n in table.column_names])
+        for name, keys in excluded_keys.items():
+            if name not in out.column_names:
+                continue
+            col = out[name]
+            gone = set(keys)
+            vals = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col.values):
+                vals[i] = (None if v is None
+                           else {k: x for k, x in v.items() if str(k) not in gone})
+            mask = np.array([v is not None and len(v) > 0 for v in vals])
+            out = out.with_column(name, Column(col.feature_type, vals, mask,
+                                               col.metadata))
+        return out

@@ -1,0 +1,1 @@
+from .scoring import score_function, micro_batch_score_function  # noqa: F401

@@ -1,0 +1,77 @@
+"""Spark-free ("local") serve-time scoring.
+
+The reference's `local` module folds a ``Map[String,Any]`` through each stage's
+``transformKeyValue`` row lambda, converting Spark-wrapped models through MLeap
+(reference: local/src/main/scala/com/salesforce/op/local/OpWorkflowModelLocal.scala:93-197).
+Here every Transformer already exposes the row-level dual ``transform_row``, so
+the scorer is simply a fold over the topologically-ordered fitted stages — no
+model-conversion layer is needed. For serving at throughput, use
+:func:`micro_batch_score_function`, which runs the columnar (jitted) path on
+micro-batches — the TPU replacement for MLeap row scoring.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+from ..table import Column, FeatureTable
+
+
+def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """Row-at-a-time scorer (reference OpWorkflowModelLocal.scoreFunction).
+
+    Returns ``fn(raw_row) -> {result feature name: value}`` where ``raw_row``
+    maps raw feature names to python values (None = missing).
+    """
+    stages = model.stages  # farthest-first layers == topological order
+    result_names = [f.name for f in model.result_features]
+    raw_gens = [(f.name, f.origin_stage) for f in model.raw_features]
+
+    def score(row: Dict[str, Any]) -> Dict[str, Any]:
+        # raw features come from each generator's extract_fn, exactly like the
+        # batch reader path (DataReader.generateDataFrame row build)
+        acc = {name: gen.extract(row) for name, gen in raw_gens}
+        for stage in stages:
+            out = stage.get_output()
+            acc[out.name] = stage.transform_row(acc)
+        return {name: acc[name] for name in result_names}
+
+    return score
+
+
+def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], List[Dict[str, Any]]]:
+    """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
+    runs the columnar/jitted DAG pass — the serving path that keeps the TPU
+    busy (SURVEY §2.10 P4: streaming micro-batches)."""
+    raw_features = model.raw_features
+    result_features = model.result_features
+
+    def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        cols = {
+            f.name: Column.of_values(
+                f.feature_type, [f.origin_stage.extract(r) for r in rows])
+            for f in raw_features
+        }
+        table = FeatureTable(cols, len(rows))
+        scored = model.score(table=table)
+        out: List[Dict[str, Any]] = []
+        for i in range(len(rows)):
+            rec: Dict[str, Any] = {}
+            for f in result_features:
+                col = scored[f.name]
+                valid = col.mask is None or bool(np.asarray(col.mask)[i])
+                if not valid:
+                    rec[f.name] = None
+                    continue
+                v = np.asarray(col.values)[i]
+                if f.type_name == "Prediction":
+                    keys = col.metadata.get("keys", ())
+                    rec[f.name] = {k: float(x) for k, x in zip(keys, v)}
+                else:
+                    rec[f.name] = v.tolist() if isinstance(v, np.ndarray) else (
+                        v.item() if isinstance(v, np.generic) else v)
+            out.append(rec)
+        return out
+
+    return score

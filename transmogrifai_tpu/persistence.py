@@ -1,0 +1,326 @@
+"""Workflow-model persistence: plan JSON + array store.
+
+The reference saves a fitted workflow as one JSON document (stages serialized
+by ctor-arg reflection, features as a JSON graph) plus Spark-native stage dirs
+(reference: core/src/main/scala/com/salesforce/op/OpWorkflowModelWriter.scala:52-180,
+OpWorkflowModelReader.scala, stages/OpPipelineStageWriter.scala,
+features/FeatureJsonHelper.scala). The TPU build keeps that shape but swaps the
+substrate: a ``plan.json`` carries the feature graph + per-stage state
+descriptors, and an ``arrays.npz`` carries every fitted device array (model
+coefficients, vocabularies' hash tables, scaler stats) as host numpy.
+
+Stage state is encoded generically from ``__dict__``: arrays → npz entries,
+JSON-able scalars inline, nested objects (summaries, vector metadata,
+FittedParams pytrees) → recursive ``__obj__`` descriptors rebuilt via
+``cls.__new__``. Stages are resolved by class name through ``STAGE_REGISTRY``
+— the analog of the reference's reflection loader. Callables serialize by
+module/qualname when importable; otherwise loading requires the original
+workflow (``load_model(path, workflow=...)``), exactly the reference's
+"resolve against original workflow" path (OpWorkflowModelReader.scala).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .features import Feature
+from .stages.base import STAGE_REGISTRY, FeatureGeneratorStage, OpPipelineStage
+from .types import feature_type_by_name
+
+PLAN_FILE = "plan.json"
+ARRAYS_FILE = "arrays.npz"
+FORMAT_VERSION = 1
+
+#: stage attributes that carry DAG wiring, rebuilt from the feature graph
+_WIRING_ATTRS = ("input_features", "_output_feature")
+
+
+class _Arrays:
+    """Accumulates arrays for the npz store, keyed by stage uid + path."""
+
+    def __init__(self):
+        self.store: Dict[str, np.ndarray] = {}
+        self._n = 0
+
+    def add(self, arr: np.ndarray) -> str:
+        key = f"a{self._n}"
+        self._n += 1
+        self.store[key] = np.asarray(arr)
+        return key
+
+
+def _is_jsonable_scalar(v: Any) -> bool:
+    return v is None or isinstance(v, (bool, int, float, str))
+
+
+def _encode(v: Any, arrays: _Arrays) -> Any:
+    """Value → JSON-able descriptor, externalizing arrays."""
+    if _is_jsonable_scalar(v):
+        if isinstance(v, float) and not np.isfinite(v):
+            return {"__float__": repr(v)}
+        return v
+    if isinstance(v, (np.floating, np.integer, np.bool_)):
+        return _encode(v.item(), arrays)
+    if isinstance(v, np.ndarray):
+        return {"__array__": arrays.add(v)}
+    # jax arrays
+    tname = type(v).__module__
+    if tname.startswith("jax") or type(v).__name__ == "ArrayImpl":
+        return {"__array__": arrays.add(np.asarray(v))}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode(x, arrays) for x in v]}
+    if isinstance(v, list):
+        return [_encode(x, arrays) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return {"__set__": [_encode(x, arrays) for x in sorted(v, key=repr)]}
+    if isinstance(v, dict):
+        if all(isinstance(k, str) for k in v):
+            return {"__dict__": {k: _encode(x, arrays) for k, x in v.items()}}
+        return {"__kvdict__": [[_encode(k, arrays), _encode(x, arrays)]
+                               for k, x in v.items()]}
+    if isinstance(v, type):
+        from .types import FeatureType
+        if issubclass(v, FeatureType):
+            return {"__feature_type__": v.__name__}
+        return {"__class__": f"{v.__module__}:{v.__qualname__}"}
+    # model families live in the registry — persist by name
+    from .models.api import ModelFamily
+    if isinstance(v, ModelFamily):
+        return {"__family__": v.name}
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {"__obj__": _clsname(v),
+                "state": {f.name: _encode(getattr(v, f.name), arrays)
+                          for f in dataclasses.fields(v)}}
+    import types as _t
+    if isinstance(v, (_t.FunctionType, _t.MethodType, _t.BuiltinFunctionType)):
+        qn = getattr(v, "__qualname__", "")
+        if "<locals>" in qn or "<lambda>" in qn or isinstance(v, _t.MethodType):
+            return {"__unresolved__": repr(v)}  # resolve from original workflow
+        return {"__fn__": f"{v.__module__}:{qn}"}
+    if hasattr(v, "__dict__"):  # plain objects + callable objects (FieldExtractor)
+        return {"__obj__": _clsname(v),
+                "state": {k: _encode(x, arrays) for k, x in vars(v).items()}}
+    return {"__unresolved__": repr(v)}
+
+
+def _clsname(v: Any) -> str:
+    cls = type(v)
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def _resolve_class(spec: str) -> type:
+    mod, qual = spec.split(":")
+    obj: Any = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class Unresolved:
+    """Placeholder for state that could not be serialized; must be resolved
+    from the original workflow at load time."""
+
+    def __init__(self, desc: str):
+        self.desc = desc
+
+    def __repr__(self):
+        return f"Unresolved({self.desc!r})"
+
+
+def _decode(d: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    if _is_jsonable_scalar(d):
+        return d
+    if isinstance(d, list):
+        return [_decode(x, arrays) for x in d]
+    assert isinstance(d, dict), d
+    if "__float__" in d:
+        return float(d["__float__"])
+    if "__array__" in d:
+        return arrays[d["__array__"]]
+    if "__tuple__" in d:
+        return tuple(_decode(x, arrays) for x in d["__tuple__"])
+    if "__set__" in d:
+        return set(_decode(x, arrays) for x in d["__set__"])
+    if "__dict__" in d:
+        return {k: _decode(x, arrays) for k, x in d["__dict__"].items()}
+    if "__kvdict__" in d:
+        return {_decode(k, arrays): _decode(x, arrays) for k, x in d["__kvdict__"]}
+    if "__feature_type__" in d:
+        return feature_type_by_name(d["__feature_type__"])
+    if "__class__" in d:
+        return _resolve_class(d["__class__"])
+    if "__family__" in d:
+        from .models.api import MODEL_REGISTRY
+        return MODEL_REGISTRY[d["__family__"]]
+    if "__fn__" in d:
+        return _resolve_class(d["__fn__"])
+    if "__obj__" in d:
+        cls = _resolve_class(d["__obj__"])
+        obj = cls.__new__(cls)
+        for k, v in d["state"].items():
+            setattr(obj, k, _decode(v, arrays))
+        return obj
+    if "__unresolved__" in d:
+        return Unresolved(d["__unresolved__"])
+    raise ValueError(f"cannot decode {d!r}")
+
+
+# ---------------------------------------------------------------------------
+# Stage (de)serialization
+# ---------------------------------------------------------------------------
+
+def stage_to_json(stage: OpPipelineStage, arrays: _Arrays) -> Dict[str, Any]:
+    state = {k: v for k, v in vars(stage).items() if k not in _WIRING_ATTRS}
+    return {
+        "className": type(stage).__name__,
+        "uid": stage.uid,
+        "state": {k: _encode(v, arrays) for k, v in state.items()},
+    }
+
+
+def stage_from_json(d: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> OpPipelineStage:
+    cls = STAGE_REGISTRY.get(d["className"])
+    if cls is None:
+        raise ValueError(
+            f"unknown stage class {d['className']!r}; import the module defining "
+            f"it before loading (stage registry has {len(STAGE_REGISTRY)} classes)")
+    stage = cls.__new__(cls)
+    stage.input_features = ()
+    stage._output_feature = None
+    for k, v in d["state"].items():
+        setattr(stage, k, _decode(v, arrays))
+    return stage
+
+
+# ---------------------------------------------------------------------------
+# Feature graph (reference FeatureJsonHelper.scala)
+# ---------------------------------------------------------------------------
+
+def features_to_json(result_features, extra_features=()) -> List[Dict[str, Any]]:
+    seen: Dict[str, Feature] = {}
+    order: List[Feature] = []
+    for f in result_features:
+        for a in f.all_features():
+            if a.uid not in seen:
+                seen[a.uid] = a
+                order.append(a)
+    # raw/blacklisted features outside the (post-surgery) result ancestry —
+    # they must still round-trip (model.raw_features keeps pre-RFF features)
+    for f in extra_features:
+        for a in f.all_features():
+            if a.uid not in seen:
+                seen[a.uid] = a
+                order.append(a)
+    return [{
+        "uid": f.uid,
+        "name": f.name,
+        "typeName": f.type_name,
+        "isResponse": f.is_response,
+        "originStageUid": f.origin_stage.uid if f.origin_stage else None,
+        "parents": [p.uid for p in f.parents],
+    } for f in order]
+
+
+def features_from_json(descs: List[Dict[str, Any]],
+                       stages: Dict[str, OpPipelineStage]) -> Dict[str, Feature]:
+    feats: Dict[str, Feature] = {}
+    for d in descs:  # descs are in dependency order (post-order per result)
+        parents = [feats[p] for p in d["parents"]]
+        stage = stages.get(d["originStageUid"])
+        f = Feature(d["name"], feature_type_by_name(d["typeName"]),
+                    d["isResponse"], stage, parents, uid=d["uid"])
+        feats[d["uid"]] = f
+        if stage is not None:
+            stage.input_features = tuple(parents)
+            stage._output_feature = f
+    return feats
+
+
+# ---------------------------------------------------------------------------
+# Model save / load
+# ---------------------------------------------------------------------------
+
+def save_model(model, path: str) -> None:
+    """Write the fitted workflow model to ``path`` (a directory):
+    plan.json + arrays.npz (reference OpWorkflowModelWriter.scala:52-80)."""
+    from .utils.version import version_info
+    os.makedirs(path, exist_ok=True)
+    arrays = _Arrays()
+    stage_descs = [stage_to_json(s, arrays) for s in model.stages]
+    extra = tuple(model.raw_features) + tuple(model.blacklisted_features)
+    raw_stage_descs = [stage_to_json(f.origin_stage, arrays) for f in extra]
+    plan = {
+        "formatVersion": FORMAT_VERSION,
+        "versionInfo": version_info(),
+        "features": features_to_json(model.result_features, extra),
+        "resultFeatures": [f.uid for f in model.result_features],
+        "rawFeatures": [f.uid for f in model.raw_features],
+        "blacklistedFeatures": [f.uid for f in model.blacklisted_features],
+        "stages": stage_descs,
+        "rawFeatureGenerators": raw_stage_descs,
+        "parameters": _encode(model.parameters, arrays),
+    }
+    with open(os.path.join(path, PLAN_FILE), "w") as fh:
+        json.dump(plan, fh, indent=2)
+    np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays.store)
+
+
+def _collect_unresolved(stage: OpPipelineStage) -> List[str]:
+    return [k for k, v in vars(stage).items() if isinstance(v, Unresolved)]
+
+
+def load_model(path: str, workflow=None):
+    """Load a fitted model saved by :func:`save_model`.
+
+    If ``workflow`` (the original OpWorkflow) is given, stages with
+    unserializable state (user lambdas) are patched from the workflow's stage
+    of the same uid — the reference's OpWorkflowModelReader "resolve against
+    workflow" path."""
+    from .workflow import OpWorkflowModel
+
+    with open(os.path.join(path, PLAN_FILE)) as fh:
+        plan = json.load(fh)
+    if plan.get("formatVersion") != FORMAT_VERSION:
+        raise ValueError(f"unsupported model format {plan.get('formatVersion')}")
+    with np.load(os.path.join(path, ARRAYS_FILE), allow_pickle=False) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+
+    stages: Dict[str, OpPipelineStage] = {}
+    for d in plan["stages"] + plan["rawFeatureGenerators"]:
+        if d["uid"] not in stages:
+            stages[d["uid"]] = stage_from_json(d, arrays)
+
+    # patch unresolved state from the original workflow (by stage uid)
+    wf_stages: Dict[str, OpPipelineStage] = {}
+    if workflow is not None:
+        for s in workflow.stages:
+            wf_stages[s.uid] = s
+        for f in workflow.raw_features:
+            wf_stages[f.origin_stage.uid] = f.origin_stage
+    for uid, stage in stages.items():
+        missing = _collect_unresolved(stage)
+        if not missing:
+            continue
+        src = wf_stages.get(uid)
+        if src is None:
+            raise ValueError(
+                f"stage {uid} has unserializable state {missing}; pass the "
+                f"original workflow to load_model to resolve it")
+        for k in missing:
+            setattr(stage, k, getattr(src, k))
+
+    feats = features_from_json(plan["features"], stages)
+    model = OpWorkflowModel()
+    model.result_features = tuple(feats[u] for u in plan["resultFeatures"])
+    model.raw_features = tuple(feats[u] for u in plan["rawFeatures"])
+    model.blacklisted_features = tuple(
+        feats[u] for u in plan.get("blacklistedFeatures", []))
+    model.parameters = _decode(plan.get("parameters", {}), arrays) or {}
+    from .dag import compute_dag
+    model._layers = compute_dag(model.result_features)
+    return model

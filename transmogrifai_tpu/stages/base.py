@@ -25,9 +25,18 @@ from ..table import Column, FeatureTable
 from ..types import FeatureType, OPVector
 
 
+#: class-name → stage class, the analog of the reference's reflection-based
+#: stage reader (OpPipelineStageReader.scala) resolving classes by name
+STAGE_REGISTRY: Dict[str, type] = {}
+
+
 class OpPipelineStage(abc.ABC):
     """Base of every stage: typed inputs, single typed output, params
     (reference OpPipelineStageBase, OpPipelineStages.scala:56-162)."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        STAGE_REGISTRY[cls.__name__] = cls
 
     #: input feature types; None entries mean "any feature type"
     input_types: Tuple[Optional[Type[FeatureType]], ...] = ()

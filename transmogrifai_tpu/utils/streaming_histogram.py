@@ -1,0 +1,237 @@
+"""ctypes binding for the native streaming histogram (see
+native/streaming_histogram.cpp — the TPU build's equivalent of the reference's
+Java StreamingHistogram, utils/.../stats/StreamingHistogram.java, plus its
+Scala enrichment RichStreamingHistogram.scala).
+
+The shared library compiles on first use with g++ into
+``transmogrifai_tpu/native/_build/`` and is cached by source mtime. If no
+toolchain is available the pure-numpy fallback implements the same algorithm
+(slower, same results) so the framework never hard-depends on the compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_HERE, "native", "streaming_histogram.cpp")
+_BUILD_DIR = os.path.join(_HERE, "native", "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libstreaminghist.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            if (not os.path.exists(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", _LIB_PATH],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.sh_create.restype = ctypes.c_void_p
+            lib.sh_create.argtypes = [ctypes.c_int]
+            lib.sh_free.argtypes = [ctypes.c_void_p]
+            lib.sh_update.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+            lib.sh_update_weighted.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+            lib.sh_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.sh_num_bins.restype = ctypes.c_int64
+            lib.sh_num_bins.argtypes = [ctypes.c_void_p]
+            lib.sh_total.restype = ctypes.c_double
+            lib.sh_total.argtypes = [ctypes.c_void_p]
+            lib.sh_min.restype = ctypes.c_double
+            lib.sh_min.argtypes = [ctypes.c_void_p]
+            lib.sh_max.restype = ctypes.c_double
+            lib.sh_max.argtypes = [ctypes.c_void_p]
+            lib.sh_get_bins.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double)]
+            lib.sh_sum.restype = ctypes.c_double
+            lib.sh_sum.argtypes = [ctypes.c_void_p, ctypes.c_double]
+            lib.sh_uniform.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_lib() is not None
+
+
+class StreamingHistogram:
+    """Fixed-size mergeable histogram sketch (SPDT algorithm)."""
+
+    def __init__(self, max_bins: int = 100):
+        self.max_bins = max(2, int(max_bins))
+        self._lib = _build_lib()
+        if self._lib is not None:
+            self._h = ctypes.c_void_p(self._lib.sh_create(self.max_bins))
+        else:
+            self._bins: List[Tuple[float, float]] = []  # (centroid, mass)
+            self._total = 0.0
+            self._min = np.inf
+            self._max = -np.inf
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.sh_free(h)
+            self._h = None
+
+    # -- updates -------------------------------------------------------------
+    def update(self, values: Sequence[float]) -> "StreamingHistogram":
+        xs = np.ascontiguousarray(np.asarray(values, dtype=np.float64).ravel())
+        if self._lib is not None:
+            self._lib.sh_update(
+                self._h, xs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                xs.shape[0])
+        else:
+            for x in xs:
+                if not np.isnan(x):
+                    self._py_insert(float(x), 1.0)
+        return self
+
+    def merge(self, other: "StreamingHistogram") -> "StreamingHistogram":
+        if self._lib is not None and other._lib is not None:
+            self._lib.sh_merge(self._h, other._h)
+        else:
+            for p, m in other.bins():
+                self._py_insert(p, m)
+            self._min = min(self._min, other.min)
+            self._max = max(self._max, other.max)
+        return self
+
+    # -- queries -------------------------------------------------------------
+    def bins(self) -> List[Tuple[float, float]]:
+        if self._lib is not None:
+            n = self._lib.sh_num_bins(self._h)
+            centers = np.zeros(n, dtype=np.float64)
+            masses = np.zeros(n, dtype=np.float64)
+            if n:
+                self._lib.sh_get_bins(
+                    self._h,
+                    centers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    masses.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            return list(zip(centers.tolist(), masses.tolist()))
+        return list(self._bins)
+
+    @property
+    def total(self) -> float:
+        if self._lib is not None:
+            return self._lib.sh_total(self._h)
+        return self._total
+
+    @property
+    def min(self) -> float:
+        if self._lib is not None:
+            return self._lib.sh_min(self._h)
+        return self._min
+
+    @property
+    def max(self) -> float:
+        if self._lib is not None:
+            return self._lib.sh_max(self._h)
+        return self._max
+
+    def sum(self, b: float) -> float:
+        """Estimated count of points <= b (paper's Sum procedure)."""
+        if self._lib is not None:
+            return self._lib.sh_sum(self._h, float(b))
+        return self._py_sum(float(b))
+
+    def quantile(self, q: float) -> float:
+        """Approximate q-quantile via binary search over sum()."""
+        if self.total == 0:
+            return float("nan")
+        target = q * self.total
+        lo, hi = self.min, self.max
+        for _ in range(60):
+            mid = (lo + hi) / 2.0
+            if self.sum(mid) < target:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2.0
+
+    def uniform(self, num_bins: int) -> np.ndarray:
+        """num_bins-1 interior boundaries of equal-mass bins (Uniform)."""
+        if num_bins < 2 or self.total == 0:
+            return np.zeros(0, dtype=np.float64)
+        if self._lib is not None:
+            out = np.zeros(num_bins - 1, dtype=np.float64)
+            self._lib.sh_uniform(
+                self._h, num_bins,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            return out
+        return np.array([self.quantile(k / num_bins)
+                         for k in range(1, num_bins)])
+
+    def density(self, boundaries: np.ndarray) -> np.ndarray:
+        """Mass per interval given sorted boundary edges (len B+1) → (B,)."""
+        sums = np.array([self.sum(b) for b in boundaries])
+        return np.diff(sums)
+
+    # -- pure-python fallback (same algorithm) -------------------------------
+    def _py_insert(self, x: float, w: float) -> None:
+        import bisect
+        ps = [p for p, _ in self._bins]
+        i = bisect.bisect_left(ps, x)
+        if i < len(self._bins) and self._bins[i][0] == x:
+            self._bins[i] = (x, self._bins[i][1] + w)
+        else:
+            self._bins.insert(i, (x, w))
+        self._total += w
+        self._min = min(self._min, x)
+        self._max = max(self._max, x)
+        while len(self._bins) > self.max_bins:
+            gaps = [self._bins[j + 1][0] - self._bins[j][0]
+                    for j in range(len(self._bins) - 1)]
+            j = int(np.argmin(gaps))
+            (p1, m1), (p2, m2) = self._bins[j], self._bins[j + 1]
+            m = m1 + m2
+            self._bins[j:j + 2] = [((p1 * m1 + p2 * m2) / m, m)]
+
+    def _py_sum(self, b: float) -> float:
+        bins = self._bins
+        if not bins:
+            return 0.0
+        if b >= bins[-1][0]:
+            if self._max > bins[-1][0] and b < self._max:
+                frac = (b - bins[-1][0]) / (self._max - bins[-1][0])
+                return self._total - bins[-1][1] / 2.0 + bins[-1][1] / 2.0 * frac
+            return self._total
+        if b < bins[0][0]:
+            if self._min < bins[0][0] and b >= self._min:
+                frac = (b - self._min) / (bins[0][0] - self._min)
+                return bins[0][1] / 2.0 * frac
+            return 0.0
+        i = 0
+        while i + 1 < len(bins) and bins[i + 1][0] <= b:
+            i += 1
+        s = sum(m for _, m in bins[:i]) + bins[i][1] / 2.0
+        if i + 1 < len(bins) and bins[i + 1][0] > bins[i][0]:
+            pi, mi = bins[i]
+            pj, mj = bins[i + 1]
+            frac = (b - pi) / (pj - pi)
+            mb = mi + (mj - mi) * frac
+            s += (mi + mb) / 2.0 * frac
+        return s

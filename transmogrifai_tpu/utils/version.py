@@ -1,0 +1,30 @@
+"""Build/version stamping for saved models (reference
+utils/src/main/scala/com/salesforce/op/utils/version/VersionInfo.scala — git
+sha + build time into model metadata)."""
+from __future__ import annotations
+
+import subprocess
+import time
+from functools import lru_cache
+from typing import Dict
+
+FRAMEWORK_VERSION = "0.1.0"
+
+
+@lru_cache(maxsize=1)
+def git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def version_info() -> Dict[str, str]:
+    return {
+        "version": FRAMEWORK_VERSION,
+        "gitSha": git_sha(),
+        "savedAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }

@@ -101,6 +101,17 @@ class OpWorkflow(_WorkflowCore):
         self._raw_feature_filter = rff
         return self
 
+    def with_model_stages(self, model: "OpWorkflowModel") -> "OpWorkflow":
+        """Partial retrain: swap in already-fitted stages by uid so only new
+        estimators refit (reference OpWorkflow.withModelStages:457-461)."""
+        if not self.result_features:
+            raise ValueError("call set_result_features before with_model_stages")
+        fitted = {s.uid: s for s in model.stages}
+        self.result_features = tuple(
+            f.copy_with_new_stages(fitted) for f in self.result_features)
+        self._layers = compute_dag(self.result_features)
+        return self
+
     @property
     def stages(self) -> List[Any]:
         return [s for layer in (self._layers or []) for s, _ in layer]
@@ -229,6 +240,21 @@ class OpWorkflowModel(_WorkflowCore):
 
     def evaluate(self, evaluator, table: Optional[FeatureTable] = None) -> Dict[str, float]:
         return self.score_and_evaluate(evaluator, table=table)[1]
+
+    # -- persistence (reference OpWorkflowModel.save) ------------------------
+    def save(self, path: str) -> None:
+        from .persistence import save_model
+        save_model(self, path)
+
+    @staticmethod
+    def load(path: str, workflow: Optional["OpWorkflow"] = None) -> "OpWorkflowModel":
+        from .persistence import load_model
+        return load_model(path, workflow=workflow)
+
+    # -- local scoring (reference local/OpWorkflowModelLocal.scala) ----------
+    def score_function(self):
+        from .local import score_function
+        return score_function(self)
 
     # -- summaries (reference OpWorkflowModel.summary:183-211) ---------------
     def summary(self) -> Dict[str, Any]:
