@@ -1,0 +1,84 @@
+"""Rich feature syntax tests (model: reference dsl Rich*FeatureTest specs)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu  # noqa: F401  (attaches DSL)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.table import FeatureTable
+from transmogrifai_tpu.types import Date, Real, RealNN, Text
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+def _score_single(feature, df):
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(feature)
+    model = wf.train()
+    return model.score(df=df)[feature.name]
+
+
+def test_arithmetic_operators():
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    b = FeatureBuilder.Real("b").extract_field().as_predictor()
+    df = pd.DataFrame({"a": [6.0, 8.0], "b": [2.0, 4.0]})
+
+    out = _score_single((a + b) / 2.0, df)
+    np.testing.assert_allclose(np.asarray(out.values), [4.0, 6.0])
+
+    out2 = _score_single(a * b - 2.0, df)
+    np.testing.assert_allclose(np.asarray(out2.values), [10.0, 30.0])
+
+    out3 = _score_single(1.0 - a, df)
+    np.testing.assert_allclose(np.asarray(out3.values), [-5.0, -7.0])
+
+
+def test_unary_math_and_alias():
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    df = pd.DataFrame({"a": [4.0, 16.0]})
+    root = a.sqrt().alias("root_a")
+    assert root.name == "root_a"
+    out = _score_single(root, df)
+    np.testing.assert_allclose(np.asarray(out.values), [2.0, 4.0])
+
+
+def test_text_dsl():
+    t = FeatureBuilder.Text("t").extract_field().as_predictor()
+    df = pd.DataFrame({"t": ["Hello World", "hello there"]})
+    toks = t.tokenize()
+    out = _score_single(toks, df)
+    assert list(out.values[0]) == ["hello", "world"]
+    assert t.text_len().feature_type.__name__ == "Integral"
+
+
+def test_pivot_and_vectorize():
+    p = FeatureBuilder.PickList("p").extract_field().as_predictor()
+    df = pd.DataFrame({"p": ["x", "y", "x", "x"]})
+    piv = p.pivot(top_k=2, min_support=1)
+    out = _score_single(piv, df)
+    mat = np.asarray(out.values)
+    assert mat.shape[1] == 4  # x, y, OTHER, null
+    assert p.vectorize().type_name == "OPVector"
+
+
+def test_date_dsl():
+    d = FeatureBuilder.Date("d").extract_field().as_predictor()
+    df = pd.DataFrame({"d": [12 * 3_600_000]})
+    uc = d.to_unit_circle(periods=("HourOfDay",))
+    out = _score_single(uc, df)
+    np.testing.assert_allclose(np.asarray(out.values)[0], [0, -1], atol=1e-6)
+    tp = d.time_period("HourOfDay")
+    out2 = _score_single(tp, df)
+    assert np.asarray(out2.values)[0] == 12
+
+
+def test_bucketize_and_sanity_check_chain():
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    rng = np.random.RandomState(0)
+    x = rng.uniform(0, 10, 300)
+    noisy = ((x > 5).astype(float) + (rng.rand(300) < 0.3)) % 2
+    df = pd.DataFrame({"y": noisy, "a": x})
+    checked = a.bucketize([0, 5, 10]).sanity_check(y)
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(checked)
+    model = wf.train()
+    out = model.score(df=df)[checked.name]
+    assert np.asarray(out.values).shape[0] == 300

@@ -1,0 +1,372 @@
+"""Contract tests for the map/date/geo/bucketizer/scaler/math stages (model:
+reference per-stage spec files, e.g. OPMapVectorizerTest,
+DateToUnitCircleTransformerTest, DecisionTreeNumericBucketizerTest,
+ScalerTransformerTest)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.bucketizers import (
+    DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+    NumericBucketizer, PercentileCalibrator,
+)
+from transmogrifai_tpu.impl.feature.dates import (
+    DateListVectorizer, DateMapToUnitCircleVectorizer,
+    DateToUnitCircleTransformer, TimePeriodTransformer, time_period_values,
+)
+from transmogrifai_tpu.impl.feature.geo import (
+    GeolocationMapVectorizer, GeolocationVectorizer, geographic_midpoint,
+)
+from transmogrifai_tpu.impl.feature.maps import (
+    MapVectorizer, SmartTextMapVectorizer, TextMapPivotVectorizer,
+)
+from transmogrifai_tpu.impl.feature.math import (
+    AliasTransformer, BinaryMathOp, JaccardSimilarity, Log, NGramSimilarity,
+    ScalarOp, SubstringTransformer, TextLenTransformer, ToOccurTransformer,
+)
+from transmogrifai_tpu.impl.feature.scalers import (
+    DescalerTransformer, FillMissingWithMean, OpScalarStandardScaler,
+    ScalerTransformer,
+)
+from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+from transmogrifai_tpu.table import Column, FeatureTable
+from transmogrifai_tpu.types import (
+    Date, DateList, DateMap, Geolocation, GeolocationMap, MultiPickListMap,
+    PickListMap, Real, RealMap, RealNN, Text, TextMap,
+)
+
+MS_DAY = 86_400_000
+
+
+def _tbl(**cols):
+    data = {}
+    for name, (ft, vals) in cols.items():
+        data[name] = (ft, vals)
+    return FeatureTable.from_columns(data)
+
+
+def _feat(name, ft, response=False):
+    b = FeatureBuilder(name, ft).extract_field()
+    return b.as_response() if response else b.as_predictor()
+
+
+class TestMapVectorizer:
+    def test_mean_fill_and_null_tracking(self):
+        f = _feat("m", RealMap)
+        tbl = _tbl(m=(RealMap, [{"a": 1.0, "b": 10.0}, {"a": 3.0}, None]))
+        model = MapVectorizer().set_input(f).fit(tbl)
+        out = model.transform_column(tbl)
+        vm = out.metadata["vector_meta"]
+        # keys a, b → (value, null) each
+        assert vm.size == 4
+        mat = np.asarray(out.values)
+        np.testing.assert_allclose(mat[:, 0], [1.0, 3.0, 2.0])   # a mean=2
+        np.testing.assert_allclose(mat[:, 1], [0, 0, 1])          # a nulls
+        np.testing.assert_allclose(mat[:, 2], [10.0, 10.0, 10.0])  # b mean=10
+        np.testing.assert_allclose(mat[:, 3], [0, 1, 1])
+        assert vm.columns[0].grouping == "a"
+
+    def test_key_lists(self):
+        f = _feat("m", RealMap)
+        tbl = _tbl(m=(RealMap, [{"a": 1.0, "b": 2.0, "c": 3.0}] * 3))
+        model = MapVectorizer(black_list_keys=["c"],
+                              track_nulls=False).set_input(f).fit(tbl)
+        out = model.transform_column(tbl)
+        assert [c.grouping for c in out.metadata["vector_meta"].columns] == ["a", "b"]
+
+
+class TestTextMapPivot:
+    def test_pivot_per_key(self):
+        f = _feat("m", PickListMap)
+        rows = [{"color": "red", "size": "L"}, {"color": "red"},
+                {"color": "blue"}, None] * 3
+        tbl = _tbl(m=(PickListMap, rows))
+        model = (TextMapPivotVectorizer(min_support=1, top_k=5)
+                 .set_input(f).fit(tbl))
+        out = model.transform_column(tbl)
+        vm = out.metadata["vector_meta"]
+        names = [(c.grouping, c.indicator_value) for c in vm.columns]
+        assert ("color", "red") in names and ("color", "blue") in names
+        assert ("size", "L") in names
+        mat = np.asarray(out.values)
+        red_idx = names.index(("color", "red"))
+        np.testing.assert_allclose(mat[:4, red_idx], [1, 1, 0, 0])
+
+    def test_multipicklist_map(self):
+        f = _feat("m", MultiPickListMap)
+        rows = [{"tags": ["a", "b"]}, {"tags": ["b"]}, None] * 4
+        tbl = _tbl(m=(MultiPickListMap, rows))
+        model = (TextMapPivotVectorizer(min_support=1, top_k=3)
+                 .set_input(f).fit(tbl))
+        mat = np.asarray(model.transform_column(tbl).values)
+        vm = model.transform_column(tbl).metadata["vector_meta"]
+        names = [(c.grouping, c.indicator_value) for c in vm.columns]
+        b_idx = names.index(("tags", "b"))
+        np.testing.assert_allclose(mat[:3, b_idx], [1, 1, 0])
+
+
+class TestDates:
+    def test_time_periods(self):
+        # 1970-01-01 was a Thursday; check a known date: 2020-06-15 (Monday)
+        ms = np.array([1592179200000])  # 2020-06-15T00:00:00Z
+        assert time_period_values(ms, "DayOfWeek")[0] == 1
+        assert time_period_values(ms, "MonthOfYear")[0] == 6
+        assert time_period_values(ms, "DayOfMonth")[0] == 15
+        assert time_period_values(ms, "HourOfDay")[0] == 0
+
+    def test_unit_circle(self):
+        f = _feat("d", Date)
+        noon = 12 * 3_600_000
+        tbl = _tbl(d=(Date, [noon, None]))
+        out = (DateToUnitCircleTransformer(periods=("HourOfDay",))
+               .set_input(f).transform_column(tbl))
+        mat = np.asarray(out.values)
+        # noon → angle π → sin 0, cos -1
+        np.testing.assert_allclose(mat[0], [0.0, -1.0], atol=1e-6)
+        np.testing.assert_allclose(mat[1], [0.0, 0.0])  # missing → off-circle
+
+    def test_date_list_since_last(self):
+        f = _feat("dl", DateList)
+        ref = 100 * MS_DAY
+        tbl = _tbl(dl=(DateList, [[10 * MS_DAY, 90 * MS_DAY], [], None]))
+        out = (DateListVectorizer(pivot="SinceLast", reference_date_ms=ref)
+               .set_input(f).transform_column(tbl))
+        mat = np.asarray(out.values)
+        np.testing.assert_allclose(mat[:, 0], [10.0, 0.0, 0.0])
+        np.testing.assert_allclose(mat[:, 1], [0.0, 1.0, 1.0])  # null ind
+
+    def test_date_list_mode_day(self):
+        f = _feat("dl", DateList)
+        # 2020-06-15/16 are Mon/Tue; two Mondays + one Tuesday → mode Monday
+        mon, tue = 1592179200000, 1592265600000
+        tbl = _tbl(dl=(DateList, [[mon, mon + 3600_000, tue]]))
+        out = (DateListVectorizer(pivot="ModeDay")
+               .set_input(f).transform_column(tbl))
+        mat = np.asarray(out.values)
+        assert mat[0, 0] == 1.0 and mat[0].sum() == 1.0  # Monday slot
+
+    def test_date_map(self):
+        f = _feat("dm", DateMap)
+        noon = 12 * 3_600_000
+        tbl = _tbl(dm=(DateMap, [{"k": noon}, None]))
+        out = (DateMapToUnitCircleVectorizer(period="HourOfDay", keys=["k"])
+               .set_input(f).transform_column(tbl))
+        mat = np.asarray(out.values)
+        np.testing.assert_allclose(mat[0], [0.0, -1.0], atol=1e-6)
+        np.testing.assert_allclose(mat[1], [0.0, 0.0])
+
+
+class TestGeo:
+    def test_midpoint(self):
+        lat, lon = geographic_midpoint(np.array([[0.0, 0.0], [0.0, 90.0]]))
+        assert lat == pytest.approx(0.0, abs=1e-6)
+        assert lon == pytest.approx(45.0, abs=1e-6)
+
+    def test_vectorizer_fill(self):
+        f = _feat("g", Geolocation)
+        tbl = _tbl(g=(Geolocation, [[10.0, 20.0, 1.0], None]))
+        model = GeolocationVectorizer().set_input(f).fit(tbl)
+        mat = np.asarray(model.transform_column(tbl).values)
+        np.testing.assert_allclose(mat[0], [10, 20, 1, 0], atol=1e-5)
+        np.testing.assert_allclose(mat[1], [10, 20, 1, 1], atol=1e-5)
+
+    def test_map_vectorizer(self):
+        f = _feat("gm", GeolocationMap)
+        tbl = _tbl(gm=(GeolocationMap, [{"home": [40.0, -75.0, 2.0]}, {}]))
+        model = GeolocationMapVectorizer().set_input(f).fit(tbl)
+        out = model.transform_column(tbl)
+        mat = np.asarray(out.values)
+        np.testing.assert_allclose(mat[0], [40, -75, 2, 0], atol=1e-5)
+        assert mat[1, 3] == 1.0  # null indicator
+
+
+class TestBucketizers:
+    def test_numeric_bucketizer(self):
+        f = _feat("x", Real)
+        tbl = _tbl(x=(Real, [0.5, 1.5, 2.5, None]))
+        stage = NumericBucketizer(splits=[0, 1, 2, 3]).set_input(f)
+        mat = np.asarray(stage.transform_column(tbl).values)
+        np.testing.assert_allclose(mat[0][:3], [1, 0, 0])
+        np.testing.assert_allclose(mat[1][:3], [0, 1, 0])
+        np.testing.assert_allclose(mat[2][:3], [0, 0, 1])
+        assert mat[3, 3] == 1.0  # null indicator
+
+    def test_decision_tree_bucketizer_finds_signal_split(self):
+        rng = np.random.RandomState(0)
+        x = rng.uniform(0, 10, 2000)
+        y = (x > 5.0).astype(float)
+        label = _feat("y", RealNN, response=True)
+        feat = _feat("x", Real)
+        tbl = _tbl(y=(RealNN, y.tolist()), x=(Real, x.tolist()))
+        model = (DecisionTreeNumericBucketizer(max_depth=1)
+                 .set_input(label, feat).fit(tbl))
+        splits = model.summary_metadata["splits"]
+        assert len(splits) == 1 and abs(splits[0] - 5.0) < 0.5
+        out = model.transform_column(tbl)
+        assert np.asarray(out.values).shape[1] == 3  # 2 buckets + null
+
+    def test_decision_tree_bucketizer_no_signal_shrinks(self):
+        rng = np.random.RandomState(1)
+        x = rng.uniform(0, 10, 500)
+        y = rng.randint(0, 2, 500).astype(float)
+        label = _feat("y", RealNN, response=True)
+        feat = _feat("x", Real)
+        tbl = _tbl(y=(RealNN, y.tolist()), x=(Real, x.tolist()))
+        model = (DecisionTreeNumericBucketizer(min_info_gain=0.05)
+                 .set_input(label, feat).fit(tbl))
+        assert not model.summary_metadata["bucketed"]
+        assert np.asarray(model.transform_column(tbl).values).shape[1] == 1
+
+    def test_map_bucketizer(self):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(0, 10, 1000)
+        y = (x > 3.0).astype(float)
+        label = _feat("y", RealNN, response=True)
+        feat = _feat("m", RealMap)
+        tbl = _tbl(y=(RealNN, y.tolist()),
+                   m=(RealMap, [{"k": float(v)} for v in x]))
+        model = (DecisionTreeNumericMapBucketizer(max_depth=1)
+                 .set_input(label, feat).fit(tbl))
+        assert abs(model.summary_metadata["splits"]["k"][0] - 3.0) < 0.5
+
+    def test_percentile_calibrator(self):
+        f = _feat("x", Real)
+        vals = list(np.linspace(0, 100, 1001))
+        tbl = _tbl(x=(Real, vals))
+        model = PercentileCalibrator(buckets=100).set_input(f).fit(tbl)
+        out = np.asarray(model.transform_column(tbl).values)
+        assert out.min() >= 0 and out.max() <= 99
+        assert out[0] < 5 and out[-1] > 94
+        # monotone non-decreasing over sorted input
+        assert (np.diff(out) >= 0).all()
+        assert model.transform_row({"x": 50.0}) == pytest.approx(
+            float(out[500]), abs=2)
+
+
+class TestScalers:
+    def test_scaler_descaler_round_trip(self):
+        x = _feat("x", Real)
+        tbl = _tbl(x=(Real, [1.0, 2.0, 4.0]))
+        scaler = ScalerTransformer(scaling_type="linear", slope=2.0,
+                                   intercept=1.0).set_input(x)
+        scaled_col = scaler.transform_column(tbl)
+        np.testing.assert_allclose(np.asarray(scaled_col.values), [3, 5, 9])
+        scaled_f = scaler.get_output()
+        tbl2 = tbl.with_column(scaled_f.name, scaled_col)
+        descaler = DescalerTransformer().set_input(scaled_f, scaled_f)
+        out = descaler.transform_column(tbl2)
+        np.testing.assert_allclose(np.asarray(out.values), [1, 2, 4], atol=1e-6)
+
+    def test_log_scaler(self):
+        x = _feat("x", Real)
+        tbl = _tbl(x=(Real, [1.0, np.e]))
+        out = (ScalerTransformer(scaling_type="log").set_input(x)
+               .transform_column(tbl))
+        np.testing.assert_allclose(np.asarray(out.values), [0, 1], atol=1e-6)
+
+    def test_standard_scaler(self):
+        x = _feat("x", RealNN)
+        tbl = _tbl(x=(RealNN, [1.0, 2.0, 3.0]))
+        model = OpScalarStandardScaler().set_input(x).fit(tbl)
+        out = np.asarray(model.transform_column(tbl).values)
+        assert out.mean() == pytest.approx(0, abs=1e-6)
+        assert out.std() == pytest.approx(1, abs=1e-6)
+
+    def test_fill_missing_with_mean(self):
+        x = _feat("x", Real)
+        tbl = _tbl(x=(Real, [1.0, None, 3.0]))
+        model = FillMissingWithMean().set_input(x).fit(tbl)
+        out = np.asarray(model.transform_column(tbl).values)
+        np.testing.assert_allclose(out, [1.0, 2.0, 3.0])
+        assert model.transform_row({"x": None}) == 2.0
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a, b = _feat("a", Real), _feat("b", Real)
+        tbl = _tbl(a=(Real, [6.0, 4.0, None]), b=(Real, [2.0, 0.0, 1.0]))
+        div = BinaryMathOp("/").set_input(a, b)
+        out = div.transform_column(tbl)
+        mat, mask = np.asarray(out.values), np.asarray(out.mask)
+        assert mat[0] == 3.0
+        assert not mask[1]  # div by zero → missing
+        assert not mask[2]  # missing input → missing
+        assert div.transform_row({"a": 6.0, "b": 2.0}) == 3.0
+        assert div.transform_row({"a": 6.0, "b": 0.0}) is None
+
+    def test_scalar_and_unary(self):
+        a = _feat("a", Real)
+        tbl = _tbl(a=(Real, [np.e]))
+        out = Log().set_input(a).transform_column(tbl)
+        np.testing.assert_allclose(np.asarray(out.values), [1.0], atol=1e-6)
+        out2 = ScalarOp("*", 3.0).set_input(a).transform_column(tbl)
+        np.testing.assert_allclose(np.asarray(out2.values), [3 * np.e],
+                                   rtol=1e-6)
+
+    def test_text_stages(self):
+        t1, t2 = _feat("t1", Text), _feat("t2", Text)
+        tbl = _tbl(t1=(Text, ["hello world", None]),
+                   t2=(Text, ["world", "x"]))
+        sub = SubstringTransformer().set_input(t1, t2)
+        vals = sub.transform_column(tbl)
+        assert np.asarray(vals.values)[0] == 1.0
+        assert not np.asarray(vals.mask)[1]
+        tlen = TextLenTransformer().set_input(t1)
+        assert np.asarray(tlen.transform_column(tbl).values)[0] == 11
+        ng = NGramSimilarity().set_input(t1, t2)
+        sims = np.asarray(ng.transform_column(tbl).values)
+        assert 0 < sims[0] < 1
+
+    def test_occur_alias_jaccard(self):
+        a = _feat("a", Real)
+        tbl = _tbl(a=(Real, [5.0, 0.0, None]))
+        occ = ToOccurTransformer().set_input(a)
+        np.testing.assert_allclose(
+            np.asarray(occ.transform_column(tbl).values), [1, 0, 0])
+        alias = AliasTransformer("renamed").set_input(a)
+        assert alias.get_output().name == "renamed"
+        from transmogrifai_tpu.types import MultiPickList
+        m1, m2 = _feat("m1", MultiPickList), _feat("m2", MultiPickList)
+        tbl2 = _tbl(m1=(MultiPickList, [["a", "b"]]),
+                    m2=(MultiPickList, [["b", "c"]]))
+        j = JaccardSimilarity().set_input(m1, m2)
+        assert np.asarray(j.transform_column(tbl2).values)[0] == pytest.approx(1 / 3)
+
+
+class TestTransmogrifierDispatch:
+    def test_new_groups_end_to_end(self):
+        import pandas as pd
+        rng = np.random.RandomState(0)
+        n = 60
+        df = pd.DataFrame({
+            "y": rng.randint(0, 2, n).astype(float),
+            "d": [int(v) for v in rng.randint(0, 1e12, n)],
+            "geo": [[float(rng.uniform(-80, 80)), float(rng.uniform(-170, 170)),
+                     1.0] for _ in range(n)],
+            "rm": [{"k1": float(rng.randn()), "k2": float(rng.randn())}
+                   for _ in range(n)],
+            "tm": [{"cat": rng.choice(["x", "y"])} for _ in range(n)],
+        })
+        y = _feat("y", RealNN, response=True)
+        d = _feat("d", Date)
+        geo = _feat("geo", Geolocation)
+        rm = _feat("rm", RealMap)
+        tm = _feat("tm", PickListMap)
+        vec = transmogrify([d, geo, rm, tm])
+        from transmogrifai_tpu.workflow import OpWorkflow
+        from transmogrifai_tpu.impl.selector.factories import (
+            BinaryClassificationModelSelector,
+        )
+        pred = (BinaryClassificationModelSelector
+                .with_train_validation_split(
+                    seed=3, models=[("OpLogisticRegression", None)])
+                .set_input(y, vec).get_output())
+        model = OpWorkflow().set_input_dataset(df).set_result_features(pred).train()
+        scored = model.score(df=df)
+        assert pred.name in scored.column_names
+        vec_col = scored[vec.name]
+        vm = vec_col.metadata["vector_meta"]
+        assert vm.size == np.asarray(vec_col.values).shape[1]
+        # every group contributed slots
+        parents = {c.parent_feature_name for c in vm.columns}
+        assert parents == {"d", "geo", "rm", "tm"}

@@ -1,0 +1,86 @@
+"""ModelInsights + RecordInsights tests (model: reference ModelInsightsTest,
+RecordInsightsLOCOTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu  # noqa: F401
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.insights import (
+    ModelInsights, RecordInsightsCorr, RecordInsightsLOCO,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(5)
+    n = 400
+    strong = rng.randn(n)
+    weak = rng.randn(n)
+    noise = rng.randn(n)
+    y = ((2.0 * strong + 0.3 * weak + 0.5 * rng.randn(n)) > 0).astype(float)
+    df = pd.DataFrame({"y": y, "strong": strong, "weak": weak, "noise": noise})
+    yf = FeatureBuilder.RealNN("y").extract_field().as_response()
+    fs = [FeatureBuilder.Real(c).extract_field().as_predictor()
+          for c in ("strong", "weak", "noise")]
+    from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+    vec = transmogrify(fs)
+    checked = vec.sanity_check(yf, min_variance=1e-6)
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=7, models=[("OpLogisticRegression", None)])
+            .set_input(yf, checked).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    model = wf.train()
+    return df, model, vec, checked, pred
+
+
+def test_model_insights(trained):
+    df, model, vec, checked, pred = trained
+    mi = ModelInsights.extract(model)
+    assert mi.label.name == "y" and mi.label.is_classification
+    assert mi.label.distribution and sum(mi.label.distribution.values()) == 400
+    assert mi.selected_model["bestModelType"] == "OpLogisticRegression"
+    assert mi.model_validation_results
+
+    by_name = {f.feature_name: f for f in mi.features}
+    assert {"strong", "weak", "noise"} <= set(by_name)
+    # the strong feature must dominate contributions
+    assert (by_name["strong"].max_abs_contribution
+            > by_name["noise"].max_abs_contribution)
+    # report renders
+    txt = mi.pretty_print()
+    assert "Best model" in txt and "strong" in txt
+    js = mi.to_json_string()
+    assert "bestModelType" in js
+
+
+def test_loco(trained):
+    df, model, vec, checked, pred = trained
+    selected = model.get_stage(pred.origin_stage.uid)
+    scored = model.score(df=df)
+    loco = RecordInsightsLOCO(selected, top_k=5).set_input(checked)
+    out = loco.transform_column(scored)
+    first = out.values[0]
+    assert isinstance(first, dict) and 0 < len(first) <= 5
+    # zeroing the strong feature must move scores more than the weak one
+    strong_keys = [k for k in first if k.startswith("strong")]
+    noise_keys = [k for k in first if k.startswith("noise")]
+    if strong_keys and noise_keys:
+        assert abs(first[strong_keys[0]]) >= abs(first[noise_keys[0]])
+    # row dual matches the columnar result
+    row = scored.row(0)
+    row_out = loco.transform_row(row)
+    assert set(row_out) == set(first)
+
+
+def test_record_insights_corr(trained):
+    df, model, vec, checked, pred = trained
+    selected = model.get_stage(pred.origin_stage.uid)
+    scored = model.score(df=df)
+    ric = RecordInsightsCorr(selected, top_k=3).set_input(checked)
+    out = ric.transform_column(scored)
+    assert isinstance(out.values[0], dict) and len(out.values[0]) <= 3

@@ -155,6 +155,33 @@ def test_local_scoring_applies_custom_extract():
             float(batch[i, pi]), abs=1e-5)
 
 
+def test_fresh_process_load(tmp_path):
+    # regression: loading in a process that never imported the stage modules
+    # must work (stage descriptors carry their defining module)
+    import subprocess
+    import sys
+    df = _make_df()
+    wf, y, pred = _build_workflow(df)
+    model = wf.train()
+    path = str(tmp_path / "model")
+    model.save(path)
+    df_path = str(tmp_path / "data.csv")
+    df.to_csv(df_path, index=False)
+    code = (
+        "import os; os.environ.setdefault('JAX_PLATFORMS','cpu')\n"
+        "import pandas as pd\n"
+        "from transmogrifai_tpu.workflow import OpWorkflowModel\n"
+        f"m = OpWorkflowModel.load({path!r})\n"
+        f"scored = m.score(df=pd.read_csv({df_path!r}))\n"
+        "assert any('modelSelector' in n for n in scored.column_names)\n"
+        "print('FRESH_LOAD_OK')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300,
+                         cwd="/root/repo")
+    assert "FRESH_LOAD_OK" in out.stdout, out.stderr[-2000:]
+
+
 def test_partial_retrain_with_model_stages():
     df = _make_df()
     wf, y, pred = _build_workflow(df)

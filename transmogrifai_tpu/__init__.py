@@ -13,6 +13,7 @@ from .types import *  # noqa: F401,F403
 from .features import Feature, FeatureBuilder
 from .table import Column, FeatureTable
 from .vector_metadata import VectorColumnMetadata, VectorMetadata
+from . import dsl  # noqa: F401  (attaches the rich feature syntax to Feature)
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,210 @@
+"""Rich feature syntax — the DSL layer.
+
+Mirrors the reference implicit-class DSL (reference: core/.../dsl/ —
+RichNumericFeature.scala, RichTextFeature.scala, RichMapFeature.scala,
+RichDateFeature.scala, RichListFeature.scala, RichFeaturesCollection.scala):
+``f1 + f2``, ``f / 2``, ``f.tokenize()``, ``f.pivot()``, ``f.bucketize(...)``,
+``f.sanity_check(label)``, ``transmogrify([...])``. In Python the "implicit
+enrichment" is direct methods on :class:`Feature`, attached on import of this
+module (imported by the package ``__init__``), so every feature carries the
+syntax with zero wrapping.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from .features import Feature
+from .impl.feature.bucketizers import (
+    DecisionTreeNumericBucketizer, NumericBucketizer, PercentileCalibrator,
+)
+from .impl.feature.dates import (
+    DEFAULT_CIRCULAR_PERIODS, DateListVectorizer, DateToUnitCircleTransformer,
+    TimePeriodTransformer,
+)
+from .impl.feature.math import (
+    AbsoluteValue, AliasTransformer, BinaryMathOp, Ceil, Exp, FilterMap, Floor,
+    JaccardSimilarity, Log, NGramSimilarity, Power, RoundTransformer, ScalarOp,
+    Sqrt, SubstringTransformer, TextLenTransformer, ToOccurTransformer,
+)
+from .impl.feature.scalers import (
+    DescalerTransformer, FillMissingWithMean, OpScalarStandardScaler,
+    ScalerTransformer,
+)
+from .impl.feature.transmogrifier import transmogrify
+from .impl.feature.vectorizers import (
+    OneHotVectorizer, SmartTextVectorizer, TextTokenizer,
+)
+# NOTE: SanityChecker is imported inside sanity_check() — it pulls in jax,
+# which must stay lazy until the user has set platform flags (see __init__)
+
+
+def _num_binop(op: str):
+    def method(self: Feature, other):
+        if isinstance(other, Feature):
+            return BinaryMathOp(op).set_input(self, other).get_output()
+        return ScalarOp(op, float(other)).set_input(self).get_output()
+    return method
+
+
+def _num_rbinop(op: str):
+    # scalar on the left: scalar + f == f + scalar; scalar - f == (f * -1) + s
+    def method(self: Feature, other):
+        if op in ("+", "*"):
+            return _num_binop(op)(self, other)
+        if op == "-":
+            neg = ScalarOp("*", -1.0).set_input(self).get_output()
+            return ScalarOp("+", float(other)).set_input(neg).get_output()
+        raise TypeError(f"unsupported reflected op {op} on Feature")
+    return method
+
+
+# -- RichNumericFeature (reference RichNumericFeature.scala) -----------------
+
+def _attach():
+    F = Feature
+    F.__add__ = _num_binop("+")
+    F.__sub__ = _num_binop("-")
+    F.__mul__ = _num_binop("*")
+    F.__truediv__ = _num_binop("/")
+    F.__radd__ = _num_rbinop("+")
+    F.__rmul__ = _num_rbinop("*")
+    F.__rsub__ = _num_rbinop("-")
+
+    def alias(self: Feature, name: str) -> Feature:
+        return AliasTransformer(name).set_input(self).get_output()
+
+    def abs_(self: Feature) -> Feature:
+        return AbsoluteValue().set_input(self).get_output()
+
+    def log(self: Feature, base: float = 2.718281828459045) -> Feature:
+        return Log(base).set_input(self).get_output()
+
+    def exp(self: Feature) -> Feature:
+        return Exp().set_input(self).get_output()
+
+    def sqrt(self: Feature) -> Feature:
+        return Sqrt().set_input(self).get_output()
+
+    def power(self: Feature, p: float) -> Feature:
+        return Power(p).set_input(self).get_output()
+
+    def round_(self: Feature) -> Feature:
+        return RoundTransformer().set_input(self).get_output()
+
+    def ceil(self: Feature) -> Feature:
+        return Ceil().set_input(self).get_output()
+
+    def floor(self: Feature) -> Feature:
+        return Floor().set_input(self).get_output()
+
+    def bucketize(self: Feature, splits: Sequence[float],
+                  bucket_labels: Optional[Sequence[str]] = None,
+                  track_nulls: bool = True, track_invalid: bool = False
+                  ) -> Feature:
+        return NumericBucketizer(
+            splits, bucket_labels=bucket_labels, track_nulls=track_nulls,
+            track_invalid=track_invalid).set_input(self).get_output()
+
+    def auto_bucketize(self: Feature, label: Feature, max_depth: int = 2,
+                       min_info_gain: float = 0.01) -> Feature:
+        return DecisionTreeNumericBucketizer(
+            max_depth=max_depth, min_info_gain=min_info_gain
+        ).set_input(label, self).get_output()
+
+    def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+        return FillMissingWithMean(default).set_input(self).get_output()
+
+    def zscore(self: Feature) -> Feature:
+        return OpScalarStandardScaler().set_input(self).get_output()
+
+    def scale(self: Feature, scaling_type: str = "linear", slope: float = 1.0,
+              intercept: float = 0.0) -> Feature:
+        return ScalerTransformer(scaling_type, slope, intercept
+                                 ).set_input(self).get_output()
+
+    def descale(self: Feature, scaled: Feature) -> Feature:
+        return DescalerTransformer().set_input(self, scaled).get_output()
+
+    def to_occur(self: Feature) -> Feature:
+        return ToOccurTransformer().set_input(self).get_output()
+
+    def percentile_calibrate(self: Feature, buckets: int = 100) -> Feature:
+        return PercentileCalibrator(buckets).set_input(self).get_output()
+
+    # -- RichTextFeature ------------------------------------------------------
+    def tokenize(self: Feature, min_token_length: int = 1) -> Feature:
+        return TextTokenizer(min_token_length).set_input(self).get_output()
+
+    def pivot(self: Feature, top_k: int = 20, min_support: int = 10,
+              track_nulls: bool = True) -> Feature:
+        return OneHotVectorizer(top_k=top_k, min_support=min_support,
+                                track_nulls=track_nulls
+                                ).set_input(self).get_output()
+
+    def smart_vectorize(self: Feature, **kw) -> Feature:
+        return SmartTextVectorizer(**kw).set_input(self).get_output()
+
+    def text_len(self: Feature) -> Feature:
+        return TextLenTransformer().set_input(self).get_output()
+
+    def contains(self: Feature, other: Feature) -> Feature:
+        return SubstringTransformer().set_input(self, other).get_output()
+
+    def jaccard_similarity(self: Feature, other: Feature) -> Feature:
+        return JaccardSimilarity().set_input(self, other).get_output()
+
+    def ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
+        return NGramSimilarity(n).set_input(self, other).get_output()
+
+    # -- RichDateFeature ------------------------------------------------------
+    def to_unit_circle(self: Feature,
+                       periods: Sequence[str] = DEFAULT_CIRCULAR_PERIODS
+                       ) -> Feature:
+        return DateToUnitCircleTransformer(periods=periods
+                                           ).set_input(self).get_output()
+
+    def time_period(self: Feature, period: str = "DayOfWeek") -> Feature:
+        return TimePeriodTransformer(period).set_input(self).get_output()
+
+    def since_last(self: Feature, reference_date_ms: Optional[int] = None
+                   ) -> Feature:
+        return DateListVectorizer(
+            "SinceLast", reference_date_ms=reference_date_ms
+        ).set_input(self).get_output()
+
+    # -- RichMapFeature -------------------------------------------------------
+    def filter_keys(self: Feature, white_list: Sequence[str] = (),
+                    black_list: Sequence[str] = ()) -> Feature:
+        return FilterMap(white_list, black_list).set_input(self).get_output()
+
+    # -- vectorize / sanity check ---------------------------------------------
+    def vectorize(self: Feature) -> Feature:
+        """Per-feature default vectorization (reference Rich*Feature.vectorize)."""
+        return transmogrify([self])
+
+    def sanity_check(self: Feature, label: Feature, **kw) -> Feature:
+        """self must be an OPVector; label a RealNN (reference
+        RichNumericFeature.sanityCheck:469)."""
+        from .impl.preparators.sanity_checker import SanityChecker
+        return SanityChecker(**kw).set_input(label, self).get_output()
+
+    for name, fn in [
+        ("alias", alias), ("abs", abs_), ("log", log), ("exp", exp),
+        ("sqrt", sqrt), ("power", power), ("round", round_), ("ceil", ceil),
+        ("floor", floor), ("bucketize", bucketize),
+        ("auto_bucketize", auto_bucketize),
+        ("fill_missing_with_mean", fill_missing_with_mean),
+        ("zscore", zscore), ("scale", scale), ("descale", descale),
+        ("to_occur", to_occur), ("percentile_calibrate", percentile_calibrate),
+        ("tokenize", tokenize), ("pivot", pivot),
+        ("smart_vectorize", smart_vectorize), ("text_len", text_len),
+        ("contains", contains), ("jaccard_similarity", jaccard_similarity),
+        ("ngram_similarity", ngram_similarity),
+        ("to_unit_circle", to_unit_circle), ("time_period", time_period),
+        ("since_last", since_last), ("filter_keys", filter_keys),
+        ("vectorize", vectorize), ("sanity_check", sanity_check),
+    ]:
+        setattr(F, name, fn)
+
+
+_attach()

@@ -152,7 +152,7 @@ def fill_numeric_bins(train: FeatureDistribution,
     if hi <= lo:
         hi = lo + 1.0
     edges = np.linspace(lo, hi, max_bins + 1)
-    edges[0], edges[-1] = -np.inf, np.inf
+    # open-ended first/last bins via sentinels beyond the observed range
     finite_edges = np.concatenate([[lo - 1.0], edges[1:-1], [hi + 1.0]])
     for dist in (train, score):
         if dist is None or dist.sketch is None:

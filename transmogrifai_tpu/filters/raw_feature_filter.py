@@ -120,7 +120,7 @@ class RawFeatureFilter:
         if label is None:
             return {}
         import jax.numpy as jnp
-        from ..ops.stats import pearson_correlation
+        from ..ops.stats import pearson_correlation, spearman_correlation
 
         y = np.asarray(label.values, dtype=np.float32)
         cols: List[np.ndarray] = []
@@ -131,10 +131,18 @@ class RawFeatureFilter:
             col = table[f.name]
             if col.kind == "map":
                 valid = col.valid_mask()
-                # one key-set per row, shared across all of the feature's keys
+                # one key-set per row, shared across all of the feature's keys;
+                # a key present with a None/NaN value counts as NULL, matching
+                # the fill-rate definition in column_distributions
+                def _row_keys(v) -> frozenset:
+                    if v is None:
+                        return frozenset()
+                    return frozenset(
+                        str(k) for k, x in v.items()
+                        if x is not None
+                        and not (isinstance(x, float) and np.isnan(x)))
                 row_keys = [
-                    {str(k) for k in col.values[i]}
-                    if valid[i] and col.values[i] is not None else frozenset()
+                    _row_keys(col.values[i]) if valid[i] else frozenset()
                     for i in range(len(col))]
                 for d in dists[f.name]:
                     ind = np.array([0.0 if d.key in ks else 1.0
@@ -148,7 +156,10 @@ class RawFeatureFilter:
         if not cols:
             return {}
         X = jnp.asarray(np.stack(cols, axis=1))
-        corrs = np.asarray(pearson_correlation(X, jnp.asarray(y)))
+        corr_fn = (spearman_correlation
+                   if self.correlation_type == "spearman"
+                   else pearson_correlation)
+        corrs = np.asarray(corr_fn(X, jnp.asarray(y)))
         return {n: float(c) for n, c in zip(names, corrs)}
 
     # -- main entry (reference generateFilteredRaw) --------------------------
@@ -198,13 +209,36 @@ class RawFeatureFilter:
                 f_metrics.append(m)
                 metrics.append(m)
 
+            # a map feature with NO discovered keys (all rows empty) would
+            # otherwise produce zero metrics and dodge the fill checks an
+            # equally-empty scalar feature fails — fall back to whole-column
+            # fill rates
+            whole_column_fallback = not f_metrics
+            if whole_column_fallback:
+                col = table[f.name]
+                m = FeatureMetrics(
+                    name=f.name, key=None,
+                    train_fill_rate=(float(col.valid_mask().mean())
+                                     if len(col) else 0.0))
+                if score_table is not None and f.name in score_table.column_names:
+                    scol = score_table[f.name]
+                    m.score_fill_rate = (float(scol.valid_mask().mean())
+                                         if len(scol) else 0.0)
+                    m.fill_rate_delta = abs(m.train_fill_rate - m.score_fill_rate)
+                    lo = min(m.train_fill_rate, m.score_fill_rate)
+                    hi = max(m.train_fill_rate, m.score_fill_rate)
+                    m.fill_ratio_diff = float(np.inf) if lo == 0 else hi / lo
+                self._apply_exclusions(m, m.score_fill_rate is not None)
+                f_metrics.append(m)
+                metrics.append(m)
+
             if f.name in self.protected_features:
                 for m in f_metrics:
                     if m.exclusion_reasons:
                         m.exclusion_reasons = [
                             r + " (protected, kept)" for r in m.exclusion_reasons]
                 continue
-            is_map = table[f.name].kind == "map"
+            is_map = table[f.name].kind == "map" and not whole_column_fallback
             if is_map and len(f_metrics) > 0:
                 bad_keys = [m.key for m in f_metrics
                             if m.exclusion_reasons and m.key is not None]
@@ -223,6 +257,7 @@ class RawFeatureFilter:
                 "maxFillRatioDiff": self.max_fill_ratio_diff,
                 "maxJSDivergence": self.max_js_divergence,
                 "maxCorrelation": self.max_correlation,
+                "correlationType": self.correlation_type,
             },
             metrics=metrics,
             excluded_features=sorted(excluded_features),

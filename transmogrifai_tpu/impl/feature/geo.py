@@ -1,0 +1,185 @@
+"""Geolocation vectorizers.
+
+TPU re-design of the reference geolocation stages (reference:
+core/.../impl/feature/GeolocationVectorizer.scala:156,
+GeolocationMapVectorizer.scala:129): a Geolocation value is a
+(lat, lon, accuracy) triple (features/.../types/Geolocation.scala:206); fit
+computes the **geographic midpoint** (3-D unit-vector average) of non-missing
+rows as the fill value; transform emits the triple + null indicator.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...stages.base import Estimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import OPVector
+from ...vector_metadata import NULL_INDICATOR, VectorColumnMetadata
+from .vectorizers import TransmogrifierDefaults, _VectorModelBase
+
+_GEO_NAMES = ("lat", "lon", "accuracy")
+
+
+def geographic_midpoint(latlon: np.ndarray) -> Tuple[float, float]:
+    """Mean point on the sphere: average 3-D unit vectors then re-project
+    (reference Geolocation.scala GeolocationExtensions midpoint logic)."""
+    lat = np.radians(latlon[:, 0])
+    lon = np.radians(latlon[:, 1])
+    x = np.cos(lat) * np.cos(lon)
+    y = np.cos(lat) * np.sin(lon)
+    z = np.sin(lat)
+    xm, ym, zm = x.mean(), y.mean(), z.mean()
+    hyp = np.hypot(xm, ym)
+    if hyp < 1e-12 and abs(zm) < 1e-12:
+        return 0.0, 0.0
+    return float(np.degrees(np.arctan2(zm, hyp))), float(np.degrees(np.arctan2(ym, xm)))
+
+
+def _geo_rows(col: Column) -> List[Optional[Sequence[float]]]:
+    valid = col.valid_mask()
+    out: List[Optional[Sequence[float]]] = []
+    for i in range(len(col)):
+        v = col.values[i] if valid[i] else None
+        out.append(list(v) if v is not None and len(v) >= 2 else None)
+    return out
+
+
+class GeolocationVectorizer(Estimator):
+    """Seq[Geolocation] → OPVector: midpoint-fill + null indicator."""
+
+    output_type = OPVector
+
+    def __init__(self, fill_with_mean: bool = True,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("vecGeo", uid)
+        self.fill_with_mean = fill_with_mean
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        fills: List[List[float]] = []
+        for f in self.input_features:
+            rows = [r for r in _geo_rows(table[f.name]) if r is not None]
+            if self.fill_with_mean and rows:
+                pts = np.array([[r[0], r[1]] for r in rows], dtype=np.float64)
+                lat, lon = geographic_midpoint(pts)
+                acc = float(np.mean([r[2] if len(r) > 2 else 0.0 for r in rows]))
+                fills.append([lat, lon, acc])
+            else:
+                fills.append([0.0, 0.0, 0.0])
+        model = GeolocationVectorizerModel(fills=fills,
+                                           track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class GeolocationVectorizerModel(_VectorModelBase):
+    def __init__(self, fills: List[List[float]], track_nulls: bool, uid=None):
+        super().__init__("vecGeo", uid)
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks, meta = [], []
+        for f, fill in zip(self.input_features, self.fills):
+            rows = _geo_rows(table[f.name])
+            width = 3 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float32)
+            for i, r in enumerate(rows):
+                if r is None:
+                    block[i, :3] = fill
+                    if self.track_nulls:
+                        block[i, 3] = 1.0
+                else:
+                    block[i, 0], block[i, 1] = float(r[0]), float(r[1])
+                    block[i, 2] = float(r[2]) if len(r) > 2 else 0.0
+            blocks.append(block)
+            meta.extend([VectorColumnMetadata(
+                f.name, f.type_name, f.name, None, descriptor_value=g)
+                for g in _GEO_NAMES])
+            if self.track_nulls:
+                meta.append(VectorColumnMetadata(
+                    f.name, f.type_name, f.name, NULL_INDICATOR))
+        return self._emit(np.concatenate(blocks, axis=1), meta)
+
+
+class GeolocationMapVectorizer(Estimator):
+    """Seq[GeolocationMap] → OPVector: per-key midpoint-fill + null indicator
+    (reference GeolocationMapVectorizer.scala)."""
+
+    output_type = OPVector
+
+    def __init__(self, track_nulls: bool = TransmogrifierDefaults.TrackNulls,
+                 uid=None):
+        super().__init__("vecGeoMap", uid)
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        all_keys: List[List[str]] = []
+        fills: List[Dict[str, List[float]]] = []
+        for f in self.input_features:
+            col = table[f.name]
+            valid = col.valid_mask()
+            keys: set = set()
+            per_key: Dict[str, List[List[float]]] = {}
+            for i in range(len(col)):
+                r = col.values[i] if valid[i] else None
+                if not r:
+                    continue
+                for k, v in r.items():
+                    if v is not None and len(v) >= 2:
+                        keys.add(str(k))
+                        per_key.setdefault(str(k), []).append(list(v))
+            kf: Dict[str, List[float]] = {}
+            for k in sorted(keys):
+                pts = np.array([[v[0], v[1]] for v in per_key[k]], dtype=np.float64)
+                lat, lon = geographic_midpoint(pts)
+                acc = float(np.mean([v[2] if len(v) > 2 else 0.0
+                                     for v in per_key[k]]))
+                kf[k] = [lat, lon, acc]
+            all_keys.append(sorted(keys))
+            fills.append(kf)
+        model = GeolocationMapVectorizerModel(
+            keys=all_keys, fills=fills, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class GeolocationMapVectorizerModel(_VectorModelBase):
+    def __init__(self, keys: List[List[str]], fills: List[Dict[str, List[float]]],
+                 track_nulls: bool, uid=None):
+        super().__init__("vecGeoMap", uid)
+        self.keys = keys
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks, meta = [], []
+        for f, keys, kf in zip(self.input_features, self.keys, self.fills):
+            col = table[f.name]
+            valid = col.valid_mask()
+            for key in keys:
+                width = 3 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width), dtype=np.float32)
+                fill = kf.get(key, [0.0, 0.0, 0.0])
+                for i in range(n):
+                    r = col.values[i] if valid[i] else None
+                    v = r.get(key) if r else None
+                    if v is None or len(v) < 2:
+                        block[i, :3] = fill
+                        if self.track_nulls:
+                            block[i, 3] = 1.0
+                    else:
+                        block[i, 0], block[i, 1] = float(v[0]), float(v[1])
+                        block[i, 2] = float(v[2]) if len(v) > 2 else 0.0
+                blocks.append(block)
+                meta.extend([VectorColumnMetadata(
+                    f.name, f.type_name, key, None, descriptor_value=g)
+                    for g in _GEO_NAMES])
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, f.type_name, key, NULL_INDICATOR))
+        mat = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), dtype=np.float32))
+        return self._emit(mat, meta)

@@ -1,0 +1,306 @@
+"""Map-typed feature vectorizers: per-key expansion with provenance.
+
+TPU re-design of the reference map vectorizer family (reference:
+core/.../impl/feature/OPMapVectorizer.scala:468 — typed map → mean/mode-filled
+reals + null indicators per key; TextMapPivotVectorizer.scala:145;
+MultiPickListMapVectorizer.scala:122; SmartTextMapVectorizer.scala:296).
+Map columns are host-side dict arrays; fit discovers the key space (optionally
+white/black-listed), and transform emits one dense float32 block whose slots
+carry ``grouping=key`` metadata so SanityChecker/ModelInsights can attribute
+them back (reference OpVectorColumnMetadata.grouping).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...features import Feature
+from ...stages.base import Estimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import OPVector
+from ...vector_metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata,
+)
+from .vectorizers import TransmogrifierDefaults, _VectorModelBase, tokenize_text
+
+
+def _map_rows(col: Column) -> List[Optional[Dict[str, Any]]]:
+    valid = col.valid_mask()
+    return [col.values[i] if valid[i] and col.values[i] is not None else None
+            for i in range(len(col))]
+
+
+def _discover_keys(rows: Sequence[Optional[Dict[str, Any]]],
+                   white: Sequence[str], black: Sequence[str]) -> List[str]:
+    keys: set = set()
+    for r in rows:
+        if r:
+            keys.update(str(k) for k in r)
+    if white:
+        keys &= set(white)
+    keys -= set(black)
+    return sorted(keys)
+
+
+class MapVectorizer(Estimator):
+    """Seq[RealMap/IntegralMap/BinaryMap/CurrencyMap/…] → OPVector.
+
+    Numeric map values per key: mean-fill (or constant) + null indicator per
+    key (reference OPMapVectorizer.scala — each typed subclass fills with
+    mean/mode and tracks nulls per key)."""
+
+    output_type = OPVector
+
+    def __init__(self, fill_with_mean: bool = TransmogrifierDefaults.FillWithMean,
+                 fill_value: float = TransmogrifierDefaults.FillValue,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls,
+                 white_list_keys: Sequence[str] = (),
+                 black_list_keys: Sequence[str] = (), uid=None):
+        super().__init__("vecMap", uid)
+        self.fill_with_mean = fill_with_mean
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+        self.white_list_keys = tuple(white_list_keys)
+        self.black_list_keys = tuple(black_list_keys)
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        all_keys: List[List[str]] = []
+        fills: List[List[float]] = []
+        for f in self.input_features:
+            rows = _map_rows(table[f.name])
+            keys = _discover_keys(rows, self.white_list_keys, self.black_list_keys)
+            kf: List[float] = []
+            for k in keys:
+                if self.fill_with_mean:
+                    vals = [float(r[k]) for r in rows
+                            if r and k in r and r[k] is not None
+                            and not (isinstance(r[k], float) and np.isnan(r[k]))]
+                    kf.append(float(np.mean(vals)) if vals else self.fill_value)
+                else:
+                    kf.append(self.fill_value)
+            all_keys.append(keys)
+            fills.append(kf)
+        model = MapVectorizerModel(keys=all_keys, fills=fills,
+                                   track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class MapVectorizerModel(_VectorModelBase):
+    def __init__(self, keys: List[List[str]], fills: List[List[float]],
+                 track_nulls: bool, uid=None):
+        super().__init__("vecMap", uid)
+        self.keys = keys
+        self.fills = fills
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks: List[np.ndarray] = []
+        meta: List[VectorColumnMetadata] = []
+        for f, keys, fills in zip(self.input_features, self.keys, self.fills):
+            rows = _map_rows(table[f.name])
+            k = len(keys)
+            width = k * (2 if self.track_nulls else 1)
+            block = np.zeros((n, width), dtype=np.float32)
+            for j, (key, fill) in enumerate(zip(keys, fills)):
+                vcol = j * (2 if self.track_nulls else 1)
+                for i, r in enumerate(rows):
+                    v = r.get(key) if r else None
+                    missing = v is None or (isinstance(v, float) and np.isnan(v))
+                    if missing:
+                        block[i, vcol] = fill
+                        if self.track_nulls:
+                            block[i, vcol + 1] = 1.0
+                    else:
+                        block[i, vcol] = float(v)
+                meta.append(VectorColumnMetadata(f.name, f.type_name, key, None))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, f.type_name, key, NULL_INDICATOR))
+            blocks.append(block)
+        mat = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), dtype=np.float32))
+        return self._emit(mat, meta)
+
+
+class TextMapPivotVectorizer(Estimator):
+    """Seq[TextMap] → OPVector: per-key top-K one-hot pivot with OTHER + null
+    (reference TextMapPivotVectorizer.scala:145)."""
+
+    output_type = OPVector
+
+    def __init__(self, top_k: int = TransmogrifierDefaults.TopK,
+                 min_support: int = TransmogrifierDefaults.MinSupport,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls,
+                 white_list_keys: Sequence[str] = (),
+                 black_list_keys: Sequence[str] = (), uid=None):
+        super().__init__("pivotTextMap", uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+        self.white_list_keys = tuple(white_list_keys)
+        self.black_list_keys = tuple(black_list_keys)
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        vocabs: List[Dict[str, List[str]]] = []
+        for f in self.input_features:
+            rows = _map_rows(table[f.name])
+            keys = _discover_keys(rows, self.white_list_keys, self.black_list_keys)
+            per_key: Dict[str, List[str]] = {}
+            for k in keys:
+                cnt = Counter()
+                for r in rows:
+                    if r and k in r and r[k] is not None:
+                        if isinstance(r[k], (list, tuple, set)):
+                            cnt.update(str(v) for v in r[k])
+                        else:
+                            cnt[str(r[k])] += 1
+                top = [v for v, c in cnt.most_common() if c >= self.min_support]
+                per_key[k] = sorted(top, key=lambda v: (-cnt[v], v))[: self.top_k]
+            vocabs.append(per_key)
+        model = TextMapPivotVectorizerModel(vocabs=vocabs,
+                                            track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class TextMapPivotVectorizerModel(_VectorModelBase):
+    def __init__(self, vocabs: List[Dict[str, List[str]]], track_nulls: bool,
+                 uid=None):
+        super().__init__("pivotTextMap", uid)
+        self.vocabs = vocabs
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks: List[np.ndarray] = []
+        meta: List[VectorColumnMetadata] = []
+        for f, per_key in zip(self.input_features, self.vocabs):
+            rows = _map_rows(table[f.name])
+            for key in sorted(per_key):
+                vocab = per_key[key]
+                k = len(vocab)
+                width = k + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width), dtype=np.float32)
+                index = {v: i for i, v in enumerate(vocab)}
+                for i, r in enumerate(rows):
+                    v = r.get(key) if r else None
+                    if v is None:
+                        if self.track_nulls:
+                            block[i, k + 1] = 1.0
+                        continue
+                    items = v if isinstance(v, (list, tuple, set)) else [v]
+                    for item in items:
+                        j = index.get(str(item))
+                        if j is None:
+                            block[i, k] = 1.0
+                        else:
+                            block[i, j] = 1.0
+                blocks.append(block)
+                meta.extend([VectorColumnMetadata(f.name, f.type_name, key, v)
+                             for v in vocab])
+                meta.append(VectorColumnMetadata(
+                    f.name, f.type_name, key, OTHER_INDICATOR))
+                if self.track_nulls:
+                    meta.append(VectorColumnMetadata(
+                        f.name, f.type_name, key, NULL_INDICATOR))
+        mat = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), dtype=np.float32))
+        return self._emit(mat, meta)
+
+
+#: MultiPickListMap pivots identically — set-valued entries hit the
+#: isinstance(list/tuple/set) path above (reference MultiPickListMapVectorizer)
+MultiPickListMapVectorizer = TextMapPivotVectorizer
+
+
+class SmartTextMapVectorizer(Estimator):
+    """Seq[TextMap] → OPVector: per-key cardinality decides pivot vs hashing
+    (reference SmartTextMapVectorizer.scala:296)."""
+
+    output_type = OPVector
+
+    def __init__(self, max_cardinality: int = TransmogrifierDefaults.MaxCardinality,
+                 top_k: int = TransmogrifierDefaults.TopK,
+                 min_support: int = TransmogrifierDefaults.MinSupport,
+                 num_hashes: int = TransmogrifierDefaults.NumHashes,
+                 track_nulls: bool = TransmogrifierDefaults.TrackNulls, uid=None):
+        super().__init__("smartTxtMapVec", uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        plans: List[Dict[str, Dict[str, Any]]] = []
+        for f in self.input_features:
+            rows = _map_rows(table[f.name])
+            keys = _discover_keys(rows, (), ())
+            plan: Dict[str, Dict[str, Any]] = {}
+            for k in keys:
+                cnt = Counter(str(r[k]) for r in rows
+                              if r and k in r and r[k] is not None)
+                if len(cnt) <= self.max_cardinality:
+                    top = [v for v, c in cnt.most_common() if c >= self.min_support]
+                    top = sorted(top, key=lambda v: (-cnt[v], v))[: self.top_k]
+                    plan[k] = {"kind": "pivot", "vocab": top}
+                else:
+                    plan[k] = {"kind": "hash"}
+            plans.append(plan)
+        model = SmartTextMapVectorizerModel(
+            plans=plans, num_hashes=self.num_hashes, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
+
+class SmartTextMapVectorizerModel(_VectorModelBase):
+    def __init__(self, plans: List[Dict[str, Dict[str, Any]]], num_hashes: int,
+                 track_nulls: bool, uid=None):
+        super().__init__("smartTxtMapVec", uid)
+        self.plans = plans
+        self.num_hashes = num_hashes
+        self.track_nulls = track_nulls
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        from .vectorizers import hash_token_lists
+        n = table.num_rows
+        blocks: List[np.ndarray] = []
+        meta: List[VectorColumnMetadata] = []
+        for f, plan in zip(self.input_features, self.plans):
+            rows = _map_rows(table[f.name])
+            for key in sorted(plan):
+                spec = plan[key]
+                vals = [r.get(key) if r else None for r in rows]
+                if spec["kind"] == "pivot":
+                    vocab = spec["vocab"]
+                    k = len(vocab)
+                    block = np.zeros((n, k + 1), dtype=np.float32)
+                    index = {v: i for i, v in enumerate(vocab)}
+                    for i, v in enumerate(vals):
+                        if v is None:
+                            continue
+                        j = index.get(str(v), -1)
+                        block[i, j if j >= 0 else k] = 1.0
+                    blocks.append(block)
+                    meta.extend([VectorColumnMetadata(f.name, f.type_name, key, v)
+                                 for v in vocab])
+                    meta.append(VectorColumnMetadata(
+                        f.name, f.type_name, key, OTHER_INDICATOR))
+                else:
+                    toks = [tokenize_text(str(v)) if v is not None else []
+                            for v in vals]
+                    blocks.append(hash_token_lists(toks, self.num_hashes))
+                    meta.extend([VectorColumnMetadata(
+                        f.name, f.type_name, key, None,
+                        descriptor_value=f"hash_{j}")
+                        for j in range(self.num_hashes)])
+                if self.track_nulls:
+                    nul = np.array([1.0 if v is None else 0.0 for v in vals],
+                                   dtype=np.float32)
+                    blocks.append(nul[:, None])
+                    meta.append(VectorColumnMetadata(
+                        f.name, f.type_name, key, NULL_INDICATOR))
+        mat = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), dtype=np.float32))
+        return self._emit(mat, meta)

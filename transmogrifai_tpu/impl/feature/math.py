@@ -1,0 +1,338 @@
+"""Row-level math and miscellaneous transformers.
+
+TPU re-design of the reference math/misc stages (reference:
+core/.../impl/feature/MathTransformers (unary+binary arithmetic, 393 LoC),
+AliasTransformer.scala:63, SubstringTransformer.scala:75,
+ToOccurTransformer.scala:67, FilterMap.scala:55, TextLenTransformer.scala:69,
+TextListNullTransformer.scala:69, DropIndicesByTransformer.scala:79,
+JaccardSimilarity.scala:46, NGramSimilarity.scala:100). Numeric transformers
+run columnar over device-eligible arrays; string/map stages stay host-side.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...stages.base import (
+    BinaryTransformer, SequenceTransformer, Transformer, UnaryTransformer,
+)
+from ...table import Column, FeatureTable
+from ...types import (
+    Binary, FeatureType, Integral, MultiPickList, OPMap, OPVector, Real,
+    RealNN, Text, TextList,
+)
+
+# ---------------------------------------------------------------------------
+# Numeric math (columnar over masked float arrays)
+# ---------------------------------------------------------------------------
+
+
+class _NumericUnary(UnaryTransformer):
+    """Real → Real elementwise with validity-mask propagation."""
+
+    def __init__(self, name: str, np_fn: Callable[[np.ndarray], np.ndarray],
+                 uid=None):
+        super().__init__(name, transform_fn=None, output_type=Real,
+                         input_type=Real, uid=uid)
+        self.np_fn = np_fn
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        with np.errstate(all="ignore"):
+            out = self.np_fn(vals).astype(np.float32)
+        mask = col.valid_mask() & np.isfinite(out)
+        out = np.where(mask, out, 0.0).astype(np.float32)
+        return Column(Real, out, mask)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        if v is None:
+            return None
+        with np.errstate(all="ignore"):
+            out = float(self.np_fn(np.array([float(v)]))[0])
+        return out if np.isfinite(out) else None
+
+
+def AbsoluteValue(uid=None):   # reference RichNumericFeature.abs
+    return _NumericUnary("abs", np.abs, uid=uid)
+
+
+def Ceil(uid=None):
+    return _NumericUnary("ceil", np.ceil, uid=uid)
+
+
+def Floor(uid=None):
+    return _NumericUnary("floor", np.floor, uid=uid)
+
+
+def RoundTransformer(uid=None):
+    return _NumericUnary("round", np.round, uid=uid)
+
+
+def Exp(uid=None):
+    return _NumericUnary("exp", np.exp, uid=uid)
+
+
+def Sqrt(uid=None):
+    return _NumericUnary("sqrt", np.sqrt, uid=uid)
+
+
+def Log(base: float = np.e, uid=None):
+    return _NumericUnary("log", lambda v: np.log(v) / np.log(base), uid=uid)
+
+
+def Power(p: float, uid=None):
+    return _NumericUnary("power", lambda v: np.power(v, p), uid=uid)
+
+
+def SquareRoot(uid=None):
+    return Sqrt(uid=uid)
+
+
+class ScalarOp(UnaryTransformer):
+    """Real (op) scalar → Real (reference RichNumericFeature +,-,*,/ scalar)."""
+
+    _OPS = {"+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide}
+
+    def __init__(self, op: str, scalar: float, uid=None):
+        super().__init__(f"scalar{op}", transform_fn=None, output_type=Real,
+                         input_type=Real, uid=uid)
+        self.op = op
+        self.scalar = float(scalar)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        with np.errstate(all="ignore"):
+            out = self._OPS[self.op](vals, self.scalar).astype(np.float32)
+        mask = col.valid_mask() & np.isfinite(out)
+        return Column(Real, np.where(mask, out, 0.0).astype(np.float32), mask)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        if v is None:
+            return None
+        with np.errstate(all="ignore"):
+            out = float(self._OPS[self.op](float(v), self.scalar))
+        return out if np.isfinite(out) else None
+
+
+class BinaryMathOp(BinaryTransformer):
+    """(Real, Real) → Real elementwise; missing propagates, div-by-0 → missing
+    (reference MathTransformers binary ops semantics)."""
+
+    _OPS = {"+": np.add, "-": np.subtract, "*": np.multiply, "/": np.divide}
+
+    def __init__(self, op: str, uid=None):
+        if op not in self._OPS:
+            raise ValueError(f"unknown op {op}")
+        super().__init__(f"binop{op}", transform_fn=None, output_type=Real,
+                         input_types=(Real, Real), uid=uid)
+        self.op = op
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        a = table[self.input_features[0].name]
+        b = table[self.input_features[1].name]
+        va = np.asarray(a.values, dtype=np.float64).reshape(-1)
+        vb = np.asarray(b.values, dtype=np.float64).reshape(-1)
+        with np.errstate(all="ignore"):
+            out = self._OPS[self.op](va, vb).astype(np.float32)
+        mask = a.valid_mask() & b.valid_mask() & np.isfinite(out)
+        return Column(Real, np.where(mask, out, 0.0).astype(np.float32), mask)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        a = row.get(self.input_features[0].name)
+        b = row.get(self.input_features[1].name)
+        if a is None or b is None:
+            return None
+        with np.errstate(all="ignore"):
+            out = float(self._OPS[self.op](float(a), float(b)))
+        return out if np.isfinite(out) else None
+
+
+# ---------------------------------------------------------------------------
+# Misc transformers
+# ---------------------------------------------------------------------------
+
+class AliasTransformer(UnaryTransformer):
+    """Identity with a new name (reference AliasTransformer.scala)."""
+
+    def __init__(self, name: str, uid=None):
+        super().__init__("alias", transform_fn=lambda v: v,
+                         output_type=Real, uid=uid)
+        self.alias = name
+
+    def set_input(self, *features):
+        out = super().set_input(*features)
+        self.output_type = features[0].feature_type
+        return out
+
+    def output_name(self) -> str:
+        return self.alias
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        return table[self.input_features[0].name]
+
+
+class SubstringTransformer(BinaryTransformer):
+    """(Text, Text) → Binary: is input2 a substring of input1 (reference
+    SubstringTransformer.scala)."""
+
+    def __init__(self, uid=None):
+        super().__init__(
+            "substring",
+            transform_fn=lambda a, b: (None if a is None or b is None
+                                       else str(b).lower() in str(a).lower()),
+            output_type=Binary, input_types=(Text, Text), uid=uid)
+
+
+class ToOccurTransformer(UnaryTransformer):
+    """Any → RealNN 1.0/0.0 occurrence flag (reference ToOccurTransformer.scala
+    — default: non-empty numeric>0 / non-empty text / true → 1.0)."""
+
+    def __init__(self, matches: Optional[Callable[[Any], bool]] = None, uid=None):
+        def default_match(v: Any) -> bool:
+            if v is None:
+                return False
+            if isinstance(v, bool):
+                return v
+            if isinstance(v, (int, float)):
+                return float(v) > 0
+            return bool(v)
+        fn = matches or default_match
+        super().__init__("toOccur",
+                         transform_fn=lambda v: 1.0 if fn(v) else 0.0,
+                         output_type=RealNN, uid=uid)
+
+
+class FilterMap(UnaryTransformer):
+    """OPMap → OPMap white/black-list filter (reference FilterMap.scala)."""
+
+    def __init__(self, white_list_keys: Sequence[str] = (),
+                 black_list_keys: Sequence[str] = (), uid=None):
+        white = set(white_list_keys)
+        black = set(black_list_keys)
+
+        def fn(v):
+            if v is None:
+                return None
+            out = {k: x for k, x in v.items()
+                   if (not white or str(k) in white) and str(k) not in black}
+            return out or None
+
+        super().__init__("filterMap", transform_fn=fn, output_type=OPMap, uid=uid)
+        self.white_list_keys = tuple(white_list_keys)
+        self.black_list_keys = tuple(black_list_keys)
+
+    def set_input(self, *features):
+        out = super().set_input(*features)
+        self.output_type = features[0].feature_type
+        return out
+
+
+class TextLenTransformer(UnaryTransformer):
+    """Text → Integral length in characters (reference TextLenTransformer)."""
+
+    def __init__(self, uid=None):
+        super().__init__("textLen",
+                         transform_fn=lambda v: 0 if v is None else len(str(v)),
+                         output_type=Integral, input_type=Text, uid=uid)
+
+
+class TextListNullTransformer(SequenceTransformer):
+    """Seq[TextList] → OPVector of null indicators (reference
+    TextListNullTransformer.scala)."""
+
+    output_type = OPVector
+
+    def __init__(self, uid=None):
+        super().__init__("textListNull", transform_fn=None,
+                         output_type=OPVector, uid=uid)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        from ...vector_metadata import (
+            NULL_INDICATOR, VectorColumnMetadata, VectorMetadata,
+        )
+        blocks, meta = [], []
+        for f in self.input_features:
+            col = table[f.name]
+            m = col.valid_mask()
+            blocks.append((~m).astype(np.float32))
+            meta.append(VectorColumnMetadata(f.name, f.type_name, f.name,
+                                             NULL_INDICATOR))
+        vm = VectorMetadata.of(self.get_output().name, meta)
+        return Column(OPVector, np.stack(blocks, axis=1), None,
+                      {"vector_meta": vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        return [0.0 if row.get(f.name) else 1.0 for f in self.input_features]
+
+
+class DropIndicesByTransformer(UnaryTransformer):
+    """OPVector → OPVector dropping slots whose metadata matches a predicate
+    (reference DropIndicesByTransformer.scala — e.g. drop null indicators)."""
+
+    def __init__(self, predicate: Callable[[Any], bool], uid=None):
+        super().__init__("dropIndicesBy", transform_fn=None,
+                         output_type=OPVector, input_type=OPVector, uid=uid)
+        self.predicate = predicate
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vm = col.metadata.get("vector_meta")
+        if vm is None:
+            raise ValueError("input vector carries no metadata")
+        keep = [i for i, c in enumerate(vm.columns) if not self.predicate(c)]
+        mat = np.asarray(col.values, dtype=np.float32)[:, keep]
+        new_vm = vm.select(keep)
+        return Column(OPVector, mat, None, {"vector_meta": new_vm})
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        raise ValueError(
+            "DropIndicesByTransformer needs the vector metadata attached to "
+            "columnar inputs; score via the batch/micro-batch path")
+
+
+def jaccard_similarity(a: Optional[Sequence[str]], b: Optional[Sequence[str]]
+                       ) -> Optional[float]:
+    """|A∩B| / |A∪B|; both empty → 1.0 (reference JaccardSim.scala)."""
+    sa = set(a or ())
+    sb = set(b or ())
+    if not sa and not sb:
+        return 1.0
+    union = sa | sb
+    return len(sa & sb) / len(union)
+
+
+class JaccardSimilarity(BinaryTransformer):
+    """(MultiPickList, MultiPickList) → RealNN (reference
+    JaccardSimilarity.scala)."""
+
+    def __init__(self, uid=None):
+        super().__init__("jaccardSim", transform_fn=jaccard_similarity,
+                         output_type=RealNN, uid=uid)
+
+
+def _ngrams(s: str, n: int) -> set:
+    s = f" {s.lower()} "
+    return {s[i:i + n] for i in range(max(len(s) - n + 1, 1))}
+
+
+class NGramSimilarity(BinaryTransformer):
+    """(Text, Text) → RealNN character n-gram Jaccard similarity (reference
+    NGramSimilarity.scala — Lucene NGramDistance approximated by n-gram
+    Jaccard; empty/missing pairs → 0)."""
+
+    def __init__(self, n: int = 3, uid=None):
+        def fn(a, b):
+            if not a or not b:
+                return 0.0
+            ga, gb = _ngrams(str(a), n), _ngrams(str(b), n)
+            if not ga or not gb:
+                return 0.0
+            return len(ga & gb) / len(ga | gb)
+        super().__init__("ngramSim", transform_fn=fn, output_type=RealNN,
+                         input_types=(None, None), uid=uid)
+        self.n = n

@@ -1,0 +1,173 @@
+"""Scaling stages with metadata for descaling predictions.
+
+TPU re-design of the reference scaling family (reference:
+core/.../impl/feature/ScalerTransformer.scala:186 — linear/log scaling whose
+args are stored in column metadata; DescalerTransformer.scala:112 — reads that
+metadata off another feature to invert; OpScalarStandardScaler.scala:109 —
+z-score fit; FillMissingWithMean.scala:76).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...stages.base import (
+    BinaryTransformer, Estimator, Transformer, UnaryTransformer,
+)
+from ...table import Column, FeatureTable
+from ...types import Real, RealNN
+
+#: metadata key carrying the scaling args (reference ScalingType + args)
+SCALER_META = "scaler"
+
+
+class ScalerTransformer(UnaryTransformer):
+    """Real → Real scaled; scaling args ride column metadata so a
+    DescalerTransformer can invert them (reference ScalerTransformer.scala)."""
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0, uid=None):
+        if scaling_type not in ("linear", "log"):
+            raise ValueError("scaling_type must be 'linear' or 'log'")
+        super().__init__(f"scale_{scaling_type}", transform_fn=None,
+                         output_type=Real, input_type=Real, uid=uid)
+        self.scaling_type = scaling_type
+        self.slope = slope
+        self.intercept = intercept
+
+    def _apply(self, vals: np.ndarray) -> np.ndarray:
+        if self.scaling_type == "linear":
+            return self.slope * vals + self.intercept
+        return np.log(vals)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = self._apply(vals)
+        meta = {SCALER_META: {"type": self.scaling_type, "slope": self.slope,
+                              "intercept": self.intercept}}
+        return Column(Real, out.astype(np.float32),
+                      None if col.mask is None else np.asarray(col.mask), meta)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        if v is None:
+            return None
+        return float(self._apply(np.array([float(v)]))[0])
+
+
+class DescalerTransformer(BinaryTransformer):
+    """(scaled value, scaler-carrying feature) → descaled value (reference
+    DescalerTransformer.scala — reads scaling metadata from input 2)."""
+
+    def __init__(self, uid=None):
+        super().__init__("descale", transform_fn=None, output_type=Real,
+                         input_types=(Real, Real), uid=uid)
+        self._scaler_args: Optional[Dict[str, Any]] = None
+
+    def _invert(self, vals: np.ndarray, args: Dict[str, Any]) -> np.ndarray:
+        if args["type"] == "linear":
+            slope = args["slope"]
+            if slope == 0:
+                raise ValueError("cannot descale: slope is 0")
+            return (vals - args["intercept"]) / slope
+        return np.exp(vals)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        val_f, scaled_f = self.input_features
+        col = table[val_f.name]
+        args = table[scaled_f.name].metadata.get(SCALER_META)
+        if args is None:
+            raise ValueError(
+                f"feature '{scaled_f.name}' carries no scaler metadata")
+        self._scaler_args = dict(args)
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        out = self._invert(vals, args)
+        return Column(Real, out.astype(np.float32),
+                      None if col.mask is None else np.asarray(col.mask))
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        if v is None or self._scaler_args is None:
+            return None
+        return float(self._invert(np.array([float(v)]), self._scaler_args)[0])
+
+
+class OpScalarStandardScaler(Estimator):
+    """RealNN → RealNN z-score (reference OpScalarStandardScaler.scala)."""
+
+    input_types = (RealNN,)
+    output_type = RealNN
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True, uid=None):
+        super().__init__("stdScaler", uid)
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        mean = float(vals.mean()) if self.with_mean else 0.0
+        std = float(vals.std()) if self.with_std else 1.0
+        model = OpScalarStandardScalerModel(
+            mean=mean, std=std if std > 0 else 1.0)
+        model.summary_metadata = {"mean": mean, "std": std}
+        return self._finalize_model(model)
+
+
+class OpScalarStandardScalerModel(Transformer):
+    output_type = RealNN
+
+    def __init__(self, mean: float, std: float, uid=None):
+        super().__init__("stdScaler", uid)
+        self.mean = mean
+        self.std = std
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float32).reshape(-1)
+        out = (vals - np.float32(self.mean)) / np.float32(self.std)
+        return Column(RealNN, out, None)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        return (float(v) - self.mean) / self.std if v is not None else None
+
+
+class FillMissingWithMean(Estimator):
+    """Real → RealNN mean-filled (reference FillMissingWithMean.scala)."""
+
+    input_types = (Real,)
+    output_type = RealNN
+
+    def __init__(self, default_value: float = 0.0, uid=None):
+        super().__init__("fillWithMean", uid)
+        self.default_value = default_value
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        m = col.valid_mask()
+        mean = float(vals[m].mean()) if m.any() else self.default_value
+        model = FillMissingWithMeanModel(mean=mean)
+        return self._finalize_model(model)
+
+
+class FillMissingWithMeanModel(Transformer):
+    output_type = RealNN
+
+    def __init__(self, mean: float, uid=None):
+        super().__init__("fillWithMean", uid)
+        self.mean = mean
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        vals = np.asarray(col.values, dtype=np.float32).reshape(-1)
+        out = np.where(col.valid_mask(), vals, np.float32(self.mean))
+        return Column(RealNN, out, None)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        v = row.get(self.input_features[0].name)
+        return float(v) if v is not None else self.mean

@@ -11,10 +11,18 @@ from typing import Dict, List, Sequence, Type
 
 from ...features import Feature
 from ...types import (
-    Binary, City, ComboBox, Country, Currency, Date, DateTime, FeatureType, ID,
-    Integral, MultiPickList, OPVector, Percent, PickList, PostalCode, Real,
-    RealNN, State, Street, Text, TextArea, TextList, Email, URL, Base64, Phone,
+    Base64, Binary, BinaryMap, City, ComboBox, Country, Currency, Date,
+    DateList, DateMap, DateTime, DateTimeMap, Email, FeatureType, Geolocation,
+    GeolocationMap, ID, Integral, MultiPickList, MultiPickListMap, OPMap,
+    OPVector, Percent, PickList, PostalCode, Prediction, Real, RealNN, State,
+    Street, Text, TextArea, TextAreaMap, TextList, TextMap, URL, Phone,
 )
+from .dates import (
+    DEFAULT_CIRCULAR_PERIODS, DateListVectorizer, DateMapToUnitCircleVectorizer,
+    DateToUnitCircleTransformer,
+)
+from .geo import GeolocationMapVectorizer, GeolocationVectorizer
+from .maps import MapVectorizer, SmartTextMapVectorizer, TextMapPivotVectorizer
 from .vectorizers import (
     BinaryVectorizer, HashingVectorizer, IntegralVectorizer, OneHotVectorizer,
     RealNNVectorizer, RealVectorizer, SmartTextVectorizer, VectorsCombiner,
@@ -24,6 +32,7 @@ from .vectorizers import (
 _CATEGORICAL_TYPES = (PickList, ComboBox, ID, Country, State, City, PostalCode,
                       Street, Phone)
 _FREE_TEXT_TYPES = (TextArea, Base64, URL, Email)
+_FREE_TEXT_MAP_TYPES = (TextMap, TextAreaMap)
 
 
 def transmogrify(features: Sequence[Feature]) -> Feature:
@@ -50,6 +59,21 @@ def transmogrify(features: Sequence[Feature]) -> Feature:
 
 def _group_of(f: Feature) -> str:
     ft = f.feature_type
+    if issubclass(ft, Prediction):
+        return "vector"
+    if issubclass(ft, GeolocationMap):
+        return "geomap"
+    if issubclass(ft, (DateMap, DateTimeMap)):
+        return "datemap"
+    if issubclass(ft, MultiPickListMap):
+        return "multipicklistmap"
+    if issubclass(ft, _FREE_TEXT_MAP_TYPES):
+        return "textmap"
+    if issubclass(ft, OPMap):
+        elem = getattr(ft, "element_type", None)
+        if elem is not None and issubclass(elem, (Real, Integral, Binary)):
+            return "numericmap"
+        return "categoricalmap"
     if issubclass(ft, RealNN):
         return "realnn"
     if issubclass(ft, (Real, Currency, Percent)):
@@ -66,6 +90,10 @@ def _group_of(f: Feature) -> str:
         return "categorical"
     if issubclass(ft, _FREE_TEXT_TYPES) or ft is Text:
         return "text"
+    if issubclass(ft, DateList):
+        return "datelist"
+    if issubclass(ft, Geolocation):
+        return "geolocation"
     if issubclass(ft, TextList):
         return "textlist"
     if issubclass(ft, OPVector):
@@ -80,9 +108,14 @@ def _vectorizer_for(group: str):
         return RealNNVectorizer()
     if group == "real":
         return RealVectorizer()
-    if group in ("integral", "date"):
-        # dates as integral until the unit-circle date vectorizer lands
+    if group == "integral":
         return IntegralVectorizer()
+    if group == "date":
+        # reference default: circular date representations (Transmogrifier
+        # case Date/DateTime with CircularDateRepresentations)
+        return DateToUnitCircleTransformer(periods=DEFAULT_CIRCULAR_PERIODS)
+    if group == "datelist":
+        return DateListVectorizer(pivot="SinceLast")
     if group == "binary":
         return BinaryVectorizer()
     if group in ("categorical", "multipicklist"):
@@ -91,6 +124,20 @@ def _vectorizer_for(group: str):
         return SmartTextVectorizer()
     if group == "textlist":
         return HashingVectorizer()
+    if group == "geolocation":
+        return GeolocationVectorizer()
+    if group == "numericmap":
+        return MapVectorizer()
+    if group == "categoricalmap":
+        return TextMapPivotVectorizer()
+    if group == "multipicklistmap":
+        return TextMapPivotVectorizer()
+    if group == "textmap":
+        return SmartTextMapVectorizer()
+    if group == "datemap":
+        return DateMapToUnitCircleVectorizer()
+    if group == "geomap":
+        return GeolocationMapVectorizer()
     if group == "vector":
         return VectorsCombiner()
     raise AssertionError(group)

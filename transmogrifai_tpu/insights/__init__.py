@@ -1,0 +1,2 @@
+from .model_insights import ModelInsights  # noqa: F401
+from .record_insights import RecordInsightsCorr, RecordInsightsLOCO  # noqa: F401

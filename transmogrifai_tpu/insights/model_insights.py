@@ -1,0 +1,271 @@
+"""ModelInsights — the full post-training report.
+
+Mirrors the reference (reference:
+core/src/main/scala/com/salesforce/op/ModelInsights.scala — extractFromStages
+:436, prettyPrint :99): walk the fitted workflow model's stages and assemble
+(1) a label summary, (2) per-feature derived-column insights (correlation,
+Cramér's V, variance, model contribution) attributed back to raw features via
+vector metadata, (3) the selected-model summary with its validation sweep, and
+(4) run metadata (blacklists, RawFeatureFilter results, version info).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DerivedColumnInsights:
+    """One vector-slot's insight row (reference Insights per derived feature)."""
+    name: str
+    parent_feature: str
+    grouping: Optional[str] = None
+    indicator_value: Optional[str] = None
+    correlation: Optional[float] = None
+    cramers_v: Optional[float] = None
+    variance: Optional[float] = None
+    mean: Optional[float] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
+    contribution: Optional[float] = None
+    dropped: bool = False
+    drop_reasons: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FeatureInsights:
+    """All derived columns of one raw feature (reference FeatureInsights)."""
+    feature_name: str
+    feature_type: str
+    derived: List[DerivedColumnInsights] = field(default_factory=list)
+
+    @property
+    def max_abs_contribution(self) -> float:
+        vals = [abs(d.contribution) for d in self.derived
+                if d.contribution is not None]
+        return max(vals) if vals else 0.0
+
+
+@dataclass
+class LabelSummary:
+    name: str
+    is_classification: bool
+    sample_size: int = 0
+    distribution: Optional[Dict[str, float]] = None  # classification counts
+    mean: Optional[float] = None
+    variance: Optional[float] = None
+
+
+@dataclass
+class ModelInsights:
+    """The report (reference ModelInsights.scala)."""
+    label: LabelSummary
+    features: List[FeatureInsights]
+    selected_model: Optional[Dict[str, Any]]
+    model_validation_results: List[Dict[str, Any]]
+    blacklisted_features: List[str]
+    raw_feature_filter_results: Optional[Dict[str, Any]]
+    version_info: Dict[str, str]
+
+    # -- extraction (reference extractFromStages :436) -----------------------
+    @staticmethod
+    def extract(model) -> "ModelInsights":
+        from ..impl.preparators.sanity_checker import SanityCheckerModel
+        from ..impl.selector.model_selector import SelectedModel
+        from ..utils.version import version_info
+
+        checker: Optional[SanityCheckerModel] = None
+        selected: Optional[SelectedModel] = None
+        for st in model.stages:
+            if isinstance(st, SanityCheckerModel) and checker is None:
+                checker = st
+            if isinstance(st, SelectedModel) and selected is None:
+                selected = st
+
+        label = ModelInsights._label_summary(model, selected)
+        features = ModelInsights._feature_insights(model, checker, selected)
+        sel_json: Optional[Dict[str, Any]] = None
+        val_results: List[Dict[str, Any]] = []
+        if selected is not None:
+            s = selected.summary
+            sel_json = {
+                "bestModelType": s.best_model_type,
+                "bestHyperparameters": s.best_hyper,
+                "validationType": s.validation_type,
+                "validationMetric": s.validation_metric,
+                "bestMetricValue": s.best_metric_value,
+                "trainEvaluation": getattr(s, "train_evaluation", {}),
+                "holdoutEvaluation": getattr(s, "holdout_evaluation", {}),
+                "problem": s.problem,
+            }
+            for r in s.validation_results:
+                val_results.append({
+                    "modelType": r.family,
+                    "numConfigurations": len(r.grid),
+                    "meanMetrics": [float(v) for v in np.asarray(r.mean_metrics)],
+                    "grid": r.grid,
+                })
+        rff = getattr(model, "rff_results", None)
+        return ModelInsights(
+            label=label,
+            features=features,
+            selected_model=sel_json,
+            model_validation_results=val_results,
+            blacklisted_features=[f.name for f in model.blacklisted_features],
+            raw_feature_filter_results=rff.to_json() if rff is not None else None,
+            version_info=version_info(),
+        )
+
+    @staticmethod
+    def _label_summary(model, selected) -> LabelSummary:
+        label_f = next((f for f in model.raw_features if f.is_response), None)
+        name = label_f.name if label_f is not None else "label"
+        is_cls = True
+        if selected is not None:
+            is_cls = selected.summary.problem in ("binary", "multiclass")
+        table = getattr(model, "train_table", None)
+        if table is None or label_f is None or name not in table.column_names:
+            return LabelSummary(name=name, is_classification=is_cls)
+        y = np.asarray(table[name].values, dtype=np.float64).reshape(-1)
+        if is_cls:
+            vals, counts = np.unique(y, return_counts=True)
+            dist = {str(v): int(c) for v, c in zip(vals.tolist(), counts.tolist())}
+            return LabelSummary(name=name, is_classification=True,
+                                sample_size=int(y.size), distribution=dist)
+        return LabelSummary(name=name, is_classification=False,
+                            sample_size=int(y.size), mean=float(y.mean()),
+                            variance=float(y.var()))
+
+    @staticmethod
+    def _feature_insights(model, checker, selected) -> List[FeatureInsights]:
+        per_raw: Dict[str, FeatureInsights] = {}
+        raw_types = {f.name: f.type_name for f in model.raw_features}
+        if checker is None:
+            return []
+        s = checker.summary
+        names: List[str] = s.get("names", [])
+        corr = s.get("correlationsWithLabel", [None] * len(names))
+        dropped = set(s.get("dropped", []))
+        reasons: Dict[str, List[str]] = s.get("reasons", {})
+        cramers: Dict[str, float] = s.get("cramersV", {})
+
+        # column → raw-feature attribution via the vector-slot name prefix
+        # (vector metadata column names start with the parent feature name)
+        contributions = ModelInsights._contributions(checker, selected, names)
+
+        for i, name in enumerate(names):
+            parent = name.split("_", 1)[0]
+            d = DerivedColumnInsights(
+                name=name, parent_feature=parent,
+                correlation=(None if corr[i] is None else float(corr[i])),
+                variance=float(s["variance"][i]) if "variance" in s else None,
+                mean=float(s["mean"][i]) if "mean" in s else None,
+                min=float(s["min"][i]) if "min" in s else None,
+                max=float(s["max"][i]) if "max" in s else None,
+                contribution=contributions.get(name),
+                dropped=name in dropped,
+                drop_reasons=list(reasons.get(name, [])),
+            )
+            for group, v in cramers.items():
+                gname = group.split("::")[0]
+                if parent == gname:
+                    d.cramers_v = float(v)
+                    break
+            fi = per_raw.setdefault(parent, FeatureInsights(
+                feature_name=parent,
+                feature_type=raw_types.get(parent, "unknown")))
+            fi.derived.append(d)
+        return sorted(per_raw.values(),
+                      key=lambda f: -f.max_abs_contribution)
+
+    @staticmethod
+    def _contributions(checker, selected, names: List[str]) -> Dict[str, float]:
+        """Per-column model contribution: |coefficient| for linear families,
+        split-gain importances for trees (reference contribution extraction
+        from the winning model)."""
+        if selected is None:
+            return {}
+        kept = checker.keep_indices if checker is not None else range(len(names))
+        kept_names = [names[i] for i in kept]
+        fitted = selected.fitted
+        try:
+            from ..models.api import MODEL_REGISTRY
+            family = MODEL_REGISTRY[fitted.family]
+            imp = getattr(family, "feature_importances", None)
+            if imp is not None:
+                vals = np.asarray(imp(fitted)).reshape(-1)
+            else:
+                return {}
+        except Exception:
+            return {}
+        if vals.size < len(kept_names):
+            # tree split-frequency vectors stop at the highest used feature
+            vals = np.pad(vals, (0, len(kept_names) - vals.size))
+        elif vals.size > len(kept_names):
+            return {}
+        return {n: float(v) for n, v in zip(kept_names, vals)}
+
+    # -- rendering (reference prettyPrint :99) -------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        def enc(o):
+            if isinstance(o, (DerivedColumnInsights, FeatureInsights,
+                              LabelSummary)):
+                return {k: enc(v) for k, v in vars(o).items()}
+            if isinstance(o, list):
+                return [enc(x) for x in o]
+            if isinstance(o, dict):
+                return {k: enc(v) for k, v in o.items()}
+            if isinstance(o, (np.floating, np.integer)):
+                return o.item()
+            if isinstance(o, float) and not np.isfinite(o):
+                return None
+            return o
+        return {
+            "label": enc(self.label),
+            "features": enc(self.features),
+            "selectedModel": enc(self.selected_model),
+            "modelValidationResults": enc(self.model_validation_results),
+            "blacklistedFeatures": self.blacklisted_features,
+            "rawFeatureFilterResults": enc(self.raw_feature_filter_results),
+            "versionInfo": self.version_info,
+        }
+
+    def to_json_string(self) -> str:
+        return json.dumps(self.to_json(), indent=2)
+
+    def pretty_print(self, top_k: int = 15) -> str:
+        lines: List[str] = ["=" * 60, "Model Insights", "=" * 60]
+        l = self.label
+        lines.append(f"Label: {l.name} "
+                     f"({'classification' if l.is_classification else 'regression'}, "
+                     f"n={l.sample_size})")
+        if l.distribution:
+            lines.append(f"  distribution: {l.distribution}")
+        if self.selected_model:
+            sm = self.selected_model
+            lines.append(f"Best model: {sm['bestModelType']} "
+                         f"({sm['validationMetric']}="
+                         f"{sm['bestMetricValue']:.4f})")
+            lines.append(f"  hyperparameters: {sm['bestHyperparameters']}")
+            if sm.get("holdoutEvaluation"):
+                show = {k: round(v, 4) for k, v in sm["holdoutEvaluation"].items()
+                        if isinstance(v, (int, float))}
+                lines.append(f"  holdout: {show}")
+        lines.append(f"Top feature contributions:")
+        rows = []
+        for fi in self.features:
+            for d in fi.derived:
+                rows.append(d)
+        rows.sort(key=lambda d: -(abs(d.contribution)
+                                  if d.contribution is not None else -1))
+        for d in rows[:top_k]:
+            c = f"{d.contribution:+.4f}" if d.contribution is not None else "   n/a"
+            cor = f"{d.correlation:+.3f}" if d.correlation is not None else "  n/a"
+            flag = " [DROPPED]" if d.dropped else ""
+            lines.append(f"  {c}  corr={cor}  {d.name}{flag}")
+        if self.blacklisted_features:
+            lines.append(f"Blacklisted raw features: {self.blacklisted_features}")
+        return "\n".join(lines)

@@ -1,0 +1,166 @@
+"""Per-record explanations: LOCO and correlation-based insights.
+
+Mirrors the reference (reference:
+core/.../impl/insights/RecordInsightsLOCO.scala:61-97 — leave-one-covariate-out:
+zero each active vector slot (grouped for text/date siblings), re-score, and
+report the top-K score diffs; RecordInsightsCorr.scala). The TPU re-design
+batches the whole thing: for n rows and G metadata groups, one device pass
+scores the (n × (G+1)) zeroed variants — the vmap-friendly structure the
+row-at-a-time Spark UDF could never use.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..stages.base import AllowLabelAsInput, Transformer
+from ..table import Column, FeatureTable
+from ..types import OPVector, TextMap
+from ..vector_metadata import VectorMetadata
+
+
+def _score_of(parts: Dict[str, np.ndarray]) -> np.ndarray:
+    """Scalar score per row from prediction parts: P(class 1) for binary,
+    max-class probability for multiclass, raw prediction for regression
+    (reference LOCO diffs the probability vector)."""
+    if "probability" in parts:
+        prob = np.asarray(parts["probability"])
+        if prob.ndim == 2 and prob.shape[1] >= 2:
+            return prob[:, 1] if prob.shape[1] == 2 else prob.max(axis=1)
+    return np.asarray(parts["prediction"]).reshape(-1)
+
+
+class RecordInsightsLOCO(AllowLabelAsInput, Transformer):
+    """OPVector → TextMap of {column name: score diff} per row.
+
+    Construct with the fitted SelectedModel (the winning model stage); wire its
+    feature-vector input feature with ``set_input``.
+    """
+
+    input_types = (OPVector,)
+    output_type = TextMap
+
+    def __init__(self, model_stage, top_k: int = 20, uid=None):
+        super().__init__("loco", uid)
+        self.model_stage = model_stage
+        self.top_k = top_k
+
+    def _groups(self, vm: Optional[VectorMetadata], d: int
+                ) -> List[Tuple[str, List[int]]]:
+        """Metadata feature groups (text/date siblings zero together,
+        reference RecordInsightsLOCO grouping)."""
+        if vm is None:
+            return [(f"c{i}", [i]) for i in range(d)]
+        out: List[Tuple[str, List[int]]] = []
+        for group, idxs in vm.index_of_group().items():
+            out.append((group, list(idxs)))
+        return out
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        from ..models.api import MODEL_REGISTRY
+        import jax.numpy as jnp
+
+        vec_f = self.input_features[0]
+        col = table[vec_f.name]
+        X = np.asarray(col.values, dtype=np.float32)
+        n, d = X.shape
+        vm = col.metadata.get("vector_meta")
+        if vm is not None:
+            self._vm = vm          # remembered for the metadata-less row dual
+        elif getattr(self, "_vm", None) is not None and self._vm.size == d:
+            vm = self._vm
+        groups = self._groups(vm, d)
+        g = len(groups)
+
+        fitted = self.model_stage.fitted
+        family = MODEL_REGISTRY[fitted.family]
+
+        base = _score_of(family.predict_one(fitted, jnp.asarray(X)))
+
+        # batched LOCO: variants[v] = X with group v zeroed; one device pass
+        # over the (g+1 skipped base) stacked matrix
+        variants = np.repeat(X[None, :, :], g, axis=0)
+        for v, (_, idxs) in enumerate(groups):
+            variants[v][:, idxs] = 0.0
+        flat = variants.reshape(g * n, d)
+        scores = _score_of(family.predict_one(fitted, jnp.asarray(flat)))
+        scores = scores.reshape(g, n)
+        diffs = base[None, :] - scores     # positive → slot pushed score up
+
+        names = [name for name, _ in groups]
+        out = np.empty(n, dtype=object)
+        k = min(self.top_k, g)
+        order = np.argsort(-np.abs(diffs), axis=0)[:k]   # (k, n)
+        for i in range(n):
+            top = {}
+            for v in order[:, i]:
+                if diffs[v, i] != 0.0:
+                    top[names[v]] = round(float(diffs[v, i]), 6)
+            out[i] = top
+        return Column(TextMap, out, np.array([bool(o) for o in out]))
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        one = FeatureTable(
+            {f.name: Column.of_values(f.feature_type, [row.get(f.name)])
+             for f in self.input_features}, 1)
+        return self.transform_column(one).values[0]
+
+
+class RecordInsightsCorr(AllowLabelAsInput, Transformer):
+    """OPVector → TextMap of {column name: value × corr(score, column)}.
+
+    The correlation-flavored cousin (reference RecordInsightsCorr.scala):
+    contributions are the row's standardized slot values scaled by each slot's
+    correlation with the model score over the scoring batch.
+    """
+
+    input_types = (OPVector,)
+    output_type = TextMap
+
+    def __init__(self, model_stage, top_k: int = 20, uid=None):
+        super().__init__("recordInsightsCorr", uid)
+        self.model_stage = model_stage
+        self.top_k = top_k
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        from ..models.api import MODEL_REGISTRY
+        from ..ops.stats import pearson_correlation
+        import jax.numpy as jnp
+
+        vec_f = self.input_features[0]
+        col = table[vec_f.name]
+        X = np.asarray(col.values, dtype=np.float32)
+        n, d = X.shape
+        vm = col.metadata.get("vector_meta")
+        names = (vm.column_names() if vm is not None
+                 else [f"c{i}" for i in range(d)])
+
+        fitted = self.model_stage.fitted
+        family = MODEL_REGISTRY[fitted.family]
+        score = _score_of(family.predict_one(fitted, jnp.asarray(X)))
+
+        corr = np.asarray(pearson_correlation(jnp.asarray(X),
+                                              jnp.asarray(score)))
+        corr = np.nan_to_num(corr)
+        mean = X.mean(axis=0)
+        std = X.std(axis=0)
+        std[std == 0] = 1.0
+        contrib = ((X - mean) / std) * corr[None, :]    # (n, d)
+
+        out = np.empty(n, dtype=object)
+        k = min(self.top_k, d)
+        order = np.argsort(-np.abs(contrib), axis=1)[:, :k]
+        for i in range(n):
+            top = {}
+            for j in order[i]:
+                if contrib[i, j] != 0.0:
+                    top[names[j]] = round(float(contrib[i, j]), 6)
+            out[i] = top
+        return Column(TextMap, out, np.array([bool(o) for o in out]))
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        raise ValueError(
+            "RecordInsightsCorr needs a scoring batch to estimate "
+            "correlations; use the columnar path")

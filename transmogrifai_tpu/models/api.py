@@ -60,6 +60,25 @@ class ModelFamily(abc.ABC):
     def predict_one(self, fitted: FittedParams, X: jnp.ndarray) -> Dict[str, np.ndarray]:
         """Single-model prediction parts: {'prediction', 'probability'?, 'rawPrediction'?}."""
 
+    def feature_importances(self, fitted: "FittedParams") -> Optional[np.ndarray]:
+        """Per-input-dimension contribution scores for ModelInsights
+        (|coefficients| for linear families, split frequencies for trees);
+        None when the family has no natural attribution."""
+        p = fitted.params
+        if isinstance(p, dict):
+            if "coef" in p:
+                return np.abs(np.asarray(p["coef"])).reshape(-1)
+            if "W" in p:
+                return np.abs(np.asarray(p["W"])).mean(axis=-1).reshape(-1)
+            if "feat" in p:  # tree ensembles: how often each feature splits
+                feats = np.asarray(p["feat"]).reshape(-1).astype(np.int64)
+                feats = feats[feats >= 0]
+                d = int(np.asarray(p.get("num_features", feats.max() + 1 if
+                                         feats.size else 1)))
+                counts = np.bincount(feats, minlength=d).astype(np.float64)
+                return counts / max(counts.sum(), 1.0)
+        return None
+
     def select_params(self, batched: Any, idx: int) -> Any:
         """Extract configuration ``idx`` from stacked params."""
         import jax

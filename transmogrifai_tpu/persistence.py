@@ -178,6 +178,7 @@ def stage_to_json(stage: OpPipelineStage, arrays: _Arrays) -> Dict[str, Any]:
     state = {k: v for k, v in vars(stage).items() if k not in _WIRING_ATTRS}
     return {
         "className": type(stage).__name__,
+        "module": type(stage).__module__,
         "uid": stage.uid,
         "state": {k: _encode(v, arrays) for k, v in state.items()},
     }
@@ -185,6 +186,14 @@ def stage_to_json(stage: OpPipelineStage, arrays: _Arrays) -> Dict[str, Any]:
 
 def stage_from_json(d: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> OpPipelineStage:
     cls = STAGE_REGISTRY.get(d["className"])
+    if cls is None and d.get("module"):
+        # fresh process: the defining module may not be imported yet — stage
+        # classes self-register on import (__init_subclass__)
+        try:
+            importlib.import_module(d["module"])
+        except ImportError:
+            pass
+        cls = STAGE_REGISTRY.get(d["className"])
     if cls is None:
         raise ValueError(
             f"unknown stage class {d['className']!r}; import the module defining "
@@ -252,7 +261,9 @@ def save_model(model, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     arrays = _Arrays()
     stage_descs = [stage_to_json(s, arrays) for s in model.stages]
-    extra = tuple(model.raw_features) + tuple(model.blacklisted_features)
+    extra_by_uid = {f.uid: f for f in
+                    tuple(model.raw_features) + tuple(model.blacklisted_features)}
+    extra = tuple(extra_by_uid.values())
     raw_stage_descs = [stage_to_json(f.origin_stage, arrays) for f in extra]
     plan = {
         "formatVersion": FORMAT_VERSION,
@@ -264,14 +275,32 @@ def save_model(model, path: str) -> None:
         "stages": stage_descs,
         "rawFeatureGenerators": raw_stage_descs,
         "parameters": _encode(model.parameters, arrays),
+        "rffResults": _encode(getattr(model, "rff_results", None), arrays),
     }
     with open(os.path.join(path, PLAN_FILE), "w") as fh:
         json.dump(plan, fh, indent=2)
     np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays.store)
 
 
+def _has_unresolved(v: Any, depth: int = 0) -> bool:
+    if isinstance(v, Unresolved):
+        return True
+    if depth > 8:
+        return False
+    if isinstance(v, (list, tuple, set)):
+        return any(_has_unresolved(x, depth + 1) for x in v)
+    if isinstance(v, dict):
+        return any(_has_unresolved(x, depth + 1) for x in v.values())
+    if hasattr(v, "__dict__") and not isinstance(v, type):
+        return any(_has_unresolved(x, depth + 1) for x in vars(v).values())
+    return False
+
+
 def _collect_unresolved(stage: OpPipelineStage) -> List[str]:
-    return [k for k, v in vars(stage).items() if isinstance(v, Unresolved)]
+    """Attributes with an Unresolved placeholder anywhere inside (nested
+    lambdas in lists/dicts/objects included) — the whole attribute is patched
+    from the original workflow's stage."""
+    return [k for k, v in vars(stage).items() if _has_unresolved(v)]
 
 
 def load_model(path: str, workflow=None):
@@ -321,6 +350,7 @@ def load_model(path: str, workflow=None):
     model.blacklisted_features = tuple(
         feats[u] for u in plan.get("blacklistedFeatures", []))
     model.parameters = _decode(plan.get("parameters", {}), arrays) or {}
+    model.rff_results = _decode(plan.get("rffResults"), arrays)
     from .dag import compute_dag
     model._layers = compute_dag(model.result_features)
     return model

@@ -296,8 +296,19 @@ class SequenceTransformer(_LambdaTransformer):
             raise ValueError(f"{type(self).__name__} needs at least one input")
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
-        vals = [row.get(f.name) for f in self.input_features]
-        return self.transform_fn(vals)
+        if self.transform_fn is not None:
+            vals = [row.get(f.name) for f in self.input_features]
+            return self.transform_fn(vals)
+        # columnar-only stages (vectorizers): run the columnar path on 1 row
+        one = FeatureTable(
+            {f.name: Column.of_values(f.feature_type, [row.get(f.name)])
+             for f in self.input_features}, 1)
+        out = self.transform_column(one)
+        if out.mask is not None and not bool(np.asarray(out.mask)[0]):
+            return None
+        v = np.asarray(out.values)[0]
+        return v.tolist() if isinstance(v, np.ndarray) else (
+            v.item() if isinstance(v, np.generic) else v)
 
     def transform_column(self, table: FeatureTable) -> Column:
         cols = [table[f.name] for f in self.input_features]

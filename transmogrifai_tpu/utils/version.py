@@ -14,9 +14,11 @@ FRAMEWORK_VERSION = "0.1.0"
 @lru_cache(maxsize=1)
 def git_sha() -> str:
     try:
+        import os
         out = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=5)
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
         return out.stdout.strip() or "unknown"
     except Exception:
         return "unknown"
