@@ -1,0 +1,187 @@
+"""Monoid aggregator + aggregating/conditional/joined reader tests (model:
+reference DataReaderTest, AggregateDataReaderTest, ConditionalDataReaderTest,
+JoinedDataReaderDataGenerationTest, aggregators tests)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.aggregators import (
+    ConcatText, CutOffTime, GeoMidpoint, LogicalOr, MaxAgg, MeanAgg, ModeAgg,
+    Sum, UnionMap, UnionSet, default_aggregator,
+)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers.aggregates import (
+    AggregateDataReader, AggregateParams, ConditionalDataReader,
+    ConditionalParams, JoinedDataReader,
+)
+from transmogrifai_tpu.readers.readers import (
+    DataFrameReader, DataReaders, StreamingDataReader,
+)
+from transmogrifai_tpu.types import (
+    MultiPickList, PickList, Real, RealMap, RealNN, Text,
+)
+
+DAY = 86_400_000
+
+
+class TestAggregators:
+    def test_basic_monoids(self):
+        assert Sum().aggregate([1.0, 2.0, None, 3.0]) == 6.0
+        assert MaxAgg().aggregate([3, 1, 2]) == 3
+        assert MeanAgg().aggregate([1.0, 3.0]) == 2.0
+        assert MeanAgg().aggregate([]) is None
+        assert LogicalOr().aggregate([False, True]) is True
+        assert ModeAgg().aggregate(["b", "a", "b"]) == "b"
+        assert ConcatText(" ").aggregate(["hello", "world"]) == "hello world"
+        assert UnionSet().aggregate([["a", "b"], ["b", "c"]]) == ["a", "b", "c"]
+        merged = UnionMap(Sum()).aggregate([{"x": 1.0}, {"x": 2.0, "y": 5.0}])
+        assert merged == {"x": 3.0, "y": 5.0}
+        mid = GeoMidpoint().aggregate([[0.0, 0.0, 1.0], [0.0, 90.0, 3.0]])
+        assert mid[1] == pytest.approx(45.0, abs=1e-6)
+        assert mid[2] == pytest.approx(2.0)
+
+    def test_defaults_by_type(self):
+        assert isinstance(default_aggregator(Real), Sum)
+        assert isinstance(default_aggregator(PickList), ModeAgg)
+        assert isinstance(default_aggregator(MultiPickList), UnionSet)
+        assert isinstance(default_aggregator(RealMap), UnionMap)
+
+
+def _events_df():
+    # user u1: purchases on days 1, 2 and 10; u2: day 1 only
+    return pd.DataFrame({
+        "user": ["u1", "u1", "u1", "u2"],
+        "t": [1 * DAY, 2 * DAY, 10 * DAY, 1 * DAY],
+        "amount": [10.0, 20.0, 99.0, 5.0],
+        "label": [0.0, 0.0, 1.0, 0.0],
+        "kind": ["a", "b", "b", "c"],
+    })
+
+
+def test_aggregate_reader_cutoff():
+    amount = FeatureBuilder.Real("amount").extract_field().as_predictor()
+    kind = FeatureBuilder.PickList("kind").extract_field().as_predictor()
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    reader = AggregateDataReader(
+        DataFrameReader(_events_df()),
+        AggregateParams(cutoff=CutOffTime.unix_epoch(5 * DAY),
+                        timestamp_field="t"),
+        key_field="user")
+    tbl = reader.generate_table([amount, kind, label])
+    assert list(tbl.key) == ["u1", "u2"]
+    # u1 predictors: days 1,2 (10+20); response: day 10 (label 1)
+    a = np.asarray(tbl["amount"].values)
+    assert a[0] == pytest.approx(30.0)
+    assert a[1] == pytest.approx(5.0)
+    y = np.asarray(tbl["label"].values)
+    assert y[0] == 1.0   # response aggregated AFTER cutoff
+    assert not tbl["label"].valid_mask()[1] or y[1] == 0.0
+    assert tbl["kind"].values[0] in ("a", "b")  # mode of pre-cutoff events
+
+
+def test_aggregate_window():
+    amount = (FeatureBuilder.Real("amount").extract_field()
+              .window(2 * DAY).as_predictor())
+    reader = AggregateDataReader(
+        DataFrameReader(_events_df()),
+        AggregateParams(cutoff=CutOffTime.unix_epoch(3 * DAY),
+                        timestamp_field="t"),
+        key_field="user")
+    tbl = reader.generate_table([amount])
+    # window of 2 days before cutoff (day 3) → only day-2 event for u1
+    assert np.asarray(tbl["amount"].values)[0] == pytest.approx(20.0)
+
+
+def test_conditional_reader():
+    amount = FeatureBuilder.Real("amount").extract_field().as_predictor()
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    # condition: the first "b"-kind event defines each user's cutoff
+    reader = ConditionalDataReader(
+        DataFrameReader(_events_df()),
+        ConditionalParams(target_condition=lambda r: r["kind"] == "b",
+                          timestamp_field="t", timestamp_to_keep="min"),
+        key_field="user")
+    tbl = reader.generate_table([amount, label])
+    # u2 never fires the condition → dropped
+    assert list(tbl.key) == ["u1"]
+    # u1 cutoff = day 2 (first 'b'); predictors strictly before → day-1 only
+    assert np.asarray(tbl["amount"].values)[0] == pytest.approx(10.0)
+    # responses at/after the condition: labels of day-2 and day-10 events
+    assert np.asarray(tbl["label"].values)[0] == 1.0
+
+
+def test_conditional_keep_unmet():
+    amount = FeatureBuilder.Real("amount").extract_field().as_predictor()
+    reader = ConditionalDataReader(
+        DataFrameReader(_events_df()),
+        ConditionalParams(target_condition=lambda r: r["kind"] == "b",
+                          timestamp_field="t",
+                          drop_if_target_condition_not_met=False),
+        key_field="user")
+    tbl = reader.generate_table([amount])
+    assert list(tbl.key) == ["u1", "u2"]
+    assert np.asarray(tbl["amount"].values)[1] == pytest.approx(5.0)
+
+
+def test_joined_reader():
+    users = pd.DataFrame({"uid": ["u1", "u2", "u3"], "age": [30.0, 40.0, 50.0]})
+    orders = pd.DataFrame({"uid": ["u1", "u2"], "total": [9.0, 7.0]})
+    age = FeatureBuilder.Real("age").extract_field().as_predictor()
+    total = FeatureBuilder.Real("total").extract_field().as_predictor()
+    left = DataFrameReader(users, key_field="uid")
+    right = DataFrameReader(orders, key_field="uid")
+
+    inner = JoinedDataReader(left, right, "inner")
+    t = inner.generate_table([age, total])
+    assert list(t.key) == ["u1", "u2"]
+
+    outer_left = JoinedDataReader(left, right, "left")
+    t2 = outer_left.generate_table([age, total])
+    assert list(t2.key) == ["u1", "u2", "u3"]
+    assert not t2["total"].valid_mask()[2]   # u3 has no order
+
+
+def test_streaming_reader():
+    amount = FeatureBuilder.Real("amount").extract_field().as_predictor()
+    batches = [pd.DataFrame({"amount": [1.0, 2.0]}),
+               pd.DataFrame({"amount": [3.0]})]
+    reader = DataReaders.Streaming.batches(batches)
+    tables = list(reader.stream_tables([amount]))
+    assert [len(t) for t in tables] == [2, 1]
+    assert np.asarray(tables[1]["amount"].values)[0] == 3.0
+
+
+def test_workflow_with_aggregate_reader():
+    from transmogrifai_tpu.workflow import OpWorkflow
+    from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector,
+    )
+    rng = np.random.RandomState(0)
+    rows = []
+    for u in range(80):
+        n_ev = rng.randint(1, 5)
+        spend = 0.0
+        for e in range(n_ev):
+            amt = float(rng.exponential(50))
+            spend += amt
+            rows.append({"user": f"u{u}", "t": (e + 1) * DAY, "amount": amt,
+                         "label": 0.0})
+        rows.append({"user": f"u{u}", "t": 50 * DAY,
+                     "amount": 0.0, "label": float(spend > 100)})
+    df = pd.DataFrame(rows)
+    amount = FeatureBuilder.Real("amount").extract_field().as_predictor()
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    vec = transmogrify([amount])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=2, models=[("OpLogisticRegression", None)])
+            .set_input(label, vec).get_output())
+    reader = AggregateDataReader(
+        DataFrameReader(df),
+        AggregateParams(cutoff=CutOffTime.unix_epoch(40 * DAY),
+                        timestamp_field="t"),
+        key_field="user")
+    model = OpWorkflow().set_reader(reader).set_result_features(pred).train()
+    sel = model.get_stage(pred.origin_stage.uid)
+    # spend>100 is perfectly recoverable from summed amounts → near-perfect
+    assert sel.summary.best_metric_value > 0.9

@@ -1,0 +1,180 @@
+"""Runner / OpParams / profiler / testkit / examples tests (model: reference
+OpWorkflowRunnerTest, testkit specs, OpIris/OpBoston helloworld)."""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu  # noqa: F401
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.readers.readers import DataFrameReader, DataReaders
+from transmogrifai_tpu.runner import (
+    OpApp, OpParams, OpWorkflowRunner, RunType, table_to_dataframe,
+)
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomIntegral, RandomList, RandomMap, RandomMultiPickList,
+    RandomReal, RandomText, RandomVector,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+class TestTestkit:
+    def test_deterministic(self):
+        a = RandomReal.normal(seed=7).take(10)
+        b = RandomReal.normal(seed=7).take(10)
+        assert a == b
+
+    def test_probability_of_empty(self):
+        vals = RandomReal.uniform(seed=1).with_probability_of_empty(0.5).take(1000)
+        frac_none = sum(v is None for v in vals) / len(vals)
+        assert 0.4 < frac_none < 0.6
+
+    def test_text_kinds(self):
+        email = RandomText.emails(seed=3).take(5)
+        assert all("@" in e for e in email)
+        pl = RandomText.pick_lists(["a", "b"], seed=3).take(20)
+        assert set(pl) <= {"a", "b"}
+        phones = RandomText.phones(seed=3).take(3)
+        assert all(p.startswith("+1") and len(p) == 12 for p in phones)
+        names = RandomText.names(seed=3).take(3)
+        assert all(" " in n for n in names)
+
+    def test_collections(self):
+        lists = RandomList(RandomText.strings(words=1, seed=2), 1, 3, seed=2).take(10)
+        assert all(1 <= len(l) <= 3 for l in lists)
+        maps = RandomMap(RandomReal.normal(seed=4), ["x", "y", "z"], seed=4).take(10)
+        assert all(set(m) <= {"x", "y", "z"} for m in maps)
+        mpl = RandomMultiPickList(["p", "q", "r"], seed=5).take(10)
+        assert all(v == sorted(set(v)) for v in mpl)
+        vec = RandomVector(4, seed=6).take(3)
+        assert all(len(v) == 4 for v in vec)
+        ints = RandomIntegral.integers(5, 10, seed=7).take(20)
+        assert all(5 <= v < 10 for v in ints)
+        bools = RandomBinary(0.9, seed=8).take(100)
+        assert sum(bools) > 70
+
+    def test_feeds_feature_table(self):
+        from transmogrifai_tpu.table import FeatureTable
+        from transmogrifai_tpu.types import Real, TextList
+        tbl = FeatureTable.from_columns({
+            "r": (Real, RandomReal.normal(seed=1)
+                  .with_probability_of_empty(0.2).take(50)),
+            "t": (TextList, RandomList(RandomText.strings(words=1, seed=2),
+                                       0, 4, seed=3).take(50)),
+        })
+        assert len(tbl) == 50
+
+
+def _wf(df):
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    x2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+    vec = transmogrify([x1, x2])
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(seed=1, models=[("OpLogisticRegression", None)])
+            .set_input(y, vec).get_output())
+    return OpWorkflow().set_result_features(pred), y, pred
+
+
+def _df(n=300, seed=3):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    return pd.DataFrame({"x1": x1, "x2": x2,
+                         "y": ((x1 - 0.5 * x2 + 0.4 * rng.randn(n)) > 0)
+                         .astype(float)})
+
+
+class TestRunner:
+    def test_train_then_score(self, tmp_path):
+        df = _df()
+        wf, y, pred = _wf(df)
+        model_dir = str(tmp_path / "model")
+        metrics_path = str(tmp_path / "metrics.json")
+        runner = OpWorkflowRunner(
+            wf, train_reader=DataFrameReader(df),
+            evaluator=OpBinaryClassificationEvaluator(),
+            label_feature=y, prediction_feature=pred)
+        res = runner.run(RunType.TRAIN, OpParams(
+            model_location=model_dir, metrics_location=metrics_path,
+            log_stage_metrics=True))
+        assert res.model is not None
+        assert os.path.exists(os.path.join(model_dir, "plan.json"))
+        metrics = json.load(open(metrics_path))
+        assert metrics["trainEvaluation"]["AuROC"] > 0.8
+        assert metrics["appMetrics"]["stageSecondsTotal"] > 0
+
+        score_out = str(tmp_path / "scores.parquet")
+        res2 = runner.run(RunType.SCORE, OpParams(
+            model_location=model_dir, write_location=score_out))
+        assert res2.scores is not None
+        written = pd.read_parquet(score_out)
+        assert pred.name in written.columns and len(written) == len(df)
+        assert written[pred.name][0]["prediction"] in (0.0, 1.0)
+
+    def test_streaming_score(self, tmp_path):
+        df = _df()
+        wf, y, pred = _wf(df)
+        runner = OpWorkflowRunner(
+            wf, train_reader=DataFrameReader(df),
+            streaming_score_reader=DataReaders.Streaming.batches(
+                [df.iloc[:100], df.iloc[100:150]]))
+        res = runner.run(RunType.STREAMING_SCORE, OpParams(
+            write_location=str(tmp_path / "stream.parquet")))
+        assert res.score_batches == 2
+        out = pd.read_parquet(str(tmp_path / "stream.parquet"))
+        assert len(out) == 150
+
+    def test_features_run_and_app(self, tmp_path):
+        df = _df()
+        wf, y, pred = _wf(df)
+        runner = OpWorkflowRunner(wf, train_reader=DataFrameReader(df))
+        app = OpApp(runner)
+        res = app.main(["--run-type", "features",
+                        "--write-location", str(tmp_path / "raw.parquet")])
+        assert res.scores is not None
+        raw = pd.read_parquet(str(tmp_path / "raw.parquet"))
+        assert {"x1", "x2", "y"} <= set(raw.columns)
+
+    def test_stage_param_injection(self):
+        df = _df()
+        wf, y, pred = _wf(df)
+        runner = OpWorkflowRunner(wf, train_reader=DataFrameReader(df))
+        res = runner.run(RunType.TRAIN, OpParams(
+            stage_params={"ModelSelector": {"problem": "binary"}}))
+        assert res.model is not None
+
+
+IRIS = "/root/reference/helloworld/src/main/resources/IrisDataset/iris.data"
+BOSTON = "/root/reference/helloworld/src/main/resources/BostonDataset/housing.data"
+
+
+@pytest.mark.skipif(not os.path.exists(IRIS), reason="iris data not available")
+def test_iris_example():
+    from transmogrifai_tpu.examples.iris import build_workflow
+    wf, label, pred = build_workflow(seed=11)
+    model = wf.train()
+    sel = model.get_stage(pred.origin_stage.uid)
+    assert sel.summary.best_metric_value > 0.85   # F1 on iris is easy
+    scored = model.score()
+    parts = np.asarray(scored[pred.name].values)
+    keys = list(scored[pred.name].metadata["keys"])
+    acc = (parts[:, keys.index("prediction")] ==
+           np.asarray(scored["irisClass"].values)).mean()
+    assert acc > 0.9
+
+
+@pytest.mark.skipif(not os.path.exists(BOSTON), reason="boston data not available")
+def test_boston_example():
+    from transmogrifai_tpu.examples.boston import build_workflow
+    wf, label, pred = build_workflow(seed=11)
+    model = wf.train()
+    sel = model.get_stage(pred.origin_stage.uid)
+    # RMSE on the training distribution should beat predicting the mean (~9.2)
+    assert sel.summary.best_metric_value < 6.0

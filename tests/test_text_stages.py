@@ -1,0 +1,170 @@
+"""Text/NLP stage tests (model: reference OpCountVectorizerTest, OpWord2VecTest,
+OpLDATest, LangDetectorTest, PhoneNumberParserTest, etc.)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.text import (
+    EmailToPickList, IsValidPhoneDefaultCountry, IsValidUrl, LangDetector,
+    MimeTypeDetector, NameEntityRecognizer, OpCountVectorizer, OpIndexToString,
+    OpLDA, OpNGram, OpStopWordsRemover, OpStringIndexer, OpWord2Vec,
+    PhoneNumberParser, UrlToDomain, ValidEmailTransformer, parse_phone,
+)
+from transmogrifai_tpu.table import FeatureTable
+from transmogrifai_tpu.types import (
+    Base64, Email, Phone, RealNN, Text, TextList, URL,
+)
+
+
+def _tbl(**cols):
+    return FeatureTable.from_columns(dict(cols))
+
+
+def _feat(name, ft):
+    return FeatureBuilder(name, ft).extract_field().as_predictor()
+
+
+def test_count_vectorizer():
+    f = _feat("t", TextList)
+    tbl = _tbl(t=(TextList, [["a", "b", "a"], ["b", "c"], None]))
+    model = OpCountVectorizer(min_df=1).set_input(f).fit(tbl)
+    out = model.transform_column(tbl)
+    vm = out.metadata["vector_meta"]
+    vocab = [c.indicator_value for c in vm.columns]
+    mat = np.asarray(out.values)
+    ai, bi = vocab.index("a"), vocab.index("b")
+    assert mat[0, ai] == 2 and mat[0, bi] == 1
+    assert mat[2].sum() == 0
+
+
+def test_ngram_and_stopwords():
+    f = _feat("t", TextList)
+    tbl = _tbl(t=(TextList, [["the", "quick", "brown", "fox"]]))
+    ng = OpNGram(n=2).set_input(f)
+    out = ng.transform_column(tbl)
+    assert out.values[0] == ["the quick", "quick brown", "brown fox"]
+    sw = OpStopWordsRemover().set_input(f)
+    assert sw.transform_column(tbl).values[0] == ["quick", "brown", "fox"]
+
+
+def test_string_indexer_round_trip():
+    f = _feat("t", Text)
+    tbl = _tbl(t=(Text, ["b", "a", "b", "b", None]))
+    model = OpStringIndexer().set_input(f).fit(tbl)
+    out = np.asarray(model.transform_column(tbl).values)
+    # b most frequent → 0; a → 1; None → "" unseen → keep bucket (2)
+    assert out[0] == 0 and out[1] == 1 and out[4] == 2
+    inv = OpIndexToString(model.labels).set_input(model.get_output())
+    tbl2 = tbl.with_column(model.get_output().name, model.transform_column(tbl))
+    back = inv.transform_column(tbl2)
+    assert back.values[0] == "b" and back.values[1] == "a"
+
+
+def test_word2vec_learns_cooccurrence():
+    rng = np.random.RandomState(0)
+    # two topic clusters; words within a cluster co-occur
+    docs = []
+    for _ in range(200):
+        if rng.rand() < 0.5:
+            docs.append(list(rng.permutation(["cat", "dog", "pet"])))
+        else:
+            docs.append(list(rng.permutation(["car", "road", "drive"])))
+    f = _feat("t", TextList)
+    tbl = _tbl(t=(TextList, docs))
+    model = (OpWord2Vec(vector_size=16, min_count=1, steps=200, seed=1)
+             .set_input(f).fit(tbl))
+    vecs = {t: model.vectors[i] for i, t in enumerate(model.vocab)}
+
+    def cos(a, b):
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+    assert cos(vecs["cat"], vecs["dog"]) > cos(vecs["cat"], vecs["car"])
+    out = model.transform_column(tbl)
+    assert np.asarray(out.values).shape == (200, 16)
+
+
+def test_lda_separates_topics():
+    rng = np.random.RandomState(0)
+    # vocabulary of 6; docs drawn from 2 disjoint topics
+    n = 120
+    X = np.zeros((n, 6), dtype=np.float32)
+    for i in range(n):
+        if i % 2 == 0:
+            X[i, :3] = rng.poisson(5, 3)
+        else:
+            X[i, 3:] = rng.poisson(5, 3)
+    from transmogrifai_tpu.types import OPVector
+    f = _feat("v", OPVector)
+    tbl = FeatureTable.from_columns({"v": (OPVector, [list(r) for r in X])})
+    model = OpLDA(k=2, max_iter=20, seed=0).set_input(f).fit(tbl)
+    mix = np.asarray(model.transform_column(tbl).values)
+    assert mix.shape == (n, 2)
+    np.testing.assert_allclose(mix.sum(1), 1.0, atol=1e-4)
+    # even and odd docs should land on different dominant topics
+    even_dom = np.argmax(mix[::2].mean(0))
+    odd_dom = np.argmax(mix[1::2].mean(0))
+    assert even_dom != odd_dom
+
+
+def test_lang_detector():
+    f = _feat("t", Text)
+    tbl = _tbl(t=(Text, ["the cat is on the table and it is happy",
+                         "le chat est sur la table et il est content",
+                         None]))
+    out = LangDetector().set_input(f).transform_column(tbl)
+    en = out.values[0]
+    fr = out.values[1]
+    assert max(en, key=en.get) == "en"
+    assert max(fr, key=fr.get) == "fr"
+    assert out.values[2] is None
+
+
+def test_ner():
+    f = _feat("t", Text)
+    tbl = _tbl(t=(Text, ["yesterday Dr. John Smith met with Mary Jones"]))
+    out = NameEntityRecognizer().set_input(f).transform_column(tbl)
+    ents = out.values[0]
+    all_ents = {e for v in ents.values() for e in v}
+    assert "John Smith" in all_ents and "Mary Jones" in all_ents
+
+
+def test_mime_detector():
+    import base64
+    f = _feat("b", Base64)
+    pdf = base64.b64encode(b"%PDF-1.4 fake").decode()
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n...").decode()
+    txt = base64.b64encode(b"hello world, plain text here").decode()
+    tbl = _tbl(b=(Base64, [pdf, png, txt, None]))
+    out = MimeTypeDetector().set_input(f).transform_column(tbl)
+    assert out.values[0] == "application/pdf"
+    assert out.values[1] == "image/png"
+    assert out.values[2] == "text/plain"
+
+
+def test_phone():
+    assert parse_phone("(555) 123-4567", "US") == ("+15551234567", True)
+    assert parse_phone("+15551234567", "US") == ("+15551234567", True)
+    assert parse_phone("123", "US")[1] is False
+    f = _feat("p", Phone)
+    tbl = _tbl(p=(Phone, ["555-123-4567", "12", None]))
+    norm = PhoneNumberParser().set_input(f).transform_column(tbl)
+    assert norm.values[0] == "+15551234567"
+    assert not norm.valid_mask()[1]
+    valid = IsValidPhoneDefaultCountry().set_input(f).transform_column(tbl)
+    assert np.asarray(valid.values)[0] == 1.0
+    assert np.asarray(valid.values)[1] == 0.0
+
+
+def test_email_url():
+    e = _feat("e", Email)
+    tbl = _tbl(e=(Email, ["a.b@example.com", "not-an-email", None]))
+    v = ValidEmailTransformer().set_input(e).transform_column(tbl)
+    assert np.asarray(v.values)[0] == 1.0 and np.asarray(v.values)[1] == 0.0
+    d = EmailToPickList().set_input(e).transform_column(tbl)
+    assert d.values[0] == "example.com" and d.values[1] is None
+    u = _feat("u", URL)
+    tbl2 = _tbl(u=(URL, ["https://www.example.com/x?q=1", "nope"]))
+    dom = UrlToDomain().set_input(u).transform_column(tbl2)
+    assert dom.values[0] == "www.example.com"
+    iv = IsValidUrl().set_input(u).transform_column(tbl2)
+    assert np.asarray(iv.values)[1] == 0.0

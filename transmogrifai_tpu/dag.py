@@ -59,19 +59,31 @@ def validate_dag(result_features: Sequence[Feature]) -> None:
             seen_stage[st.uid] = st
 
 
+class _NullProfiler:
+    def track(self, stage, op, layer=-1):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+_NULL_PROFILER = _NullProfiler()
+
+
 def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
+                          profiler: Optional[Any] = None,
                           ) -> Tuple[FeatureTable, Dict[str, Any]]:
     """Fit estimators layer-by-layer, transforming as we go (reference
     FitStagesUtil.fitAndTransformDAG / fitAndTransformLayer).
 
     Returns (transformed table, {estimator uid → fitted model}).
     """
+    prof = profiler or _NULL_PROFILER
     fitted: Dict[str, Any] = {}
-    for layer in layers:
+    for li, layer in enumerate(layers):
         models: List[Transformer] = []
         for stage, _ in layer:
             if isinstance(stage, Estimator):
-                model = stage.fit(table)
+                with prof.track(stage, "fit", li):
+                    model = stage.fit(table)
                 fitted[stage.uid] = model
                 models.append(model)
             elif isinstance(stage, Transformer):
@@ -79,19 +91,23 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
             else:
                 raise TypeError(f"unexpected stage kind {type(stage).__name__}")
         for model in models:
-            table = model.transform(table)
+            with prof.track(model, "transform", li):
+                table = model.transform(table)
     return table, fitted
 
 
 def apply_transformations_dag(table: FeatureTable, layers: List[StageLayer],
+                              profiler: Optional[Any] = None,
                               ) -> FeatureTable:
     """Score-time pass: all stages must already be transformers (reference
     OpWorkflowCore.applyTransformationsDAG:321-345)."""
-    for layer in layers:
+    prof = profiler or _NULL_PROFILER
+    for li, layer in enumerate(layers):
         for stage, _ in layer:
             if isinstance(stage, Estimator):
                 raise ValueError(
                     f"stage {stage.uid} is an unfitted estimator; "
                     "score requires a fitted workflow model")
-            table = stage.transform(table)
+            with prof.track(stage, "transform", li):
+                table = stage.transform(table)
     return table

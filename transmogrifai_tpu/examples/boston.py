@@ -1,0 +1,50 @@
+"""Boston housing regression — helloworld parity example.
+
+Mirrors the reference helloworld app (reference:
+helloworld/src/main/scala/com/salesforce/hw/boston/OpBoston.scala): housing
+numerics → transmogrify → RegressionModelSelector → train/score.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..features import Feature, FeatureBuilder
+from ..impl.feature import transmogrify
+from ..impl.selector import RegressionModelSelector
+from ..workflow import OpWorkflow
+
+BOSTON_SCHEMA = ["crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+                 "rad", "tax", "ptratio", "b", "lstat", "medv"]
+DEFAULT_PATH = ("/root/reference/helloworld/src/main/resources/"
+                "BostonDataset/housing.data")
+
+
+def boston_features() -> Tuple[Feature, Feature]:
+    """(medv label, featureVector) (reference OpBoston.scala definitions)."""
+    label = FeatureBuilder.RealNN("medv").extract_field().as_response()
+    preds = []
+    for c in BOSTON_SCHEMA[:-1]:
+        if c == "chas":
+            preds.append(FeatureBuilder.Binary(c).extract(
+                lambda r: bool(r.get("chas"))).as_predictor())
+        else:
+            preds.append(FeatureBuilder.Real(c).extract_field().as_predictor())
+    return label, transmogrify(preds)
+
+
+def build_workflow(path: str = DEFAULT_PATH, seed: int = 42):
+    import pandas as pd
+    df = pd.read_csv(path, header=None, names=BOSTON_SCHEMA, sep=r"\s+")
+    label, vec = boston_features()
+    pred = (RegressionModelSelector
+            .with_train_validation_split(seed=seed)
+            .set_input(label, vec).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    return wf, label, pred
+
+
+def main(path: str = DEFAULT_PATH):
+    wf, label, pred = build_workflow(path)
+    model = wf.train()
+    print(model.summary_pretty())
+    return model

@@ -1,0 +1,50 @@
+"""Iris multiclass — helloworld parity example.
+
+Mirrors the reference helloworld app (reference:
+helloworld/src/main/scala/com/salesforce/hw/iris/OpIris.scala): sepal/petal
+numerics → transmogrify → MultiClassificationModelSelector (with DataCutter)
+→ train/score.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..features import Feature, FeatureBuilder
+from ..impl.feature import transmogrify
+from ..impl.selector import MultiClassificationModelSelector
+from ..workflow import OpWorkflow
+
+IRIS_SCHEMA = ["sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+DEFAULT_PATH = ("/root/reference/helloworld/src/main/resources/"
+                "IrisDataset/iris.data")
+_CLASSES = ("Iris-setosa", "Iris-versicolor", "Iris-virginica")
+
+
+def iris_features() -> Tuple[Feature, Feature]:
+    """(label, featureVector) (reference OpIris.scala feature definitions —
+    the label is the indexed irisClass)."""
+    label = FeatureBuilder.RealNN("irisClass").extract(
+        lambda r: float(_CLASSES.index(r.get("irisClass")))
+        if r.get("irisClass") in _CLASSES else None).as_response()
+    nums = [FeatureBuilder.RealNN(c).extract_field().as_predictor()
+            for c in IRIS_SCHEMA[:4]]
+    return label, transmogrify(nums)
+
+
+def build_workflow(path: str = DEFAULT_PATH, seed: int = 42):
+    import pandas as pd
+    df = pd.read_csv(path, header=None, names=IRIS_SCHEMA).dropna()
+    label, vec = iris_features()
+    pred = (MultiClassificationModelSelector
+            .with_cross_validation(seed=seed)
+            .set_input(label, vec).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    return wf, label, pred
+
+
+def main(path: str = DEFAULT_PATH):
+    wf, label, pred = build_workflow(path)
+    model = wf.train()
+    print(model.summary_pretty())
+    return model

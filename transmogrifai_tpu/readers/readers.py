@@ -166,6 +166,29 @@ class ParquetReader(DataReader):
         return pd.read_parquet((params or {}).get("path", self.path))
 
 
+class StreamingDataReader(Reader):
+    """Micro-batch scoring input (reference StreamingReaders.scala — DStream
+    micro-batches become an iterator of DataFrames; each batch materializes
+    one FeatureTable for the device, SURVEY §2.10 P4)."""
+
+    def __init__(self, batches: Iterable, **kw):
+        super().__init__(**kw)
+        self.batches = batches
+
+    def read(self, params: Optional[dict] = None):
+        raise ValueError("streaming readers produce batches; use stream_tables")
+
+    def generate_table(self, raw_features: Sequence[Feature],
+                       params: Optional[dict] = None) -> FeatureTable:
+        raise ValueError("streaming readers produce batches; use stream_tables")
+
+    def stream_tables(self, raw_features: Sequence[Feature]):
+        for df in self.batches:
+            yield dataframe_to_table(df, raw_features,
+                                     key_field=self.key_field,
+                                     key_fn=self.key_fn)
+
+
 class DataReaders:
     """Factory namespace (reference DataReaders.scala:44-278)."""
 
@@ -186,3 +209,42 @@ class DataReaders:
         @staticmethod
         def dataframe(df, key_field: Optional[str] = None) -> DataFrameReader:
             return DataFrameReader(df, key_field=key_field)
+
+    class Aggregate:
+        """Event-aggregating variants (reference DataReaders.Aggregate)."""
+
+        @staticmethod
+        def csv(path: str, aggregate_params, key_field: str,
+                schema: Optional[Sequence[str]] = None, header: bool = True):
+            from .aggregates import AggregateDataReader
+            return AggregateDataReader(
+                CSVReader(path, schema=schema, header=header),
+                aggregate_params, key_field=key_field)
+
+        @staticmethod
+        def dataframe(df, aggregate_params, key_field: str):
+            from .aggregates import AggregateDataReader
+            return AggregateDataReader(DataFrameReader(df), aggregate_params,
+                                       key_field=key_field)
+
+    class Conditional:
+        """Conditional-aggregation variants (reference DataReaders.Conditional)."""
+
+        @staticmethod
+        def csv(path: str, conditional_params, key_field: str,
+                schema: Optional[Sequence[str]] = None, header: bool = True):
+            from .aggregates import ConditionalDataReader
+            return ConditionalDataReader(
+                CSVReader(path, schema=schema, header=header),
+                conditional_params, key_field=key_field)
+
+        @staticmethod
+        def dataframe(df, conditional_params, key_field: str):
+            from .aggregates import ConditionalDataReader
+            return ConditionalDataReader(DataFrameReader(df), conditional_params,
+                                         key_field=key_field)
+
+    class Streaming:
+        @staticmethod
+        def batches(batches: Iterable, key_field: Optional[str] = None):
+            return StreamingDataReader(batches, key_field=key_field)

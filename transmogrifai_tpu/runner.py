@@ -1,0 +1,276 @@
+"""Run configuration, workflow runner, and CLI app.
+
+Mirrors the reference run layer (reference:
+features/src/main/scala/com/salesforce/op/OpParams.scala:40-160 — JSON run
+config with per-stage param injection, reader paths, model/metrics locations;
+core/src/main/scala/com/salesforce/op/OpWorkflowRunner.scala:70-459 — run
+types Train/Score/StreamingScore/Features/Evaluate wiring readers + workflow,
+saving model and metrics; OpApp.scala:213 — CLI entry).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .table import FeatureTable
+from .workflow import OpWorkflow, OpWorkflowModel
+
+
+class OpParams:
+    """JSON-serializable run config (reference OpParams.scala:81-160)."""
+
+    def __init__(self,
+                 stage_params: Optional[Dict[str, Dict[str, Any]]] = None,
+                 reader_params: Optional[Dict[str, Any]] = None,
+                 model_location: Optional[str] = None,
+                 write_location: Optional[str] = None,
+                 metrics_location: Optional[str] = None,
+                 log_stage_metrics: bool = False,
+                 custom_params: Optional[Dict[str, Any]] = None):
+        self.stage_params = dict(stage_params or {})
+        self.reader_params = dict(reader_params or {})
+        self.model_location = model_location
+        self.write_location = write_location
+        self.metrics_location = metrics_location
+        self.log_stage_metrics = log_stage_metrics
+        self.custom_params = dict(custom_params or {})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "stageParams": self.stage_params,
+            "readerParams": self.reader_params,
+            "modelLocation": self.model_location,
+            "writeLocation": self.write_location,
+            "metricsLocation": self.metrics_location,
+            "logStageMetrics": self.log_stage_metrics,
+            "customParams": self.custom_params,
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "OpParams":
+        return OpParams(
+            stage_params=d.get("stageParams"),
+            reader_params=d.get("readerParams"),
+            model_location=d.get("modelLocation"),
+            write_location=d.get("writeLocation"),
+            metrics_location=d.get("metricsLocation"),
+            log_stage_metrics=bool(d.get("logStageMetrics", False)),
+            custom_params=d.get("customParams"),
+        )
+
+    @staticmethod
+    def from_file(path: str) -> "OpParams":
+        with open(path) as fh:
+            return OpParams.from_json(json.load(fh))
+
+
+class OpWorkflowRunnerResult:
+    """(reference OpWorkflowRunner result types)."""
+
+    def __init__(self, run_type: str):
+        self.run_type = run_type
+        self.model: Optional[OpWorkflowModel] = None
+        self.metrics: Dict[str, Any] = {}
+        self.scores: Optional[FeatureTable] = None
+        self.score_batches: int = 0
+
+
+class RunType:
+    TRAIN = "train"
+    SCORE = "score"
+    STREAMING_SCORE = "streamingScore"
+    FEATURES = "features"
+    EVALUATE = "evaluate"
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE)
+
+
+def table_to_dataframe(table: FeatureTable):
+    """FeatureTable → pandas DataFrame (score writing path; the analog of
+    OpWorkflowModel.saveScores' avro write, reference :376-421)."""
+    import pandas as pd
+    data: Dict[str, Any] = {}
+    if table.key is not None:
+        data[FeatureTable.KEY] = list(table.key)
+    for name in table.column_names:
+        col = table[name]
+        vals = np.asarray(col.values)
+        valid = col.valid_mask()
+        if vals.ndim > 1:
+            keys = col.metadata.get("keys")
+            if keys:  # prediction column → one flat dict per row
+                data[name] = [dict(zip(keys, row)) for row in vals.tolist()]
+            else:
+                data[name] = [list(map(float, row)) for row in vals.tolist()]
+        elif col.kind in ("real", "binary", "integral", "date"):
+            out = vals.astype(object)
+            out[~valid] = None
+            data[name] = out
+        else:
+            data[name] = [v if ok else None for v, ok in zip(vals, valid)]
+    return pd.DataFrame(data)
+
+
+class OpWorkflowRunner:
+    """Wires readers + workflow + evaluator per run type (reference
+    OpWorkflowRunner.scala: train :163-181, score :204-222,
+    streamingScore :232-263)."""
+
+    def __init__(self, workflow: OpWorkflow,
+                 train_reader=None, score_reader=None,
+                 streaming_score_reader=None,
+                 evaluator=None,
+                 label_feature=None, prediction_feature=None):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.streaming_score_reader = streaming_score_reader
+        self.evaluator = evaluator
+        self.label_feature = label_feature
+        self.prediction_feature = prediction_feature
+
+    def _eval(self):
+        ev = self.evaluator
+        if ev is None:
+            return None
+        if self.label_feature is not None:
+            ev.set_label_col(self.label_feature)
+        if self.prediction_feature is not None:
+            ev.set_prediction_col(self.prediction_feature)
+        return ev
+
+    def run(self, run_type: str, params: Optional[OpParams] = None
+            ) -> OpWorkflowRunnerResult:
+        params = params or OpParams()
+        if params.stage_params:
+            self.workflow.set_parameters({"stageParams": params.stage_params})
+        if params.log_stage_metrics and self.workflow.profiler is None:
+            self.workflow.with_profiler()
+        result = OpWorkflowRunnerResult(run_type)
+        handler = {
+            RunType.TRAIN: self._train,
+            RunType.SCORE: self._score,
+            RunType.STREAMING_SCORE: self._streaming_score,
+            RunType.FEATURES: self._features,
+            RunType.EVALUATE: self._evaluate,
+        }.get(run_type)
+        if handler is None:
+            raise ValueError(f"unknown run type {run_type!r}; one of {RunType.ALL}")
+        handler(result, params)
+        if params.metrics_location and result.metrics:
+            os.makedirs(os.path.dirname(params.metrics_location) or ".",
+                        exist_ok=True)
+            with open(params.metrics_location, "w") as fh:
+                json.dump(result.metrics, fh, indent=2, default=str)
+        return result
+
+    def _train(self, result: OpWorkflowRunnerResult, params: OpParams) -> None:
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        model = self.workflow.train()
+        result.model = model
+        if params.model_location:
+            model.save(params.model_location)
+        ev = self._eval()
+        if ev is not None and model.train_table is not None:
+            result.metrics["trainEvaluation"] = {
+                k: v for k, v in ev.evaluate_all(model.train_table).items()
+                if isinstance(v, (int, float))}
+        if self.workflow.profiler is not None:
+            result.metrics["appMetrics"] = self.workflow.profiler.app_metrics()
+
+    def _load_model(self, params: OpParams) -> OpWorkflowModel:
+        if params.model_location:
+            return OpWorkflowModel.load(params.model_location,
+                                        workflow=self.workflow)
+        # no saved model: train in place (keeps small pipelines one-shot)
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        return self.workflow.train()
+
+    def _score(self, result: OpWorkflowRunnerResult, params: OpParams) -> None:
+        model = self._load_model(params)
+        reader = self.score_reader or self.train_reader
+        if reader is not None:
+            model.set_reader(reader)
+        scored = model.score()
+        result.model = model
+        result.scores = scored
+        ev = self._eval()
+        if ev is not None:
+            result.metrics["scoreEvaluation"] = {
+                k: v for k, v in ev.evaluate_all(scored).items()
+                if isinstance(v, (int, float))}
+        if params.write_location:
+            os.makedirs(os.path.dirname(params.write_location) or ".",
+                        exist_ok=True)
+            table_to_dataframe(scored).to_parquet(params.write_location)
+
+    def _streaming_score(self, result: OpWorkflowRunnerResult,
+                         params: OpParams) -> None:
+        model = self._load_model(params)
+        reader = self.streaming_score_reader
+        if reader is None:
+            raise ValueError("streamingScore needs a streaming_score_reader")
+        n = 0
+        frames = []
+        for batch in reader.stream_tables(model.raw_features):
+            scored = model.score(table=batch)
+            frames.append(table_to_dataframe(scored))
+            n += 1
+        result.model = model
+        result.score_batches = n
+        if params.write_location and frames:
+            import pandas as pd
+            os.makedirs(os.path.dirname(params.write_location) or ".",
+                        exist_ok=True)
+            pd.concat(frames).to_parquet(params.write_location)
+
+    def _features(self, result: OpWorkflowRunnerResult, params: OpParams) -> None:
+        reader = self.train_reader or self.workflow.reader
+        if reader is None:
+            raise ValueError("features run needs a reader")
+        if not self.workflow.raw_features:
+            raise ValueError("call set_result_features before a features run")
+        table = reader.generate_table(self.workflow.raw_features)
+        result.scores = table
+        if params.write_location:
+            table_to_dataframe(table).to_parquet(params.write_location)
+
+    def _evaluate(self, result: OpWorkflowRunnerResult, params: OpParams) -> None:
+        if self.evaluator is None:
+            raise ValueError("evaluate run needs an evaluator")
+        self._score(result, params)
+        result.metrics["evaluation"] = result.metrics.pop("scoreEvaluation", {})
+
+
+class OpApp:
+    """CLI entry (reference OpApp.scala / OpAppWithRunner): subclass, provide
+    the runner, call ``main()``."""
+
+    def __init__(self, runner: OpWorkflowRunner):
+        self.runner = runner
+
+    def parse_args(self, argv: Optional[List[str]] = None) -> argparse.Namespace:
+        p = argparse.ArgumentParser(description="transmogrifai_tpu app")
+        p.add_argument("--run-type", required=True, choices=RunType.ALL)
+        p.add_argument("--param-location", default=None,
+                       help="path to an OpParams JSON file")
+        p.add_argument("--model-location", default=None)
+        p.add_argument("--write-location", default=None)
+        p.add_argument("--metrics-location", default=None)
+        return p.parse_args(argv)
+
+    def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
+        a = self.parse_args(argv)
+        params = (OpParams.from_file(a.param_location)
+                  if a.param_location else OpParams())
+        for attr, val in (("model_location", a.model_location),
+                          ("write_location", a.write_location),
+                          ("metrics_location", a.metrics_location)):
+            if val:
+                setattr(params, attr, val)
+        return self.runner.run(a.run_type, params)

@@ -1,0 +1,4 @@
+from .random_data import (  # noqa: F401
+    RandomBinary, RandomData, RandomIntegral, RandomList, RandomMap,
+    RandomMultiPickList, RandomReal, RandomText, RandomVector,
+)

@@ -1,0 +1,264 @@
+"""Seeded random feature-data generators.
+
+Mirrors the reference testkit (reference:
+testkit/src/main/scala/com/salesforce/op/testkit/ — RandomData.scala:43-75,
+RandomReal.scala:45-110, RandomText.scala, RandomMap.scala, RandomList.scala,
+RandomVector.scala, RandomIntegral.scala, RandomBinary.scala): infinite,
+deterministic streams of typed feature values with configurable
+``probability_of_empty`` null injection — the data source for stage contract
+tests and synthetic benchmark tables.
+"""
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class RandomData:
+    """Infinite seeded stream (reference RandomData trait). Iterate or
+    ``take(n)``; ``with_probability_of_empty(p)`` injects Nones."""
+
+    def __init__(self, seed: int = 42):
+        self._rng = np.random.RandomState(seed)
+        self.probability_of_empty = 0.0
+
+    def with_probability_of_empty(self, p: float) -> "RandomData":
+        self.probability_of_empty = float(p)
+        return self
+
+    def reset(self, seed: int) -> "RandomData":
+        self._rng = np.random.RandomState(seed)
+        return self
+
+    def _one(self) -> Any:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self.probability_of_empty and self._rng.rand() < self.probability_of_empty:
+            return None
+        return self._one()
+
+    def take(self, n: int) -> List[Any]:
+        return [next(self) for _ in range(n)]
+
+    # fluent alias matching the reference's `limit`
+    limit = take
+
+
+class RandomReal(RandomData):
+    """reference RandomReal: uniform/normal/poisson/exponential/gamma/
+    lognormal distributions."""
+
+    def __init__(self, dist: str = "normal", seed: int = 42, **kw):
+        super().__init__(seed)
+        self.dist = dist
+        self.kw = kw
+
+    @staticmethod
+    def uniform(lo: float = 0.0, hi: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("uniform", seed, low=lo, high=hi)
+
+    @staticmethod
+    def normal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("normal", seed, loc=mean, scale=sigma)
+
+    @staticmethod
+    def poisson(lam: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("poisson", seed, lam=lam)
+
+    @staticmethod
+    def exponential(scale: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("exponential", seed, scale=scale)
+
+    @staticmethod
+    def gamma(shape: float = 2.0, scale: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("gamma", seed, shape=shape, scale=scale)
+
+    @staticmethod
+    def lognormal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal("lognormal", seed, mean=mean, sigma=sigma)
+
+    def _one(self) -> float:
+        return float(getattr(self._rng, self.dist)(**self.kw))
+
+
+class RandomIntegral(RandomData):
+    """reference RandomIntegral: uniform ints or poisson counts; also epoch
+    dates via ``dates``."""
+
+    def __init__(self, lo: int = 0, hi: int = 100, seed: int = 42):
+        super().__init__(seed)
+        self.lo, self.hi = lo, hi
+
+    @staticmethod
+    def integers(lo: int = 0, hi: int = 100, seed: int = 42) -> "RandomIntegral":
+        return RandomIntegral(lo, hi, seed)
+
+    @staticmethod
+    def dates(start_ms: int = 1_500_000_000_000, span_days: int = 365,
+              seed: int = 42) -> "RandomIntegral":
+        return RandomIntegral(start_ms, start_ms + span_days * 86_400_000, seed)
+
+    def _one(self) -> int:
+        return int(self._rng.randint(self.lo, self.hi))
+
+
+class RandomBinary(RandomData):
+    """reference RandomBinary(probabilityOfSuccess)."""
+
+    def __init__(self, probability_of_true: float = 0.5, seed: int = 42):
+        super().__init__(seed)
+        self.p = probability_of_true
+
+    def _one(self) -> bool:
+        return bool(self._rng.rand() < self.p)
+
+
+_FIRST_NAMES = ("james mary robert patricia john jennifer michael linda david "
+                "elizabeth william barbara richard susan joseph jessica thomas "
+                "sarah charles karen").split()
+_LAST_NAMES = ("smith johnson williams brown jones garcia miller davis "
+               "rodriguez martinez hernandez lopez gonzalez wilson anderson "
+               "thomas taylor moore jackson martin").split()
+_COUNTRIES = ("United States,Canada,Mexico,Brazil,France,Germany,Spain,Italy,"
+              "Japan,China,India,Australia,Kenya,Egypt,Norway").split(",")
+_DOMAINS = "example.com test.org mail.net company.io sample.co".split()
+_WORDS = ("alpha beta gamma delta epsilon omega sigma lambda theta kappa "
+          "zeta quick brown fox lazy dog lorem ipsum dolor amet").split()
+
+
+class RandomText(RandomData):
+    """reference RandomText: strings/names/emails/urls/countries/phones/
+    picklists/ids/base64."""
+
+    def __init__(self, kind: str = "strings", seed: int = 42,
+                 domain: Optional[Sequence[str]] = None, words: int = 5):
+        super().__init__(seed)
+        self.kind = kind
+        self.domain = list(domain) if domain is not None else None
+        self.words = words
+
+    @staticmethod
+    def strings(words: int = 5, seed: int = 42) -> "RandomText":
+        return RandomText("strings", seed, words=words)
+
+    @staticmethod
+    def names(seed: int = 42) -> "RandomText":
+        return RandomText("names", seed)
+
+    @staticmethod
+    def emails(domain: str = "example.com", seed: int = 42) -> "RandomText":
+        return RandomText("emails", seed, domain=[domain])
+
+    @staticmethod
+    def urls(seed: int = 42) -> "RandomText":
+        return RandomText("urls", seed)
+
+    @staticmethod
+    def countries(seed: int = 42) -> "RandomText":
+        return RandomText("countries", seed)
+
+    @staticmethod
+    def phones(seed: int = 42) -> "RandomText":
+        return RandomText("phones", seed)
+
+    @staticmethod
+    def pick_lists(domain: Sequence[str], seed: int = 42) -> "RandomText":
+        return RandomText("picklists", seed, domain=domain)
+
+    @staticmethod
+    def ids(seed: int = 42) -> "RandomText":
+        return RandomText("ids", seed)
+
+    @staticmethod
+    def base64(seed: int = 42) -> "RandomText":
+        return RandomText("base64", seed)
+
+    def _one(self) -> str:
+        r = self._rng
+        if self.kind == "strings":
+            k = r.randint(1, self.words + 1)
+            return " ".join(r.choice(_WORDS) for _ in range(k))
+        if self.kind == "names":
+            return f"{r.choice(_FIRST_NAMES).title()} {r.choice(_LAST_NAMES).title()}"
+        if self.kind == "emails":
+            dom = r.choice(self.domain) if self.domain else r.choice(_DOMAINS)
+            return f"{r.choice(_FIRST_NAMES)}.{r.choice(_LAST_NAMES)}@{dom}"
+        if self.kind == "urls":
+            return f"https://{r.choice(_DOMAINS)}/{r.choice(_WORDS)}"
+        if self.kind == "countries":
+            return str(r.choice(_COUNTRIES))
+        if self.kind == "phones":
+            return "+1" + "".join(str(r.randint(0, 10)) for _ in range(10))
+        if self.kind == "picklists":
+            return str(r.choice(self.domain))
+        if self.kind == "ids":
+            alphabet = np.array(list(string.ascii_uppercase + string.digits))
+            return "".join(r.choice(alphabet) for _ in range(12))
+        if self.kind == "base64":
+            import base64
+            return base64.b64encode(r.bytes(24)).decode()
+        raise ValueError(self.kind)
+
+
+class RandomList(RandomData):
+    """reference RandomList: lists drawn from an element generator."""
+
+    def __init__(self, element: RandomData, min_len: int = 0, max_len: int = 5,
+                 seed: int = 42):
+        super().__init__(seed)
+        self.element = element
+        self.min_len, self.max_len = min_len, max_len
+
+    def _one(self) -> List[Any]:
+        k = int(self._rng.randint(self.min_len, self.max_len + 1))
+        return [v for v in self.element.take(k) if v is not None]
+
+
+class RandomMultiPickList(RandomList):
+    def __init__(self, domain: Sequence[str], min_len: int = 0,
+                 max_len: int = 3, seed: int = 42):
+        super().__init__(RandomText.pick_lists(domain, seed=seed + 1),
+                         min_len, max_len, seed)
+
+    def _one(self) -> List[str]:
+        return sorted(set(super()._one()))
+
+
+class RandomMap(RandomData):
+    """reference RandomMap: maps of an element generator under generated keys."""
+
+    def __init__(self, element: RandomData, keys: Sequence[str],
+                 min_keys: int = 1, seed: int = 42):
+        super().__init__(seed)
+        self.element = element
+        self.keys = list(keys)
+        self.min_keys = min_keys
+
+    def _one(self) -> Dict[str, Any]:
+        k = int(self._rng.randint(self.min_keys, len(self.keys) + 1))
+        chosen = list(self._rng.choice(self.keys, size=k, replace=False))
+        out = {}
+        for key in chosen:
+            v = next(self.element)
+            if v is not None:
+                out[key] = v
+        return out
+
+
+class RandomVector(RandomData):
+    """reference RandomVector: dense vectors from a real generator."""
+
+    def __init__(self, dim: int, element: Optional[RandomReal] = None,
+                 seed: int = 42):
+        super().__init__(seed)
+        self.dim = dim
+        self.element = element or RandomReal.normal(seed=seed + 1)
+
+    def _one(self) -> List[float]:
+        return [v if v is not None else 0.0 for v in self.element.take(self.dim)]

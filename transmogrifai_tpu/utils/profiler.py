@@ -1,0 +1,68 @@
+"""Per-stage wall-clock profiling.
+
+The analog of the reference's Spark-listener metrics collection (reference:
+utils/.../spark/OpSparkListener.scala:55-110 — per-stage run time aggregated
+into AppMetrics at app end, wired by OpWorkflowRunner.scala:139-154). Here the
+scheduler itself times every fit/transform; ``jax.profiler`` traces can be
+layered on top for device-level detail (start_trace/stop_trace around train).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class StageProfiler:
+    """Collects per-stage timings during fit/score (AppMetrics analog).
+
+    Aggregates run forever in O(#stage classes) memory; raw per-op records are
+    kept in a bounded ring so long-running streaming scorers don't grow
+    without bound."""
+
+    def __init__(self, max_records: int = 10_000):
+        self.records: deque = deque(maxlen=max_records)
+        self.app_start = time.time()
+        self._total = 0.0
+        self._count = 0
+        self._by_stage: Dict[str, float] = {}
+        self._by_op: Dict[str, float] = {}
+
+    @contextmanager
+    def track(self, stage: Any, op: str, layer: int = -1):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            secs = time.perf_counter() - t0
+            name = type(stage).__name__
+            self.records.append({
+                "stage": name,
+                "uid": getattr(stage, "uid", "?"),
+                "op": op,
+                "layer": layer,
+                "seconds": secs,
+            })
+            self._total += secs
+            self._count += 1
+            self._by_stage[name] = self._by_stage.get(name, 0.0) + secs
+            self._by_op[op] = self._by_op.get(op, 0.0) + secs
+
+    # -- aggregation (reference AppMetrics) ----------------------------------
+    def app_metrics(self) -> Dict[str, Any]:
+        return {
+            "appDurationSecs": time.time() - self.app_start,
+            "stageSecondsTotal": self._total,
+            "byStage": dict(sorted(self._by_stage.items(), key=lambda kv: -kv[1])),
+            "byOp": dict(self._by_op),
+            "numRecords": self._count,
+        }
+
+    def pretty(self, top_k: int = 15) -> str:
+        m = self.app_metrics()
+        lines = [f"Stage timings ({m['numRecords']} ops, "
+                 f"{m['stageSecondsTotal']:.2f}s total):"]
+        for name, secs in list(m["byStage"].items())[:top_k]:
+            lines.append(f"  {secs:8.3f}s  {name}")
+        return "\n".join(lines)

@@ -79,6 +79,14 @@ class OpWorkflow(_WorkflowCore):
         super().__init__()
         self._layers = None
         self._raw_feature_filter = None
+        self.profiler = None
+
+    def with_profiler(self, profiler=None) -> "OpWorkflow":
+        """Collect per-stage wall-clock metrics during train (the reference's
+        OpSparkListener/logStageMetrics knob, OpParams.scala:66-72)."""
+        from .utils.profiler import StageProfiler
+        self.profiler = profiler or StageProfiler()
+        return self
 
     def set_result_features(self, *features: Feature) -> "OpWorkflow":
         """Reconstruct the stage DAG from lineage (reference
@@ -133,7 +141,8 @@ class OpWorkflow(_WorkflowCore):
                 result_features, layers = self._apply_blacklist(blacklist)
                 blacklisted = tuple(blacklist)
         self._inject_stage_params([s for layer in layers for s, _ in layer])
-        table, fitted = fit_and_transform_dag(table, layers)
+        table, fitted = fit_and_transform_dag(table, layers,
+                                              profiler=self.profiler)
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in result_features)
         model = OpWorkflowModel()
@@ -144,6 +153,11 @@ class OpWorkflow(_WorkflowCore):
         model.blacklisted_features = blacklisted
         model.rff_results = rff_results
         model.train_table = table
+        if self.profiler is not None:
+            # score timings get their own collector — mixing them into the
+            # train AppMetrics would conflate fit and serve costs
+            from .utils.profiler import StageProfiler
+            model.profiler = StageProfiler()
         model._layers = compute_dag(new_results)
         return model
 
@@ -206,6 +220,7 @@ class OpWorkflowModel(_WorkflowCore):
         self._layers = None
         self.train_table: Optional[FeatureTable] = None
         self.rff_results = None
+        self.profiler = None
 
     @property
     def stages(self) -> List[Any]:
@@ -225,7 +240,8 @@ class OpWorkflowModel(_WorkflowCore):
             table = dataframe_to_table(df, self.raw_features)
         if table is None:
             table = self._generate_raw_table()
-        scored = apply_transformations_dag(table, self._layers)
+        scored = apply_transformations_dag(table, self._layers,
+                                           profiler=self.profiler)
         if keep_raw_features and keep_intermediate_features:
             return scored
         keep = [f.name for f in self.result_features if f.name in scored.column_names]
