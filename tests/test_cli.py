@@ -1,0 +1,91 @@
+"""CLI project-generator tests (model: reference cli/src/test — generated
+projects compile and run)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from transmogrifai_tpu.cli import generate, infer_schema, main
+
+
+def _csv(tmp_path, n=150, seed=4):
+    rng = np.random.RandomState(seed)
+    x1 = rng.randn(n)
+    df = pd.DataFrame({
+        "id": range(n),
+        "x1": x1,
+        "count": rng.randint(0, 10, n),
+        "color": rng.choice(["red", "green", "blue"], n),
+        "note": [f"free text {i} {rng.rand():.6f}" for i in range(n)],
+        "y": (x1 > 0).astype(float),
+    })
+    path = str(tmp_path / "data.csv")
+    df.to_csv(path, index=False)
+    return path, df
+
+
+def test_infer_schema(tmp_path):
+    path, df = _csv(tmp_path)
+    problem, fields = infer_schema(df, "y", "id")
+    assert problem == "binary"
+    d = dict(fields)
+    assert d["x1"] == "Real" and d["count"] == "Integral"
+    assert d["color"] == "PickList" and d["note"] == "Text"
+    assert "id" not in d and "y" not in d
+
+    df2 = df.assign(y=np.random.RandomState(0).randn(len(df)))
+    assert infer_schema(df2, "y", None)[0] == "regression"
+
+    # integer-coded quantities with many distinct values are regression
+    # targets too, not 100-class classification
+    df3 = df.assign(y=np.random.RandomState(0).randint(100, 999, len(df)))
+    assert infer_schema(df3, "y", None)[0] == "regression"
+
+
+def test_generate_remaps_noncontiguous_numeric_labels(tmp_path):
+    path, df = _csv(tmp_path)
+    # binary response coded {1, 2}: must be re-indexed to {0, 1}, not passed
+    # through raw (balancer/metrics assume 0..K-1)
+    df = df.assign(y=(df["y"] + 1).astype(int))
+    df.to_csv(path, index=False)
+    out = str(tmp_path / "proj12")
+    generate(path, "y", out, "MyApp", id_field="id")
+    app = open(os.path.join(out, "app.py")).read()
+    assert "RESPONSE_LABELS" in app and "extract_field().as_response" not in app
+    # labels already 0..K-1 pass through untouched
+    df0 = df.assign(y=(df["y"] - 1))
+    df0.to_csv(path, index=False)
+    out0 = str(tmp_path / "proj01")
+    generate(path, "y", out0, "MyApp", id_field="id")
+    app0 = open(os.path.join(out0, "app.py")).read()
+    assert "RESPONSE_LABELS" not in app0
+
+
+def test_generate_files(tmp_path):
+    path, df = _csv(tmp_path)
+    out = str(tmp_path / "proj")
+    files = generate(path, "y", out, "MyApp", id_field="id")
+    assert set(files) == {"app.py", "README.md", "test_app.py"}
+    app = open(os.path.join(out, "app.py")).read()
+    assert "BinaryClassificationModelSelector" in app
+    assert "FeatureBuilder.PickList('color')" in app
+    compile(app, "app.py", "exec")  # must be valid python
+
+
+def test_generated_app_trains(tmp_path):
+    path, df = _csv(tmp_path)
+    out = str(tmp_path / "proj")
+    main(["gen", "--input", path, "--response", "y", "--output", out,
+          "--id-field", "id"])
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    r = subprocess.run(
+        [sys.executable, "app.py", "--run-type", "train",
+         "--model-location", str(tmp_path / "model")],
+        cwd=out, env=env, capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert os.path.exists(str(tmp_path / "model" / "plan.json"))
+    assert "Best model" in r.stdout or "ModelSelector" in r.stdout

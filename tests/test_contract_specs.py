@@ -1,0 +1,69 @@
+"""The published contract specs applied to a sample of stages — both
+validating the spec machinery and giving each stage the reference-style
+contract coverage (reference: every stage has a spec file extending
+OpTransformerSpec/OpEstimatorSpec)."""
+import numpy as np
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.bucketizers import NumericBucketizer
+from transmogrifai_tpu.impl.feature.scalers import FillMissingWithMean
+from transmogrifai_tpu.impl.feature.vectorizers import (
+    OneHotVectorizer, RealVectorizer,
+)
+from transmogrifai_tpu.impl.feature.math import BinaryMathOp
+from transmogrifai_tpu.table import FeatureTable
+from transmogrifai_tpu.test import OpEstimatorSpec, OpTransformerSpec
+from transmogrifai_tpu.types import PickList, Real
+
+
+class TestBinaryMathOpSpec(OpTransformerSpec):
+    @classmethod
+    def build(cls):
+        a = FeatureBuilder.Real("a").extract_field().as_predictor()
+        b = FeatureBuilder.Real("b").extract_field().as_predictor()
+        stage = BinaryMathOp("/").set_input(a, b)
+        table = FeatureTable.from_columns({
+            "a": (Real, [6.0, 4.0, None]),
+            "b": (Real, [2.0, 0.0, 1.0]),
+        })
+        return stage, table, [3.0, None, None]
+
+
+class TestNumericBucketizerSpec(OpTransformerSpec):
+    @classmethod
+    def build(cls):
+        f = FeatureBuilder.Real("x").extract_field().as_predictor()
+        stage = NumericBucketizer([0.0, 1.0, 2.0]).set_input(f)
+        table = FeatureTable.from_columns({"x": (Real, [0.5, 1.5, None])})
+        return stage, table, [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0],
+                              [0.0, 0.0, 1.0]]
+
+
+class TestFillMissingWithMeanSpec(OpEstimatorSpec):
+    @classmethod
+    def build(cls):
+        f = FeatureBuilder.Real("x").extract_field().as_predictor()
+        stage = FillMissingWithMean().set_input(f)
+        table = FeatureTable.from_columns({"x": (Real, [1.0, None, 3.0])})
+        return stage, table, [1.0, 2.0, 3.0]
+
+
+class TestRealVectorizerSpec(OpEstimatorSpec):
+    @classmethod
+    def build(cls):
+        f = FeatureBuilder.Real("x").extract_field().as_predictor()
+        stage = RealVectorizer().set_input(f)
+        table = FeatureTable.from_columns({"x": (Real, [1.0, None, 3.0])})
+        return stage, table, [[1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+
+
+class TestOneHotVectorizerSpec(OpEstimatorSpec):
+    @classmethod
+    def build(cls):
+        f = FeatureBuilder.PickList("c").extract_field().as_predictor()
+        stage = OneHotVectorizer(top_k=2, min_support=1).set_input(f)
+        table = FeatureTable.from_columns(
+            {"c": (PickList, ["a", "b", "a", None])})
+        # columns: a, b, OTHER, null
+        return stage, table, [[1.0, 0.0, 0.0, 0.0], [0.0, 1.0, 0.0, 0.0],
+                              [1.0, 0.0, 0.0, 0.0], [0.0, 0.0, 0.0, 1.0]]

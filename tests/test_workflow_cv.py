@@ -1,0 +1,119 @@
+"""Workflow-level CV + RandomParamBuilder tests (model: reference
+OpWorkflowCVTest, RandomParamBuilderTest)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu  # noqa: F401
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.impl.selector.random_param_builder import (
+    RandomParamBuilder,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+def _df(n=400, seed=9):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2 + 0.5 * rng.randn(n)) > 0).astype(float)
+    return pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+
+
+def _graph(df, cv=True):
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    x2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+    vec = transmogrify([x1, x2])
+    checked = vec.sanity_check(y, min_variance=1e-8)
+    factory = (BinaryClassificationModelSelector.with_cross_validation
+               if cv else BinaryClassificationModelSelector.with_train_validation_split)
+    pred = (factory(seed=2, models=[("OpLogisticRegression", None)])
+            .set_input(y, checked).get_output())
+    return y, vec, checked, pred
+
+
+def test_workflow_cv_end_to_end():
+    df = _df()
+    y, vec, checked, pred = _graph(df)
+    wf = (OpWorkflow().set_input_dataset(df)
+          .set_result_features(pred).with_workflow_cv())
+    model = wf.train()
+    sel = model.get_stage(pred.origin_stage.uid)
+    # the sweep ran through find_best_estimator (preset) and recorded results
+    assert sel.summary.best_metric_value > 0.6
+    assert sel.summary.validation_results
+    # final model still scores fine
+    scored = model.score(df=df)
+    parts = np.asarray(scored[pred.name].values)
+    keys = list(scored[pred.name].metadata["keys"])
+    acc = (parts[:, keys.index("prediction")] == df["y"].to_numpy()).mean()
+    assert acc > 0.75
+    # the during-DAG (SanityChecker) was ALSO fitted on the full data
+    assert any(type(s).__name__ == "SanityCheckerModel" for s in model.stages)
+
+
+def test_workflow_cv_requires_single_selector():
+    df = _df()
+    y = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    vec = transmogrify([x1])
+    wf = (OpWorkflow().set_input_dataset(df)
+          .set_result_features(vec).with_workflow_cv())
+    with pytest.raises(ValueError, match="exactly one ModelSelector"):
+        wf.train()
+
+
+def test_workflow_cv_matches_plain_direction():
+    # same data, with and without workflow CV: both must find a usable model
+    df = _df()
+    y1, v1, c1, pred_plain = _graph(df)
+    m_plain = (OpWorkflow().set_input_dataset(df)
+               .set_result_features(pred_plain).train())
+    y2, v2, c2, pred_cv = _graph(df)
+    m_cv = (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred_cv).with_workflow_cv().train())
+    s_plain = m_plain.get_stage(pred_plain.origin_stage.uid).summary
+    s_cv = m_cv.get_stage(pred_cv.origin_stage.uid).summary
+    assert abs(s_plain.best_metric_value - s_cv.best_metric_value) < 0.15
+
+
+class TestRandomParamBuilder:
+    def test_distributions(self):
+        grid = (RandomParamBuilder(seed=5)
+                .log_uniform("regParam", 1e-4, 1.0)
+                .uniform("elasticNetParam", 0.0, 1.0)
+                .integers("depth", 2, 5)
+                .choice("kind", ["a", "b"])
+                .build(200))
+        assert len(grid) == 200
+        regs = np.array([g["regParam"] for g in grid])
+        assert regs.min() >= 1e-4 and regs.max() <= 1.0
+        # log-uniform: median far below the arithmetic midpoint
+        assert np.median(regs) < 0.2
+        assert all(2 <= g["depth"] <= 5 for g in grid)
+        assert {g["kind"] for g in grid} == {"a", "b"}
+
+    def test_deterministic(self):
+        g1 = RandomParamBuilder(seed=3).uniform("x", 0, 1).build(5)
+        g2 = RandomParamBuilder(seed=3).uniform("x", 0, 1).build(5)
+        assert g1 == g2
+
+    def test_feeds_selector(self):
+        df = _df(200)
+        y = FeatureBuilder.RealNN("y").extract_field().as_response()
+        x1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+        vec = transmogrify([x1])
+        grid = (RandomParamBuilder(seed=1)
+                .log_uniform("regParam", 1e-3, 0.5)
+                .uniform("elasticNetParam", 0.0, 1.0).build(12))
+        pred = (BinaryClassificationModelSelector
+                .with_train_validation_split(
+                    seed=1, models=[("OpLogisticRegression", grid)])
+                .set_input(y, vec).get_output())
+        model = OpWorkflow().set_input_dataset(df).set_result_features(pred).train()
+        sel = model.get_stage(pred.origin_stage.uid)
+        assert len(sel.summary.validation_results[0].grid) == 12

@@ -110,6 +110,85 @@ class ModelSelector(AllowLabelAsInput, Estimator):
                 "multiclass": ("F1", True),
                 "regression": ("RootMeanSquaredError", False)}[self.problem]
 
+    # -- workflow-level CV (reference findBestEstimator :112-121) ------------
+    def find_best_estimator(self, table: FeatureTable,
+                            during_layers: Sequence[Sequence[Tuple[Any, int]]],
+                            ) -> BestEstimator:
+        """Leakage-free validation: per fold, fit fresh copies of the in-CV
+        DAG (label-dependent prep like SanityChecker) on the fold's train rows
+        only, then sweep the model grid on the fold-specific feature matrix
+        (reference OpValidator.applyDAG :228-256 + getSummary). The winner is
+        recorded; the subsequent normal ``fit`` skips validation and refits it
+        on the full prepared data (reference OpWorkflow.fitStages :397-442)."""
+        label_f, vec_f = self.input_features
+        y_all = np.asarray(table[label_f.name].values,
+                           dtype=np.float32).reshape(-1)
+        n = len(y_all)
+        # reserve the SAME holdout the later fit() will carve out (splitter
+        # split is seed-deterministic in n), so selection never sees it
+        if self.splitter is not None and self.splitter.reserve_test_fraction > 0:
+            train_idx, _ = self.splitter.split(n)
+        else:
+            train_idx = np.arange(n)
+        y_train_raw = y_all[train_idx]
+        prep = (self.splitter.pre_validation_prepare(y_train_raw)
+                if self.splitter is not None
+                else PreparedData(indices=np.arange(len(y_train_raw))))
+        sel_rows = train_idx[prep.indices]
+        sub = table.take(sel_rows)
+        y = y_all[sel_rows]
+        if prep.label_mapping:
+            y = np.vectorize(
+                lambda v: prep.label_mapping.get(int(v), -1))(y).astype(np.float32)
+        num_classes = int(y.max()) + 1 if self.problem != "regression" else 1
+        if self.problem == "binary":
+            num_classes = 2
+        metric_name, larger_better = self.validation_metric
+
+        val_masks = self.validator.make_splits(y)          # (F, n)
+        fold_results: List[List[Any]] = []
+        for f in range(val_masks.shape[0]):
+            train_rows = np.nonzero(~val_masks[f])[0]
+            full_tbl = sub
+            for layer in during_layers:
+                for stage, _ in layer:
+                    if isinstance(stage, Estimator):
+                        # fit on the fold's train rows only; one transform of
+                        # the full table serves both train and val rows
+                        model = stage.fit(full_tbl.take(train_rows))
+                    else:
+                        model = stage
+                    full_tbl = model.transform(full_tbl)
+            if vec_f.name not in full_tbl.column_names:
+                raise ValueError(
+                    f"in-CV DAG did not produce feature '{vec_f.name}'")
+            Xf = jnp.asarray(np.asarray(full_tbl[vec_f.name].values,
+                                        dtype=np.float32))
+            yd = jnp.asarray(y)
+            fold_results.append(self.validator.validate(
+                self.models, Xf, yd, self.problem, metric_name, larger_better,
+                num_classes, val_masks=val_masks[f][None, :]))
+
+        # average fold winners per (family, grid point)
+        best: Optional[BestEstimator] = None
+        merged: List[Any] = []
+        for i, (family, grid) in enumerate(self.models):
+            folds = np.stack([fr.results[i].fold_metrics[0]
+                              for fr in fold_results])      # (F, G)
+            mean = folds.mean(axis=0)
+            r = fold_results[0].results[i]
+            r.fold_metrics, r.mean_metrics = folds, mean
+            merged.append(r)
+            g_best = int(np.argmax(mean) if larger_better else np.argmin(mean))
+            value = float(mean[g_best])
+            if best is None or ((value > best.metric_value) if larger_better
+                                else (value < best.metric_value)):
+                best = BestEstimator(family.name, dict(grid[g_best]), value)
+        assert best is not None
+        best.results = merged
+        self._preset_best = best
+        return best
+
     # -- fit (reference ModelSelector.fit :135-196) --------------------------
     def fit(self, table: FeatureTable) -> Transformer:
         label_f, vec_f = self.input_features
@@ -137,9 +216,17 @@ class ModelSelector(AllowLabelAsInput, Estimator):
 
         metric_name, larger_better = self.validation_metric
         Xd, yd = jnp.asarray(X), jnp.asarray(y)
-        best = self.validator.validate(
-            self.models, Xd, yd, self.problem, metric_name, larger_better,
-            num_classes)
+        preset = getattr(self, "_preset_best", None)
+        if preset is not None:
+            # workflow-level CV already ran (find_best_estimator); skip the
+            # in-selector sweep and refit the recorded winner. Consume it so a
+            # later refit on new data validates from scratch.
+            self._preset_best = None
+            best = preset
+        else:
+            best = self.validator.validate(
+                self.models, Xd, yd, self.problem, metric_name, larger_better,
+                num_classes)
 
         # refit winner on full prepared train (reference :158-159)
         family = MODEL_REGISTRY[best.family_name]

@@ -124,10 +124,16 @@ class OpValidator:
     def validate(self, models: Sequence[Tuple[ModelFamily, List[Dict[str, Any]]]],
                  X: jnp.ndarray, y: jnp.ndarray, problem: str,
                  metric_name: str, larger_better: bool, num_classes: int,
+                 val_masks: Optional[np.ndarray] = None,
                  ) -> BestEstimator:
         """Run the full |families| × |grid| × |folds| sweep. Each family is one
-        vmapped fit_batch + predict_batch + batched-metric program."""
-        val_masks = self.make_splits(np.asarray(y))  # (F, n)
+        vmapped fit_batch + predict_batch + batched-metric program.
+
+        ``val_masks`` overrides the fold construction with explicit (F, n)
+        boolean validation masks — used by the workflow-level CV path, which
+        must evaluate one externally-prepared fold at a time."""
+        if val_masks is None:
+            val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
         train_w = jnp.asarray(~val_masks, dtype=jnp.float32)    # (F, n)
         val_m = jnp.asarray(val_masks)                          # (F, n)

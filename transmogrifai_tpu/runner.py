@@ -179,6 +179,11 @@ class OpWorkflowRunner:
             result.metrics["trainEvaluation"] = {
                 k: v for k, v in ev.evaluate_all(model.train_table).items()
                 if isinstance(v, (int, float))}
+        # always record the per-stage summaries (selector sweep results,
+        # sanity-checker drops) so --metrics-location has content even
+        # without an explicit evaluator (reference writes train metrics
+        # unconditionally, OpWorkflowRunner.scala:169-178)
+        result.metrics["summary"] = model.summary()
         if self.workflow.profiler is not None:
             result.metrics["appMetrics"] = self.workflow.profiler.app_metrics()
 

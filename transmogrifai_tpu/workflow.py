@@ -80,6 +80,15 @@ class OpWorkflow(_WorkflowCore):
         self._layers = None
         self._raw_feature_filter = None
         self.profiler = None
+        self._workflow_cv = False
+
+    def with_workflow_cv(self) -> "OpWorkflow":
+        """Leakage-free workflow-level cross-validation: label-dependent prep
+        stages (SanityChecker, supervised bucketizers) refit inside every CV
+        fold instead of once before the sweep (reference
+        OpWorkflow.withWorkflowCV + FitStagesUtil.cutDAG:305-358)."""
+        self._workflow_cv = True
+        return self
 
     def with_profiler(self, profiler=None) -> "OpWorkflow":
         """Collect per-stage wall-clock metrics during train (the reference's
@@ -141,8 +150,11 @@ class OpWorkflow(_WorkflowCore):
                 result_features, layers = self._apply_blacklist(blacklist)
                 blacklisted = tuple(blacklist)
         self._inject_stage_params([s for layer in layers for s, _ in layer])
-        table, fitted = fit_and_transform_dag(table, layers,
-                                              profiler=self.profiler)
+        if self._workflow_cv:
+            table, fitted = self._fit_with_workflow_cv(table, layers)
+        else:
+            table, fitted = fit_and_transform_dag(table, layers,
+                                                  profiler=self.profiler)
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in result_features)
         model = OpWorkflowModel()
@@ -160,6 +172,81 @@ class OpWorkflow(_WorkflowCore):
             model.profiler = StageProfiler()
         model._layers = compute_dag(new_results)
         return model
+
+    def _fit_with_workflow_cv(self, table: FeatureTable, layers):
+        """The cutDAG path (reference FitStagesUtil.cutDAG:305-358 +
+        OpWorkflow.fitStages:397-442): fit label-independent stages once,
+        run ModelSelector.find_best_estimator with per-fold copies of the
+        label-dependent ("during") DAG, then fit everything remaining —
+        including the during stages on the full data and the selector, which
+        now skips its own sweep and refits the recorded winner."""
+        from .impl.selector.model_selector import ModelSelector
+        from .stages.base import AllowLabelAsInput
+
+        all_stages = [(s, d) for layer in layers for s, d in layer]
+        selectors = [s for s, _ in all_stages if isinstance(s, ModelSelector)]
+        if len(selectors) != 1:
+            raise ValueError(
+                f"workflow-level CV requires exactly one ModelSelector, "
+                f"found {len(selectors)} (reference FitStagesUtil.cutDAG:313)")
+        sel = selectors[0]
+        _, vec_f = sel.input_features
+
+        # taint propagation over the FULL result ancestry: a feature is
+        # label-dependent if its origin stage consumes the label while
+        # producing a predictor (AllowLabelAsInput estimators), is the
+        # selector itself, or has any tainted parent. Tainted stages — and
+        # everything downstream of them, selector outputs included — defer to
+        # the rest phase so their inputs exist when they fit.
+        tainted: Dict[str, bool] = {}
+        ordered: List[Feature] = []
+        seen: set = set()
+        for rf in self.result_features:
+            for feat in rf.all_features():      # post-order: parents first
+                if feat.uid in seen:
+                    continue
+                seen.add(feat.uid)
+                ordered.append(feat)
+                st = feat.origin_stage
+                own = ((isinstance(st, Estimator)
+                        and isinstance(st, AllowLabelAsInput))
+                       or st is sel)
+                tainted[feat.uid] = own or any(tainted.get(p.uid, False)
+                                               for p in feat.parents)
+        tainted_stage_uids = {f_.origin_stage.uid for f_ in ordered
+                              if tainted[f_.uid] and not f_.is_raw}
+
+        before_layers = [[(s, d) for s, d in layer
+                          if s.uid not in tainted_stage_uids]
+                         for layer in layers]
+        table1, fitted_before = fit_and_transform_dag(
+            table, before_layers, profiler=self.profiler)
+
+        # the in-CV DAG refit per fold: tainted estimator stages on the
+        # selector-input ancestry (not the selector, not its downstream)
+        vec_anc = {f_.origin_stage.uid for f_ in vec_f.all_features()
+                   if not f_.is_raw}
+        during_layers = [[(s, d) for s, d in layer
+                          if s.uid in tainted_stage_uids and s.uid in vec_anc
+                          and s is not sel]
+                         for layer in layers]
+        during_layers = [l for l in during_layers if l]
+
+        rest_layers = [[(s, d) for s, d in layer
+                        if s.uid in tainted_stage_uids]
+                       for layer in layers]
+        rest_layers = [l for l in rest_layers if l]
+        try:
+            sel.find_best_estimator(table1, during_layers)
+            table2, fitted_rest = fit_and_transform_dag(
+                table1, rest_layers, profiler=self.profiler)
+        except Exception:
+            # don't leave a recorded winner behind: a later plain train()
+            # on the same stage objects must validate from scratch, not
+            # silently reuse a selection made on this failed run's data
+            sel._preset_best = None
+            raise
+        return table2, {**fitted_before, **fitted_rest}
 
     def _apply_blacklist(self, blacklist: Sequence[Feature]):
         """DAG surgery removing blacklisted raw features (reference
