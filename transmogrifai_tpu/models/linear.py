@@ -45,53 +45,121 @@ def _unscale(coef_s: jnp.ndarray, bias_s: jnp.ndarray, mean: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# Binary logistic regression — prox-Newton (IRLS + coordinate-wise soft
-# thresholding for the L1 part)
+# Binary logistic regression — batched prox-Newton-CG
+#
+# The whole |grid| × |folds| batch is ONE program in which every heavy op is a
+# shared (n,d)@(d,B) matmul over the raw feature matrix: per-configuration
+# standardization is folded into coefficient algebra (Xs·v computed as
+# X·(v/scale) − mean·(v/scale)), so X is read once per matmul instead of being
+# re-materialized per configuration, and the Newton direction comes from a
+# fixed-length conjugate-gradient solve whose Hessian-vector products are two
+# such matmuls (the LIBLINEAR trust-region-Newton structure, batched). This is
+# the MXU-shaped replacement for the reference's per-config SparkML fits
+# (OpValidator.scala:270-322).
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("iters",))
-def _fit_logreg(X, y, w, reg, elastic_net, iters=25):
-    n, d = X.shape
-    Xs, mean, scale = _standardize(X, w)
-    cnt = jnp.maximum(w.sum(), 1.0)
+@partial(jax.jit, static_argnames=("newton_iters", "cg_iters"))
+def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=12, cg_iters=10):
+    """Fit B logistic regressions at once. W: (B, n) per-config row weights;
+    reg/elastic_net: (B,). Returns (coef (B, d), bias (B,)) in original scale.
+    """
+    nB = W.shape[0]
+    d = X.shape[1]
+    # global standardization keeps the shared matmuls well-conditioned at
+    # fast (default) matmul precision whatever the raw column scales; the
+    # per-config standardized space — and hence Spark's regularization
+    # semantics (standardization=true) — is invariant to this affine map.
+    g_mean = X.mean(axis=0)
+    g_scale = jnp.sqrt(jnp.maximum(X.var(axis=0), 1e-12))
+    Xg = (X - g_mean) / g_scale
+
+    Wt = W.T                                            # (n, B)
+    cnt = jnp.maximum(W.sum(axis=1), 1.0)               # (B,)
+    mean = (Wt.T @ Xg) / cnt[:, None]                   # (B, d) per-config
+    ex2 = (Wt.T @ (Xg * Xg)) / cnt[:, None]
+    var = jnp.maximum(ex2 - mean ** 2, 1e-12)
+    scale = jnp.sqrt(var)                               # (B, d)
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
+    yv = y[:, None]                                     # (n, 1)
 
-    def step(carry, _):
-        coef, bias = carry
-        z = Xs @ coef + bias
-        p = jax.nn.sigmoid(z)
-        s = jnp.maximum(p * (1 - p), 1e-6) * w
-        g_coef = (Xs * (w * (p - y))[:, None]).sum(0) / cnt + l2 * coef
-        g_bias = (w * (p - y)).sum() / cnt
-        H = jnp.einsum("ni,nj->ij", Xs * s[:, None], Xs, precision=_PREC) / cnt
-        H = H + (l2 + 1e-8) * jnp.eye(d, dtype=X.dtype)
-        h_bias = s.sum() / cnt + 1e-8
-        Hx_b = (Xs * s[:, None]).sum(0) / cnt
-        # full (d+1) system with bias row/col
-        Ha = jnp.zeros((d + 1, d + 1), X.dtype)
-        Ha = Ha.at[:d, :d].set(H).at[d, d].set(h_bias)
-        Ha = Ha.at[:d, d].set(Hx_b).at[d, :d].set(Hx_b)
-        g = jnp.concatenate([g_coef, jnp.array([g_bias], X.dtype)])
-        delta = jnp.linalg.solve(Ha, g)
-        coef = coef - delta[:d]
-        bias = bias - delta[d]
-        # prox step for L1 in the diagonal-Hessian metric
-        thresh = l1 / jnp.maximum(jnp.diag(H), 1e-8)
-        coef = jnp.where(l1 > 0,
-                         jnp.sign(coef) * jnp.maximum(jnp.abs(coef) - thresh, 0.0),
-                         coef)
-        return (coef, bias), None
+    def xs_dot(A):
+        # Xs A^T for A (B, d) → (n, B)
+        At = A / scale
+        return Xg @ At.T - (mean * At).sum(axis=1)[None, :]
 
-    init = (jnp.zeros((d,), X.dtype), jnp.asarray(0.0, X.dtype))
-    (coef_s, bias_s), _ = jax.lax.scan(step, init, None, length=iters)
-    coef, bias = _unscale(coef_s, bias_s, mean, scale)
+    def xs_t_dot(V):
+        # Xs^T V for V (n, B) → (B, d)
+        return ((V.T @ Xg) - V.sum(axis=0)[:, None] * mean) / scale
+
+    def newton_step(carry, _):
+        A, b = carry                                    # (B, d), (B,)
+        Z = xs_dot(A) + b[None, :]                      # (n, B)
+        P = jax.nn.sigmoid(Z)
+        R = Wt * (P - yv)                               # (n, B)
+        S = Wt * jnp.maximum(P * (1 - P), 1e-6)         # (n, B)
+        g_A = xs_t_dot(R) / cnt[:, None] + l2[:, None] * A
+        g_b = R.sum(axis=0) / cnt
+        ssum = S.sum(axis=0)
+
+        def hv(VA, vb):                                 # H·[v; v_b], all B
+            U = xs_dot(VA) + vb[None, :]
+            T = S * U
+            hA = xs_t_dot(T) / cnt[:, None] + (l2 + 1e-8)[:, None] * VA
+            hb = T.sum(axis=0) / cnt + 1e-8 * vb
+            return hA, hb
+
+        def cg_step(c, _):
+            dA, db, rA, rb, pA, pb, rs = c
+            hA, hb = hv(pA, pb)
+            pHp = (pA * hA).sum(axis=1) + pb * hb
+            alpha = rs / jnp.maximum(pHp, 1e-20)
+            dA = dA + alpha[:, None] * pA
+            db = db + alpha * pb
+            rA = rA - alpha[:, None] * hA
+            rb = rb - alpha * hb
+            rs_new = (rA * rA).sum(axis=1) + rb * rb
+            beta = rs_new / jnp.maximum(rs, 1e-20)
+            pA = rA + beta[:, None] * pA
+            pb = rb + beta * pb
+            return (dA, db, rA, rb, pA, pb, rs_new), None
+
+        z0 = jnp.zeros_like(A)
+        zb = jnp.zeros_like(b)
+        rs0 = (g_A * g_A).sum(axis=1) + g_b * g_b
+        (dA, db, *_), _ = jax.lax.scan(
+            cg_step, (z0, zb, g_A, g_b, g_A, g_b, rs0), None, length=cg_iters)
+
+        A = A - dA
+        b = b - db
+        # prox for L1 in the diagonal-Hessian metric:
+        # diag(Hs) = (Sᵀ Xg² − 2 mean·(Sᵀ Xg) + Σ S·mean²) / var / cnt
+        StX = S.T @ Xg
+        StX2 = S.T @ (Xg * Xg)
+        diag = (StX2 - 2 * mean * StX
+                + ssum[:, None] * mean ** 2) / var / cnt[:, None]
+        thresh = l1[:, None] / jnp.maximum(diag, 1e-8)
+        A = jnp.where(l1[:, None] > 0,
+                      jnp.sign(A) * jnp.maximum(jnp.abs(A) - thresh, 0.0), A)
+        return (A, b), None
+
+    A0 = jnp.zeros((nB, d), X.dtype)
+    b0 = jnp.zeros((nB,), X.dtype)
+    (A, b), _ = jax.lax.scan(newton_step, (A0, b0), None, length=newton_iters)
+    # per-config standardized → Xg space → original space
+    coef_g = A / scale
+    bias_g = b - (coef_g * mean).sum(axis=1)
+    coef = coef_g / g_scale
+    bias = bias_g - (coef * g_mean).sum(axis=1)
     return coef, bias
 
 
-_fit_logreg_batch = jax.jit(
-    jax.vmap(_fit_logreg, in_axes=(None, None, 0, 0, 0)),
-    static_argnames=())
+def _fit_logreg(X, y, w, reg, elastic_net):
+    """Single-config fit: the B=1 slice of the batched solver."""
+    coef, bias = _fit_logreg_batch(
+        X, y, w[None, :], jnp.asarray([reg], X.dtype),
+        jnp.asarray([elastic_net], X.dtype))
+    return coef[0], bias[0]
 
 
 class LogisticRegressionFamily(ModelFamily):

@@ -16,6 +16,93 @@ import jax.numpy as jnp
 
 from .stats import _rank
 
+# -- binned threshold curves (large-n path) ----------------------------------
+# Above this row count, AuROC/AuPR switch from exact sort-based scans to
+# binned threshold curves — the same downsampling Spark's
+# BinaryClassificationMetrics applies (numBins=1000 there; 4096 here), but
+# computed sort- and scatter-free: bin indices split into a (64, 64)
+# high/low pair and the histogram becomes chunked one-hot outer-product
+# matmuls that tile onto the MXU.
+_BINNED_MIN_N = 100_000
+_NUM_BINS = 4096
+_HI = 64
+_LO = _NUM_BINS // _HI
+_HIST_CHUNK = 32768
+
+
+def _binned_hists(scores: jnp.ndarray, labels: jnp.ndarray,
+                  mask: jnp.ndarray):
+    """(pos_hist, total_hist), each (_NUM_BINS,), over the masked subset;
+    bins span the masked score range (descending-threshold curves read the
+    histograms reversed)."""
+    n = scores.shape[0]
+    inf = jnp.asarray(jnp.inf, scores.dtype)
+    smin = jnp.min(jnp.where(mask, scores, inf))
+    smax = jnp.max(jnp.where(mask, scores, -inf))
+    width = jnp.maximum(smax - smin, 1e-12)
+    idx = jnp.clip(((scores - smin) / width * _NUM_BINS).astype(jnp.int32),
+                   0, _NUM_BINS - 1)
+    w = mask.astype(scores.dtype)
+    pos = w * (labels > 0.5)
+    pad = (-n) % _HIST_CHUNK
+    if pad:
+        idx = jnp.pad(idx, (0, pad))      # padded rows carry zero weight
+        w = jnp.pad(w, (0, pad))
+        pos = jnp.pad(pos, (0, pad))
+    hi = idx // _LO
+    lo = idx % _LO
+    iot_hi = jnp.arange(_HI, dtype=jnp.int32)
+    iot_lo = jnp.arange(_LO, dtype=jnp.int32)
+
+    def step(carry, k):
+        hp, ha = carry
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, k * _HIST_CHUNK,
+                                                    _HIST_CHUNK)
+        h, l = sl(hi), sl(lo)
+        wp = sl(pos).astype(jnp.bfloat16)   # 0/1 weights: exact in bf16
+        wa = sl(w).astype(jnp.bfloat16)
+        oh_hi = (h[:, None] == iot_hi).astype(jnp.bfloat16)
+        oh_lo = (l[:, None] == iot_lo).astype(jnp.bfloat16)
+        hp = hp + jnp.einsum("nh,nl->hl", oh_hi * wp[:, None], oh_lo,
+                             preferred_element_type=jnp.float32)
+        ha = ha + jnp.einsum("nh,nl->hl", oh_hi * wa[:, None], oh_lo,
+                             preferred_element_type=jnp.float32)
+        return (hp, ha), None
+
+    z = jnp.zeros((_HI, _LO), jnp.float32)
+    (hp, ha), _ = jax.lax.scan(step, (z, z),
+                               jnp.arange((n + pad) // _HIST_CHUNK))
+    return hp.reshape(-1), ha.reshape(-1)
+
+
+def _auroc_from_hists(hp: jnp.ndarray, ha: jnp.ndarray) -> jnp.ndarray:
+    """Trapezoid over the binned ROC curve: each bin is one tie group, so this
+    is the grouped tie-corrected Mann-Whitney statistic."""
+    hp, ha = hp[::-1], ha[::-1]
+    hn = ha - hp
+    ctp, cfp = jnp.cumsum(hp), jnp.cumsum(hn)
+    n_pos, n_neg = ctp[-1], cfp[-1]
+    tpr = ctp / jnp.maximum(n_pos, 1.0)
+    fpr = cfp / jnp.maximum(n_neg, 1.0)
+    tp = jnp.concatenate([jnp.zeros(1, tpr.dtype), tpr[:-1]])
+    fp = jnp.concatenate([jnp.zeros(1, fpr.dtype), fpr[:-1]])
+    area = ((fpr - fp) * (tpr + tp) / 2).sum()
+    return jnp.where((n_pos > 0) & (n_neg > 0), area, 0.0)
+
+
+def _aupr_from_hists(hp: jnp.ndarray, ha: jnp.ndarray) -> jnp.ndarray:
+    """Binned precision-recall curve, first point at (recall 0, precision 1)
+    matching the exact path's convention."""
+    hp, ha = hp[::-1], ha[::-1]
+    hn = ha - hp
+    ctp, cfp = jnp.cumsum(hp), jnp.cumsum(hn)
+    n_pos = jnp.maximum(ctp[-1], 1.0)
+    rec = ctp / n_pos
+    prec = ctp / jnp.maximum(ctp + cfp, 1.0)
+    rp = jnp.concatenate([jnp.zeros(1, rec.dtype), rec[:-1]])
+    pp = jnp.concatenate([jnp.ones(1, prec.dtype), prec[:-1]])
+    return ((rec - rp) * (prec + pp) / 2).sum()
+
 
 @jax.jit
 def binary_confusion(scores: jnp.ndarray, labels: jnp.ndarray,
@@ -32,7 +119,11 @@ def binary_confusion(scores: jnp.ndarray, labels: jnp.ndarray,
 
 @jax.jit
 def auroc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Exact AuROC via the Mann-Whitney rank formula (tie-correct)."""
+    """AuROC: exact Mann-Whitney rank formula (tie-correct); above
+    _BINNED_MIN_N rows, binned threshold curves (Spark-style downsampling)."""
+    if scores.shape[0] >= _BINNED_MIN_N:
+        return _auroc_from_hists(
+            *_binned_hists(scores, labels, jnp.ones_like(scores, jnp.bool_)))
     pos = (labels > 0.5).astype(scores.dtype)
     n_pos = pos.sum()
     n_neg = pos.shape[0] - n_pos
@@ -46,7 +137,10 @@ def auroc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 def aupr(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Area under the precision-recall curve, linear interpolation over
     distinct-threshold boundary points (matches Spark's areaUnderPR up to its
-    first-point convention)."""
+    first-point convention); binned above _BINNED_MIN_N rows."""
+    if scores.shape[0] >= _BINNED_MIN_N:
+        return _aupr_from_hists(
+            *_binned_hists(scores, labels, jnp.ones_like(scores, jnp.bool_)))
     n = scores.shape[0]
     order = jnp.argsort(-scores)
     s = scores[order]
@@ -74,7 +168,9 @@ def auroc_masked(scores: jnp.ndarray, labels: jnp.ndarray,
     """AuROC over the masked subset. Masked rows get +inf scores (ranking above
     all valid rows, so valid ranks 1..n_valid are unchanged) and are excluded
     from the positive/negative counts — used inside vmapped CV where every fold
-    shares one static shape."""
+    shares one static shape. Binned above _BINNED_MIN_N rows."""
+    if scores.shape[0] >= _BINNED_MIN_N:
+        return _auroc_from_hists(*_binned_hists(scores, labels, mask))
     s = jnp.where(mask, scores, jnp.inf)
     pos = (labels > 0.5) & mask
     n_pos = pos.sum().astype(scores.dtype)
@@ -89,7 +185,10 @@ def auroc_masked(scores: jnp.ndarray, labels: jnp.ndarray,
 def aupr_masked(scores: jnp.ndarray, labels: jnp.ndarray,
                 mask: jnp.ndarray) -> jnp.ndarray:
     """AuPR over the masked subset (masked rows sink to -inf and contribute
-    nothing to cumulative TP/FP, so curve deltas in their range are zero)."""
+    nothing to cumulative TP/FP, so curve deltas in their range are zero).
+    Binned above _BINNED_MIN_N rows."""
+    if scores.shape[0] >= _BINNED_MIN_N:
+        return _aupr_from_hists(*_binned_hists(scores, labels, mask))
     n = scores.shape[0]
     s_in = jnp.where(mask, scores, -jnp.inf)
     order = jnp.argsort(-s_in)
