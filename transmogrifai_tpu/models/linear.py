@@ -58,6 +58,47 @@ def _unscale(coef_s: jnp.ndarray, bias_s: jnp.ndarray, mean: jnp.ndarray,
 # (OpValidator.scala:270-322).
 # ---------------------------------------------------------------------------
 
+class _BatchStd:
+    """Per-config standardization algebra over shared matmuls.
+
+    Globally standardizes X once (keeps the shared matmuls well-conditioned
+    at fast default matmul precision whatever the raw column scales), then
+    expresses each config's weighted standardization algebraically:
+    Xs·v = Xg·(v/scale) − mean·(v/scale). The per-config standardized space —
+    and hence Spark's regularization semantics (standardization=true) — is
+    invariant to the global affine map. X is never copied per config."""
+
+    def __init__(self, X, W):
+        g_mean = X.mean(axis=0)
+        g_scale = jnp.sqrt(jnp.maximum(X.var(axis=0), 1e-12))
+        self.g_mean, self.g_scale = g_mean, g_scale
+        self.Xg = (X - g_mean) / g_scale
+        self.Wt = W.T                                        # (n, B)
+        self.cnt = jnp.maximum(W.sum(axis=1), 1.0)           # (B,)
+        mean = (self.Wt.T @ self.Xg) / self.cnt[:, None]     # (B, d)
+        ex2 = (self.Wt.T @ (self.Xg * self.Xg)) / self.cnt[:, None]
+        self.var = jnp.maximum(ex2 - mean ** 2, 1e-12)
+        self.mean, self.scale = mean, jnp.sqrt(self.var)     # (B, d)
+
+    def xs_dot(self, A):
+        """Xs Aᵀ for A (B, d) → (n, B)."""
+        At = A / self.scale
+        return self.Xg @ At.T - (self.mean * At).sum(axis=1)[None, :]
+
+    def xs_t_dot(self, V):
+        """Xsᵀ V for V (n, B) → (B, d)."""
+        return ((V.T @ self.Xg)
+                - V.sum(axis=0)[:, None] * self.mean) / self.scale
+
+    def unscale(self, A, b):
+        """Per-config standardized coefficients → original scale."""
+        coef_g = A / self.scale
+        bias_g = b - (coef_g * self.mean).sum(axis=1)
+        coef = coef_g / self.g_scale
+        bias = bias_g - (coef * self.g_mean).sum(axis=1)
+        return coef, bias
+
+
 @partial(jax.jit, static_argnames=("newton_iters", "cg_iters"))
 def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=12, cg_iters=10):
     """Fit B logistic regressions at once. W: (B, n) per-config row weights;
@@ -65,32 +106,13 @@ def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=12, cg_iters=10):
     """
     nB = W.shape[0]
     d = X.shape[1]
-    # global standardization keeps the shared matmuls well-conditioned at
-    # fast (default) matmul precision whatever the raw column scales; the
-    # per-config standardized space — and hence Spark's regularization
-    # semantics (standardization=true) — is invariant to this affine map.
-    g_mean = X.mean(axis=0)
-    g_scale = jnp.sqrt(jnp.maximum(X.var(axis=0), 1e-12))
-    Xg = (X - g_mean) / g_scale
-
-    Wt = W.T                                            # (n, B)
-    cnt = jnp.maximum(W.sum(axis=1), 1.0)               # (B,)
-    mean = (Wt.T @ Xg) / cnt[:, None]                   # (B, d) per-config
-    ex2 = (Wt.T @ (Xg * Xg)) / cnt[:, None]
-    var = jnp.maximum(ex2 - mean ** 2, 1e-12)
-    scale = jnp.sqrt(var)                               # (B, d)
+    std = _BatchStd(X, W)
+    Xg, Wt, cnt = std.Xg, std.Wt, std.cnt
+    mean, var, scale = std.mean, std.var, std.scale
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
     yv = y[:, None]                                     # (n, 1)
-
-    def xs_dot(A):
-        # Xs A^T for A (B, d) → (n, B)
-        At = A / scale
-        return Xg @ At.T - (mean * At).sum(axis=1)[None, :]
-
-    def xs_t_dot(V):
-        # Xs^T V for V (n, B) → (B, d)
-        return ((V.T @ Xg) - V.sum(axis=0)[:, None] * mean) / scale
+    xs_dot, xs_t_dot = std.xs_dot, std.xs_t_dot
 
     def newton_step(carry, _):
         A, b = carry                                    # (B, d), (B,)
@@ -146,12 +168,7 @@ def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=12, cg_iters=10):
     A0 = jnp.zeros((nB, d), X.dtype)
     b0 = jnp.zeros((nB,), X.dtype)
     (A, b), _ = jax.lax.scan(newton_step, (A0, b0), None, length=newton_iters)
-    # per-config standardized → Xg space → original space
-    coef_g = A / scale
-    bias_g = b - (coef_g * mean).sum(axis=1)
-    coef = coef_g / g_scale
-    bias = bias_g - (coef * g_mean).sum(axis=1)
-    return coef, bias
+    return std.unscale(A, b)
 
 
 def _fit_logreg(X, y, w, reg, elastic_net):
@@ -317,42 +334,51 @@ class LinearRegressionFamily(ModelFamily):
 
 
 # ---------------------------------------------------------------------------
-# Linear SVC — squared hinge + L2, Nesterov accelerated GD
+# Linear SVC — squared hinge + L2, Nesterov accelerated GD, batched over
+# configs via the same shared-matmul standardization algebra as logistic.
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("iters",))
-def _fit_svc(X, y, w, reg, iters=150):
-    n, d = X.shape
-    Xs, mean, scale = _standardize(X, w)
-    cnt = jnp.maximum(w.sum(), 1.0)
-    ypm = 2.0 * y - 1.0  # {0,1} → {-1,+1}
+def _fit_svc_batch(X, y, W, reg, iters=150):
+    """Fit B linear SVCs at once. W: (B, n) row weights; reg: (B,).
+    Each GD step is two shared (n,d)@(d,B) matmuls."""
+    nB = W.shape[0]
+    d = X.shape[1]
+    std = _BatchStd(X, W)
+    Wt, cnt = std.Wt, std.cnt
+    ypm = (2.0 * y - 1.0)[:, None]                      # (n, 1), {-1,+1}
 
-    def loss_grad(theta):
-        coef, bias = theta[:d], theta[d]
-        m = ypm * (Xs @ coef + bias)
-        act = jnp.maximum(1.0 - m, 0.0)
-        g_m = -2.0 * act * ypm * w
-        g_coef = (Xs * g_m[:, None]).sum(0) / cnt + reg * coef
-        g_bias = g_m.sum() / cnt
-        return jnp.concatenate([g_coef, jnp.array([g_bias], X.dtype)])
+    def loss_grad(A, b):
+        M = ypm * (std.xs_dot(A) + b[None, :])          # (n, B) margins
+        act = jnp.maximum(1.0 - M, 0.0)
+        G_m = -2.0 * act * ypm * Wt                     # (n, B)
+        g_A = std.xs_t_dot(G_m) / cnt[:, None] + reg[:, None] * A
+        g_b = G_m.sum(axis=0) / cnt
+        return g_A, g_b
 
     # Lipschitz ≈ 2·mean row-norm² (+ reg); standardized rows → ‖x‖² ≈ d
-    lr = 1.0 / (2.0 * d / 4.0 + reg + 1.0)
+    lr = 1.0 / (2.0 * d / 4.0 + reg + 1.0)              # (B,)
 
     def step(carry, _):
-        theta, theta_prev, t = carry
-        mom = theta + (t - 1.0) / (t + 2.0) * (theta - theta_prev)
-        nxt = mom - lr * loss_grad(mom)
-        return (nxt, theta, t + 1.0), None
+        A, b, Ap, bp, t = carry
+        mom = (t - 1.0) / (t + 2.0)
+        mA = A + mom * (A - Ap)
+        mb = b + mom * (b - bp)
+        g_A, g_b = loss_grad(mA, mb)
+        return (mA - lr[:, None] * g_A, mb - lr * g_b, A, b, t + 1.0), None
 
-    z = jnp.zeros((d + 1,), X.dtype)
-    (theta, _, _), _ = jax.lax.scan(step, (z, z, jnp.asarray(1.0, X.dtype)),
-                                    None, length=iters)
-    coef, bias = _unscale(theta[:d], theta[d], mean, scale)
-    return coef, bias
+    zA = jnp.zeros((nB, d), X.dtype)
+    zb = jnp.zeros((nB,), X.dtype)
+    (A, b, _, _, _), _ = jax.lax.scan(
+        step, (zA, zb, zA, zb, jnp.asarray(1.0, X.dtype)), None, length=iters)
+    return std.unscale(A, b)
 
 
-_fit_svc_batch = jax.jit(jax.vmap(_fit_svc, in_axes=(None, None, 0, 0)))
+def _fit_svc(X, y, w, reg, iters=150):
+    """Single-config fit: the B=1 slice of the batched solver."""
+    coef, bias = _fit_svc_batch(X, y, w[None, :], jnp.asarray([reg], X.dtype),
+                                iters=iters)
+    return coef[0], bias[0]
 
 
 class LinearSVCFamily(ModelFamily):

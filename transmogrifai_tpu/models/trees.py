@@ -11,9 +11,18 @@ XGBoost's C++:
 
 * **Histogram growth** (the XGBoost-hist / LightGBM algorithm): features are
   quantile-binned once into int32 bins (n_bins=32 — Spark's maxBins default);
-  each tree level's split search is ONE segment-sum scatter into a
-  (nodes, features, bins, stats) histogram, a cumsum over bins, and an argmax
-  — all static shapes, all on device, no per-node host control flow.
+  each tree level's split search is a (nodes, features, bins, stats)
+  histogram, a cumsum over bins, and an argmax — all static shapes, all on
+  device, no per-node host control flow.
+* **MXU histograms, no scatters**: split search runs on a deterministic
+  strided row sample (≤ _HIST_SAMPLE rows, weights rescaled by n/S — the
+  XGBoost 'approx'/GOSS design point: split thresholds are order-statistic
+  estimates and converge long before 65k rows), and each level's histogram
+  is ONE matmul — (nodes⊗stats)ᵀ @ bin-one-hot — against a bin one-hot
+  matrix built once per fit. Leaf statistics stay EXACT: the full dataset is
+  routed down the grown tree (bin-space comparisons identical to growth) and
+  reduced with a leaf-one-hot matmul. Scatter-free end to end, so the whole
+  builder tiles onto the MXU and scales to millions of rows.
 * **Complete-heap trees of static depth**: arrays feat/thresh/leaf. A node
   that stops early keeps threshold +inf so every row routes left — training
   and serving follow identical routing with zero dynamic shapes. Empty
@@ -38,6 +47,11 @@ from .api import FittedParams, ModelFamily, register_family
 
 N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 
+#: split-search sample cap: histograms are built from at most this many
+#: evenly-strided rows (weights rescaled by n/S so count-based stopping
+#: criteria keep full-data semantics); leaf values use ALL rows.
+_HIST_SAMPLE = 65536
+
 
 # ---------------------------------------------------------------------------
 # Binning
@@ -50,10 +64,94 @@ def _quantile_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
 
 
 def _bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
-    """bin(x) = #{edges < x} ∈ [0, n_bins-1], shape (n, d) int32."""
-    return jax.vmap(
-        lambda e, col: jnp.searchsorted(e, col, side="left"),
-        in_axes=(0, 1), out_axes=1)(edges, X).astype(jnp.int32)
+    """bin(x) = #{edges < x} ∈ [0, n_bins-1], shape (n, d) int32.
+
+    Computed as a sum of broadcast comparisons — one fused elementwise pass
+    (TPU sorts/searchsorted are far slower than n_bins comparisons)."""
+    return (X[:, :, None] > edges[None, :, :]).sum(axis=2, dtype=jnp.int32)
+
+
+def _sample_rows(n: int) -> np.ndarray:
+    """Deterministic strided sample indices for split search (static)."""
+    if n <= _HIST_SAMPLE:
+        return np.arange(n)
+    return np.linspace(0, n - 1, _HIST_SAMPLE).astype(np.int64)
+
+
+def _bin_one_hot(binned_s: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """(S, d·n_bins) bf16 one-hot of the sampled bin matrix — the constant
+    RHS of every level histogram matmul, built once per fit."""
+    S, d = binned_s.shape
+    oh = (binned_s[:, :, None]
+          == jnp.arange(n_bins, dtype=jnp.int32)).astype(jnp.bfloat16)
+    return oh.reshape(S, d * n_bins)
+
+
+def _cmp_matrix(binned: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """(n, d·n_bins) bf16 decision bits: CMP[r, f·nb+b] = 1[bin(r,f) > b].
+
+    One matmul of CMP against a per-level (feature, bin) selector answers
+    'does row r go right at node j' for every row and node at once — routing
+    becomes MXU work instead of per-row gathers."""
+    n, d = binned.shape
+    cmp = (binned[:, :, None]
+           > jnp.arange(n_bins, dtype=jnp.int32)).astype(jnp.bfloat16)
+    return cmp.reshape(n, d * n_bins)
+
+
+def _level_sel(feat_lvl: jnp.ndarray, bin_lvl: jnp.ndarray, d: int,
+               n_bins: int) -> jnp.ndarray:
+    """(m, d·n_bins) bf16 selector: row j is one-hot at (feat_j, bin_j); the
+    sentinel bin n_bins gives an all-zero row (decision 0 → go left)."""
+    fb = feat_lvl * n_bins + jnp.minimum(bin_lvl, n_bins - 1)
+    oh = ((fb[:, None] == jnp.arange(d * n_bins, dtype=jnp.int32))
+          & (bin_lvl < n_bins)[:, None])
+    return oh.astype(jnp.bfloat16)
+
+
+def _route_cmp(cmp: jnp.ndarray, feat_heaps: jnp.ndarray,
+               bin_heaps: jnp.ndarray, depth: int, n_bins: int,
+               d: int) -> jnp.ndarray:
+    """Route every row down T trees at once with one decision matmul per
+    level: D = CMP @ selᵀ → (n, T·m) go-right bits, picked per row by a fused
+    node-one-hot reduction. feat/bin heaps: (T, 2^depth−1). Returns (n, T)
+    leaf assignments in [0, 2^depth)."""
+    n = cmp.shape[0]
+    T = feat_heaps.shape[0]
+    node = jnp.zeros((n, T), jnp.int32)
+    for level in range(depth):
+        base = 2 ** level - 1
+        m = 2 ** level
+        sel = _level_sel(feat_heaps[:, base:base + m].reshape(-1),
+                         bin_heaps[:, base:base + m].reshape(-1),
+                         d, n_bins)                       # (T·m, d·nb)
+        D = jnp.einsum("nf,af->na", cmp, sel,
+                       preferred_element_type=jnp.bfloat16)  # 0/1, exact
+        D = D.reshape(n, T, m)
+        n_oh = (node[:, :, None]
+                == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
+        go = (D * n_oh).sum(-1)                            # (n, T)
+        node = 2 * node + (go > 0.5).astype(jnp.int32)
+    return node
+
+
+def _leaf_reduce_forest(node: jnp.ndarray, stats: jnp.ndarray,
+                        w: jnp.ndarray, depth: int):
+    """Exact leaf statistics for T trees at once: a (T·L)-wide leaf-one-hot
+    matmul. node: (n, T). Returns (T, L, k) stat sums and (T, L) weights."""
+    n, T = node.shape
+    L = 2 ** depth
+    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]  # (n, T)
+    # f32 one-hot and stats: leaf values are served predictions, so they
+    # must not inherit bf16 rounding (histogram matmuls may; these may not)
+    l_oh = (comb[:, :, None].reshape(n, T, 1)
+            == jnp.arange(T * L, dtype=jnp.int32).reshape(1, T, L)
+            ).astype(jnp.float32).reshape(n, T * L)
+    aug = jnp.concatenate([stats * w[:, None], w[:, None]], axis=1)
+    out = jnp.einsum("na,nk->ak", l_oh, aug.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)     # (T·L, k+1)
+    out = out.reshape(T, L, -1)
+    return out[..., :-1], out[..., -1]
 
 
 # ---------------------------------------------------------------------------
@@ -101,31 +199,39 @@ def _split_gain(SL, SR, total, cfg, mode: str):
     return gain, valid
 
 
-def _grow_tree(binned, edges, stats, w, feat_mask, cfg, *,
+def _grow_tree(bin_oh, cmp_s, edges, stats_s, w_s, feat_mask, cfg, *,
                depth: int, n_bins: int, mode: str):
-    """Grow one complete-heap tree.
+    """Grow one complete-heap tree on the split-search sample.
 
-    binned: (n, d) int32; stats: (n, k) per-row stat vector; w: (n,) row
-    weights (folds × bootstrap); feat_mask: (d,) bool; cfg: traced scalars
-    {max_depth, min_instances, min_info_gain, lam, min_child_weight}.
+    bin_oh: (S, d·n_bins) bf16 bin one-hot (shared across trees/configs);
+    cmp_s: (S, d·n_bins) bf16 decision bits (shared); stats_s: (S, k) per-row
+    stat vector; w_s: (S,) row weights (folds × bootstrap, pre-scaled by
+    n/S); feat_mask: (d,) bool; cfg: traced scalars {max_depth,
+    min_instances, min_info_gain, lam, min_child_weight}.
 
-    Returns (feat_heap (2^D-1,), thresh_heap (2^D-1,), leaf_stats (2^D, k),
-    leaf_w (2^D,), node (n,) final leaf assignment).
+    Each level's histogram is ONE matmul — (node-one-hot ⊗ weighted stats)ᵀ @
+    bin_oh → (m·k, d·n_bins) — and sample routing is a decision matmul
+    against cmp_s; both batch cleanly under vmap over trees/configs (the
+    shared operand is never copied). Returns (feat_heap (2^D−1,), thresh_heap
+    (2^D−1,), bin_heap (2^D−1,) int32 with sentinel n_bins for non-splits,
+    node_s (S,) final sample leaf assignment).
     """
-    n, d = binned.shape
-    k = stats.shape[1]
-    sw = stats * w[:, None]
+    S = bin_oh.shape[0]
+    d = feat_mask.shape[0]
+    k = stats_s.shape[1]
+    sw = (stats_s * w_s[:, None]).astype(jnp.bfloat16)      # (S, k)
     feat_heap = jnp.zeros((2 ** depth - 1,), jnp.int32)
     thr_heap = jnp.full((2 ** depth - 1,), jnp.inf, dtype=jnp.float32)
-    node = jnp.zeros((n,), jnp.int32)
-    jd = jnp.arange(d, dtype=jnp.int32)
+    bin_heap = jnp.full((2 ** depth - 1,), n_bins, dtype=jnp.int32)
+    node = jnp.zeros((S,), jnp.int32)
     for level in range(depth):
         m = 2 ** level
-        flat = (node[:, None] * d + jd[None, :]) * n_bins + binned
-        vals = jnp.broadcast_to(sw[:, None, :], (n, d, k)).reshape(n * d, k)
-        hist = jax.ops.segment_sum(vals, flat.reshape(-1),
-                                   num_segments=m * d * n_bins)
-        hist = hist.reshape(m, d, n_bins, k)
+        n_oh = (node[:, None]
+                == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
+        A = (n_oh[:, :, None] * sw[:, None, :]).reshape(S, m * k)
+        hist = jnp.einsum("sa,sf->af", A, bin_oh,
+                          preferred_element_type=jnp.float32)
+        hist = hist.reshape(m, k, d, n_bins).transpose(0, 2, 3, 1)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                      # (m, k) node totals
         SL = cum[:, :, :-1, :]                        # split "bin <= b"
@@ -144,24 +250,14 @@ def _grow_tree(binned, edges, stats, w, feat_mask, cfg, *,
         feat_heap = feat_heap.at[m - 1: 2 * m - 1].set(
             jnp.where(do_split, bf, 0))
         thr_heap = thr_heap.at[m - 1: 2 * m - 1].set(thr)
-        row_bin = jnp.take_along_axis(binned, bf[node][:, None], axis=1)[:, 0]
-        go_right = do_split[node] & (row_bin > bb[node])
-        node = 2 * node + go_right.astype(jnp.int32)
-    leaf_stats = jax.ops.segment_sum(sw, node, num_segments=2 ** depth)
-    leaf_w = jax.ops.segment_sum(w, node, num_segments=2 ** depth)
-    return feat_heap, thr_heap, leaf_stats, leaf_w, node
-
-
-def _predict_tree(feat, thr, leaf, X, depth: int):
-    """Route raw rows down one heap tree; returns leaf rows (n, k)."""
-    n = X.shape[0]
-    node = jnp.zeros((n,), jnp.int32)
-    for _ in range(depth):
-        f = feat[node]
-        t = thr[node]
-        xv = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-        node = 2 * node + 1 + (xv > t).astype(jnp.int32)
-    return leaf[node - (2 ** depth - 1)]
+        bb_eff = jnp.where(do_split, bb, n_bins)
+        bin_heap = bin_heap.at[m - 1: 2 * m - 1].set(bb_eff)
+        sel = _level_sel(jnp.where(do_split, bf, 0), bb_eff, d, n_bins)
+        go = ((jnp.einsum("sf,af->sa", cmp_s, sel,
+                          preferred_element_type=jnp.bfloat16)
+               * n_oh).sum(-1) > 0.5)
+        node = 2 * node + go.astype(jnp.int32)
+    return feat_heap, thr_heap, bin_heap, node
 
 
 # ---------------------------------------------------------------------------
@@ -187,26 +283,59 @@ def _make_stats(y, num_classes: int, task: str):
     return jnp.stack([-y, ones, ones], axis=1), "gh"
 
 
+def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True):
+    """Shared per-fit prep: sampled edges, bin matrices, the sampled bin
+    one-hot histogram RHS + decision bits, and per-row stats. ``full_bin``
+    skips binning the full dataset for fits that never touch it (GBT trains
+    entirely on the sample)."""
+    n = X.shape[0]
+    samp = jnp.asarray(_sample_rows(n))
+    Xs = X[samp]
+    edges = _quantile_edges(Xs, n_bins)
+    if full_bin:
+        binned = _bin_features(X, edges)
+        binned_s = binned[samp]
+    else:
+        binned = None
+        binned_s = _bin_features(Xs, edges)
+    bin_oh = _bin_one_hot(binned_s, n_bins)
+    cmp_s = _cmp_matrix(binned_s, n_bins)
+    stats, mode = _make_stats(y, num_classes, task)
+    w_scale = jnp.asarray(n / samp.shape[0], X.dtype)
+    return samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale
+
+
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task"))
 def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
                   depth, n_bins, num_classes, task):
-    edges = _quantile_edges(X, n_bins)
-    binned = _bin_features(X, edges)
-    stats, mode = _make_stats(y, num_classes, task)
-    fmask = jnp.ones((X.shape[1],), bool)
+    d = X.shape[1]
+    samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale = \
+        _prep_tree_inputs(X, y, n_bins, num_classes, task)
+    fmask = jnp.ones((d,), bool)
+    stats_s = stats[samp]
 
-    def one(args):
-        w, md, mi, mg = args
+    def grow_one(w, md, mi, mg):
         cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
                "lam": 1e-6, "min_child_weight": 0.0}
-        f, t, ls, lw, _ = _grow_tree(binned, edges, stats, w, fmask, cfg,
-                                     depth=depth, n_bins=n_bins, mode=mode)
-        leaf = _class_leaf(ls, lw) if task == "classification" \
-            else _mean_leaf(ls, lw)[:, None]
-        return f, t, leaf
+        return _grow_tree(bin_oh, cmp_s, edges, stats_s, w[samp] * w_scale,
+                          fmask, cfg, depth=depth, n_bins=n_bins, mode=mode)
 
-    feat, thr, leaf = jax.lax.map(one, (weights, max_depth, min_inst, min_gain))
-    return {"feat": feat, "thresh": thr, "leaf": leaf}
+    feat, thr, bheap, _ = jax.vmap(grow_one)(
+        weights, max_depth, min_inst, min_gain)            # (B, H)
+
+    # exact full-data leaf stats, one config at a time (bounds memory)
+    cmp_full = _cmp_matrix(binned, n_bins)
+
+    def leaf_one(args):
+        f, bh, w = args
+        node = _route_cmp(cmp_full, f[None], bh[None], depth, n_bins, d)
+        ls, lw = _leaf_reduce_forest(node, stats, w, depth)
+        return (_class_leaf(ls[0], lw[0]) if task == "classification"
+                else _mean_leaf(ls[0], lw[0])[:, None])
+
+    leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
+    return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
+            "edges": edges}
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
@@ -215,40 +344,50 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                   subsample, seeds, *, depth, n_bins, num_classes, task,
                   n_trees):
     n, d = X.shape
-    edges = _quantile_edges(X, n_bins)
-    binned = _bin_features(X, edges)
-    stats, mode = _make_stats(y, num_classes, task)
+    samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale = \
+        _prep_tree_inputs(X, y, n_bins, num_classes, task)
     # per-tree feature subset (Spark featureSubsetStrategy auto:
     # sqrt for classification, 1/3 for regression)
     p_feat = float(np.ceil(np.sqrt(d)) / d) if task == "classification" \
         else max(1.0 / 3.0, 1.0 / d)
+    S = bin_oh.shape[0]
+    stats_s = stats[samp]
+    cmp_full = _cmp_matrix(binned, n_bins)
 
     def one(args):
         w, md, mi, mg, ss, seed = args
         cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
                "lam": 1e-6, "min_child_weight": 0.0}
         base = jax.random.PRNGKey(seed.astype(jnp.uint32))
+        w_s = w[samp] * w_scale
 
-        def tree_step(_, t):
+        def grow_t(t):
+            # bootstrap the split-search sample (the forest's randomness
+            # lives in split selection; leaf stats are exact full-data
+            # class/mean statistics per grown tree)
             k1, k2 = jax.random.split(jax.random.fold_in(base, t))
-            boot = jax.random.poisson(k1, ss, (n,)).astype(X.dtype)
+            boot_s = jax.random.poisson(k1, ss, (S,)).astype(X.dtype)
             fmask = jax.random.bernoulli(k2, p_feat, (d,))
-            f, th, ls, lw, _ = _grow_tree(
-                binned, edges, stats, w * boot, fmask, cfg,
-                depth=depth, n_bins=n_bins, mode=mode)
-            leaf = _class_leaf(ls, lw) if task == "classification" \
-                else _mean_leaf(ls, lw)[:, None]
-            return None, (f, th, leaf)
+            f, th, bh, _ = _grow_tree(
+                bin_oh, cmp_s, edges, stats_s, w_s * boot_s, fmask,
+                cfg, depth=depth, n_bins=n_bins, mode=mode)
+            return f, th, bh
 
-        _, (fs, ths, leaves) = jax.lax.scan(tree_step, None,
-                                            jnp.arange(n_trees))
-        return fs, ths, leaves
+        fs, ths, bhs = jax.vmap(grow_t)(jnp.arange(n_trees))   # (T, H)
+        node = _route_cmp(cmp_full, fs, bhs, depth, n_bins, d)  # (n, T)
+        ls, lw = _leaf_reduce_forest(node, stats, w, depth)     # (T, L, k)
+        leaves = (jax.vmap(_class_leaf)(ls, lw)
+                  if task == "classification"
+                  else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+        return fs, ths, bhs, leaves
 
-    feat, thr, leaf = jax.lax.map(
+    feat, thr, bheap, leaf = jax.lax.map(
         one, (weights, max_depth, min_inst, min_gain, subsample, seeds))
     tree_mask = (jnp.arange(n_trees)[None, :] <
                  num_trees[:, None]).astype(jnp.float32)
-    return {"feat": feat, "thresh": thr, "leaf": leaf, "tree_mask": tree_mask}
+    return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
+            "tree_mask": tree_mask,
+            "edges": edges}
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
@@ -259,96 +398,156 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
     """Gradient boosting: binary logistic / regression squared / multiclass
     softmax (one tree per class per round, vmapped over the class axis)."""
     n, d = X.shape
-    edges = _quantile_edges(X, n_bins)
-    binned = _bin_features(X, edges)
+    samp, edges, _, bin_oh, cmp_s, _, _, w_scale = \
+        _prep_tree_inputs(X, y, n_bins, num_classes, "regression",
+                          full_bin=False)
     fmask = jnp.ones((d,), bool)
     C = num_classes if task == "multiclass" else 1
-    y_i = y.astype(jnp.int32)
-    Y1 = jax.nn.one_hot(y_i, max(C, 2), dtype=X.dtype) if task == "multiclass" \
-        else None
+    B = weights.shape[0]
+    S = bin_oh.shape[0]
+    L = 2 ** depth
+    y_s = y[samp]
+    Y1_s = (jax.nn.one_hot(y_s.astype(jnp.int32), max(C, 2), dtype=X.dtype)
+            if task == "multiclass" else None)
+    W_s = weights[:, samp] * w_scale                       # (B, S)
+    # boosting state lives on the split-search sample: gradients, F and leaf
+    # values all come from it (the XGBoost subsample design point); at 65k
+    # rows and ≥2^depth≥8 leaves every leaf still averages 1000+ rows
+    if task == "regression":
+        f0 = ((weights * y[None, :]).sum(1)
+              / jnp.maximum(weights.sum(1), 1.0))[:, None]  # (B, 1)
+    else:
+        f0 = jnp.zeros((B, C), X.dtype)
+    F_init = jnp.broadcast_to(f0[:, None, :], (B, S, C))
 
-    def one(args):
-        w, md, mi, mg, it, eta, lm, mcw = args
-        cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
-               "lam": lm, "min_child_weight": mcw}
-        if task == "regression":
-            f0 = jnp.full((1,), (w * y).sum() / jnp.maximum(w.sum(), 1.0))
+    def grow_bc(g, h, w_b, cfg, lm):
+        """One (config, class) tree on the sample; returns heaps, leaf
+        values, and per-sample-row predictions."""
+        st = jnp.stack([g, h, jnp.ones_like(g)], axis=1)   # (S, 3)
+        f, th, bh, node_s = _grow_tree(
+            bin_oh, cmp_s, edges, st, w_b, fmask, cfg,
+            depth=depth, n_bins=n_bins, mode="gh")
+        l_oh = (node_s[:, None]
+                == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+        sums = jnp.einsum("sl,sk->lk", l_oh, st * w_b[:, None],
+                          preferred_element_type=jnp.float32)
+        leaf = -sums[:, 0] / (sums[:, 1] + lm + 1e-12)
+        pred_s = leaf[node_s]
+        return f, th, bh, leaf, pred_s
+
+    def one_config_round(F_b, args):
+        """(S, C) state for one config → grown trees for each class."""
+        w_b, cfg, lm, eta_b, it_b, t = args
+        if task == "binary":
+            p = jax.nn.sigmoid(F_b[:, 0])
+            g = (p - y_s)[None, :]
+            h = jnp.maximum(p * (1 - p), 1e-6)[None, :]
+        elif task == "regression":
+            g = (F_b[:, 0] - y_s)[None, :]
+            h = jnp.ones((1, S), X.dtype)
         else:
-            f0 = jnp.zeros((C,), X.dtype)
-        F_init = jnp.broadcast_to(f0[None, :], (n, C))
+            P = jax.nn.softmax(F_b, axis=1)
+            g = (P - Y1_s[:, :C]).T
+            h = jnp.maximum(P * (1 - P), 1e-6).T
+        f, th, bh, leaf, preds = jax.vmap(
+            grow_bc, in_axes=(0, 0, None, None, None))(g, h, w_b, cfg, lm)
+        active = (t.astype(jnp.float32) < it_b).astype(X.dtype)
+        return F_b + eta_b * active * preds.T, (f, th, bh, leaf)
 
-        def grow_class(g, h):
-            ones = jnp.ones_like(g)
-            st = jnp.stack([g, h, ones], axis=1)
-            f, th, ls, lw, node = _grow_tree(
-                binned, edges, st, w, fmask, cfg,
-                depth=depth, n_bins=n_bins, mode="gh")
-            leaf = -ls[:, 0] / (ls[:, 1] + lm + 1e-12)
-            return f, th, leaf, leaf[node]
+    def round_step(F, t):                                   # F: (B, S, C)
+        cfgs = {"max_depth": max_depth, "min_instances": min_inst,
+                "min_info_gain": min_gain, "lam": lam,
+                "min_child_weight": min_child_weight}
+        F_new, out = jax.vmap(one_config_round)(
+            F, (W_s, cfgs, lam, step_size, max_iter,
+                jnp.broadcast_to(t, (B,))))
+        return F_new, out
 
-        def round_step(F, t):
-            if task == "binary":
-                p = jax.nn.sigmoid(F[:, 0])
-                g = (p - y)[None, :]
-                h = jnp.maximum(p * (1 - p), 1e-6)[None, :]
-            elif task == "regression":
-                g = (F[:, 0] - y)[None, :]
-                h = jnp.ones((1, n), X.dtype)
-            else:
-                P = jax.nn.softmax(F, axis=1)
-                g = (P - Y1[:, :C]).T
-                h = jnp.maximum(P * (1 - P), 1e-6).T
-            f, th, leaf, preds = jax.vmap(grow_class)(g, h)   # (C, ...)
-            active = (t.astype(jnp.float32) < it).astype(X.dtype)
-            F_new = F + eta * active * preds.T
-            return F_new, (f, th, leaf)
-
-        _, (fs, ths, leaves) = jax.lax.scan(round_step, F_init,
-                                            jnp.arange(n_rounds))
-        return fs, ths, leaves, f0
-
-    feat, thr, leaf, f0 = jax.lax.map(
-        one, (weights, max_depth, min_inst, min_gain, max_iter, step_size,
-              lam, min_child_weight))
+    _, (feat, thr, bheap, leaf) = jax.lax.scan(
+        round_step, F_init, jnp.arange(n_rounds))
+    # (T, B, C, ...) → (B, T, C, ...)
+    feat = jnp.swapaxes(feat, 0, 1)
+    thr = jnp.swapaxes(thr, 0, 1)
+    bheap = jnp.swapaxes(bheap, 0, 1)
+    leaf = jnp.swapaxes(leaf, 0, 1)
     tree_mask = (jnp.arange(n_rounds)[None, :] <
                  max_iter[:, None]).astype(jnp.float32)
-    return {"feat": feat, "thresh": thr, "leaf": leaf, "f0": f0,
-            "eta": step_size, "tree_mask": tree_mask}
+    return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
+            "f0": f0, "eta": step_size, "tree_mask": tree_mask,
+            "edges": edges}
 
 
 # ---------------------------------------------------------------------------
 # Batched predict drivers
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("depth",))
-def _predict_dt_batch(feat, thr, leaf, X, *, depth):
-    return jax.vmap(lambda f, t, l: _predict_tree(f, t, l, X, depth))(
-        feat, thr, leaf)                                  # (B, n, k)
+def _leaf_select(node, leaf_flat):
+    """(n, A) one-hot of node-with-offset → values; fused one-hot matmul.
+    node: (n, T) leaf ids; leaf_flat: (T·L, k) values. Returns (n, k) sums
+    over trees (leaf_flat rows carry any per-tree weighting)."""
+    n, T = node.shape
+    A, k = leaf_flat.shape
+    L = A // T
+    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
+    # f32 end to end: served predictions must match the exact leaf values
+    l_oh = (comb[:, :, None]
+            == jnp.arange(A, dtype=jnp.int32).reshape(1, T, L)
+            ).astype(jnp.float32).reshape(n, A)
+    return jnp.einsum("na,ak->nk", l_oh, leaf_flat.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def _predict_rf_batch(feat, thr, leaf, tree_mask, X, *, depth):
-    def one(f, t, l, m):
-        per_tree = jax.vmap(
-            lambda ft, tt, lt: _predict_tree(ft, tt, lt, X, depth))(f, t, l)
-        wsum = (per_tree * m[:, None, None]).sum(0)
-        return wsum / jnp.maximum(m.sum(), 1.0)
-    return jax.vmap(one)(feat, thr, leaf, tree_mask)      # (B, n, k)
+@partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
+    d = X.shape[1]
+    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+
+    def one(args):
+        f, bh, l = args
+        node = _route_cmp(cmp, f[None], bh[None], depth, n_bins, d)
+        return _leaf_select(node, l)                       # (n, k)
+
+    return jax.lax.map(one, (feat, bins, leaf))            # (B, n, k)
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def _predict_gbt_batch(feat, thr, leaf, f0, eta, tree_mask, X, *, depth):
-    def one(f, t, l, f0b, etab, m):
-        # f: (T, C, M) — flatten tree×class, route, re-split
-        T, C, M = f.shape
-        per = jax.vmap(lambda ft, tt, lt: _predict_tree(
-            ft, tt, lt[:, None], X, depth))(
-            f.reshape(T * C, M), t.reshape(T * C, M),
-            l.reshape(T * C, -1))                          # (T*C, n, 1)
-        per = per[..., 0].reshape(T, C, -1)
-        contrib = (per * m[:, None, None]).sum(0)          # (C, n)
-        return f0b[:, None] + etab * contrib
-    return jax.vmap(one)(feat, thr, leaf, f0, eta, tree_mask)  # (B, C, n)
+@partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
+                      n_bins):
+    d = X.shape[1]
+    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+
+    def one(args):
+        f, bh, l, m = args                                 # (T,H) (T,L,k) (T,)
+        T, L, k = l.shape
+        node = _route_cmp(cmp, f, bh, depth, n_bins, d)    # (n, T)
+        lw = (l * m[:, None, None]).reshape(T * L, k)
+        s = _leaf_select(node, lw)
+        return s / jnp.maximum(m.sum(), 1.0)
+
+    return jax.lax.map(one, (feat, bins, leaf, tree_mask))  # (B, n, k)
+
+
+@partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _predict_gbt_batch(feat, bins, leaf, f0, eta, tree_mask, edges, X, *,
+                       depth, n_bins):
+    d = X.shape[1]
+    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+
+    def one(args):
+        f, bh, l, f0b, etab, m = args     # (T,C,H), leaf (T,C,L), m (T,)
+        T, C, H = f.shape
+        L = l.shape[-1]
+        node = _route_cmp(cmp, f.reshape(T * C, H), bh.reshape(T * C, H),
+                          depth, n_bins, d)                # (n, T·C)
+        # class-routing matrix: value·one-hot(class) per (tree, class, leaf)
+        lv = (l * m[:, None, None]).reshape(T * C * L)
+        cls = jnp.tile(jnp.repeat(jnp.arange(C), L), T)
+        M = lv[:, None] * (cls[:, None]
+                           == jnp.arange(C)).astype(lv.dtype)  # (T·C·L, C)
+        contrib = _leaf_select(node, M)                    # (n, C)
+        return (f0b[None, :] + etab * contrib).T           # (C, n)
+
+    return jax.lax.map(one, (feat, bins, leaf, f0, eta, tree_mask))
 
 
 # ---------------------------------------------------------------------------
@@ -369,6 +568,20 @@ class _TreeFamilyBase(ModelFamily):
         if "regression" in self.supports and len(self.supports) == 1:
             return "regression"
         return "classification"
+
+    def select_params(self, batched, idx):
+        """Per-config slice, except the bin-edge table, which is shared by
+        every configuration of a fit and stored once."""
+        import jax
+        return {k: (np.asarray(v) if k == "edges" else np.asarray(v[idx]))
+                for k, v in batched.items()}
+
+    @staticmethod
+    def _edges_of(params):
+        """Shared (d, n_bins−1) edge table whether params came from a batched
+        fit (2-D) or went through predict_one's uniform [None] stacking."""
+        e = jnp.asarray(params["edges"])
+        return e[0] if e.ndim == 3 else e
 
 
 #: reference DefaultSelectorParams.MaxDepth is {3, 6, 12}; the default grid
@@ -398,8 +611,10 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
-        out = _predict_dt_batch(params["feat"], params["thresh"],
-                                params["leaf"], X, depth=depth)
+        edges = self._edges_of(params)
+        out = _predict_dt_batch(params["feat"], params["bins"],
+                                params["leaf"], edges, X, depth=depth,
+                                n_bins=edges.shape[-1] + 1)
         return _shape_scores(out, num_classes, self._task(num_classes))
 
     def predict_one(self, fitted: FittedParams, X):
@@ -435,9 +650,11 @@ class RandomForestFamilyBase(_TreeFamilyBase):
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
-        out = _predict_rf_batch(params["feat"], params["thresh"],
-                                params["leaf"], params["tree_mask"], X,
-                                depth=depth)
+        edges = self._edges_of(params)
+        out = _predict_rf_batch(params["feat"], params["bins"],
+                                params["leaf"], params["tree_mask"],
+                                edges, X, depth=depth,
+                                n_bins=edges.shape[-1] + 1)
         return _shape_scores(out, num_classes, self._task(num_classes))
 
     def predict_one(self, fitted: FittedParams, X):
@@ -481,9 +698,11 @@ class GBTFamilyBase(_TreeFamilyBase):
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-1])
+        edges = self._edges_of(params)
         margins = _predict_gbt_batch(
-            params["feat"], params["thresh"], params["leaf"], params["f0"],
-            params["eta"], params["tree_mask"], X, depth=depth)  # (B, C, n)
+            params["feat"], params["bins"], params["leaf"], params["f0"],
+            params["eta"], params["tree_mask"], edges, X, depth=depth,
+            n_bins=edges.shape[-1] + 1)                          # (B, C, n)
         task = self._gbt_task(num_classes)
         if task == "regression":
             return margins[:, 0, :]
