@@ -1,0 +1,42 @@
+"""Dataprep example parity with the reference's published expected outputs
+(reference helloworld/src/main/scala/com/salesforce/hw/dataprep/
+ConditionalAggregation.scala — the 'Expected Output' table in the source —
+and JoinsAndAggregates.scala) on the reference's own CSV fixtures."""
+import os
+
+import numpy as np
+import pytest
+
+_RES = "/root/reference/helloworld/src/main/resources"
+needs_data = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference example datasets not present")
+
+
+@needs_data
+def test_conditional_aggregation_matches_reference_expected_output():
+    from transmogrifai_tpu.examples.dataprep import conditional_aggregation
+    tbl = conditional_aggregation()
+    got = {str(k): (float(np.asarray(tbl["numVisitsWeekPrior"].values)[i]),
+                    float(np.asarray(tbl["numPurchasesNextDay"].values)[i]))
+           for i, k in enumerate(tbl.key)}
+    # (visitsWeekPrior, purchasesNextDay) per the reference source comment
+    assert got == {
+        "xyz@salesforce.com": (3.0, 1.0),
+        "lmn@salesforce.com": (0.0, 1.0),
+        "abc@salesforce.com": (1.0, 0.0),
+    }
+
+
+@needs_data
+def test_joins_and_aggregates():
+    from transmogrifai_tpu.examples.dataprep import joins_and_aggregates
+    tbl, ctr = joins_and_aggregates()
+    keys = [str(k) for k in tbl.key]
+    assert set(keys) >= {"123", "456", "789"}
+    i = keys.index("123")
+    # user 123: 2 clicks on 09-03 (within a day of the 09-04 cutoff),
+    # 1 send in the prior week, 1 click after the cutoff
+    assert np.asarray(tbl["numClicksYday"].values)[i] == 2.0
+    assert np.asarray(tbl["numSendsLastWeek"].values)[i] == 1.0
+    assert np.asarray(tbl["numClicksTomorrow"].values)[i] == 1.0
+    assert ctr[i] == pytest.approx(1.0)

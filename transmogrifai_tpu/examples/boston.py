@@ -48,3 +48,7 @@ def main(path: str = DEFAULT_PATH):
     model = wf.train()
     print(model.summary_pretty())
     return model
+
+
+if __name__ == "__main__":
+    main()

@@ -74,3 +74,8 @@ def run(csv_path: str = DEFAULT_PATH, seed: int = 42) -> OpWorkflowModel:
     wf, survived, prediction = build_workflow(csv_path, seed)
     model = wf.train()
     return model
+
+
+if __name__ == "__main__":
+    model = run()
+    print(model.summary_pretty())

@@ -224,32 +224,47 @@ class LogisticRegressionFamily(ModelFamily):
 
 
 @partial(jax.jit, static_argnames=("num_classes", "iters"))
-def _fit_softmax(X, y_idx, w, reg, num_classes, iters=200):
-    """Multinomial logistic regression via full-batch Adam (fixed-length scan)."""
-    n, d = X.shape
-    Xs, mean, scale = _standardize(X, w)
-    cnt = jnp.maximum(w.sum(), 1.0)
-    Y = jax.nn.one_hot(y_idx, num_classes, dtype=X.dtype)
+def _fit_softmax_batch(X, y_idx, W_rows, reg, num_classes, iters=200):
+    """Multinomial logistic regression, all B configs in one program of
+    shared matmuls: full-batch Adam whose forward/backward are single
+    (n,d)@(d,B·C) / (d,n)@(n,B·C) contractions via the same standardization
+    algebra as the binary solver. W_rows: (B, n) row weights; reg: (B,).
+    Returns (W (B, d, C), b (B, C)) in original scale."""
+    C = num_classes
+    nB = W_rows.shape[0]
+    d = X.shape[1]
+    std = _BatchStd(X, W_rows)
+    Xg, cnt = std.Xg, std.cnt
+    mean, scale = std.mean, std.scale                   # (B, d)
+    Wt = W_rows.T                                       # (n, B)
+    Y = jax.nn.one_hot(y_idx, C, dtype=X.dtype)         # (n, C)
 
-    def loss_fn(params):
-        W, b = params
-        logits = Xs @ W + b
-        lp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -(Y * lp).sum(axis=1) * w
-        return nll.sum() / cnt + 0.5 * reg * (W ** 2).sum()
+    def grads(Wc, b):
+        """Wc: (B, d, C) per-config standardized coefs; b: (B, C)."""
+        At = Wc / scale[:, :, None]                     # (B, d, C)
+        off = (mean[:, :, None] * At).sum(axis=1)       # (B, C)
+        Z = jnp.einsum("nd,bdc->nbc", Xg, At) + (b - off)[None]
+        P = jax.nn.softmax(Z, axis=-1)
+        R = Wt[:, :, None] * (P - Y[:, None, :])        # (n, B, C)
+        GX = jnp.einsum("nd,nbc->bdc", Xg, R)           # Xgᵀ R
+        Rsum = R.sum(axis=0)                            # (B, C)
+        g_W = ((GX - mean[:, :, None] * Rsum[:, None, :]) / scale[:, :, None]
+               / cnt[:, None, None]) + reg[:, None, None] * Wc
+        g_b = Rsum / cnt[:, None]
+        return g_W, g_b
 
     # hand-rolled Adam (optax pulls jax.experimental.checkify, which clashes
     # with the axon platform-registry rewrite in this environment)
     lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
-    params = (jnp.zeros((d, num_classes), X.dtype),
-              jnp.zeros((num_classes,), X.dtype))
+    params = (jnp.zeros((nB, d, C), X.dtype), jnp.zeros((nB, C), X.dtype))
     zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
 
     def step(carry, i):
         params, m, v = carry
-        g = jax.grad(loss_fn)(params)
-        m = jax.tree_util.tree_map(lambda a, b: b1 * a + (1 - b1) * b, m, g)
-        v = jax.tree_util.tree_map(lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+        g = grads(*params)
+        m = jax.tree_util.tree_map(lambda a, b_: b1 * a + (1 - b1) * b_, m, g)
+        v = jax.tree_util.tree_map(
+            lambda a, b_: b2 * a + (1 - b2) * b_ * b_, v, g)
         t = i + 1.0
         params = jax.tree_util.tree_map(
             lambda p, mm, vv: p - lr * (mm / (1 - b1 ** t)) /
@@ -258,15 +273,21 @@ def _fit_softmax(X, y_idx, w, reg, num_classes, iters=200):
 
     (params, _, _), _ = jax.lax.scan(
         step, (params, zeros, zeros), jnp.arange(iters, dtype=X.dtype))
-    W_s, b_s = params
-    W = W_s / scale[:, None]
-    b = b_s - (W * mean[:, None]).sum(0)
-    return W, b
+    Wc, b = params
+    # per-config standardized → Xg space → original space (per class)
+    W_g = Wc / scale[:, :, None]
+    b_g = b - (W_g * mean[:, :, None]).sum(axis=1)
+    Wx = W_g / std.g_scale[None, :, None]
+    bx = b_g - (Wx * std.g_mean[None, :, None]).sum(axis=1)
+    return Wx, bx
 
 
-_fit_softmax_batch = jax.jit(
-    jax.vmap(_fit_softmax, in_axes=(None, None, 0, 0, None)),
-    static_argnames=("num_classes", "iters"))
+def _fit_softmax(X, y_idx, w, reg, num_classes, iters=200):
+    """Single-config fit: the B=1 slice of the batched solver."""
+    W, b = _fit_softmax_batch(X, y_idx, w[None, :],
+                              jnp.asarray([reg], X.dtype), num_classes,
+                              iters=iters)
+    return W[0], b[0]
 
 
 # ---------------------------------------------------------------------------

@@ -50,16 +50,25 @@ class AggregateParams:
 def _aggregate_groups(groups: "Dict[str, List[Tuple[Optional[int], dict]]]",
                       raw_features: Sequence[Feature],
                       cutoff_of: Callable[[str], Optional[int]],
+                      response_window_default: Optional[int] = None,
+                      strict_predictor: bool = False,
                       ) -> FeatureTable:
     """Fold each key's time-sorted events into one row (reference
-    FeatureAggregator.extract: predictors ≤ cutoff, responses > cutoff,
-    optional trailing aggregate window on predictors)."""
+    FeatureAggregator.extract: predictors aggregate the trailing window
+    (cutoff−window, cutoff]; responses the leading window
+    (cutoff, cutoff+window]; windowless features take everything on their
+    side of the cutoff). ``strict_predictor`` excludes events AT the cutoff
+    from predictors — conditional readers use it so the condition-firing
+    event itself is neither predictor nor response (reference
+    ConditionalDataReader: predictors strictly before the target event)."""
     keys = sorted(groups)
     cols: Dict[str, Column] = {}
     for f in raw_features:
         gen = f.origin_stage
         agg: MonoidAggregator = gen.aggregator or default_aggregator(f.feature_type)
         window = gen.aggregate_window
+        if f.is_response and window is None:
+            window = response_window_default
         out_vals: List[Any] = []
         for k in keys:
             events = groups[k]   # sorted by time (None times first)
@@ -70,8 +79,13 @@ def _aggregate_groups(groups: "Dict[str, List[Tuple[Optional[int], dict]]]",
                     if f.is_response:
                         if t is None or t <= cutoff:
                             continue
+                        # leading window: (cutoff, cutoff + window]
+                        if window is not None and t > cutoff + window:
+                            continue
                     else:
-                        if t is not None and t > cutoff:
+                        if t is not None and (t > cutoff
+                                              or (strict_predictor
+                                                  and t == cutoff)):
                             continue
                         # trailing window is half-open: (cutoff-window, cutoff]
                         if (window is not None and t is not None
@@ -137,6 +151,7 @@ class ConditionalParams:
                  timestamp_fn: Optional[Callable[[Any], Optional[int]]] = None,
                  timestamp_to_keep: str = "min",
                  drop_if_target_condition_not_met: bool = True,
+                 response_window: Optional[int] = None,
                  seed: int = 42):
         if timestamp_to_keep not in ("min", "max", "random"):
             raise ValueError("timestamp_to_keep must be min|max|random")
@@ -144,6 +159,9 @@ class ConditionalParams:
         self.timestamp = _timestamp_getter(timestamp_field, timestamp_fn)
         self.timestamp_to_keep = timestamp_to_keep
         self.drop_if_target_condition_not_met = drop_if_target_condition_not_met
+        #: default leading window for response features that set none
+        #: (reference ConditionalParams.responseWindow)
+        self.response_window = response_window
         self.seed = seed
 
 
@@ -182,12 +200,10 @@ class ConditionalDataReader(Reader):
                 cutoffs[k] = rng.choice(sorted(fired))
         if cp.drop_if_target_condition_not_met:
             groups = {k: v for k, v in groups.items() if cutoffs[k] is not None}
-        # condition time itself belongs to the response window: shift the
-        # predictor cutoff just below it (reference: predictors strictly
-        # before the target event)
         return _aggregate_groups(
-            groups, raw_features,
-            lambda k: None if cutoffs[k] is None else cutoffs[k] - 1)
+            groups, raw_features, lambda k: cutoffs[k],
+            response_window_default=cp.response_window,
+            strict_predictor=True)
 
 
 class JoinedDataReader(Reader):
