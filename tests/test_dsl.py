@@ -82,3 +82,28 @@ def test_bucketize_and_sanity_check_chain():
     model = wf.train()
     out = model.score(df=df)[checked.name]
     assert np.asarray(out.values).shape[0] == 300
+
+
+def test_text_domain_dsl_accessors():
+    """reference RichTextFeature email/url/phone syntax."""
+    import pandas as pd
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    email = FeatureBuilder.Email("e").extract_field().as_predictor()
+    url = FeatureBuilder.URL("u").extract_field().as_predictor()
+    phone = FeatureBuilder.Phone("p").extract_field().as_predictor()
+    feats = [email.is_valid_email(), url.to_url_domain(), url.is_valid_url(),
+             phone.is_valid_phone()]
+    df = pd.DataFrame({
+        "e": ["a@x.com", "nope", None],
+        "u": ["https://sub.example.com/x", "bad url", None],
+        "p": ["650-123-4567", "12", None],
+    })
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(*feats).train())
+    out = model.score(df=df)
+    assert np.asarray(out[feats[0].name].values).tolist() == [1.0, 0.0, 0.0]
+    assert out[feats[1].name].values[0] == "sub.example.com"
+    assert np.asarray(out[feats[2].name].values)[1] == 0.0
+    assert np.asarray(out[feats[3].name].values)[0] == 1.0

@@ -78,3 +78,59 @@ def test_graft_entry_and_dryrun():
     out = jax.jit(fn)(*args)
     assert np.all(np.isfinite(np.asarray(out)))
     ge.dryrun_multichip(8)
+
+
+def test_validator_mesh_matches_unsharded():
+    """The mesh-sharded sweep must select the same winner with the same
+    metrics as the single-device sweep (rows pad with zero weights, configs
+    pad with wrap-around repeats)."""
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+
+    X, y = _synth(n=333)  # deliberately not divisible by the data axis
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    family = MODEL_REGISTRY["OpLogisticRegression"]
+    grid = [{"regParam": r, "elasticNetParam": e}
+            for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]
+    models = [(family, grid)]
+
+    plain = OpCrossValidation(num_folds=3, seed=7).validate(
+        models, Xd, yd, "binary", "AuPR", True, 2)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = OpCrossValidation(num_folds=3, seed=7, mesh=mesh).validate(
+        models, Xd, yd, "binary", "AuPR", True, 2)
+    assert sharded.family_name == plain.family_name
+    assert sharded.hyper == plain.hyper
+    np.testing.assert_allclose(sharded.results[0].mean_metrics,
+                               plain.results[0].mean_metrics, atol=1e-4)
+
+
+def test_workflow_with_mesh_trains():
+    """End-to-end: OpWorkflow.with_mesh shards the selector sweep."""
+    import pandas as pd
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(3)
+    n = 300
+    x1 = rng.randn(n)
+    x2 = rng.randn(n)
+    df = pd.DataFrame({"x1": x1, "x2": x2,
+                       "y": (x1 + 0.5 * x2 > 0).astype(float)})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    f1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+    vec = tg.transmogrify([f1, f2])
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        models=[("OpLogisticRegression", None)])
+        .set_input(label, vec).get_output())
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(pred).with_mesh(mesh).train())
+    scored = model.score(df=df)
+    p = np.asarray(scored[pred.name].values)[:, 0]
+    assert ((p == df["y"].values).mean()) > 0.9

@@ -135,6 +135,43 @@ def _attach():
     def tokenize(self: Feature, min_token_length: int = 1) -> Feature:
         return TextTokenizer(min_token_length).set_input(self).get_output()
 
+    # -- domain-text accessors (reference RichTextFeature email/url/phone
+    # syntax backed by the parser stages in impl/feature/text.py) ----------
+    def is_valid_email(self: Feature) -> Feature:
+        from .impl.feature.text import ValidEmailTransformer
+        return ValidEmailTransformer().set_input(self).get_output()
+
+    def to_email_domain(self: Feature, top_k: int = 20,
+                        min_support: int = 10) -> Feature:
+        from .impl.feature.text import EmailToPickList
+        return (EmailToPickList(top_k=top_k, min_support=min_support)
+                .set_input(self).get_output())
+
+    def to_url_domain(self: Feature) -> Feature:
+        from .impl.feature.text import UrlToDomain
+        return UrlToDomain().set_input(self).get_output()
+
+    def is_valid_url(self: Feature) -> Feature:
+        from .impl.feature.text import IsValidUrl
+        return IsValidUrl().set_input(self).get_output()
+
+    def is_valid_phone(self: Feature, region: str = "US") -> Feature:
+        from .impl.feature.text import IsValidPhoneDefaultCountry
+        return (IsValidPhoneDefaultCountry(default_region=region)
+                .set_input(self).get_output())
+
+    def detect_languages(self: Feature) -> Feature:
+        from .impl.feature.text import LangDetector
+        return LangDetector().set_input(self).get_output()
+
+    def detect_mime_types(self: Feature) -> Feature:
+        from .impl.feature.text import MimeTypeDetector
+        return MimeTypeDetector().set_input(self).get_output()
+
+    def recognize_entities(self: Feature) -> Feature:
+        from .impl.feature.text import NameEntityRecognizer
+        return NameEntityRecognizer().set_input(self).get_output()
+
     def pivot(self: Feature, top_k: int = 20, min_support: int = 10,
               track_nulls: bool = True) -> Feature:
         return OneHotVectorizer(top_k=top_k, min_support=min_support,
@@ -203,6 +240,13 @@ def _attach():
         ("to_unit_circle", to_unit_circle), ("time_period", time_period),
         ("since_last", since_last), ("filter_keys", filter_keys),
         ("vectorize", vectorize), ("sanity_check", sanity_check),
+        ("is_valid_email", is_valid_email),
+        ("to_email_domain", to_email_domain),
+        ("to_url_domain", to_url_domain), ("is_valid_url", is_valid_url),
+        ("is_valid_phone", is_valid_phone),
+        ("detect_languages", detect_languages),
+        ("detect_mime_types", detect_mime_types),
+        ("recognize_entities", recognize_entities),
     ]:
         setattr(F, name, fn)
 

@@ -75,6 +75,13 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         self.evaluator = evaluator
         self.models = self._resolve_models(models)
 
+    def set_mesh(self, mesh) -> "ModelSelector":
+        """Shard the sweep over a ('data', 'model') mesh: rows over 'data',
+        the config batch over 'model' (SURVEY §2.10 P1/P2; the reference's
+        8-thread Future pool becomes mesh axes)."""
+        self.validator.mesh = mesh
+        return self
+
     def _resolve_models(self, models):
         resolved: List[Tuple[ModelFamily, List[Dict[str, Any]]]] = []
         from ...models import glm, trees  # noqa: F401 (registers families)

@@ -91,11 +91,19 @@ def _metric_fn(problem: str, metric: str):
 
 
 class OpValidator:
-    """Shared validation machinery (reference OpValidator.scala)."""
+    """Shared validation machinery (reference OpValidator.scala).
 
-    def __init__(self, seed: int = 42, stratify: bool = False):
+    ``mesh``: optional ``jax.sharding.Mesh`` with ('data', 'model') axes —
+    rows shard over 'data' and the config batch over 'model' (for families
+    whose fit is a single vmapped program; sequential-scan families keep
+    their configs whole and still get row sharding). The reference's analog
+    is its 8-thread Future pool (OpValidator.scala:318-333); here the
+    parallel axes are mesh axes and XLA inserts the psum collectives."""
+
+    def __init__(self, seed: int = 42, stratify: bool = False, mesh=None):
         self.seed = seed
         self.stratify = stratify
+        self.mesh = mesh
 
     # -- fold construction ---------------------------------------------------
     def make_splits(self, y: np.ndarray) -> np.ndarray:
@@ -135,9 +143,26 @@ class OpValidator:
         if val_masks is None:
             val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
+        if self.mesh is not None:
+            # equal shards need n divisible by the data axis: pad with
+            # zero-weight rows (excluded from fits and from val masks)
+            n_data = self.mesh.shape["data"]
+            n_pad = ((n + n_data - 1) // n_data) * n_data
+            if n_pad != n:
+                X = jnp.pad(X, ((0, n_pad - n),) + ((0, 0),) * (X.ndim - 1))
+                y = jnp.pad(y, (0, n_pad - n))
+                val_masks = np.pad(val_masks, ((0, 0), (0, n_pad - n)))
         train_w = jnp.asarray(~val_masks, dtype=jnp.float32)    # (F, n)
+        if self.mesh is not None and n_pad != n:
+            train_w = train_w.at[:, n:].set(0.0)
         val_m = jnp.asarray(val_masks)                          # (F, n)
         metric = _metric_fn(problem, metric_name)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            row_sh = NamedSharding(self.mesh, P("data"))
+            X = jax.device_put(X, NamedSharding(
+                self.mesh, P("data", *([None] * (X.ndim - 1)))))
+            y = jax.device_put(y, row_sh)
 
         results: List[ValidationResult] = []
         best: Optional[BestEstimator] = None
@@ -147,8 +172,22 @@ class OpValidator:
             # tile: config b = fold f * G + g
             W = jnp.repeat(train_w, G, axis=0)                   # (F*G, n)
             tiled = {k: jnp.tile(v, F) for k, v in garr.items()}  # (F*G,)
+            B_true = W.shape[0]
+            if self.mesh is not None and getattr(family, "shardable", True):
+                n_model = self.mesh.shape["model"]
+                B_pad = ((B_true + n_model - 1) // n_model) * n_model
+                if B_pad != B_true:
+                    idx = jnp.arange(B_pad) % B_true
+                    W = W[idx]
+                    tiled = {k: v[idx] for k, v in tiled.items()}
+                W = jax.device_put(W, NamedSharding(self.mesh,
+                                                    P("model", "data")))
+                tiled = {k: jax.device_put(v, NamedSharding(self.mesh,
+                                                            P("model")))
+                         for k, v in tiled.items()}
             params = family.fit_batch(X, y, W, tiled, num_classes)
-            scores = family.predict_batch(params, X, num_classes)  # (F*G, n[, C])
+            scores = family.predict_batch(params, X, num_classes)
+            scores = scores[:B_true]                             # (F*G, n[, C])
             VM = jnp.repeat(val_m, G, axis=0)                    # (F*G, n)
             if problem == "multiclass":
                 m = metric(scores, y, VM, num_classes)

@@ -254,18 +254,23 @@ class ModelInsights:
                 show = {k: round(v, 4) for k, v in sm["holdoutEvaluation"].items()
                         if isinstance(v, (int, float))}
                 lines.append(f"  holdout: {show}")
-        lines.append(f"Top feature contributions:")
         rows = []
         for fi in self.features:
             for d in fi.derived:
                 rows.append(d)
         rows.sort(key=lambda d: -(abs(d.contribution)
                                   if d.contribution is not None else -1))
-        for d in rows[:top_k]:
-            c = f"{d.contribution:+.4f}" if d.contribution is not None else "   n/a"
-            cor = f"{d.correlation:+.3f}" if d.correlation is not None else "  n/a"
-            flag = " [DROPPED]" if d.dropped else ""
-            lines.append(f"  {c}  corr={cor}  {d.name}{flag}")
+        from ..utils.table_format import format_table
+        table_rows = [
+            [(f"{d.contribution:+.4f}" if d.contribution is not None
+              else "n/a"),
+             (f"{d.correlation:+.3f}" if d.correlation is not None
+              else "n/a"),
+             d.name + (" [DROPPED]" if d.dropped else "")]
+            for d in rows[:top_k]]
+        lines.append(format_table(["contribution", "correlation", "feature"],
+                                  table_rows,
+                                  title="Top feature contributions"))
         if self.blacklisted_features:
             lines.append(f"Blacklisted raw features: {self.blacklisted_features}")
         return "\n".join(lines)

@@ -560,6 +560,10 @@ def _g(grid, key, default):
 
 
 class _TreeFamilyBase(ModelFamily):
+    #: config sweep runs under lax.map (sequential per chip), so the batch
+    #: axis cannot shard over the 'model' mesh axis; rows still shard.
+    shardable = False
+
     task_of = staticmethod(lambda problem: "classification"
                            if problem in ("binary", "multiclass")
                            else "regression")

@@ -11,9 +11,10 @@ from .collectives import (
     psum, pmean, pmax, all_gather, reduce_scatter, host_gather,
 )
 from .sharded import shard_table, sharded_fit_batch, sharded_col_stats
+from . import distributed
 
 __all__ = [
     "MeshSpec", "make_mesh", "default_mesh", "data_parallel_sharding",
     "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "host_gather",
-    "shard_table", "sharded_fit_batch", "sharded_col_stats",
+    "shard_table", "sharded_fit_batch", "sharded_col_stats", "distributed",
 ]

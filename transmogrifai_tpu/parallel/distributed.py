@@ -1,0 +1,69 @@
+"""Multi-host bootstrap over jax.distributed.
+
+The reference scales out through Spark's driver/executor cluster (reference:
+OpWorkflowRunner/OpApp submitting to a Spark master; shuffle + netty RPC as
+the communication backend, SURVEY §2.10 P5). Here the cluster substrate is
+``jax.distributed``: each host process calls :func:`initialize`, after which
+``jax.devices()`` is the GLOBAL device list and the same ``Mesh``-based code
+(mesh.py, sharded.py) spans hosts — XLA routes collectives over ICI within a
+TPU slice and DCN across slices. Nothing else in the framework changes
+between one chip and a multi-host pod: that is the point of the design.
+
+Typical pod usage (one process per host)::
+
+    from transmogrifai_tpu.parallel import distributed, make_mesh, MeshSpec
+    distributed.initialize()              # env-driven on TPU pods
+    mesh = make_mesh(MeshSpec(data=-1, model=4))
+    workflow.with_mesh(mesh).train()
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join (or bootstrap) the multi-host runtime.
+
+    On TPU pods all three arguments are discovered from the environment by
+    ``jax.distributed.initialize`` (TPU metadata); on CPU/GPU clusters pass
+    them explicitly or via ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``. Idempotent: a second call in
+    the same process is a no-op, and single-process runs (no coordinator
+    discoverable) are left untouched."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # TPU pod: fully env-discovered; plain single process: nothing to do
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def is_primary() -> bool:
+    """True on the process that should write models/metrics (the reference's
+    driver role)."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "sync") -> None:
+    """Cross-host synchronization point (e.g. before reading a model another
+    host just wrote)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

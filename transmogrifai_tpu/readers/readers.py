@@ -166,6 +166,36 @@ class ParquetReader(DataReader):
         return pd.read_parquet((params or {}).get("path", self.path))
 
 
+class AvroReader(DataReader):
+    """Avro container files (reference AvroReaders.scala:134; decoding via
+    the in-repo pure-python container codec, utils/avro.py). Nested record
+    fields flatten dotted (a.b) to match FeatureBuilder field extraction."""
+
+    def __init__(self, path: str, **kw):
+        super().__init__(**kw)
+        self.path = path
+
+    @staticmethod
+    def _flatten(rec, prefix=""):
+        out = {}
+        for k, v in rec.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict) and v and all(
+                    isinstance(x, (dict, str, int, float, bool, type(None),
+                                   list)) for x in v.values()) \
+                    and any(isinstance(x, dict) for x in v.values()):
+                out.update(AvroReader._flatten(v, f"{key}."))
+            else:
+                out[key] = v
+        return out
+
+    def read(self, params: Optional[dict] = None):
+        import pandas as pd
+        from ..utils.avro import read_avro
+        path = (params or {}).get("path", self.path)
+        return pd.DataFrame([self._flatten(r) for r in read_avro(path)])
+
+
 class StreamingDataReader(Reader):
     """Micro-batch scoring input (reference StreamingReaders.scala — DStream
     micro-batches become an iterator of DataFrames; each batch materializes
@@ -210,6 +240,10 @@ class DataReaders:
         def dataframe(df, key_field: Optional[str] = None) -> DataFrameReader:
             return DataFrameReader(df, key_field=key_field)
 
+        @staticmethod
+        def avro(path: str, key_field: Optional[str] = None) -> AvroReader:
+            return AvroReader(path, key_field=key_field)
+
     class Aggregate:
         """Event-aggregating variants (reference DataReaders.Aggregate)."""
 
@@ -220,6 +254,12 @@ class DataReaders:
             return AggregateDataReader(
                 CSVReader(path, schema=schema, header=header),
                 aggregate_params, key_field=key_field)
+
+        @staticmethod
+        def avro(path: str, aggregate_params, key_field: str):
+            from .aggregates import AggregateDataReader
+            return AggregateDataReader(AvroReader(path), aggregate_params,
+                                       key_field=key_field)
 
         @staticmethod
         def dataframe(df, aggregate_params, key_field: str):
@@ -242,6 +282,12 @@ class DataReaders:
         def dataframe(df, conditional_params, key_field: str):
             from .aggregates import ConditionalDataReader
             return ConditionalDataReader(DataFrameReader(df), conditional_params,
+                                         key_field=key_field)
+
+        @staticmethod
+        def avro(path: str, conditional_params, key_field: str):
+            from .aggregates import ConditionalDataReader
+            return ConditionalDataReader(AvroReader(path), conditional_params,
                                          key_field=key_field)
 
     class Streaming:

@@ -68,6 +68,20 @@ class OpParams:
             return OpParams.from_json(json.load(fh))
 
 
+
+def _write_scores(df, path: str) -> None:
+    """Write scored output by extension: .avro (the reference's saveScores
+    format, via utils/avro.py), .csv, or parquet (default)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if path.endswith(".avro"):
+        from .utils.avro import write_avro
+        write_avro(path, df.to_dict("records"))
+    elif path.endswith(".csv"):
+        df.to_csv(path, index=False)
+    else:
+        df.to_parquet(path)
+
+
 class OpWorkflowRunnerResult:
     """(reference OpWorkflowRunner result types)."""
 
@@ -210,9 +224,7 @@ class OpWorkflowRunner:
                 k: v for k, v in ev.evaluate_all(scored).items()
                 if isinstance(v, (int, float))}
         if params.write_location:
-            os.makedirs(os.path.dirname(params.write_location) or ".",
-                        exist_ok=True)
-            table_to_dataframe(scored).to_parquet(params.write_location)
+            _write_scores(table_to_dataframe(scored), params.write_location)
 
     def _streaming_score(self, result: OpWorkflowRunnerResult,
                          params: OpParams) -> None:
@@ -230,9 +242,7 @@ class OpWorkflowRunner:
         result.score_batches = n
         if params.write_location and frames:
             import pandas as pd
-            os.makedirs(os.path.dirname(params.write_location) or ".",
-                        exist_ok=True)
-            pd.concat(frames).to_parquet(params.write_location)
+            _write_scores(pd.concat(frames), params.write_location)
 
     def _features(self, result: OpWorkflowRunnerResult, params: OpParams) -> None:
         reader = self.train_reader or self.workflow.reader

@@ -97,6 +97,16 @@ class OpWorkflow(_WorkflowCore):
         self.profiler = profiler or StageProfiler()
         return self
 
+    def with_mesh(self, mesh) -> "OpWorkflow":
+        """Distribute training over a ('data', 'model') device mesh: every
+        stage exposing ``set_mesh`` (ModelSelector — rows over 'data',
+        configs over 'model') picks it up at train time. The reference's
+        cluster topology (Spark driver+executors) becomes a jax mesh; under
+        ``jax.distributed`` (parallel.distributed.initialize) the same code
+        spans hosts with ICI inside a slice and DCN across slices."""
+        self._mesh = mesh
+        return self
+
     def set_result_features(self, *features: Feature) -> "OpWorkflow":
         """Reconstruct the stage DAG from lineage (reference
         OpWorkflow.setResultFeatures:85-105)."""
@@ -150,6 +160,12 @@ class OpWorkflow(_WorkflowCore):
                 result_features, layers = self._apply_blacklist(blacklist)
                 blacklisted = tuple(blacklist)
         self._inject_stage_params([s for layer in layers for s, _ in layer])
+        mesh = getattr(self, "_mesh", None)
+        if mesh is not None:
+            for layer in layers:
+                for s, _ in layer:
+                    if hasattr(s, "set_mesh"):
+                        s.set_mesh(mesh)
         if self._workflow_cv:
             table, fitted = self._fit_with_workflow_cv(table, layers)
         else:
@@ -380,6 +396,13 @@ class OpWorkflowModel(_WorkflowCore):
             elif getattr(stage, "summary_metadata", None):
                 lines.append(f"-- {type(stage).__name__} ({stage.uid})")
         return "\n".join(lines)
+
+    def model_insights(self, feature=None):
+        """Full model report extracted from the fitted stages (reference
+        OpWorkflowModel.modelInsights:163-176). ``feature`` is accepted for
+        API parity; insights always cover the model's result features."""
+        from .insights import ModelInsights
+        return ModelInsights.extract(self)
 
 
 def _json_default(o):
