@@ -9,9 +9,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.metrics import (
-    aupr, auroc, binary_confusion, log_loss, threshold_metrics,
+    aupr, aupr_masked, auroc, auroc_masked, binary_confusion,
+    log_loss, log_loss_masked, threshold_metrics,
 )
 from ..table import FeatureTable
+from ..utils.padding import bucket_for
 from .base import OpEvaluatorBase
 
 
@@ -31,28 +33,45 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
         prob = parts.get("probability")
         scores = prob[:, 1] if prob is not None and prob.shape[1] > 1 else \
             parts["prediction"]
-        return self._metrics(jnp.asarray(label), jnp.asarray(scores))
+        # rows bucket-padded (mask False, score below every threshold) so
+        # the metric programs are shared across dataset sizes
+        n = len(label)
+        n_pad = bucket_for(n)
+        lab = np.zeros(n_pad, np.float32)
+        lab[:n] = label
+        sc = np.full(n_pad, -1.0, np.float32)
+        sc[:n] = scores
+        mask = np.zeros(n_pad, bool)
+        mask[:n] = True
+        return self._metrics(jnp.asarray(lab), jnp.asarray(sc),
+                             jnp.asarray(mask))
 
     def evaluate_arrays(self, label, scores, probability=None) -> float:
         s = probability if probability is not None else scores
         return float(aupr(jnp.asarray(s), jnp.asarray(label)))
 
-    def _metrics(self, label, scores) -> Dict[str, float]:
-        tp, tn, fp, fn = binary_confusion(scores, label)
-        tp, tn, fp, fn = map(float, (tp, tn, fp, fn))
+    def _metrics(self, label, scores, mask) -> Dict[str, float]:
+        w = mask.astype(scores.dtype)
+        pred = (scores >= 0.5).astype(scores.dtype) * w
+        pos = (label > 0.5).astype(scores.dtype) * w
+        tp = float((pred * pos).sum())
+        fp = float((pred * (w - pos)).sum())
+        fn = float(((w - pred) * pos).sum())
+        tn = float(w.sum()) - tp - fp - fn
         precision = tp / (tp + fp) if tp + fp > 0 else 0.0
         recall = tp / (tp + fn) if tp + fn > 0 else 0.0
         f1 = 2 * precision * recall / (precision + recall) if precision + recall > 0 else 0.0
         n = tp + tn + fp + fn
+        # padded rows score -1 → never >= any threshold in [0, 1]
         thr, p_curve, r_curve, f1_curve = threshold_metrics(
             scores, label, num_bins=self.num_threshold_bins)
         return {
             "Precision": precision, "Recall": recall, "F1": f1,
-            "AuROC": float(auroc(scores, label)),
-            "AuPR": float(aupr(scores, label)),
+            "AuROC": float(auroc_masked(scores, label, mask)),
+            "AuPR": float(aupr_masked(scores, label, mask)),
             "Error": (fp + fn) / n if n > 0 else 0.0,
             "TP": tp, "TN": tn, "FP": fp, "FN": fn,
-            "LogLoss": float(log_loss(scores, label)),
+            "LogLoss": float(log_loss_masked(scores, label, mask)),
             "thresholds": np.asarray(thr).tolist(),
             "precisionByThreshold": np.asarray(p_curve).tolist(),
             "recallByThreshold": np.asarray(r_curve).tolist(),

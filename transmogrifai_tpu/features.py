@@ -282,6 +282,16 @@ class FeatureBuilder:
         assert resp is not None
         return resp, feats
 
+    @staticmethod
+    def from_row(row: Dict[str, Any], response: str,
+                 response_type: Optional[Type[FeatureType]] = None,
+                 ) -> Tuple[Feature, List[Feature]]:
+        """Infer raw features from one sample record (reference
+        FeatureBuilder.fromRow:231-241). Returns (response, predictors)."""
+        import pandas as pd
+        return FeatureBuilder.from_dataframe(pd.DataFrame([row]), response,
+                                             response_type=response_type)
+
 
 # Attach one typed factory per concrete feature type:
 #   FeatureBuilder.Real, FeatureBuilder.PickList, FeatureBuilder.RealMap, …

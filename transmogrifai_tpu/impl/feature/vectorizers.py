@@ -498,7 +498,10 @@ class VectorsCombiner(SequenceTransformer):
         vm = VectorMetadata.flatten(self.get_output().name, metas)
         mat = np.concatenate(blocks, axis=1)
         assert vm.size == mat.shape[1], (vm.size, mat.shape)
-        return Column(OPVector, mat, None, {"vector_meta": vm})
+        # one host→device upload here; every downstream consumer
+        # (SanityChecker, ModelSelector, scoring) reuses the device buffer
+        import jax.numpy as jnp
+        return Column(OPVector, jnp.asarray(mat), None, {"vector_meta": vm})
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
         out: List[float] = []

@@ -86,9 +86,11 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         label_f, vec_f = self.input_features
         y = np.asarray(table[label_f.name].values, dtype=np.float32).reshape(-1)
         col = table[vec_f.name]
-        X = np.asarray(col.values, dtype=np.float32)
         vm: Optional[VectorMetadata] = col.metadata.get("vector_meta")
-        n, d = X.shape
+        # the feature matrix stays on device end to end — at millions of rows
+        # a host round-trip would dwarf the stats kernels themselves
+        Xd_all = jnp.asarray(col.values, dtype=jnp.float32)
+        n, d = Xd_all.shape
 
         # sampling (reference :524-529, capped :720-739)
         target = min(int(n * self.check_sample) if self.check_sample < 1.0 else n,
@@ -96,11 +98,10 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         if target < n:
             rng = np.random.RandomState(self.seed)
             idx = rng.choice(n, size=target, replace=False)
-            Xs, ys = X[idx], y[idx]
+            Xd, ys = Xd_all[jnp.asarray(idx)], y[idx]
         else:
-            Xs, ys = X, y
-
-        Xd, yd = jnp.asarray(Xs), jnp.asarray(ys)
+            Xd, ys = Xd_all, y
+        yd = jnp.asarray(ys)
         stats = col_stats(Xd)
         if self.correlation_type_spearman:
             corr = spearman_correlation(Xd, yd)
@@ -212,14 +213,18 @@ class SanityCheckerModel(AllowLabelAsInput, Transformer):
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features
         col = table[vec_f.name]
-        X = np.asarray(col.values)
         keep = np.asarray(self.keep_indices)
         vm: Optional[VectorMetadata] = col.metadata.get("vector_meta")
         new_meta = {}
         if vm is not None:
             new_meta["vector_meta"] = VectorMetadata(
                 self.get_output().name, vm.select(self.keep_indices).columns)
-        return Column(OPVector, np.ascontiguousarray(X[:, keep]), None, new_meta)
+        vals = col.values
+        if isinstance(vals, np.ndarray):
+            out = np.ascontiguousarray(vals[:, keep])
+        else:  # device array: slice on device, no host round-trip
+            out = vals[:, jnp.asarray(keep)]
+        return Column(OPVector, out, None, new_meta)
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
         _, vec_f = self.input_features

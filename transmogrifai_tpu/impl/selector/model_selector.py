@@ -21,6 +21,7 @@ from ...table import Column, FeatureTable
 from ...types import OPVector, Prediction, RealNN
 from ..tuning.splitters import DataSplitter, PreparedData, Splitter
 from ..tuning.validators import BestEstimator, OpCrossValidation, OpValidator
+from ...utils.padding import bucket_for
 
 
 @dataclass
@@ -169,8 +170,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             if vec_f.name not in full_tbl.column_names:
                 raise ValueError(
                     f"in-CV DAG did not produce feature '{vec_f.name}'")
-            Xf = jnp.asarray(np.asarray(full_tbl[vec_f.name].values,
-                                        dtype=np.float32))
+            Xf = jnp.asarray(full_tbl[vec_f.name].values, dtype=jnp.float32)
             yd = jnp.asarray(y)
             fold_results.append(self.validator.validate(
                 self.models, Xf, yd, self.problem, metric_name, larger_better,
@@ -200,7 +200,9 @@ class ModelSelector(AllowLabelAsInput, Estimator):
     def fit(self, table: FeatureTable) -> Transformer:
         label_f, vec_f = self.input_features
         y_all = np.asarray(table[label_f.name].values, dtype=np.float32).reshape(-1)
-        X_all = np.asarray(table[vec_f.name].values, dtype=np.float32)
+        # the feature matrix never visits the host: row selections for the
+        # holdout/balancer are index gathers on device
+        Xd_all = jnp.asarray(table[vec_f.name].values, dtype=jnp.float32)
         n = len(y_all)
 
         # reserve holdout (reference splitter.split in workflow fitStages)
@@ -214,7 +216,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
                 if self.splitter is not None
                 else PreparedData(indices=np.arange(len(y_train_raw))))
         sel = train_idx[prep.indices]
-        X, y = X_all[sel], y_all[sel]
+        y = y_all[sel]
         if prep.label_mapping:
             y = np.vectorize(lambda v: prep.label_mapping.get(int(v), -1))(y).astype(np.float32)
         num_classes = int(y.max()) + 1 if self.problem != "regression" else 1
@@ -222,7 +224,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             num_classes = 2
 
         metric_name, larger_better = self.validation_metric
-        Xd, yd = jnp.asarray(X), jnp.asarray(y)
+        Xd, yd = Xd_all[jnp.asarray(sel)], jnp.asarray(y)
         preset = getattr(self, "_preset_best", None)
         if preset is not None:
             # workflow-level CV already ran (find_best_estimator); skip the
@@ -235,11 +237,18 @@ class ModelSelector(AllowLabelAsInput, Estimator):
                 self.models, Xd, yd, self.problem, metric_name, larger_better,
                 num_classes)
 
-        # refit winner on full prepared train (reference :158-159)
+        # refit winner on full prepared train (reference :158-159); rows
+        # bucket-padded with zero weights for compile reuse
         family = MODEL_REGISTRY[best.family_name]
         garr = family.grid_to_arrays([best.hyper])
-        W = jnp.ones((1, len(y)), dtype=jnp.float32)
-        params_b = family.fit_batch(Xd, yd, W, garr, num_classes)
+        n_fit = len(y)
+        n_pad = bucket_for(n_fit)
+        Xf, yf = Xd, yd
+        if n_pad != n_fit:
+            Xf = jnp.pad(Xd, ((0, n_pad - n_fit), (0, 0)))
+            yf = jnp.pad(yd, (0, n_pad - n_fit))
+        W = jnp.zeros((1, n_pad), jnp.float32).at[:, :n_fit].set(1.0)
+        params_b = family.fit_batch(Xf, yf, W, garr, num_classes)
         fitted = FittedParams(
             family=family.name, params=family.select_params(params_b, 0),
             hyper=dict(best.hyper), num_classes=num_classes)
@@ -314,9 +323,15 @@ class SelectedModel(AllowLabelAsInput, Transformer):
 
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features
-        X = jnp.asarray(np.asarray(table[vec_f.name].values, dtype=np.float32))
+        X = jnp.asarray(table[vec_f.name].values, dtype=jnp.float32)
+        n = X.shape[0]
+        n_pad = bucket_for(n)
+        if n_pad != n:  # bucket rows so the predict program is reused
+            X = jnp.pad(X, ((0, n_pad - n), (0, 0)))
         family = MODEL_REGISTRY[self.fitted.family]
         parts = family.predict_one(self.fitted, X)
+        if n_pad != n:
+            parts = {k: v[:n] for k, v in parts.items()}
         parts = dict(parts,
                      prediction=self._unmap_prediction(parts["prediction"]))
         return prediction_column(parts)

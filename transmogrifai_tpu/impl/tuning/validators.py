@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
+from ...utils.padding import bucket_for
 from ...ops.metrics import (
     aupr_masked, auroc_masked, binary_threshold_metrics_masked,
     log_loss_masked, multiclass_metrics_masked, regression_metrics_masked,
@@ -143,17 +144,19 @@ class OpValidator:
         if val_masks is None:
             val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
-        if self.mesh is not None:
-            # equal shards need n divisible by the data axis: pad with
-            # zero-weight rows (excluded from fits and from val masks)
-            n_data = self.mesh.shape["data"]
-            n_pad = ((n + n_data - 1) // n_data) * n_data
-            if n_pad != n:
-                X = jnp.pad(X, ((0, n_pad - n),) + ((0, 0),) * (X.ndim - 1))
-                y = jnp.pad(y, (0, n_pad - n))
-                val_masks = np.pad(val_masks, ((0, 0), (0, n_pad - n)))
+        # bucket the row count so every fit/predict/metric program is reused
+        # across datasets/folds/stages (utils/padding.py); under a mesh the
+        # bucket also aligns to the data axis for equal shards. Pad rows
+        # carry zero weight and False val masks — results are unchanged.
+        n_data = self.mesh.shape["data"] if self.mesh is not None else 1
+        n_pad = bucket_for(n, multiple_of=n_data)
+        if n_pad != n:
+            X = jnp.pad(X, ((0, n_pad - n),) + ((0, 0),) * (X.ndim - 1))
+            y = jnp.pad(y, (0, n_pad - n))
+            val_masks = np.pad(np.asarray(val_masks),
+                               ((0, 0), (0, n_pad - n)))
         train_w = jnp.asarray(~val_masks, dtype=jnp.float32)    # (F, n)
-        if self.mesh is not None and n_pad != n:
+        if n_pad != n:
             train_w = train_w.at[:, n:].set(0.0)
         val_m = jnp.asarray(val_masks)                          # (F, n)
         metric = _metric_fn(problem, metric_name)

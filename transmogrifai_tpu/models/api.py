@@ -15,6 +15,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+
+from ..utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
 import numpy as np
 
 

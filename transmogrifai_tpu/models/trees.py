@@ -52,6 +52,10 @@ N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 #: criteria keep full-data semantics); leaf values use ALL rows.
 _HIST_SAMPLE = 65536
 
+#: trees per chunk in the exact-leaf full-data pass (bounds the (rows,
+#: trees·leaves) one-hot transient)
+_LEAF_CHUNK = 8
+
 
 # ---------------------------------------------------------------------------
 # Binning
@@ -374,11 +378,29 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
             return f, th, bh
 
         fs, ths, bhs = jax.vmap(grow_t)(jnp.arange(n_trees))   # (T, H)
-        node = _route_cmp(cmp_full, fs, bhs, depth, n_bins, d)  # (n, T)
-        ls, lw = _leaf_reduce_forest(node, stats, w, depth)     # (T, L, k)
-        leaves = (jax.vmap(_class_leaf)(ls, lw)
-                  if task == "classification"
-                  else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+
+        # exact full-data leaf stats in chunks of _LEAF_CHUNK trees: the
+        # all-trees-at-once (n, T·L) leaf-one-hot peaks several GB at
+        # millions of rows; per-chunk it is (n, C·L) while the matmuls stay
+        # batched. Padded chunk slots carry sentinel heaps (all rows → leaf
+        # 0) and are dropped after.
+        C = _LEAF_CHUNK
+        T_pad = -(-n_trees // C) * C
+        fs_p = jnp.pad(fs, ((0, T_pad - n_trees), (0, 0)))
+        bhs_p = jnp.pad(bhs, ((0, T_pad - n_trees), (0, 0)),
+                        constant_values=n_bins)
+
+        def leaf_chunk(args):
+            f_c, bh_c = args                                   # (C, H)
+            node = _route_cmp(cmp_full, f_c, bh_c, depth, n_bins, d)
+            ls, lw = _leaf_reduce_forest(node, stats, w, depth)
+            return (jax.vmap(_class_leaf)(ls, lw)
+                    if task == "classification"
+                    else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+
+        lv = jax.lax.map(leaf_chunk, (fs_p.reshape(T_pad // C, C, -1),
+                                      bhs_p.reshape(T_pad // C, C, -1)))
+        leaves = lv.reshape(T_pad, *lv.shape[2:])[:n_trees]    # (T, L, k)
         return fs, ths, bhs, leaves
 
     feat, thr, bheap, leaf = jax.lax.map(

@@ -15,6 +15,10 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
 import numpy as np
 
 

@@ -318,6 +318,29 @@ class SequenceTransformer(_LambdaTransformer):
         return Column.of_values(self.output_type, vals)
 
 
+class BinarySequenceTransformer(SequenceTransformer):
+    """One distinguished input + variadic homogeneous rest (reference
+    base/sequence/BinarySequenceTransformer.scala): transform_fn receives
+    (head_value, [rest_values])."""
+
+    def _check_input_length(self, features):
+        if len(features) < 2:
+            raise ValueError(
+                f"{type(self).__name__} needs a head input plus at least one "
+                f"sequence input")
+
+
+class _BinarySequenceEstimatorMixin:
+    """fit_fn receives (head_column, [rest_columns]) (reference
+    base/sequence/BinarySequenceEstimator.scala)."""
+
+    def fit(self, table):
+        cols = [table[f.name] for f in self.input_features]
+        state = self.fit_fn(cols[0], cols[1:])
+        model = self.make_model(state)
+        return self._finalize_model(model)
+
+
 class _LambdaEstimator(Estimator):
     """Estimator from a fit function: fit_fn(columns...) → transform lambdas."""
 
@@ -352,6 +375,24 @@ class BinaryEstimator(_LambdaEstimator):
         self.input_types = tuple(input_types)
 
 
+class TernaryEstimator(_LambdaEstimator):
+    """(reference base/ternary/TernaryEstimator.scala)."""
+
+    def __init__(self, operation_name, fit_fn, output_type, make_model,
+                 input_types: Tuple = (None, None, None), **kw):
+        super().__init__(operation_name, fit_fn, output_type, make_model, **kw)
+        self.input_types = tuple(input_types)
+
+
+class QuaternaryEstimator(_LambdaEstimator):
+    """(reference base/quaternary/QuaternaryEstimator.scala)."""
+
+    def __init__(self, operation_name, fit_fn, output_type, make_model,
+                 input_types: Tuple = (None, None, None, None), **kw):
+        super().__init__(operation_name, fit_fn, output_type, make_model, **kw)
+        self.input_types = tuple(input_types)
+
+
 class SequenceEstimator(_LambdaEstimator):
     """Variadic homogeneous-input estimator (reference
     base/sequence/SequenceEstimator.scala:57) — base of all multi-feature
@@ -366,3 +407,13 @@ class SequenceEstimator(_LambdaEstimator):
         state = self.fit_fn(cols)
         model = self.make_model(state)
         return self._finalize_model(model)
+
+class BinarySequenceEstimator(_BinarySequenceEstimatorMixin, SequenceEstimator):
+    """(reference base/sequence/BinarySequenceEstimator.scala)."""
+
+    def _check_input_length(self, features):
+        if len(features) < 2:
+            raise ValueError(
+                f"{type(self).__name__} needs a head input plus at least one "
+                f"sequence input")
+

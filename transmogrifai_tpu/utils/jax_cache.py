@@ -1,0 +1,35 @@
+"""Persistent XLA compilation cache setup.
+
+First compilation of each jitted program costs seconds (tens of seconds on
+remote-compile backends); the reference has no analog cost because Spark
+plans interpret immediately. Enabling jax's persistent compilation cache
+makes every run after the first skip straight to execution for unchanged
+program shapes. Applied once, lazily, from the modules that first touch jax;
+a user-set ``jax_compilation_cache_dir`` (or ``JAX_COMPILATION_CACHE_DIR``)
+always wins.
+"""
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def ensure_compilation_cache() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    try:
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            return  # user already configured one
+        d = os.environ.get(
+            "TRANSMOGRIFAI_TPU_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "transmogrifai_tpu", "jax"))
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cacheless operation is only slower, never wrong

@@ -1,0 +1,40 @@
+"""Row-count bucketing for compile reuse.
+
+Under jit every distinct row count is a distinct XLA program; AutoML
+pipelines naturally produce many (raw n, balanced n, per-fold n, holdout n),
+which would recompile every fit/predict/metric kernel per size. Padding the
+row axis up to a coarse geometric grid of bucket sizes makes shapes repeat,
+so each program compiles once and is reused across stages, datasets and
+runs (with the persistent compilation cache). Padding rows carry zero weight
+/ False masks everywhere, so results are bit-identical to unpadded runs.
+
+The grid: multiples of 256 on a ~1.19× geometric ladder (4 buckets per
+octave) — at most ~19% wasted FLOPs, ~26 distinct shapes across 1k → 1B
+rows.
+"""
+from __future__ import annotations
+
+import math
+
+_STEPS_PER_OCTAVE = 4
+_MIN_BUCKET = 256
+
+
+def row_bucket(n: int) -> int:
+    """Smallest bucket ≥ n on the geometric grid."""
+    if n <= _MIN_BUCKET:
+        return _MIN_BUCKET
+    k = math.ceil(_STEPS_PER_OCTAVE * math.log2(n / _MIN_BUCKET))
+    b = _MIN_BUCKET * 2 ** (k / _STEPS_PER_OCTAVE)
+    b = int(math.ceil(b / _MIN_BUCKET) * _MIN_BUCKET)
+    while b < n:  # guard rounding
+        b += _MIN_BUCKET
+    return b
+
+
+def bucket_for(n: int, multiple_of: int = 1) -> int:
+    """Bucket ≥ n that is also a multiple of ``multiple_of`` (mesh shards)."""
+    b = row_bucket(n)
+    if multiple_of > 1:
+        b = int(math.ceil(b / multiple_of) * multiple_of)
+    return b
