@@ -1,0 +1,73 @@
+"""Adversarial feature patterns the SanityChecker must catch (model:
+reference core/src/test/.../BadFeatureZooTest.scala — seeded testkit data
+with planted leakers/constants, asserting the checker's removals)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.workflow import OpWorkflow
+
+
+def _zoo(n=2000, seed=11):
+    rng = np.random.RandomState(seed)
+    y = (rng.rand(n) > 0.5).astype(float)
+    df = pd.DataFrame({
+        "y": y,
+        "good": rng.randn(n) + 0.3 * y,          # mildly predictive, keep
+        "constant": np.full(n, 3.14),             # zero variance
+        "label_copy": y * 2.0 - 1.0,              # perfectly correlated leaker
+        # categorical that encodes the label exactly (Cramér's V = 1)
+        "cat_leak": np.where(y > 0.5, "pos", "neg"),
+        # ordinary categorical, keep
+        "cat_ok": rng.choice(["a", "b", "c"], n),
+    })
+    return df
+
+
+@pytest.fixture(scope="module")
+def checked_meta():
+    df = _zoo()
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real("good").extract_field().as_predictor(),
+             FeatureBuilder.Real("constant").extract_field().as_predictor(),
+             FeatureBuilder.Real("label_copy").extract_field().as_predictor(),
+             FeatureBuilder.PickList("cat_leak").extract_field().as_predictor(),
+             FeatureBuilder.PickList("cat_ok").extract_field().as_predictor()]
+    vec = tg.transmogrify(feats)
+    checked = vec.sanity_check(label)
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(checked).train())
+    sc = model.get_stage(checked.origin_stage.uid)
+    out = model.score(df=df)
+    kept_meta = out[checked.name].metadata["vector_meta"]
+    return sc.summary, [c.parent_feature_name for c in kept_meta.columns]
+
+
+def test_constant_feature_dropped(checked_meta):
+    summary, kept_parents = checked_meta
+    assert "constant" not in kept_parents
+    reasons = summary["reasons"]
+    assert any("variance" in " ".join(r) for f, r in reasons.items()
+               if f.startswith("constant"))
+
+
+def test_label_copy_dropped(checked_meta):
+    summary, kept_parents = checked_meta
+    assert "label_copy" not in kept_parents
+    reasons = summary["reasons"]
+    assert any("corr" in " ".join(r).lower() for f, r in reasons.items()
+               if f.startswith("label_copy"))
+
+
+def test_categorical_leaker_dropped(checked_meta):
+    summary, kept_parents = checked_meta
+    # every pivot column of the leaking categorical must be gone
+    assert "cat_leak" not in kept_parents
+
+
+def test_good_features_kept(checked_meta):
+    _, kept_parents = checked_meta
+    assert "good" in kept_parents
+    assert "cat_ok" in kept_parents
