@@ -43,12 +43,23 @@ def _fit_glm(X, y, w, reg, fam, var_power, iters=25):
     p = jnp.where(fam == FAMILY_CODES["poisson"], 1.0,
                   jnp.where(fam == FAMILY_CODES["gamma"], 2.0, var_power))
 
-    def step(theta, _):
+    def deviance(theta):
+        """Weighted mean deviance-like loss per family (log link):
+        gaussian: squared error; others: -y·η + μ (poisson-shaped surrogate,
+        monotone in fit quality for the log-link families)."""
+        eta = jnp.clip(Xa @ theta, -30.0, 30.0)
+        mu = jnp.exp(eta)
+        loss_log = (mu - y * eta)
+        loss_gauss = 0.5 * (y - eta) ** 2
+        return (jnp.where(is_gauss, loss_gauss, loss_log) * w).sum() / cnt
+
+    def step(carry, _):
+        theta, best_theta, best_loss = carry
         eta = Xa @ theta
         mu = jnp.exp(jnp.clip(eta, -30.0, 30.0))
         # log link: W = μ^(2-p), z = η + (y-μ)/μ ; identity: W = 1, z = y
         W_log = jnp.power(jnp.maximum(mu, 1e-12), 2.0 - p)
-        z_log = eta + (y - mu) / jnp.maximum(mu, 1e-12)
+        z_log = jnp.clip(eta + (y - mu) / jnp.maximum(mu, 1e-12), -1e6, 1e6)
         W = jnp.where(is_gauss, 1.0, W_log) * w
         z = jnp.where(is_gauss, y, z_log)
         A = jnp.einsum("ni,nj->ij", Xa * W[:, None], Xa,
@@ -57,10 +68,20 @@ def _fit_glm(X, y, w, reg, fam, var_power, iters=25):
             [jnp.full((d,), reg), jnp.zeros((1,))])) \
             + 1e-8 * jnp.eye(d + 1, dtype=X.dtype)
         rhs = (Xa * (W * z)[:, None]).sum(0) / cnt
-        return jnp.linalg.solve(A, rhs), None
+        prop = jnp.linalg.solve(A, rhs)
+        prop = jnp.where(jnp.all(jnp.isfinite(prop)), prop, theta)
+        # divergence guard: track the best iterate (mismatched family/link
+        # configs — e.g. log link on negative targets — oscillate or blow
+        # up; keep the best-deviance parameters instead of the last)
+        loss = deviance(prop)
+        better = loss < best_loss
+        best_theta = jnp.where(better, prop, best_theta)
+        best_loss = jnp.where(better, loss, best_loss)
+        return (prop, best_theta, best_loss), None
 
     theta0 = jnp.zeros((d + 1,), X.dtype)
-    theta, _ = jax.lax.scan(step, theta0, None, length=iters)
+    init = (theta0, theta0, deviance(theta0))
+    (_, theta, _), _ = jax.lax.scan(step, init, None, length=iters)
     return theta[:d], theta[d]
 
 
