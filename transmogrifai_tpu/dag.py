@@ -70,20 +70,36 @@ _NULL_PROFILER = _NullProfiler()
 
 def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                           profiler: Optional[Any] = None,
+                          checkpoint: Optional[Any] = None,
+                          preloaded: Optional[Dict[str, Any]] = None,
                           ) -> Tuple[FeatureTable, Dict[str, Any]]:
     """Fit estimators layer-by-layer, transforming as we go (reference
     FitStagesUtil.fitAndTransformDAG / fitAndTransformLayer).
 
+    ``checkpoint(model)`` is invoked after each estimator fit and
+    ``preloaded`` {uid → fitted model} skips refitting — together they give
+    crash-resumable training (the analog of the reference's persist-every-K
+    resilience, OpWorkflowModel.scala:449-455).
+
     Returns (transformed table, {estimator uid → fitted model}).
     """
     prof = profiler or _NULL_PROFILER
+    pre = preloaded or {}
     fitted: Dict[str, Any] = {}
     for li, layer in enumerate(layers):
         models: List[Transformer] = []
         for stage, _ in layer:
             if isinstance(stage, Estimator):
-                with prof.track(stage, "fit", li):
-                    model = stage.fit(table)
+                if stage.uid in pre:
+                    model = pre[stage.uid]
+                    # re-wire onto this DAG's features (uids match)
+                    model.input_features = stage.input_features
+                    model._output_feature = stage.get_output()
+                else:
+                    with prof.track(stage, "fit", li):
+                        model = stage.fit(table)
+                    if checkpoint is not None:
+                        checkpoint(model)
                 fitted[stage.uid] = model
                 models.append(model)
             elif isinstance(stage, Transformer):

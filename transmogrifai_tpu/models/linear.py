@@ -100,7 +100,7 @@ class _BatchStd:
 
 
 @partial(jax.jit, static_argnames=("newton_iters", "cg_iters"))
-def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=12, cg_iters=10):
+def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=10, cg_iters=8):
     """Fit B logistic regressions at once. W: (B, n) per-config row weights;
     reg/elastic_net: (B,). Returns (coef (B, d), bias (B,)) in original scale.
     """

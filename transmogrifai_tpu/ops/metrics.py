@@ -59,20 +59,23 @@ def _binned_hists(scores: jnp.ndarray, labels: jnp.ndarray,
         sl = lambda a: jax.lax.dynamic_slice_in_dim(a, k * _HIST_CHUNK,
                                                     _HIST_CHUNK)
         h, l = sl(hi), sl(lo)
-        wp = sl(pos).astype(jnp.bfloat16)   # 0/1 weights: exact in bf16
-        wa = sl(w).astype(jnp.bfloat16)
-        oh_hi = (h[:, None] == iot_hi).astype(jnp.bfloat16)
-        oh_lo = (l[:, None] == iot_lo).astype(jnp.bfloat16)
+        # 0/1 weights: int8 operands with int32 accumulation are exact and
+        # run the MXU at twice the bf16 rate on v5e
+        wp = sl(pos).astype(jnp.int8)
+        wa = sl(w).astype(jnp.int8)
+        oh_hi = (h[:, None] == iot_hi).astype(jnp.int8)
+        oh_lo = (l[:, None] == iot_lo).astype(jnp.int8)
         hp = hp + jnp.einsum("nh,nl->hl", oh_hi * wp[:, None], oh_lo,
-                             preferred_element_type=jnp.float32)
+                             preferred_element_type=jnp.int32)
         ha = ha + jnp.einsum("nh,nl->hl", oh_hi * wa[:, None], oh_lo,
-                             preferred_element_type=jnp.float32)
+                             preferred_element_type=jnp.int32)
         return (hp, ha), None
 
-    z = jnp.zeros((_HI, _LO), jnp.float32)
+    z = jnp.zeros((_HI, _LO), jnp.int32)
     (hp, ha), _ = jax.lax.scan(step, (z, z),
                                jnp.arange((n + pad) // _HIST_CHUNK))
-    return hp.reshape(-1), ha.reshape(-1)
+    return (hp.reshape(-1).astype(jnp.float32),
+            ha.reshape(-1).astype(jnp.float32))
 
 
 def _auroc_from_hists(hp: jnp.ndarray, ha: jnp.ndarray) -> jnp.ndarray:

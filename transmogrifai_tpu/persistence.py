@@ -354,3 +354,43 @@ def load_model(path: str, workflow=None):
     from .dag import compute_dag
     model._layers = compute_dag(model.result_features)
     return model
+
+
+# ---------------------------------------------------------------------------
+# Per-stage training checkpoints (the TPU build's resilience analog of the
+# reference's persist-every-K-stages, OpWorkflowModel.scala:449-455 /
+# FitStagesUtil.scala:125-131: deterministic re-execution from saved fitted
+# stage state instead of Spark lineage recomputation)
+# ---------------------------------------------------------------------------
+
+def save_stage_checkpoint(stage: OpPipelineStage, ckpt_dir: str) -> None:
+    """Persist one fitted stage as <uid>.json + <uid>.npz."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays = _Arrays()
+    desc = stage_to_json(stage, arrays)
+    with open(os.path.join(ckpt_dir, f"{stage.uid}.json"), "w") as fh:
+        json.dump(desc, fh)
+    np.savez_compressed(os.path.join(ckpt_dir, f"{stage.uid}.npz"),
+                        **arrays.store)
+
+
+def load_stage_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
+    """Load every stage checkpoint in ``ckpt_dir``, keyed by uid. Corrupt or
+    partially-written entries are skipped (they refit instead)."""
+    out: Dict[str, OpPipelineStage] = {}
+    if not os.path.isdir(ckpt_dir):
+        return out
+    for fname in os.listdir(ckpt_dir):
+        if not fname.endswith(".json"):
+            continue
+        uid = fname[:-5]
+        try:
+            with open(os.path.join(ckpt_dir, fname)) as fh:
+                desc = json.load(fh)
+            with np.load(os.path.join(ckpt_dir, f"{uid}.npz"),
+                         allow_pickle=False) as npz:
+                arrays = dict(npz)
+            out[uid] = stage_from_json(desc, arrays)
+        except Exception:
+            continue
+    return out

@@ -97,6 +97,17 @@ class OpWorkflow(_WorkflowCore):
         self.profiler = profiler or StageProfiler()
         return self
 
+    def with_checkpoint_dir(self, path: str) -> "OpWorkflow":
+        """Crash-resumable training: every fitted estimator persists to
+        ``path`` as it completes, and a re-run skips stages already
+        checkpointed there (matched by uid). The TPU build's analog of the
+        reference's persist-every-K-stages resilience
+        (OpWorkflowModel.scala:449-455, FitStagesUtil.scala:125-131) —
+        deterministic re-execution from saved state instead of Spark lineage
+        recomputation."""
+        self._checkpoint_dir = path
+        return self
+
     def with_mesh(self, mesh) -> "OpWorkflow":
         """Distribute training over a ('data', 'model') device mesh: every
         stage exposing ``set_mesh`` (ModelSelector — rows over 'data',
@@ -166,11 +177,21 @@ class OpWorkflow(_WorkflowCore):
                 for s, _ in layer:
                     if hasattr(s, "set_mesh"):
                         s.set_mesh(mesh)
+        ckpt_dir = getattr(self, "_checkpoint_dir", None)
+        checkpoint = None
+        preloaded = None
+        if ckpt_dir is not None:
+            from .persistence import (load_stage_checkpoints,
+                                      save_stage_checkpoint)
+            preloaded = load_stage_checkpoints(ckpt_dir)
+            checkpoint = lambda model: save_stage_checkpoint(model, ckpt_dir)
         if self._workflow_cv:
             table, fitted = self._fit_with_workflow_cv(table, layers)
         else:
             table, fitted = fit_and_transform_dag(table, layers,
-                                                  profiler=self.profiler)
+                                                  profiler=self.profiler,
+                                                  checkpoint=checkpoint,
+                                                  preloaded=preloaded)
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in result_features)
         model = OpWorkflowModel()
