@@ -370,3 +370,51 @@ class TestTransmogrifierDispatch:
         # every group contributed slots
         parents = {c.parent_feature_name for c in vm.columns}
         assert parents == {"d", "geo", "rm", "tm"}
+
+
+def test_text_map_null_estimator():
+    from transmogrifai_tpu.impl.feature.maps import TextMapNullEstimator
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.table import FeatureTable
+    from transmogrifai_tpu.types import TextMap
+    import numpy as np
+    f = FeatureBuilder("m", TextMap).extract_field().as_predictor()
+    tbl = FeatureTable.from_columns({"m": (TextMap, [
+        {"a": "x", "b": "y"}, {"a": ""}, None, {"b": "z"}])})
+    model = TextMapNullEstimator().set_input(f).fit(tbl)
+    out = model.transform_column(tbl)
+    vm = out.metadata["vector_meta"]
+    keys = [c.grouping for c in vm.columns]
+    assert keys == ["a", "b"]
+    mat = np.asarray(out.values)
+    # row0 has both → no nulls; row1 a empty → null; row2 all null
+    assert mat[0].tolist() == [0.0, 0.0]
+    assert mat[1].tolist() == [1.0, 1.0]
+    assert mat[2].tolist() == [1.0, 1.0]
+    assert mat[3].tolist() == [1.0, 0.0]
+    # row dual agrees
+    assert model.transform_row({"m": {"b": "z"}}) == [1.0, 0.0]
+
+
+def test_op_collection_transformers():
+    from transmogrifai_tpu.impl.feature.math import (
+        OPCollectionTransformer, OPListTransformer, OPMapTransformer,
+        OPSetTransformer,
+    )
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.table import FeatureTable
+    from transmogrifai_tpu.types import MultiPickList, TextList, TextMap
+    fl = FeatureBuilder("l", TextList).extract_field().as_predictor()
+    tbl = FeatureTable.from_columns({"l": (TextList, [["a", "b"], None, []])})
+    up = OPListTransformer(lambda s: s.upper()).set_input(fl)
+    out = up.transform_column(tbl)
+    assert out.values[0] == ["A", "B"]
+    assert up.transform_row({"l": ["x"]}) == ["X"]
+    fs = FeatureBuilder("s", MultiPickList).extract_field().as_predictor()
+    tbl2 = FeatureTable.from_columns({"s": (MultiPickList, [{"a", "b"}])})
+    st = OPSetTransformer(lambda s: s + "!").set_input(fs)
+    assert st.transform_column(tbl2).values[0] == {"a!", "b!"}
+    fm = FeatureBuilder("m", TextMap).extract_field().as_predictor()
+    tbl3 = FeatureTable.from_columns({"m": (TextMap, [{"k": "v"}])})
+    mt = OPMapTransformer(lambda s: s * 2, TextMap).set_input(fm)
+    assert mt.transform_column(tbl3).values[0] == {"k": "vv"}

@@ -168,3 +168,57 @@ def test_email_url():
     assert dom.values[0] == "www.example.com"
     iv = IsValidUrl().set_input(u).transform_column(tbl2)
     assert np.asarray(iv.values)[1] == 0.0
+
+
+def test_string_indexer_no_filter_round_trip():
+    from transmogrifai_tpu.impl.feature.text import (
+        OpIndexToStringNoFilter, OpStringIndexerNoFilter, UNSEEN_LABEL,
+    )
+    f = _feat("t", Text)
+    tbl = _tbl(t=(Text, ["b", "a", "b", None, "zz"]))
+    model = OpStringIndexerNoFilter().set_input(f).fit(tbl)
+    out = np.asarray(model.transform_column(tbl).values)
+    # every row gets an index; unseen bucket = len(labels)
+    assert len(out) == 5 and np.all(out >= 0)
+    assert model.summary_metadata["labels"][-1] == UNSEEN_LABEL
+    inv = OpIndexToStringNoFilter(model.labels).set_input(model.get_output())
+    tbl2 = tbl.with_column(model.get_output().name, model.transform_column(tbl))
+    back = inv.transform_column(tbl2)
+    assert back.values[0] == "b" and back.values[1] == "a"
+    # null text indexed into the unseen bucket round-trips to UnseenLabel
+    assert back.values[3] == UNSEEN_LABEL
+    assert inv.transform_fn(None) == UNSEEN_LABEL
+
+
+def test_no_filter_null_vs_empty_and_nan():
+    from transmogrifai_tpu.impl.feature.text import (
+        OpIndexToStringNoFilter, OpStringIndexerNoFilter, UNSEEN_LABEL,
+    )
+    f = _feat("t", Text)
+    # "" is in the training vocabulary; null must STILL go to the unseen bucket
+    tbl = _tbl(t=(Text, ["", "a", None]))
+    model = OpStringIndexerNoFilter().set_input(f).fit(tbl)
+    out = np.asarray(model.transform_column(tbl).values)
+    assert out[2] == len(model.labels)           # null → unseen, not ""
+    assert out[0] != out[2]
+    inv = OpIndexToStringNoFilter(model.labels).set_input(model.get_output())
+    # NaN / None / out-of-range all decode to UnseenLabel, never crash
+    assert inv.transform_fn(float("nan")) == UNSEEN_LABEL
+    assert inv.transform_fn(None) == UNSEEN_LABEL
+    assert inv.transform_fn(99.0) == UNSEEN_LABEL
+    # columnar path respects the valid mask
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import RealNN
+    idx_name = model.get_output().name
+    t2 = FeatureTable({idx_name: Column.of_values(RealNN, [None, 0.0])}, 2)
+    back = inv.transform_column(t2)
+    assert back.values[0] == UNSEEN_LABEL and back.values[1] == model.labels[0]
+
+
+def test_op_collection_transform_fn_contract():
+    from transmogrifai_tpu.impl.feature.math import OPListTransformer
+    f = _feat("l", TextList)
+    up = OPListTransformer(lambda s: s.upper()).set_input(f)
+    # the documented transform_fn contract works (was shadowed to None)
+    assert up.transform_fn(["a", "b"]) == ["A", "B"]
+    assert up.transform_fn(None) is None

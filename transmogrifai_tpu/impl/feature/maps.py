@@ -304,3 +304,51 @@ class SmartTextMapVectorizerModel(_VectorModelBase):
         mat = (np.concatenate(blocks, axis=1) if blocks
                else np.zeros((n, 0), dtype=np.float32))
         return self._emit(mat, meta)
+
+
+class TextMapNullEstimator(Estimator):
+    """Seq[TextMap] → OPVector of per-key null indicators (reference
+    TextMapNullEstimator.scala:108). An estimator because the key space must
+    be discovered from the training data; the fitted model emits one
+    null-indicator slot per (feature, key)."""
+
+    output_type = OPVector
+
+    def __init__(self, white_list_keys: Sequence[str] = (),
+                 black_list_keys: Sequence[str] = (), uid=None):
+        super().__init__("textMapNull", uid)
+        self.white_list_keys = tuple(white_list_keys)
+        self.black_list_keys = tuple(black_list_keys)
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        keys = [
+            _discover_keys(_map_rows(table[f.name]),
+                           self.white_list_keys, self.black_list_keys)
+            for f in self.input_features
+        ]
+        return self._finalize_model(TextMapNullModel(keys=keys))
+
+
+class TextMapNullModel(_VectorModelBase):
+    def __init__(self, keys: List[List[str]], uid=None):
+        super().__init__("textMapNull", uid)
+        self.keys = keys
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        n = table.num_rows
+        blocks: List[np.ndarray] = []
+        meta: List[VectorColumnMetadata] = []
+        for f, keys in zip(self.input_features, self.keys):
+            rows = _map_rows(table[f.name])
+            block = np.zeros((n, len(keys)), dtype=np.float32)
+            for j, key in enumerate(keys):
+                for i, r in enumerate(rows):
+                    v = r.get(key) if r else None
+                    if v is None or str(v) == "":
+                        block[i, j] = 1.0
+                meta.append(VectorColumnMetadata(
+                    f.name, f.type_name, key, NULL_INDICATOR))
+            blocks.append(block)
+        mat = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), dtype=np.float32))
+        return self._emit(mat, meta)

@@ -10,7 +10,7 @@ run columnar over device-eligible arrays; string/map stages stay host-side.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Type
 
 import numpy as np
 
@@ -336,3 +336,62 @@ class NGramSimilarity(BinaryTransformer):
         super().__init__("ngramSim", transform_fn=fn, output_type=RealNN,
                          input_types=(None, None), uid=uid)
         self.n = n
+
+
+# ---------------------------------------------------------------------------
+# Collection-lifted transformers (reference OPCollectionTransformer.scala:209)
+# ---------------------------------------------------------------------------
+
+
+class OPCollectionTransformer(UnaryTransformer):
+    """Lift a scalar value function over the elements of a collection feature
+    (reference OPCollectionTransformer.scala — OPList/OPSet/OPMapTransformer
+    wrap a unary stage so it applies per element). ``element_fn`` runs on each
+    list element / set member / map value; empty or null collections pass
+    through as empty."""
+
+    def __init__(self, element_fn: Callable[[Any], Any],
+                 output_type: Type[FeatureType],
+                 input_type: Optional[Type[FeatureType]] = None,
+                 operation_name: str = "collectionApply", uid=None):
+        super().__init__(operation_name, transform_fn=self._apply,
+                         output_type=output_type, input_type=input_type,
+                         uid=uid)
+        self.element_fn = element_fn
+
+    def _apply(self, v):
+        if v is None:
+            return None
+        if isinstance(v, dict):
+            return {k: self.element_fn(x) for k, x in v.items()}
+        if isinstance(v, (set, frozenset)):
+            return {self.element_fn(x) for x in v}
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return [self.element_fn(x) for x in v]
+        return self.element_fn(v)
+
+
+class OPListTransformer(OPCollectionTransformer):
+    """TextList/DateList element-wise map (reference OPListTransformer)."""
+
+    def __init__(self, element_fn, output_type=TextList, input_type=TextList,
+                 uid=None):
+        super().__init__(element_fn, output_type, input_type,
+                         operation_name="listApply", uid=uid)
+
+
+class OPSetTransformer(OPCollectionTransformer):
+    """MultiPickList element-wise map (reference OPSetTransformer)."""
+
+    def __init__(self, element_fn, output_type=MultiPickList,
+                 input_type=MultiPickList, uid=None):
+        super().__init__(element_fn, output_type, input_type,
+                         operation_name="setApply", uid=uid)
+
+
+class OPMapTransformer(OPCollectionTransformer):
+    """Map value-wise map, keys preserved (reference OPMapTransformer)."""
+
+    def __init__(self, element_fn, output_type, input_type=None, uid=None):
+        super().__init__(element_fn, output_type, input_type,
+                         operation_name="mapApply", uid=uid)

@@ -191,10 +191,15 @@ class OpStringIndexerModel(Transformer):
         super().__init__("strIdx", uid)
         self.labels = labels
         self.handle_invalid = handle_invalid
+        #: NoFilter variant: null always goes to the unseen bucket, even when
+        #: "" is a trained label (null and empty must not conflate there)
+        self.null_to_unseen = False
 
     def _index(self, v: Optional[str]) -> Optional[float]:
         index = {t: i for i, t in enumerate(self.labels)}
         if v is None:
+            if self.null_to_unseen:
+                return float(len(self.labels))
             v = ""
         j = index.get(str(v))
         if j is not None:
@@ -214,6 +219,30 @@ class OpStringIndexerModel(Transformer):
 
     def transform_fn(self, v):
         return self._index(v)
+
+
+#: label used by the NoFilter indexer variants for out-of-vocabulary values
+UNSEEN_LABEL = "UnseenLabel"
+
+
+class OpStringIndexerNoFilter(OpStringIndexer):
+    """Text → RealNN index that never drops rows (reference
+    OpStringIndexerNoFilter.scala): unseen/null values all map to the
+    reserved ``UnseenLabel`` index (= vocab size) so the full label set
+    round-trips through OpIndexToStringNoFilter."""
+
+    def __init__(self, unseen_name: str = UNSEEN_LABEL, uid=None):
+        super().__init__(handle_invalid="keep", uid=uid)
+        self.unseen_name = unseen_name
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        model = super().fit(table)
+        model.null_to_unseen = True
+        model.summary_metadata = {
+            "labels": model.labels + [self.unseen_name],
+            "unseenName": self.unseen_name,
+        }
+        return model
 
 
 class OpIndexToString(Transformer):
@@ -236,6 +265,38 @@ class OpIndexToString(Transformer):
     def transform_fn(self, v):
         i = int(v) if v is not None else -1
         return self.labels[i] if 0 <= i < len(self.labels) else None
+
+
+class OpIndexToStringNoFilter(OpIndexToString):
+    """RealNN index → Text label, with out-of-range indices mapped to the
+    reserved ``unseen_name`` instead of null (reference
+    OpIndexToStringNoFilter.scala — the inverse of OpStringIndexerNoFilter,
+    so label round-trips are total)."""
+
+    def __init__(self, labels: Sequence[str], unseen_name: str = UNSEEN_LABEL,
+                 uid=None):
+        super().__init__(labels, uid=uid)
+        self.unseen_name = unseen_name
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        valid = col.valid_mask()
+        raw = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        out = []
+        for i in range(len(raw)):
+            if not valid[i] or np.isnan(raw[i]):
+                out.append(self.unseen_name)
+                continue
+            j = int(raw[i])
+            out.append(self.labels[j] if 0 <= j < len(self.labels)
+                       else self.unseen_name)
+        return Column.of_values(Text, out)
+
+    def transform_fn(self, v):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return self.unseen_name
+        i = int(v)
+        return self.labels[i] if 0 <= i < len(self.labels) else self.unseen_name
 
 
 # ---------------------------------------------------------------------------
