@@ -16,7 +16,11 @@ import pytest
 # The axon sitecustomize registers the tunneled-TPU PJRT plugin in every
 # interpreter; jax's backends() initializes every registered factory, so a
 # slow/wedged tunnel would stall CPU-only tests. Deregister non-CPU factories
-# before any backend initialization.
+# before any backend initialization. Import modules that lazily register
+# per-platform lowering rules FIRST — registering against a deregistered
+# platform raises (e.g. checkify via pallas interpret mode).
+from jax._src import checkify as _checkify  # noqa: F401
+from jax.experimental import pallas as _pl  # noqa: F401
 from jax._src import xla_bridge as _xb
 
 for _name in list(_xb._backend_factories):

@@ -18,8 +18,9 @@ XGBoost's C++:
   strided row sample (≤ _HIST_SAMPLE rows, weights rescaled by n/S — the
   XGBoost 'approx'/GOSS design point: split thresholds are order-statistic
   estimates and converge long before 65k rows), and each level's histogram
-  is ONE matmul — (nodes⊗stats)ᵀ @ bin-one-hot — against a bin one-hot
-  matrix built once per fit. Leaf statistics stay EXACT: the full dataset is
+  is ONE matmul — (nodes⊗stats)ᵀ expanded against the int32 bin codes by
+  the fused pallas kernel (ops/tree_hist.py): the bin one-hot is built
+  tile-by-tile in VMEM and never reaches HBM. Leaf statistics stay EXACT: the full dataset is
   routed down the grown tree (bin-space comparisons identical to growth) and
   reduced with a leaf-one-hot matmul. Scatter-free end to end, so the whole
   builder tiles onto the MXU and scales to millions of rows.
@@ -43,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.tree_hist import hist_matmul, route_matmul
 from .api import FittedParams, ModelFamily, register_family
 
 N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
@@ -82,56 +84,35 @@ def _sample_rows(n: int) -> np.ndarray:
     return np.linspace(0, n - 1, _HIST_SAMPLE).astype(np.int64)
 
 
-def _bin_one_hot(binned_s: jnp.ndarray, n_bins: int) -> jnp.ndarray:
-    """(S, d·n_bins) bf16 one-hot of the sampled bin matrix — the constant
-    RHS of every level histogram matmul, built once per fit."""
-    S, d = binned_s.shape
-    oh = (binned_s[:, :, None]
-          == jnp.arange(n_bins, dtype=jnp.int32)).astype(jnp.bfloat16)
-    return oh.reshape(S, d * n_bins)
-
-
-def _cmp_matrix(binned: jnp.ndarray, n_bins: int) -> jnp.ndarray:
-    """(n, d·n_bins) bf16 decision bits: CMP[r, f·nb+b] = 1[bin(r,f) > b].
-
-    One matmul of CMP against a per-level (feature, bin) selector answers
-    'does row r go right at node j' for every row and node at once — routing
-    becomes MXU work instead of per-row gathers."""
-    n, d = binned.shape
-    cmp = (binned[:, :, None]
-           > jnp.arange(n_bins, dtype=jnp.int32)).astype(jnp.bfloat16)
-    return cmp.reshape(n, d * n_bins)
-
-
-def _level_sel(feat_lvl: jnp.ndarray, bin_lvl: jnp.ndarray, d: int,
-               n_bins: int) -> jnp.ndarray:
-    """(m, d·n_bins) bf16 selector: row j is one-hot at (feat_j, bin_j); the
-    sentinel bin n_bins gives an all-zero row (decision 0 → go left)."""
-    fb = feat_lvl * n_bins + jnp.minimum(bin_lvl, n_bins - 1)
-    oh = ((fb[:, None] == jnp.arange(d * n_bins, dtype=jnp.int32))
-          & (bin_lvl < n_bins)[:, None])
-    return oh.astype(jnp.bfloat16)
-
-
-def _route_cmp(cmp: jnp.ndarray, feat_heaps: jnp.ndarray,
-               bin_heaps: jnp.ndarray, depth: int, n_bins: int,
-               d: int) -> jnp.ndarray:
-    """Route every row down T trees at once with one decision matmul per
-    level: D = CMP @ selᵀ → (n, T·m) go-right bits, picked per row by a fused
-    node-one-hot reduction. feat/bin heaps: (T, 2^depth−1). Returns (n, T)
-    leaf assignments in [0, 2^depth)."""
-    n = cmp.shape[0]
+def _route_codes(codes: jnp.ndarray, feat_heaps: jnp.ndarray,
+                 bin_heaps: jnp.ndarray, depth: int, n_bins: int,
+                 d: int) -> jnp.ndarray:
+    """Route every row down T trees at once: per level the fused pallas
+    kernel (ops/tree_hist.py route_matmul) expands the bin codes' comparison
+    bits in VMEM and matmuls them against the level's (feature, bin)
+    selector — the (n, d·n_bins) cmp matrix (4 GB at 1M rows × 64 features)
+    never exists. Go-right bits are picked per row by a fused node-one-hot
+    reduction. feat/bin heaps: (T, 2^depth−1). Returns (n, T) leaf
+    assignments in [0, 2^depth). Every level pads its node axis to the
+    deepest level's width: on the pallas path that makes the whole loop one
+    kernel program, and on the XLA path the 128-wide contraction measures
+    FASTER than exact tiny widths (RF leaf pass 4.0s vs 5.8s at 1M rows) —
+    see the dispatch note in ops/tree_hist.py for why the cmp build also
+    stays inside each call."""
+    n = codes.shape[0]
     T = feat_heaps.shape[0]
+    m_max = 2 ** (depth - 1)
     node = jnp.zeros((n, T), jnp.int32)
     for level in range(depth):
         base = 2 ** level - 1
         m = 2 ** level
-        sel = _level_sel(feat_heaps[:, base:base + m].reshape(-1),
-                         bin_heaps[:, base:base + m].reshape(-1),
-                         d, n_bins)                       # (T·m, d·nb)
-        D = jnp.einsum("nf,af->na", cmp, sel,
-                       preferred_element_type=jnp.bfloat16)  # 0/1, exact
-        D = D.reshape(n, T, m)
+        f_lvl = jnp.pad(feat_heaps[:, base:base + m],
+                        ((0, 0), (0, m_max - m)))
+        b_lvl = jnp.pad(bin_heaps[:, base:base + m],
+                        ((0, 0), (0, m_max - m)), constant_values=n_bins)
+        D = route_matmul(codes, f_lvl.reshape(-1), b_lvl.reshape(-1),
+                         n_bins)
+        D = D.reshape(n, T, -1)[:, :, :m]
         n_oh = (node[:, :, None]
                 == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
         go = (D * n_oh).sum(-1)                            # (n, T)
@@ -203,24 +184,26 @@ def _split_gain(SL, SR, total, cfg, mode: str):
     return gain, valid
 
 
-def _grow_tree(bin_oh, cmp_s, edges, stats_s, w_s, feat_mask, cfg, *,
+def _grow_tree(codes_s, edges, stats_s, w_s, feat_mask, cfg, *,
                depth: int, n_bins: int, mode: str):
     """Grow one complete-heap tree on the split-search sample.
 
-    bin_oh: (S, d·n_bins) bf16 bin one-hot (shared across trees/configs);
-    cmp_s: (S, d·n_bins) bf16 decision bits (shared); stats_s: (S, k) per-row
-    stat vector; w_s: (S,) row weights (folds × bootstrap, pre-scaled by
-    n/S); feat_mask: (d,) bool; cfg: traced scalars {max_depth,
-    min_instances, min_info_gain, lam, min_child_weight}.
+    codes_s: (S, d) int32 bin codes (shared across trees/configs);
+    stats_s: (S, k) per-row stat vector; w_s: (S,) row weights (folds ×
+    bootstrap, pre-scaled by n/S); feat_mask: (d,) bool; cfg: traced scalars
+    {max_depth, min_instances, min_info_gain, lam, min_child_weight}.
 
-    Each level's histogram is ONE matmul — (node-one-hot ⊗ weighted stats)ᵀ @
-    bin_oh → (m·k, d·n_bins) — and sample routing is a decision matmul
-    against cmp_s; both batch cleanly under vmap over trees/configs (the
-    shared operand is never copied). Returns (feat_heap (2^D−1,), thresh_heap
-    (2^D−1,), bin_heap (2^D−1,) int32 with sentinel n_bins for non-splits,
-    node_s (S,) final sample leaf assignment).
+    Each level's histogram is ONE fused one-hot matmul — (node-one-hot ⊗
+    weighted stats)ᵀ expanded against the bin codes → (m·k, d·n_bins) — and
+    sample routing is the fused route_matmul, both pallas kernels from
+    ops/tree_hist.py (neither the bin one-hot nor the cmp matrix ever
+    reaches HBM; non-TPU backends fall back to the XLA einsums). Both batch
+    cleanly under vmap over trees/configs (shared codes are never copied —
+    vmap widens the stat/node columns of the single kernel call). Returns (feat_heap (2^D−1,),
+    thresh_heap (2^D−1,), bin_heap (2^D−1,) int32 with sentinel n_bins for
+    non-splits, node_s (S,) final sample leaf assignment).
     """
-    S = bin_oh.shape[0]
+    S = codes_s.shape[0]
     d = feat_mask.shape[0]
     k = stats_s.shape[1]
     sw = (stats_s * w_s[:, None]).astype(jnp.bfloat16)      # (S, k)
@@ -228,13 +211,17 @@ def _grow_tree(bin_oh, cmp_s, edges, stats_s, w_s, feat_mask, cfg, *,
     thr_heap = jnp.full((2 ** depth - 1,), jnp.inf, dtype=jnp.float32)
     bin_heap = jnp.full((2 ** depth - 1,), n_bins, dtype=jnp.int32)
     node = jnp.zeros((S,), jnp.int32)
+    # every level calls the histogram kernel at the deepest level's width so
+    # the whole loop shares ONE pallas program (early levels pad with zero
+    # columns — the kernel is far from the bottleneck, compiles are not)
+    mk_max = 2 ** (depth - 1) * k
     for level in range(depth):
         m = 2 ** level
         n_oh = (node[:, None]
                 == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
         A = (n_oh[:, :, None] * sw[:, None, :]).reshape(S, m * k)
-        hist = jnp.einsum("sa,sf->af", A, bin_oh,
-                          preferred_element_type=jnp.float32)
+        A = jnp.pad(A, ((0, 0), (0, mk_max - m * k)))
+        hist = hist_matmul(codes_s, A, n_bins)[:m * k]
         hist = hist.reshape(m, k, d, n_bins).transpose(0, 2, 3, 1)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                      # (m, k) node totals
@@ -256,10 +243,11 @@ def _grow_tree(bin_oh, cmp_s, edges, stats_s, w_s, feat_mask, cfg, *,
         thr_heap = thr_heap.at[m - 1: 2 * m - 1].set(thr)
         bb_eff = jnp.where(do_split, bb, n_bins)
         bin_heap = bin_heap.at[m - 1: 2 * m - 1].set(bb_eff)
-        sel = _level_sel(jnp.where(do_split, bf, 0), bb_eff, d, n_bins)
-        go = ((jnp.einsum("sf,af->sa", cmp_s, sel,
-                          preferred_element_type=jnp.bfloat16)
-               * n_oh).sum(-1) > 0.5)
+        f_pad = jnp.pad(jnp.where(do_split, bf, 0), (0, 2 ** (depth - 1) - m))
+        b_pad = jnp.pad(bb_eff, (0, 2 ** (depth - 1) - m),
+                        constant_values=n_bins)
+        D = route_matmul(codes_s, f_pad, b_pad, n_bins)[:, :m]   # (S, m)
+        go = (D * n_oh).sum(-1) > 0.5
         node = 2 * node + go.astype(jnp.int32)
     return feat_heap, thr_heap, bin_heap, node
 
@@ -288,10 +276,10 @@ def _make_stats(y, num_classes: int, task: str):
 
 
 def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True):
-    """Shared per-fit prep: sampled edges, bin matrices, the sampled bin
-    one-hot histogram RHS + decision bits, and per-row stats. ``full_bin``
-    skips binning the full dataset for fits that never touch it (GBT trains
-    entirely on the sample)."""
+    """Shared per-fit prep: sampled edges, full + sampled int32 bin codes
+    (the operands of the fused histogram/routing kernels), per-row stats,
+    and the n/S weight rescale. ``full_bin`` skips binning the full dataset
+    for fits that never touch it (GBT trains entirely on the sample)."""
     n = X.shape[0]
     samp = jnp.asarray(_sample_rows(n))
     Xs = X[samp]
@@ -302,18 +290,16 @@ def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True):
     else:
         binned = None
         binned_s = _bin_features(Xs, edges)
-    bin_oh = _bin_one_hot(binned_s, n_bins)
-    cmp_s = _cmp_matrix(binned_s, n_bins)
     stats, mode = _make_stats(y, num_classes, task)
     w_scale = jnp.asarray(n / samp.shape[0], X.dtype)
-    return samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale
+    return samp, edges, binned, binned_s, stats, mode, w_scale
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task"))
 def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
                   depth, n_bins, num_classes, task):
     d = X.shape[1]
-    samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale = \
+    samp, edges, binned, binned_s, stats, mode, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, task)
     fmask = jnp.ones((d,), bool)
     stats_s = stats[samp]
@@ -321,18 +307,16 @@ def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
     def grow_one(w, md, mi, mg):
         cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
                "lam": 1e-6, "min_child_weight": 0.0}
-        return _grow_tree(bin_oh, cmp_s, edges, stats_s, w[samp] * w_scale,
+        return _grow_tree(binned_s, edges, stats_s, w[samp] * w_scale,
                           fmask, cfg, depth=depth, n_bins=n_bins, mode=mode)
 
     feat, thr, bheap, _ = jax.vmap(grow_one)(
         weights, max_depth, min_inst, min_gain)            # (B, H)
 
     # exact full-data leaf stats, one config at a time (bounds memory)
-    cmp_full = _cmp_matrix(binned, n_bins)
-
     def leaf_one(args):
         f, bh, w = args
-        node = _route_cmp(cmp_full, f[None], bh[None], depth, n_bins, d)
+        node = _route_codes(binned, f[None], bh[None], depth, n_bins, d)
         ls, lw = _leaf_reduce_forest(node, stats, w, depth)
         return (_class_leaf(ls[0], lw[0]) if task == "classification"
                 else _mean_leaf(ls[0], lw[0])[:, None])
@@ -348,15 +332,14 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                   subsample, seeds, *, depth, n_bins, num_classes, task,
                   n_trees):
     n, d = X.shape
-    samp, edges, binned, bin_oh, cmp_s, stats, mode, w_scale = \
+    samp, edges, binned, binned_s, stats, mode, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, task)
     # per-tree feature subset (Spark featureSubsetStrategy auto:
     # sqrt for classification, 1/3 for regression)
     p_feat = float(np.ceil(np.sqrt(d)) / d) if task == "classification" \
         else max(1.0 / 3.0, 1.0 / d)
-    S = bin_oh.shape[0]
+    S = binned_s.shape[0]
     stats_s = stats[samp]
-    cmp_full = _cmp_matrix(binned, n_bins)
 
     def one(args):
         w, md, mi, mg, ss, seed = args
@@ -373,7 +356,7 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
             boot_s = jax.random.poisson(k1, ss, (S,)).astype(X.dtype)
             fmask = jax.random.bernoulli(k2, p_feat, (d,))
             f, th, bh, _ = _grow_tree(
-                bin_oh, cmp_s, edges, stats_s, w_s * boot_s, fmask,
+                binned_s, edges, stats_s, w_s * boot_s, fmask,
                 cfg, depth=depth, n_bins=n_bins, mode=mode)
             return f, th, bh
 
@@ -392,7 +375,7 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
 
         def leaf_chunk(args):
             f_c, bh_c = args                                   # (C, H)
-            node = _route_cmp(cmp_full, f_c, bh_c, depth, n_bins, d)
+            node = _route_codes(binned, f_c, bh_c, depth, n_bins, d)
             ls, lw = _leaf_reduce_forest(node, stats, w, depth)
             return (jax.vmap(_class_leaf)(ls, lw)
                     if task == "classification"
@@ -420,13 +403,13 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
     """Gradient boosting: binary logistic / regression squared / multiclass
     softmax (one tree per class per round, vmapped over the class axis)."""
     n, d = X.shape
-    samp, edges, _, bin_oh, cmp_s, _, _, w_scale = \
+    samp, edges, _, binned_s, _, _, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, "regression",
                           full_bin=False)
     fmask = jnp.ones((d,), bool)
     C = num_classes if task == "multiclass" else 1
     B = weights.shape[0]
-    S = bin_oh.shape[0]
+    S = binned_s.shape[0]
     L = 2 ** depth
     y_s = y[samp]
     Y1_s = (jax.nn.one_hot(y_s.astype(jnp.int32), max(C, 2), dtype=X.dtype)
@@ -447,7 +430,7 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
         values, and per-sample-row predictions."""
         st = jnp.stack([g, h, jnp.ones_like(g)], axis=1)   # (S, 3)
         f, th, bh, node_s = _grow_tree(
-            bin_oh, cmp_s, edges, st, w_b, fmask, cfg,
+            binned_s, edges, st, w_b, fmask, cfg,
             depth=depth, n_bins=n_bins, mode="gh")
         l_oh = (node_s[:, None]
                 == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
@@ -522,11 +505,11 @@ def _leaf_select(node, leaf_flat):
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
     d = X.shape[1]
-    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+    codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l = args
-        node = _route_cmp(cmp, f[None], bh[None], depth, n_bins, d)
+        node = _route_codes(codes, f[None], bh[None], depth, n_bins, d)
         return _leaf_select(node, l)                       # (n, k)
 
     return jax.lax.map(one, (feat, bins, leaf))            # (B, n, k)
@@ -536,12 +519,12 @@ def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
 def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
                       n_bins):
     d = X.shape[1]
-    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+    codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l, m = args                                 # (T,H) (T,L,k) (T,)
         T, L, k = l.shape
-        node = _route_cmp(cmp, f, bh, depth, n_bins, d)    # (n, T)
+        node = _route_codes(codes, f, bh, depth, n_bins, d)
         lw = (l * m[:, None, None]).reshape(T * L, k)
         s = _leaf_select(node, lw)
         return s / jnp.maximum(m.sum(), 1.0)
@@ -553,14 +536,14 @@ def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
 def _predict_gbt_batch(feat, bins, leaf, f0, eta, tree_mask, edges, X, *,
                        depth, n_bins):
     d = X.shape[1]
-    cmp = _cmp_matrix(_bin_features(X, edges), n_bins)
+    codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l, f0b, etab, m = args     # (T,C,H), leaf (T,C,L), m (T,)
         T, C, H = f.shape
         L = l.shape[-1]
-        node = _route_cmp(cmp, f.reshape(T * C, H), bh.reshape(T * C, H),
-                          depth, n_bins, d)                # (n, T·C)
+        node = _route_codes(codes, f.reshape(T * C, H), bh.reshape(T * C, H),
+                            depth, n_bins, d)              # (n, T·C)
         # class-routing matrix: value·one-hot(class) per (tree, class, leaf)
         lv = (l * m[:, None, None]).reshape(T * C * L)
         cls = jnp.tile(jnp.repeat(jnp.arange(C), L), T)
