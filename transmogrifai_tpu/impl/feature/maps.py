@@ -263,7 +263,6 @@ class SmartTextMapVectorizerModel(_VectorModelBase):
         self.track_nulls = track_nulls
 
     def transform_column(self, table: FeatureTable) -> Column:
-        from .vectorizers import hash_token_lists
         n = table.num_rows
         blocks: List[np.ndarray] = []
         meta: List[VectorColumnMetadata] = []
@@ -288,9 +287,10 @@ class SmartTextMapVectorizerModel(_VectorModelBase):
                     meta.append(VectorColumnMetadata(
                         f.name, f.type_name, key, OTHER_INDICATOR))
                 else:
-                    toks = [tokenize_text(str(v)) if v is not None else []
-                            for v in vals]
-                    blocks.append(hash_token_lists(toks, self.num_hashes))
+                    from .vectorizers import tokenize_hash_texts
+                    blocks.append(tokenize_hash_texts(
+                        [str(v) if v is not None else None for v in vals],
+                        self.num_hashes))
                     meta.extend([VectorColumnMetadata(
                         f.name, f.type_name, key, None,
                         descriptor_value=f"hash_{j}")

@@ -334,8 +334,34 @@ def _hash_token(tok: str, num_hashes: int) -> int:
     return zlib.crc32(tok.encode("utf-8")) % num_hashes
 
 
+def tokenize_hash_texts(docs: Sequence[Optional[str]], num_hashes: int,
+                        min_token_length: int = 1,
+                        binary: bool = False) -> np.ndarray:
+    """Fused tokenize + hashing-trick counts for a document batch: the
+    native C kernel handles ASCII docs (native/text_ops.cpp), the
+    Unicode-aware Python tokenizer fills in the flagged rows — results are
+    identical to tokenize_text + hash_token_lists by construction."""
+    from ...utils.text_native import tokenize_hash_native
+    res = tokenize_hash_native(docs, num_hashes, min_token_length, binary)
+    if res is None:
+        return hash_token_lists(
+            [tokenize_text(d, min_token_length) for d in docs],
+            num_hashes, binary)
+    counts, needs_py = res
+    if needs_py.any():
+        idx = np.nonzero(needs_py)[0]
+        counts[idx] = hash_token_lists(
+            [tokenize_text(docs[i], min_token_length) for i in idx],
+            num_hashes, binary)
+    return counts
+
+
 def hash_token_lists(token_lists: Sequence[Sequence[str]], num_hashes: int,
                      binary: bool = False) -> np.ndarray:
+    from ...utils.text_native import hash_token_lists_native
+    native = hash_token_lists_native(token_lists, num_hashes, binary)
+    if native is not None:
+        return native
     out = np.zeros((len(token_lists), num_hashes), dtype=np.float32)
     for i, toks in enumerate(token_lists):
         for t in toks or ():
@@ -455,9 +481,9 @@ class SmartTextVectorizerModel(_VectorModelBase):
                 meta.extend(_meta_cols(
                     f, [(f.name, v) for v in vocab] + [(f.name, OTHER_INDICATOR)]))
             else:
-                toks = [tokenize_text(v if ok else None)
-                        for v, ok in zip(vals, m)]
-                blocks.append(hash_token_lists(toks, self.num_hashes))
+                blocks.append(tokenize_hash_texts(
+                    [v if ok else None for v, ok in zip(vals, m)],
+                    self.num_hashes))
                 meta.extend([VectorColumnMetadata(
                     f.name, f.type_name, f.name, None,
                     descriptor_value=f"hash_{j}") for j in range(self.num_hashes)])
