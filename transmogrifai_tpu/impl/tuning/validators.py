@@ -192,11 +192,21 @@ class OpValidator:
             scores = family.predict_batch(params, X, num_classes)
             scores = scores[:B_true]                             # (F*G, n[, C])
             VM = jnp.repeat(val_m, G, axis=0)                    # (F*G, n)
+            # round the config axis up to a multiple of 32 so the jitted
+            # metric program is shared across families of similar grid sizes
+            # — compiles dominate on backends where the persistent cache
+            # cannot deserialize. (NOT bucket_for: its 256-row floor would
+            # pad a 12-config sweep 21x.)
+            B_m = -(-B_true // 32) * 32
+            if B_m != B_true:
+                scores = jnp.pad(scores, ((0, B_m - B_true),)
+                                 + ((0, 0),) * (scores.ndim - 1))
+                VM = jnp.pad(VM, ((0, B_m - B_true), (0, 0)))
             if problem == "multiclass":
                 m = metric(scores, y, VM, num_classes)
             else:
                 m = metric(scores, y, VM)
-            fold_metrics = np.asarray(m).reshape(F, G)
+            fold_metrics = np.asarray(m[:B_true]).reshape(F, G)
             mean_metrics = fold_metrics.mean(axis=0)
             results.append(ValidationResult(
                 family=family.name, grid=list(grid), metric_name=metric_name,
