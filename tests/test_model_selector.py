@@ -148,3 +148,70 @@ def test_kfold_masks_partition():
     # stratified: each fold has both classes
     for f in range(4):
         assert len(np.unique(y[smasks[f]])) == 2
+
+
+def test_fold_sliced_scoring_matches_masked_path():
+    """The fold-sliced scoring path (gather each fold's validation rows)
+    must produce the same per-fold metrics as full-row masked scoring (the
+    mesh / explicit-mask path)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees   # noqa: F401
+
+    rng = np.random.RandomState(0)
+    n, d = 600, 8
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    y = jnp.asarray((np.asarray(X) @ rng.randn(d).astype(np.float32)
+                     + 0.3 * rng.randn(n) > 0).astype(np.float32))
+    models = [(MODEL_REGISTRY["OpLogisticRegression"],
+               [{"regParam": 0.01, "elasticNetParam": 0.0},
+                {"regParam": 0.1, "elasticNetParam": 0.5}]),
+              (MODEL_REGISTRY["OpDecisionTreeClassifier"],
+               [{"maxDepth": 3}])]
+    cv = OpCrossValidation(num_folds=3, seed=7)
+
+    sliced = cv.validate(models, X, y, "binary", "AuPR", True, 2)
+    # fold_sliced=False forces the full-row masked scoring path (the same
+    # code the mesh path runs) on identical seeded splits
+    masked = cv.validate(models, X, y, "binary", "AuPR", True, 2,
+                         fold_sliced=False)
+    for i in range(len(models)):
+        got = np.asarray(sliced.results[i].fold_metrics)          # (3, G)
+        want = np.asarray(masked.results[i].fold_metrics)
+        assert np.allclose(got, want, rtol=1e-4, atol=1e-5), (got, want)
+
+
+def test_fold_sliced_pins_binned_metric_choice():
+    """Fold-slicing shrinks the metric's row axis; the binned-vs-exact
+    AuROC choice must follow the PRE-slice row count so both scoring paths
+    agree even when n is above the binned threshold but n/F is below it."""
+    import numpy as np
+    import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    from transmogrifai_tpu.ops import metrics as M
+    import transmogrifai_tpu.models.linear  # noqa: F401
+
+    old = M._BINNED_MIN_N
+    M._BINNED_MIN_N = 512          # n=900 above, n/3=300 below
+    try:
+        rng = np.random.RandomState(1)
+        n, d = 900, 6
+        X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        y = jnp.asarray((np.asarray(X) @ rng.randn(d).astype(np.float32)
+                         + 0.5 * rng.randn(n) > 0).astype(np.float32))
+        models = [(MODEL_REGISTRY["OpLogisticRegression"],
+                   [{"regParam": 0.01, "elasticNetParam": 0.0}])]
+        cv = OpCrossValidation(num_folds=3, seed=3)
+        sliced = cv.validate(models, X, y, "binary", "AuROC", True, 2)
+        masked = cv.validate(models, X, y, "binary", "AuROC", True, 2,
+                             fold_sliced=False)
+        got = np.asarray(sliced.results[0].fold_metrics)
+        want = np.asarray(masked.results[0].fold_metrics)
+        # same algorithm (binned) on both paths -> near-identical values
+        assert np.allclose(got, want, rtol=1e-3, atol=2e-3), (got, want)
+    finally:
+        M._BINNED_MIN_N = old

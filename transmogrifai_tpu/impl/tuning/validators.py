@@ -55,20 +55,28 @@ class BestEstimator:
     results: List[ValidationResult] = field(default_factory=list)
 
 
-def _metric_fn(problem: str, metric: str):
+def _metric_fn(problem: str, metric: str, batched_y: bool = False,
+               binned: "Optional[bool]" = None):
     """Jitted batched metric over (B, n) scores with (B, n) val masks,
     honoring the evaluator's requested metric name (reference: the validator
-    optimizes whatever evaluator the selector was configured with)."""
+    optimizes whatever evaluator the selector was configured with).
+    ``batched_y``: labels are (B, n) per-config (the fold-sliced scoring
+    path, where each config's rows are its own fold's validation rows)
+    instead of one shared (n,) vector."""
+    y_ax = 0 if batched_y else None
     if problem == "binary":
         if metric in ("AuPR", "AuROC"):
             base = {"AuPR": aupr_masked, "AuROC": auroc_masked}[metric]
-            return jax.jit(jax.vmap(base, in_axes=(0, None, 0)))
+            if binned is not None:
+                from functools import partial as _partial
+                base = _partial(base, binned=binned)
+            return jax.jit(jax.vmap(base, in_axes=(0, y_ax, 0)))
         if metric in ("Precision", "Recall", "F1", "Error"):
             def one_b(scores, y, mask):
                 return binary_threshold_metrics_masked(scores, y, mask)[metric]
-            return jax.jit(jax.vmap(one_b, in_axes=(0, None, 0)))
+            return jax.jit(jax.vmap(one_b, in_axes=(0, y_ax, 0)))
         if metric == "LogLoss":
-            return jax.jit(jax.vmap(log_loss_masked, in_axes=(0, None, 0)))
+            return jax.jit(jax.vmap(log_loss_masked, in_axes=(0, y_ax, 0)))
         raise ValueError(f"unknown binary validation metric '{metric}'")
     if problem == "multiclass":
         if metric not in ("F1", "Precision", "Recall", "Error"):
@@ -78,7 +86,7 @@ def _metric_fn(problem: str, metric: str):
             pred = probs.argmax(axis=-1).astype(jnp.int32)
             return multiclass_metrics_masked(
                 pred, y.astype(jnp.int32), mask, num_classes)[metric]
-        return jax.jit(jax.vmap(one, in_axes=(0, None, 0, None)),
+        return jax.jit(jax.vmap(one, in_axes=(0, y_ax, 0, None)),
                        static_argnums=(3,))
     if problem == "regression":
         if metric not in ("RootMeanSquaredError", "MeanSquaredError",
@@ -87,7 +95,7 @@ def _metric_fn(problem: str, metric: str):
 
         def one_r(pred, y, mask):
             return regression_metrics_masked(pred, y, mask)[metric]
-        return jax.jit(jax.vmap(one_r, in_axes=(0, None, 0)))
+        return jax.jit(jax.vmap(one_r, in_axes=(0, y_ax, 0)))
     raise ValueError(problem)
 
 
@@ -134,13 +142,16 @@ class OpValidator:
                  X: jnp.ndarray, y: jnp.ndarray, problem: str,
                  metric_name: str, larger_better: bool, num_classes: int,
                  val_masks: Optional[np.ndarray] = None,
+                 fold_sliced: Optional[bool] = None,
                  ) -> BestEstimator:
         """Run the full |families| × |grid| × |folds| sweep. Each family is one
         vmapped fit_batch + predict_batch + batched-metric program.
 
         ``val_masks`` overrides the fold construction with explicit (F, n)
         boolean validation masks — used by the workflow-level CV path, which
-        must evaluate one externally-prepared fold at a time."""
+        must evaluate one externally-prepared fold at a time. ``fold_sliced``
+        forces the per-fold row-gather scoring path on/off (default: on
+        whenever rows are not mesh-sharded)."""
         if val_masks is None:
             val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
@@ -159,7 +170,35 @@ class OpValidator:
         if n_pad != n:
             train_w = train_w.at[:, n:].set(0.0)
         val_m = jnp.asarray(val_masks)                          # (F, n)
-        metric = _metric_fn(problem, metric_name)
+        # fold-sliced scoring: every (fold, config) pair only needs ITS
+        # fold's validation rows, so predict + metric run on the gathered
+        # per-fold partitions (~n/F rows each) instead of all n rows and a
+        # mask — an F x cut on the heavy tree predicts. The mesh path keeps
+        # full-row scoring (rows are sharded; a host-built gather would
+        # break the sharding layout).
+        if fold_sliced is None:
+            fold_sliced = self.mesh is None
+        fold_sliced = fold_sliced and self.mesh is None
+        if fold_sliced:
+            vm_np = np.asarray(val_masks)
+            nf = int(vm_np.sum(axis=1).max()) if F > 0 else 0
+            nf_b = bucket_for(max(nf, 1))
+            fidx = np.zeros((F, nf_b), np.int32)
+            fvalid = np.zeros((F, nf_b), bool)
+            for f in range(F):
+                rows = np.nonzero(vm_np[f])[0]
+                fidx[f, :len(rows)] = rows
+                fvalid[f, :len(rows)] = True
+            fidx_d = jnp.asarray(fidx.reshape(-1))
+            fvalid_d = jnp.asarray(fvalid)
+            Xf = X[fidx_d].reshape((F, nf_b) + X.shape[1:])
+            yf = y[fidx_d].reshape(F, nf_b)
+        # pin binned-vs-exact AuROC/AuPR to the PRE-slice row count so
+        # fold-sliced and full-row scoring choose the same algorithm
+        from ...ops.metrics import _BINNED_MIN_N
+        metric = _metric_fn(
+            problem, metric_name, batched_y=fold_sliced,
+            binned=(n_pad >= _BINNED_MIN_N) if fold_sliced else None)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             row_sh = NamedSharding(self.mesh, P("data"))
@@ -189,9 +228,21 @@ class OpValidator:
                                                             P("model")))
                          for k, v in tiled.items()}
             params = family.fit_batch(X, y, W, tiled, num_classes)
-            scores = family.predict_batch(params, X, num_classes)
-            scores = scores[:B_true]                             # (F*G, n[, C])
-            VM = jnp.repeat(val_m, G, axis=0)                    # (F*G, n)
+            if fold_sliced:
+                per_fold = [
+                    family.predict_batch(
+                        family.slice_params(params, f * G, (f + 1) * G),
+                        Xf[f], num_classes)
+                    for f in range(F)
+                ]
+                scores = jnp.concatenate(per_fold, axis=0)  # (F*G, nf[, C])
+                Y = jnp.repeat(yf, G, axis=0)               # (F*G, nf)
+                VM = jnp.repeat(fvalid_d, G, axis=0)
+            else:
+                scores = family.predict_batch(params, X, num_classes)
+                scores = scores[:B_true]                    # (F*G, n[, C])
+                Y = y
+                VM = jnp.repeat(val_m, G, axis=0)           # (F*G, n)
             # round the config axis up to a multiple of 32 so the jitted
             # metric program is shared across families of similar grid sizes
             # — compiles dominate on backends where the persistent cache
@@ -202,10 +253,12 @@ class OpValidator:
                 scores = jnp.pad(scores, ((0, B_m - B_true),)
                                  + ((0, 0),) * (scores.ndim - 1))
                 VM = jnp.pad(VM, ((0, B_m - B_true), (0, 0)))
+                if fold_sliced:
+                    Y = jnp.pad(Y, ((0, B_m - B_true), (0, 0)))
             if problem == "multiclass":
-                m = metric(scores, y, VM, num_classes)
+                m = metric(scores, Y, VM, num_classes)
             else:
-                m = metric(scores, y, VM)
+                m = metric(scores, Y, VM)
             fold_metrics = np.asarray(m[:B_true]).reshape(F, G)
             mean_metrics = fold_metrics.mean(axis=0)
             results.append(ValidationResult(

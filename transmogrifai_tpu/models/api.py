@@ -88,6 +88,13 @@ class ModelFamily(abc.ABC):
         import jax
         return jax.tree_util.tree_map(lambda a: np.asarray(a[idx]), batched)
 
+    def slice_params(self, batched: Any, lo: int, hi: int) -> Any:
+        """Slice a config-range [lo, hi) of stacked params, on device.
+        Families whose params carry unbatched leaves (shared bin edges,
+        static ints) override this to leave those leaves whole."""
+        import jax
+        return jax.tree_util.tree_map(lambda a: a[lo:hi], batched)
+
     def grid_to_arrays(self, grid: Sequence[Dict[str, Any]]) -> Dict[str, jnp.ndarray]:
         keys = sorted({k for g in grid for k in g})
         return {k: jnp.asarray([g[k] for g in grid], dtype=jnp.float32) for k in keys}

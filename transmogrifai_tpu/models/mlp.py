@@ -125,6 +125,16 @@ class MultilayerPerceptronFamily(ModelFamily):
             grid["stepSize"], seeds, h_max, nc, self.max_iter)
         return {"params": params, "masks": (m1, m2), "num_classes": nc}
 
+    def slice_params(self, batched, lo, hi):
+        import jax
+        return {
+            "params": jax.tree_util.tree_map(lambda a: a[lo:hi],
+                                             batched["params"]),
+            "masks": jax.tree_util.tree_map(lambda a: a[lo:hi],
+                                            batched["masks"]),
+            "num_classes": batched["num_classes"],
+        }
+
     def predict_batch(self, params, X, num_classes):
         probs = _predict_mlp_batch(params["params"], params["masks"], X)
         if num_classes <= 2:

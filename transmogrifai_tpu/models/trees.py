@@ -585,6 +585,11 @@ class _TreeFamilyBase(ModelFamily):
         return {k: (np.asarray(v) if k == "edges" else np.asarray(v[idx]))
                 for k, v in batched.items()}
 
+    def slice_params(self, batched, lo, hi):
+        # quantile bin edges are shared across the whole sweep
+        return {k: (v if k == "edges" else v[lo:hi])
+                for k, v in batched.items()}
+
     @staticmethod
     def _edges_of(params):
         """Shared (d, n_bins−1) edge table whether params came from a batched

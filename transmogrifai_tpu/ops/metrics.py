@@ -165,14 +165,19 @@ def aupr(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(boundary, seg, 0.0).sum()
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("binned",))
 def auroc_masked(scores: jnp.ndarray, labels: jnp.ndarray,
-                 mask: jnp.ndarray) -> jnp.ndarray:
+                 mask: jnp.ndarray, binned: Optional[bool] = None
+                 ) -> jnp.ndarray:
     """AuROC over the masked subset. Masked rows get +inf scores (ranking above
     all valid rows, so valid ranks 1..n_valid are unchanged) and are excluded
     from the positive/negative counts — used inside vmapped CV where every fold
-    shares one static shape. Binned above _BINNED_MIN_N rows."""
-    if scores.shape[0] >= _BINNED_MIN_N:
+    shares one static shape. Binned above _BINNED_MIN_N rows; pass ``binned``
+    to pin the algorithm regardless of shape (the fold-sliced CV path pins it
+    to the pre-slice row count so results match full-row scoring)."""
+    use_binned = (binned if binned is not None
+                  else scores.shape[0] >= _BINNED_MIN_N)
+    if use_binned:
         return _auroc_from_hists(*_binned_hists(scores, labels, mask))
     s = jnp.where(mask, scores, jnp.inf)
     pos = (labels > 0.5) & mask
@@ -184,13 +189,17 @@ def auroc_masked(scores: jnp.ndarray, labels: jnp.ndarray,
     return jnp.where((n_pos > 0) & (n_neg > 0), u / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("binned",))
 def aupr_masked(scores: jnp.ndarray, labels: jnp.ndarray,
-                mask: jnp.ndarray) -> jnp.ndarray:
+                mask: jnp.ndarray, binned: Optional[bool] = None
+                ) -> jnp.ndarray:
     """AuPR over the masked subset (masked rows sink to -inf and contribute
     nothing to cumulative TP/FP, so curve deltas in their range are zero).
-    Binned above _BINNED_MIN_N rows."""
-    if scores.shape[0] >= _BINNED_MIN_N:
+    Binned above _BINNED_MIN_N rows; ``binned`` pins the algorithm (see
+    auroc_masked)."""
+    use_binned = (binned if binned is not None
+                  else scores.shape[0] >= _BINNED_MIN_N)
+    if use_binned:
         return _aupr_from_hists(*_binned_hists(scores, labels, mask))
     n = scores.shape[0]
     s_in = jnp.where(mask, scores, -jnp.inf)
