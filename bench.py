@@ -3,9 +3,16 @@
 The reference's hot loop is |models| × |paramMaps| × |folds| sequential Spark
 fits throttled by an 8-thread pool (reference: OpValidator.scala:270-322,
 OpCrossValidation.scala). BASELINE.md sets the target: >= 100 model×fold fits
-per second on a 1M-row tabular dataset. Here the whole sweep is one vmapped,
-jitted XLA program (logistic-regression prox-Newton batch), so the metric is
-(configurations × folds) / wall-clock of fit + predict + metric.
+per second on a 1M-row tabular dataset.
+
+This drives the PRODUCT sweep path — ``OpCrossValidation.validate`` — not a
+hand-rolled loop: one vmapped fit_batch for the whole grid (logistic
+prox-Newton batch), one batched predict, and the masked binned-AuROC metric.
+(Logistic, like all single-matmul-predict families, opts out of fold-sliced
+scoring — fold_sliced_predict=False — so this path is full-row masked
+scoring; tree families take the fold-gather path instead.) The metric is
+(configurations × folds) / wall-clock of the full validate() call, including
+host-side split construction.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is value / 100 (the BASELINE.json north-star target; the
@@ -21,9 +28,9 @@ import numpy as np
 def main():
     import jax
     import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
     from transmogrifai_tpu.models.api import MODEL_REGISTRY
     import transmogrifai_tpu.models.linear  # noqa: F401
-    from transmogrifai_tpu.ops.metrics import auroc_masked
 
     platform = jax.devices()[0].platform
     n = int(os.environ.get("BENCH_ROWS", 1_000_000 if platform == "tpu" else 20_000))
@@ -39,31 +46,24 @@ def main():
     w_true = rng.randn(d).astype(np.float32)
     y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
 
-    family = MODEL_REGISTRY["OpLogisticRegression"]
-    garr = family.grid_to_arrays(grid)
-    val = np.zeros((folds, n), dtype=bool)
-    perm = rng.permutation(n)
-    for f in range(folds):
-        val[f, perm[f::folds]] = True
-    train_w = jnp.asarray(np.repeat(~val, len(grid), axis=0), jnp.float32)
-    val_m = jnp.asarray(np.repeat(val, len(grid), axis=0))
-    tiled = {k: jnp.tile(v, folds) for k, v in garr.items()}
+    models = [(MODEL_REGISTRY["OpLogisticRegression"], grid)]
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
 
-    metric = jax.jit(jax.vmap(auroc_masked, in_axes=(0, None, 0)))
-
     def sweep():
-        params = family.fit_batch(Xd, yd, train_w, tiled, 2)
-        scores = family.predict_batch(params, Xd, 2)
-        return metric(scores, yd, val_m)
+        cv = OpCrossValidation(num_folds=folds, seed=0)
+        best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+        # host materialization below makes the timing honest even where
+        # async sync is a no-op (tunneled backends)
+        return np.asarray(best.results[0].fold_metrics)
 
-    np.asarray(sweep())                     # compile warmup
+    m = sweep()                              # compile warmup
+    assert m.shape == (folds, len(grid)) and np.all(np.isfinite(m))
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        m = np.asarray(sweep())             # host materialization: honest
-    dt = (time.perf_counter() - t0) / reps  # timing even where async sync
-    assert np.all(np.isfinite(m))           # is a no-op (tunneled backends)
+        m = sweep()
+    dt = (time.perf_counter() - t0) / reps
+    assert np.all(np.isfinite(m))
 
     fits_per_sec = B / dt
     print(json.dumps({

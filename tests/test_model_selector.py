@@ -193,18 +193,25 @@ def test_fold_sliced_pins_binned_metric_choice():
     from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
     from transmogrifai_tpu.models.api import MODEL_REGISTRY
     from transmogrifai_tpu.ops import metrics as M
-    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees  # noqa: F401
 
     old = M._BINNED_MIN_N
     M._BINNED_MIN_N = 512          # n=900 above, n/3=300 below
+    # _BINNED_MIN_N is read at trace time inside the module-level-jitted
+    # metrics; stale per-shape traces from earlier tests would silently
+    # bypass the patched threshold (and the un-patch below)
+    M.auroc_masked.clear_cache()
+    M.aupr_masked.clear_cache()
     try:
         rng = np.random.RandomState(1)
         n, d = 900, 6
         X = jnp.asarray(rng.randn(n, d).astype(np.float32))
         y = jnp.asarray((np.asarray(X) @ rng.randn(d).astype(np.float32)
                          + 0.5 * rng.randn(n) > 0).astype(np.float32))
-        models = [(MODEL_REGISTRY["OpLogisticRegression"],
-                   [{"regParam": 0.01, "elasticNetParam": 0.0}])]
+        # a tree family: linear families opt out of fold-sliced predicts
+        # (fold_sliced_predict=False), so only trees exercise the pin
+        models = [(MODEL_REGISTRY["OpDecisionTreeClassifier"],
+                   [{"maxDepth": 3}])]
         cv = OpCrossValidation(num_folds=3, seed=3)
         sliced = cv.validate(models, X, y, "binary", "AuROC", True, 2)
         masked = cv.validate(models, X, y, "binary", "AuROC", True, 2,
@@ -215,3 +222,5 @@ def test_fold_sliced_pins_binned_metric_choice():
         assert np.allclose(got, want, rtol=1e-3, atol=2e-3), (got, want)
     finally:
         M._BINNED_MIN_N = old
+        M.auroc_masked.clear_cache()
+        M.aupr_masked.clear_cache()

@@ -179,26 +179,39 @@ class OpValidator:
         if fold_sliced is None:
             fold_sliced = self.mesh is None
         fold_sliced = fold_sliced and self.mesh is None
-        if fold_sliced:
-            vm_np = np.asarray(val_masks)
-            nf = int(vm_np.sum(axis=1).max()) if F > 0 else 0
-            nf_b = bucket_for(max(nf, 1))
-            fidx = np.zeros((F, nf_b), np.int32)
-            fvalid = np.zeros((F, nf_b), bool)
-            for f in range(F):
-                rows = np.nonzero(vm_np[f])[0]
-                fidx[f, :len(rows)] = rows
-                fvalid[f, :len(rows)] = True
-            fidx_d = jnp.asarray(fidx.reshape(-1))
-            fvalid_d = jnp.asarray(fvalid)
-            Xf = X[fidx_d].reshape((F, nf_b) + X.shape[1:])
-            yf = y[fidx_d].reshape(F, nf_b)
+        # the fold gather is built lazily, only when a family opts in
+        # (fold_sliced_predict): single-matmul predicts are cheaper scored
+        # full-row than paying the row gather
+        _fold_cache: Dict[str, Any] = {}
+
+        def _fold_data():
+            if "Xf" not in _fold_cache:
+                vm_np = np.asarray(val_masks)
+                nf = int(vm_np.sum(axis=1).max()) if F > 0 else 0
+                nf_b = bucket_for(max(nf, 1))
+                fidx = np.zeros((F, nf_b), np.int32)
+                fvalid = np.zeros((F, nf_b), bool)
+                for f in range(F):
+                    rows = np.nonzero(vm_np[f])[0]
+                    fidx[f, :len(rows)] = rows
+                    fvalid[f, :len(rows)] = True
+                fidx_d = jnp.asarray(fidx.reshape(-1))
+                _fold_cache["Xf"] = X[fidx_d].reshape(
+                    (F, nf_b) + X.shape[1:])
+                _fold_cache["yf"] = y[fidx_d].reshape(F, nf_b)
+                _fold_cache["valid"] = jnp.asarray(fvalid)
+            return (_fold_cache["Xf"], _fold_cache["yf"],
+                    _fold_cache["valid"])
         # pin binned-vs-exact AuROC/AuPR to the PRE-slice row count so
         # fold-sliced and full-row scoring choose the same algorithm
         from ...ops.metrics import _BINNED_MIN_N
-        metric = _metric_fn(
-            problem, metric_name, batched_y=fold_sliced,
-            binned=(n_pad >= _BINNED_MIN_N) if fold_sliced else None)
+        from functools import lru_cache
+
+        @lru_cache(maxsize=None)
+        def _metric(sliced: bool):
+            return _metric_fn(
+                problem, metric_name, batched_y=sliced,
+                binned=(n_pad >= _BINNED_MIN_N) if sliced else None)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             row_sh = NamedSharding(self.mesh, P("data"))
@@ -228,7 +241,10 @@ class OpValidator:
                                                             P("model")))
                          for k, v in tiled.items()}
             params = family.fit_batch(X, y, W, tiled, num_classes)
-            if fold_sliced:
+            sliced = fold_sliced and getattr(family, "fold_sliced_predict",
+                                             True)
+            if sliced:
+                Xf, yf, fvalid_d = _fold_data()
                 per_fold = [
                     family.predict_batch(
                         family.slice_params(params, f * G, (f + 1) * G),
@@ -243,6 +259,7 @@ class OpValidator:
                 scores = scores[:B_true]                    # (F*G, n[, C])
                 Y = y
                 VM = jnp.repeat(val_m, G, axis=0)           # (F*G, n)
+            metric = _metric(sliced)
             # round the config axis up to a multiple of 32 so the jitted
             # metric program is shared across families of similar grid sizes
             # — compiles dominate on backends where the persistent cache
@@ -253,7 +270,7 @@ class OpValidator:
                 scores = jnp.pad(scores, ((0, B_m - B_true),)
                                  + ((0, 0),) * (scores.ndim - 1))
                 VM = jnp.pad(VM, ((0, B_m - B_true), (0, 0)))
-                if fold_sliced:
+                if sliced:
                     Y = jnp.pad(Y, ((0, B_m - B_true), (0, 0)))
             if problem == "multiclass":
                 m = metric(scores, Y, VM, num_classes)

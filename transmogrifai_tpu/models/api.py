@@ -88,6 +88,13 @@ class ModelFamily(abc.ABC):
         import jax
         return jax.tree_util.tree_map(lambda a: np.asarray(a[idx]), batched)
 
+    #: whether the CV sweep should score this family's configs on gathered
+    #: per-fold row partitions (saves F x predict+metric work when predict
+    #: is expensive — trees route every row through every tree) or on the
+    #: full row set with masks (single-matmul predicts: the row gather costs
+    #: more than it saves). See OpValidator.validate.
+    fold_sliced_predict: bool = True
+
     def slice_params(self, batched: Any, lo: int, hi: int) -> Any:
         """Slice a config-range [lo, hi) of stacked params, on device.
         Families whose params carry unbatched leaves (shared bin edges,
