@@ -178,15 +178,18 @@ def test_string_indexer_no_filter_round_trip():
     tbl = _tbl(t=(Text, ["b", "a", "b", None, "zz"]))
     model = OpStringIndexerNoFilter().set_input(f).fit(tbl)
     out = np.asarray(model.transform_column(tbl).values)
-    # every row gets an index; unseen bucket = len(labels)
+    # every row gets an index; trained null is its own frequency-ranked
+    # label (reference countByValue over Option), NOT the unseen bucket
     assert len(out) == 5 and np.all(out >= 0)
+    assert out[3] < len(model.labels)
     assert model.summary_metadata["labels"][-1] == UNSEEN_LABEL
+    assert "null" in model.summary_metadata["labels"]
     inv = OpIndexToStringNoFilter(model.labels).set_input(model.get_output())
     tbl2 = tbl.with_column(model.get_output().name, model.transform_column(tbl))
     back = inv.transform_column(tbl2)
     assert back.values[0] == "b" and back.values[1] == "a"
-    # null text indexed into the unseen bucket round-trips to UnseenLabel
-    assert back.values[3] == UNSEEN_LABEL
+    # trained null round-trips to the rendered 'null' label
+    assert back.values[3] == "null"
     assert inv.transform_fn(None) == UNSEEN_LABEL
 
 
@@ -195,12 +198,17 @@ def test_no_filter_null_vs_empty_and_nan():
         OpIndexToStringNoFilter, OpStringIndexerNoFilter, UNSEEN_LABEL,
     )
     f = _feat("t", Text)
-    # "" is in the training vocabulary; null must STILL go to the unseen bucket
+    # "" is in the training vocabulary alongside a trained null; they must
+    # get DISTINCT indices (null is its own label, never conflated with "")
     tbl = _tbl(t=(Text, ["", "a", None]))
     model = OpStringIndexerNoFilter().set_input(f).fit(tbl)
     out = np.asarray(model.transform_column(tbl).values)
-    assert out[2] == len(model.labels)           # null → unseen, not ""
+    assert out[2] < len(model.labels)            # trained null → own index
     assert out[0] != out[2]
+    # a null UNSEEN in training still goes to the unseen bucket
+    tbl_nonull = _tbl(t=(Text, ["", "a", "a"]))
+    m2 = OpStringIndexerNoFilter().set_input(f).fit(tbl_nonull)
+    assert m2.transform_fn(None) == float(len(m2.labels))
     inv = OpIndexToStringNoFilter(model.labels).set_input(model.get_output())
     # NaN / None / out-of-range all decode to UnseenLabel, never crash
     assert inv.transform_fn(float("nan")) == UNSEEN_LABEL
@@ -212,7 +220,8 @@ def test_no_filter_null_vs_empty_and_nan():
     idx_name = model.get_output().name
     t2 = FeatureTable({idx_name: Column.of_values(RealNN, [None, 0.0])}, 2)
     back = inv.transform_column(t2)
-    assert back.values[0] == UNSEEN_LABEL and back.values[1] == model.labels[0]
+    # index 0 is the trained null, rendered as 'null' on the way back out
+    assert back.values[0] == UNSEEN_LABEL and back.values[1] == "null"
 
 
 def test_op_collection_transform_fn_contract():

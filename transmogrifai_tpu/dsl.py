@@ -141,11 +141,12 @@ def _attach():
         from .impl.feature.text import ValidEmailTransformer
         return ValidEmailTransformer().set_input(self).get_output()
 
-    def to_email_domain(self: Feature, top_k: int = 20,
-                        min_support: int = 10) -> Feature:
+    def to_email_domain(self: Feature) -> Feature:
+        """Email → PickList of the domain (reference RichTextFeature
+        toEmailDomain). Pivot the result with ``.pivot(top_k=...)`` — the
+        reference's domain pivoting is likewise a separate vectorize step."""
         from .impl.feature.text import EmailToPickList
-        return (EmailToPickList(top_k=top_k, min_support=min_support)
-                .set_input(self).get_output())
+        return EmailToPickList().set_input(self).get_output()
 
     def to_url_domain(self: Feature) -> Feature:
         from .impl.feature.text import UrlToDomain
@@ -225,7 +226,7 @@ def _attach():
         from .impl.preparators.sanity_checker import SanityChecker
         return SanityChecker(**kw).set_input(label, self).get_output()
 
-    for name, fn in [
+    methods = [
         ("alias", alias), ("abs", abs_), ("log", log), ("exp", exp),
         ("sqrt", sqrt), ("power", power), ("round", round_), ("ceil", ceil),
         ("floor", floor), ("bucketize", bucketize),
@@ -247,8 +248,12 @@ def _attach():
         ("detect_languages", detect_languages),
         ("detect_mime_types", detect_mime_types),
         ("recognize_entities", recognize_entities),
-    ]:
+    ]
+    for name, fn in methods:
         setattr(F, name, fn)
+    return tuple(name for name, _ in methods)
 
 
-_attach()
+#: every DSL method attached to Feature — tests assert each one runs
+#: end-to-end (the round-1 to_email_domain crash must never recur)
+DSL_METHODS = _attach()

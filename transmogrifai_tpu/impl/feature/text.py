@@ -172,12 +172,21 @@ class OpStringIndexer(Estimator):
             raise ValueError("handle_invalid must be error|skip|keep")
         self.handle_invalid = handle_invalid
 
+    #: NoFilter overrides: count invalid rows as a trainable None label
+    count_nulls = False
+
     def fit(self, table: FeatureTable) -> Transformer:
         f = self.input_features[0]
         col = table[f.name]
         valid = col.valid_mask()
-        cnt = Counter(str(col.values[i]) for i in range(len(col)) if valid[i])
-        labels = sorted(cnt, key=lambda t: (-cnt[t], t))
+        if self.count_nulls:
+            cnt = Counter(str(col.values[i]) if valid[i] else None
+                          for i in range(len(col)))
+        else:
+            cnt = Counter(str(col.values[i])
+                          for i in range(len(col)) if valid[i])
+        # rank by frequency; ties: null sorts with "" deterministically first
+        labels = sorted(cnt, key=lambda t: (-cnt[t], t is not None, t or ""))
         model = OpStringIndexerModel(labels=labels,
                                      handle_invalid=self.handle_invalid)
         model.summary_metadata = {"labels": labels}
@@ -191,13 +200,20 @@ class OpStringIndexerModel(Transformer):
         super().__init__("strIdx", uid)
         self.labels = labels
         self.handle_invalid = handle_invalid
-        #: NoFilter variant: null always goes to the unseen bucket, even when
-        #: "" is a trained label (null and empty must not conflate there)
+        #: NoFilter variant: a null UNSEEN in training goes to the unseen
+        #: bucket instead of conflating with "" (a null seen in training is
+        #: its own label via the None entry in `labels` — see _index)
         self.null_to_unseen = False
+        self._label_index = {t: i for i, t in enumerate(labels)}
 
     def _index(self, v: Optional[str]) -> Optional[float]:
-        index = {t: i for i, t in enumerate(self.labels)}
+        index = self._label_index
         if v is None:
+            # NoFilter trains null as its own frequency-ranked label
+            # (reference OpStringIndexerNoFilter.scala countByValue over
+            # Option); only a null unseen in training goes to UnseenLabel
+            if None in index:
+                return float(index[None])
             if self.null_to_unseen:
                 return float(len(self.labels))
             v = ""
@@ -227,9 +243,19 @@ UNSEEN_LABEL = "UnseenLabel"
 
 class OpStringIndexerNoFilter(OpStringIndexer):
     """Text → RealNN index that never drops rows (reference
-    OpStringIndexerNoFilter.scala): unseen/null values all map to the
-    reserved ``UnseenLabel`` index (= vocab size) so the full label set
-    round-trips through OpIndexToStringNoFilter."""
+    OpStringIndexerNoFilter.scala). Matching the reference's ``countByValue``
+    over Option: a null seen in training is itself a frequency-ranked label
+    (a frequent null can take index 0) rendered as ``'null'`` in metadata;
+    only values/nulls genuinely unseen in training map to the reserved
+    ``UnseenLabel`` index (= vocab size) so the full label set round-trips
+    through OpIndexToStringNoFilter.
+
+    Caveat (shared with the reference's metadata rendering): a LITERAL
+    ``"null"`` string in the training data renders identically to the
+    trained-null label, so metadata label names are not injective in that
+    corner — indices remain distinct and decoding is still total."""
+
+    count_nulls = True
 
     def __init__(self, unseen_name: str = UNSEEN_LABEL, uid=None):
         super().__init__(handle_invalid="keep", uid=uid)
@@ -239,7 +265,8 @@ class OpStringIndexerNoFilter(OpStringIndexer):
         model = super().fit(table)
         model.null_to_unseen = True
         model.summary_metadata = {
-            "labels": model.labels + [self.unseen_name],
+            "labels": ["null" if t is None else t for t in model.labels]
+            + [self.unseen_name],
             "unseenName": self.unseen_name,
         }
         return model
@@ -253,7 +280,9 @@ class OpIndexToString(Transformer):
 
     def __init__(self, labels: Sequence[str], uid=None):
         super().__init__("idxToStr", uid)
-        self.labels = list(labels)
+        # a None label (NoFilter's trained-null) renders as 'null', matching
+        # the reference metadata — text output can't carry a distinct None
+        self.labels = ["null" if t is None else t for t in labels]
 
     def transform_column(self, table: FeatureTable) -> Column:
         col = table[self.input_features[0].name]

@@ -11,6 +11,8 @@ on device, shardable across chips over the 'model' mesh axis.
 """
 from __future__ import annotations
 
+import functools
+
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -55,6 +57,7 @@ class BestEstimator:
     results: List[ValidationResult] = field(default_factory=list)
 
 
+@functools.lru_cache(maxsize=None)
 def _metric_fn(problem: str, metric: str, batched_y: bool = False,
                binned: "Optional[bool]" = None):
     """Jitted batched metric over (B, n) scores with (B, n) val masks,

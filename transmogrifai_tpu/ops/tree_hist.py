@@ -29,6 +29,11 @@ Layout notes
 
 Fallback: on non-TPU backends (CPU test mesh, virtual-device dry runs) the
 same contraction runs as the plain XLA one-hot einsum.
+
+NOTE: `_use_pallas()` / `_interpret()` read TG_TREE_PALLAS and the backend at
+*trace time* inside jitted tree fits — once a shape is traced, flipping the
+env var has no effect for that shape until the jit caches are cleared
+(`jax.clear_caches()`), which tests that toggle the flag must do.
 """
 from __future__ import annotations
 
