@@ -5,6 +5,9 @@ slice, 8 host devices."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# shrink DEFAULT selector grids so CPU suites stay fast (full-fidelity run:
+# TG_FAST_GRIDS=0 pytest tests/); explicit grids in tests are unaffected
+os.environ.setdefault("TG_FAST_GRIDS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -10,6 +10,8 @@ import pytest
 
 from transmogrifai_tpu.cli import generate, infer_schema, main
 
+pytestmark = pytest.mark.slow
+
 
 def _csv(tmp_path, n=150, seed=4):
     rng = np.random.RandomState(seed)

@@ -7,6 +7,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 _RES = "/root/reference/helloworld/src/main/resources"
 needs_data = pytest.mark.skipif(
     not os.path.isdir(_RES), reason="reference example datasets not present")

@@ -1,5 +1,8 @@
 import numpy as np
 import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.slow
 
 from transmogrifai_tpu.models.api import MODEL_REGISTRY, FittedParams
 import transmogrifai_tpu.models.mlp  # noqa: F401

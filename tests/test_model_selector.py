@@ -31,7 +31,10 @@ def _wire(sel):
     return sel
 
 
-def test_binary_selector_cv():
+def test_binary_selector_cv(monkeypatch):
+    # this test pins the FULL reference default grids (6-point LR grid), so
+    # opt out of the suite-wide TG_FAST_GRIDS shrink
+    monkeypatch.setenv("TG_FAST_GRIDS", "0")
     tbl, y = _binary_table()
     sel = _wire(BinaryClassificationModelSelector.with_cross_validation(seed=7))
     model = sel.fit(tbl)

@@ -8,8 +8,11 @@ import pytest
 from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
 from transmogrifai_tpu.examples.titanic import DEFAULT_PATH, build_workflow
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(DEFAULT_PATH), reason="Titanic dataset not available")
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.exists(DEFAULT_PATH),
+                       reason="Titanic dataset not available"),
+]
 
 
 def test_titanic_end_to_end():

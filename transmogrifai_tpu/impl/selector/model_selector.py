@@ -9,6 +9,8 @@ the fitted SelectedModel emits a Prediction column.
 """
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -106,8 +108,20 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             if self.problem not in fam.supports:
                 raise ValueError(
                     f"{fam.name} does not support problem kind '{self.problem}'")
-            resolved.append((fam, grid if grid is not None
-                             else fam.default_grid(self.problem)))
+            if grid is None:
+                grid = fam.default_grid(self.problem)
+                # test-time knob: shrink DEFAULT grids so CPU CI suites stay
+                # fast; explicitly-passed grids are never touched. Env (not a
+                # fixture) because the CLI test's generated app runs in a
+                # subprocess. Loud, so a leaked env can't silently degrade a
+                # real AutoML run.
+                if os.environ.get("TG_FAST_GRIDS", "").lower() in ("1", "true"):
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "TG_FAST_GRIDS is set: default %s grid truncated "
+                        "%d -> 2 configs (test mode)", fam.name, len(grid))
+                    grid = grid[:2]
+            resolved.append((fam, grid))
         return resolved
 
     @property

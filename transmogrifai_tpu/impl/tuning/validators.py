@@ -207,10 +207,9 @@ class OpValidator:
                     _fold_cache["valid"])
         # pin binned-vs-exact AuROC/AuPR to the PRE-slice row count so
         # fold-sliced and full-row scoring choose the same algorithm
+        # (_metric_fn itself is memoized at module level)
         from ...ops.metrics import _BINNED_MIN_N
-        from functools import lru_cache
 
-        @lru_cache(maxsize=None)
         def _metric(sliced: bool):
             return _metric_fn(
                 problem, metric_name, batched_y=sliced,
