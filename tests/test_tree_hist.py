@@ -23,9 +23,19 @@ def _hist_direct(codes, A, nb):
     return out
 
 
-def _route_direct(codes, feat, bins, nb):
-    D = (codes[:, feat] > bins[None, :]) & (bins[None, :] < nb)
-    return D.astype(np.float32)
+def _descend_direct(codes, feat, bins, depth, nb):
+    """Reference complete-heap descent: (n, T) leaf assignments."""
+    n = codes.shape[0]
+    T = feat.shape[0]
+    node = np.zeros((n, T), np.int64)
+    for lvl in range(depth):
+        base = 2 ** lvl - 1
+        for t in range(T):
+            h = base + node[:, t]
+            go = ((bins[t, h] < nb)
+                  & (codes[np.arange(n), feat[t, h]] > bins[t, h]))
+            node[:, t] = 2 * node[:, t] + go
+    return node
 
 
 @pytest.mark.parametrize("use_pallas", [False, True])
@@ -59,34 +69,51 @@ def test_hist_matmul_vmap_flattens(use_pallas, monkeypatch):
 
 
 @pytest.mark.parametrize("use_pallas", [False, True])
-def test_route_matmul(use_pallas, monkeypatch):
+@pytest.mark.parametrize("shape", [(333, 11, 5, 4, 8, 3),
+                                   (150, 7, 1, 3, 16, 1),
+                                   (257, 9, 9, 6, 32, 4)])
+def test_forest_leaf_sums(use_pallas, shape, monkeypatch):
     monkeypatch.setenv("TG_TREE_PALLAS", "1" if use_pallas else "0")
+    jax.clear_caches()
+    from transmogrifai_tpu.ops import forest
+    n, d, T, depth, nb, k = shape
+    H, L = 2 ** depth - 1, 2 ** depth
     rng = np.random.RandomState(2)
-    nb = 32
-    codes = rng.randint(0, nb, (500, 11)).astype(np.int32)
-    feat = rng.randint(0, 11, (13,)).astype(np.int32)
-    bins = rng.randint(0, nb + 1, (13,)).astype(np.int32)   # incl. sentinel
-    got = np.asarray(tree_hist.route_matmul(
-        jnp.asarray(codes), jnp.asarray(feat), jnp.asarray(bins), nb),
-        np.float32)
-    want = _route_direct(codes, feat, bins, nb)
-    assert np.array_equal(got, want)
+    codes = rng.randint(0, nb, (n, d)).astype(np.int32)
+    feat = rng.randint(0, d, (T, H)).astype(np.int32)
+    bins = rng.randint(0, nb, (T, H)).astype(np.int32)
+    bins[rng.rand(T, H) < 0.3] = nb                   # stop sentinels
+    aug = rng.randn(n, k).astype(np.float32)
+    node = _descend_direct(codes, feat, bins, depth, nb)
+    want = np.zeros((T, L, k))
+    for t in range(T):
+        np.add.at(want[t], node[:, t], aug.astype(np.float64))
+    got = np.asarray(forest.forest_leaf_sums(
+        jnp.asarray(codes), jnp.asarray(feat), jnp.asarray(bins),
+        jnp.asarray(aug), depth=depth, n_bins=nb))
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-3)
 
 
 @pytest.mark.parametrize("use_pallas", [False, True])
-def test_route_matmul_vmap(use_pallas, monkeypatch):
+def test_forest_predict(use_pallas, monkeypatch):
     monkeypatch.setenv("TG_TREE_PALLAS", "1" if use_pallas else "0")
+    jax.clear_caches()
+    from transmogrifai_tpu.ops import forest
+    n, d, T, depth, nb, k = 270, 6, 4, 5, 32, 2
+    H, L = 2 ** depth - 1, 2 ** depth
     rng = np.random.RandomState(3)
-    nb = 16
-    codes = rng.randint(0, nb, (256, 4)).astype(np.int32)
-    featb = rng.randint(0, 4, (3, 7)).astype(np.int32)
-    binsb = rng.randint(0, nb + 1, (3, 7)).astype(np.int32)
-    got = np.asarray(jax.vmap(
-        lambda f, b: tree_hist.route_matmul(jnp.asarray(codes), f, b, nb))(
-        jnp.asarray(featb), jnp.asarray(binsb)), np.float32)
-    for v in range(3):
-        assert np.array_equal(got[v], _route_direct(codes, featb[v],
-                                                    binsb[v], nb))
+    codes = rng.randint(0, nb, (n, d)).astype(np.int32)
+    feat = rng.randint(0, d, (T, H)).astype(np.int32)
+    bins = rng.randint(0, nb + 1, (T, H)).astype(np.int32)
+    leaf = rng.randn(T, L, k).astype(np.float32)
+    node = _descend_direct(codes, feat, bins, depth, nb)
+    want = np.zeros((n, k))
+    for t in range(T):
+        want += leaf[t, node[:, t]]
+    got = np.asarray(forest.forest_predict(
+        jnp.asarray(codes), jnp.asarray(feat), jnp.asarray(bins),
+        jnp.asarray(leaf), depth=depth, n_bins=nb))
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_sentinel_codes_contribute_nothing():

@@ -242,7 +242,7 @@ class OpValidator:
                 tiled = {k: jax.device_put(v, NamedSharding(self.mesh,
                                                             P("model")))
                          for k, v in tiled.items()}
-            params = family.fit_batch(X, y, W, tiled, num_classes)
+            params = family.sweep_fit_batch(X, y, W, tiled, num_classes)
             sliced = fold_sliced and getattr(family, "fold_sliced_predict",
                                              True)
             if sliced:

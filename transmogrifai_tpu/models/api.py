@@ -55,6 +55,16 @@ class ModelFamily(abc.ABC):
         leading axis B.
         """
 
+    def sweep_fit_batch(self, X: jnp.ndarray, y: jnp.ndarray,
+                        weights: jnp.ndarray, grid: Dict[str, jnp.ndarray],
+                        num_classes: int) -> Any:
+        """``fit_batch`` for CV-sweep candidates. Families may trade exact
+        fitted state for sweep throughput here (tree families use
+        sample-based leaf values — validation scoring only); the selector
+        refits the winner through plain ``fit_batch``. Default: identical
+        to ``fit_batch``."""
+        return self.fit_batch(X, y, weights, grid, num_classes)
+
     @abc.abstractmethod
     def predict_batch(self, params: Any, X: jnp.ndarray,
                       num_classes: int) -> jnp.ndarray:

@@ -20,18 +20,26 @@ XGBoost's C++:
   estimates and converge long before 65k rows), and each level's histogram
   is ONE matmul — (nodes⊗stats)ᵀ expanded against the int32 bin codes by
   the fused pallas kernel (ops/tree_hist.py): the bin one-hot is built
-  tile-by-tile in VMEM and never reaches HBM. Leaf statistics stay EXACT: the full dataset is
-  routed down the grown tree (bin-space comparisons identical to growth) and
-  reduced with a leaf-one-hot matmul. Scatter-free end to end, so the whole
-  builder tiles onto the MXU and scales to millions of rows.
+  tile-by-tile in VMEM and never reaches HBM. Routing between levels is a
+  *feature-select matmul*: the split feature's bin code is gathered by a
+  (d, nodes) one-hot matmul and compared against the bin threshold —
+  1/n_bins-th the FLOPs of a comparison-bit contraction.
+* **Leaf statistics**: during the CV sweep, leaf values come from the
+  split-search sample the grower already routed (free — a segment-sum of
+  the sample's final node ids via the histogram kernel); the sweep only
+  needs them to *score validation rows*, and the winner is refit with
+  ``sweep=False`` where the FULL dataset is routed down the grown trees by
+  the fused descent kernel (ops/forest.py) for EXACT served leaf values.
+  Scatter-free end to end, so the whole builder tiles onto the MXU and
+  scales to millions of rows.
 * **Complete-heap trees of static depth**: arrays feat/thresh/leaf. A node
   that stops early keeps threshold +inf so every row routes left — training
   and serving follow identical routing with zero dynamic shapes. Empty
   descendant leaves are unreachable by construction.
-* **The sweep**: hyperparameter × fold configurations run under ``lax.map``
-  (sequential per chip — histogram building already saturates the chip) and
-  shard over the 'model' mesh axis across chips via ``sharded_fit_batch``;
-  CV folds are 0/1 row weights exactly like the linear families.
+* **The sweep**: hyperparameter × fold configurations run in chunks of
+  ``_CFG_CHUNK_COLS``-bounded vmaps (one wide histogram matmul per tree
+  level for the whole chunk) under an outer ``lax.map``; CV folds are 0/1
+  row weights exactly like the linear families.
 * Binned routing and raw-value routing agree exactly: bin(x) = #{edges < x},
   so (bin > b) ⇔ (x > edges[b]) even with tied edges.
 """
@@ -44,19 +52,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.tree_hist import hist_matmul, route_matmul
+from ..ops.forest import forest_leaf_sums, forest_predict
+from ..ops.tree_hist import hist_matmul
 from .api import FittedParams, ModelFamily, register_family
 
 N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 
 #: split-search sample cap: histograms are built from at most this many
 #: evenly-strided rows (weights rescaled by n/S so count-based stopping
-#: criteria keep full-data semantics); leaf values use ALL rows.
+#: criteria keep full-data semantics); served leaf values use ALL rows
+#: (exact refit pass), sweep-time leaf values use the sample.
 _HIST_SAMPLE = 65536
 
-#: trees per chunk in the exact-leaf full-data pass (bounds the (rows,
-#: trees·leaves) one-hot transient)
-_LEAF_CHUNK = 8
+#: config-chunk sizing: batch configurations together until the deepest
+#: level's histogram node width (configs x trees x nodes) reaches this
+#: bound, then lax.map over chunks (bounds the (S, width) transients)
+_CFG_CHUNK_COLS = 16384
+
+#: trees per fused-descent call (ops/forest.py pallas cap)
+_PREDICT_TREE_CHUNK = 128
 
 
 # ---------------------------------------------------------------------------
@@ -84,87 +98,56 @@ def _sample_rows(n: int) -> np.ndarray:
     return np.linspace(0, n - 1, _HIST_SAMPLE).astype(np.int64)
 
 
-def _route_codes(codes: jnp.ndarray, feat_heaps: jnp.ndarray,
-                 bin_heaps: jnp.ndarray, depth: int, n_bins: int,
-                 d: int) -> jnp.ndarray:
-    """Route every row down T trees at once: per level the fused pallas
-    kernel (ops/tree_hist.py route_matmul) expands the bin codes' comparison
-    bits in VMEM and matmuls them against the level's (feature, bin)
-    selector — the (n, d·n_bins) cmp matrix (4 GB at 1M rows × 64 features)
-    never exists. Go-right bits are picked per row by a fused node-one-hot
-    reduction. feat/bin heaps: (T, 2^depth−1). Returns (n, T) leaf
-    assignments in [0, 2^depth). Every level pads its node axis to the
-    deepest level's width: on the pallas path that makes the whole loop one
-    kernel program, and on the XLA path the 128-wide contraction measures
-    FASTER than exact tiny widths (RF leaf pass 4.0s vs 5.8s at 1M rows) —
-    see the dispatch note in ops/tree_hist.py for why the cmp build also
-    stays inside each call."""
-    n = codes.shape[0]
+def _exact_leaf_stats(codes: jnp.ndarray, feat_heaps: jnp.ndarray,
+                      bin_heaps: jnp.ndarray, stats: jnp.ndarray,
+                      w: jnp.ndarray, depth: int, n_bins: int):
+    """EXACT full-data leaf statistics via the fused descent kernel
+    (ops/forest.py): route every row down T trees and accumulate stat sums
+    per (tree, leaf) without any (n, T·m) HBM intermediate. Returns
+    (T, L, k) stat sums and (T, L) weight sums. f32 end to end — leaf
+    values are served predictions and must not inherit bf16 rounding."""
     T = feat_heaps.shape[0]
-    m_max = 2 ** (depth - 1)
-    node = jnp.zeros((n, T), jnp.int32)
-    for level in range(depth):
-        base = 2 ** level - 1
-        m = 2 ** level
-        f_lvl = jnp.pad(feat_heaps[:, base:base + m],
-                        ((0, 0), (0, m_max - m)))
-        b_lvl = jnp.pad(bin_heaps[:, base:base + m],
-                        ((0, 0), (0, m_max - m)), constant_values=n_bins)
-        D = route_matmul(codes, f_lvl.reshape(-1), b_lvl.reshape(-1),
-                         n_bins)
-        D = D.reshape(n, T, -1)[:, :, :m]
-        n_oh = (node[:, :, None]
-                == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
-        go = (D * n_oh).sum(-1)                            # (n, T)
-        node = 2 * node + (go > 0.5).astype(jnp.int32)
-    return node
-
-
-def _leaf_reduce_forest(node: jnp.ndarray, stats: jnp.ndarray,
-                        w: jnp.ndarray, depth: int):
-    """Exact leaf statistics for T trees at once: a (T·L)-wide leaf-one-hot
-    matmul. node: (n, T). Returns (T, L, k) stat sums and (T, L) weights."""
-    n, T = node.shape
-    L = 2 ** depth
-    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]  # (n, T)
-    # f32 one-hot and stats: leaf values are served predictions, so they
-    # must not inherit bf16 rounding (histogram matmuls may; these may not)
-    l_oh = (comb[:, :, None].reshape(n, T, 1)
-            == jnp.arange(T * L, dtype=jnp.int32).reshape(1, T, L)
-            ).astype(jnp.float32).reshape(n, T * L)
     aug = jnp.concatenate([stats * w[:, None], w[:, None]], axis=1)
-    out = jnp.einsum("na,nk->ak", l_oh, aug.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)     # (T·L, k+1)
-    out = out.reshape(T, L, -1)
+    parts = []
+    for lo in range(0, T, _PREDICT_TREE_CHUNK):
+        hi = min(lo + _PREDICT_TREE_CHUNK, T)
+        parts.append(forest_leaf_sums(
+            codes, feat_heaps[lo:hi], bin_heaps[lo:hi], aug,
+            depth=depth, n_bins=n_bins))
+    out = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
     return out[..., :-1], out[..., -1]
 
-
-# ---------------------------------------------------------------------------
-# Single-tree growth
-# ---------------------------------------------------------------------------
 
 def _split_gain(SL, SR, total, cfg, mode: str):
     """Gain + validity for every candidate split.
 
-    SL/SR: (m, d, n_bins-1, k) left/right stats; total: (m, k).
+    SL/SR: (m, d, n_bins-1, k) left/right stats; total: (m, k); cfg values
+    are scalars (per-config growth under vmap) or (m,) arrays (the
+    tree-batched grower, one entry per heap node).
     mode 'gh': stats = [grad, hess, count] — XGBoost-style Newton gain,
     normalized by parent count so min_info_gain is scale-free (matches the
     variance-impurity gain Spark compares against minInfoGain).
     mode 'counts': stats = per-class weighted counts — Gini gain.
     """
+    def bc(v):  # broadcast a scalar or (m,) cfg entry over (m, d, nb-1)
+        v = jnp.asarray(v)
+        return v[:, None, None] if v.ndim == 1 else v
+
     if mode == "gh":
-        lam = cfg["lam"]
+        lam_v = jnp.asarray(cfg["lam"])          # scalar or (m,)
+        lam = bc(lam_v)
         GL, HL, CL = SL[..., 0], SL[..., 1], SL[..., 2]
         GR, HR, CR = SR[..., 0], SR[..., 1], SR[..., 2]
         GP, HP, CP = total[:, 0], total[:, 1], total[:, 2]
 
-        def score(G, H):
-            return G * G / (H + lam + 1e-12)
+        def score(G, H, l):
+            return G * G / (H + l + 1e-12)
 
-        raw = score(GL, HL) + score(GR, HR) - score(GP, HP)[:, None, None]
+        raw = (score(GL, HL, lam) + score(GR, HR, lam)
+               - score(GP, HP, lam_v)[:, None, None])
         gain = raw / jnp.maximum(CP, 1.0)[:, None, None]
-        mcw = cfg["min_child_weight"]
-        mi = jnp.maximum(cfg["min_instances"], 1e-6)
+        mcw = bc(cfg["min_child_weight"])
+        mi = jnp.maximum(bc(cfg["min_instances"]), 1e-6)
         valid = (CL >= mi) & (CR >= mi) & (HL >= mcw) & (HR >= mcw)
         return gain, valid
     # Gini (classification trees)
@@ -179,7 +162,7 @@ def _split_gain(SL, SR, total, cfg, mode: str):
     impP = gini(total, wP)[:, None, None]
     wPn = jnp.maximum(wP, 1e-12)[:, None, None]
     gain = impP - (wL / wPn) * gini(SL, wL) - (wR / wPn) * gini(SR, wR)
-    mi = jnp.maximum(cfg["min_instances"], 1e-6)
+    mi = jnp.maximum(bc(cfg["min_instances"]), 1e-6)
     valid = (wL >= mi) & (wR >= mi)
     return gain, valid
 
@@ -194,34 +177,36 @@ def _grow_tree(codes_s, edges, stats_s, w_s, feat_mask, cfg, *,
     {max_depth, min_instances, min_info_gain, lam, min_child_weight}.
 
     Each level's histogram is ONE fused one-hot matmul — (node-one-hot ⊗
-    weighted stats)ᵀ expanded against the bin codes → (m·k, d·n_bins) — and
-    sample routing is the fused route_matmul, both pallas kernels from
-    ops/tree_hist.py (neither the bin one-hot nor the cmp matrix ever
-    reaches HBM; non-TPU backends fall back to the XLA einsums). Both batch
-    cleanly under vmap over trees/configs (shared codes are never copied —
-    vmap widens the stat/node columns of the single kernel call). Returns (feat_heap (2^D−1,),
-    thresh_heap (2^D−1,), bin_heap (2^D−1,) int32 with sentinel n_bins for
-    non-splits, node_s (S,) final sample leaf assignment).
+    weighted stats)ᵀ expanded against the bin codes (hist_matmul,
+    ops/tree_hist.py; the bin one-hot never reaches HBM on the pallas path)
+    — and sample routing is a plain-XLA feature-select matmul: a (d, m)
+    one-hot of the chosen split features gathers each node's bin code for
+    an elementwise threshold compare. Batches under vmap over trees/configs
+    (GBT's per-round trees); the heavily-batched DT/RF sweeps use the
+    tree-batched `_grow_forest` instead, whose flattened lane layout avoids
+    the tiny-minor-dim arrays vmap produces here. Returns (feat_heap
+    (2^D−1,), thresh_heap (2^D−1,), bin_heap (2^D−1,) int32 with sentinel
+    n_bins for non-splits, node_s (S,) final sample leaf assignment).
     """
     S = codes_s.shape[0]
     d = feat_mask.shape[0]
     k = stats_s.shape[1]
     sw = (stats_s * w_s[:, None]).astype(jnp.bfloat16)      # (S, k)
+    codes_f = codes_s.astype(jnp.bfloat16)  # bin codes < 256: exact in bf16
     feat_heap = jnp.zeros((2 ** depth - 1,), jnp.int32)
     thr_heap = jnp.full((2 ** depth - 1,), jnp.inf, dtype=jnp.float32)
     bin_heap = jnp.full((2 ** depth - 1,), n_bins, dtype=jnp.int32)
     node = jnp.zeros((S,), jnp.int32)
-    # every level calls the histogram kernel at the deepest level's width so
-    # the whole loop shares ONE pallas program (early levels pad with zero
-    # columns — the kernel is far from the bottleneck, compiles are not)
-    mk_max = 2 ** (depth - 1) * k
+    # each level runs at its NATURAL node width m = 2^level (half the
+    # padded-to-deepest FLOPs summed over levels); under vmap the batch axis
+    # widens the histogram's stat columns, one kernel call per level for the
+    # whole chunk
     for level in range(depth):
         m = 2 ** level
         n_oh = (node[:, None]
                 == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
         A = (n_oh[:, :, None] * sw[:, None, :]).reshape(S, m * k)
-        A = jnp.pad(A, ((0, 0), (0, mk_max - m * k)))
-        hist = hist_matmul(codes_s, A, n_bins)[:m * k]
+        hist = hist_matmul(codes_s, A, n_bins)
         hist = hist.reshape(m, k, d, n_bins).transpose(0, 2, 3, 1)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                      # (m, k) node totals
@@ -243,18 +228,123 @@ def _grow_tree(codes_s, edges, stats_s, w_s, feat_mask, cfg, *,
         thr_heap = thr_heap.at[m - 1: 2 * m - 1].set(thr)
         bb_eff = jnp.where(do_split, bb, n_bins)
         bin_heap = bin_heap.at[m - 1: 2 * m - 1].set(bb_eff)
-        f_pad = jnp.pad(jnp.where(do_split, bf, 0), (0, 2 ** (depth - 1) - m))
-        b_pad = jnp.pad(bb_eff, (0, 2 ** (depth - 1) - m),
-                        constant_values=n_bins)
-        D = route_matmul(codes_s, f_pad, b_pad, n_bins)[:, :m]   # (S, m)
-        go = (D * n_oh).sum(-1) > 0.5
+        # feature-select routing: gather each node's split-feature code by a
+        # (d, m) one-hot matmul, compare against the bin threshold (sentinel
+        # n_bins ⇒ never greater ⇒ route left), pick the row's node via the
+        # n_oh mask already built for the histogram
+        f_sel = (jnp.where(do_split, bf, 0)[None, :]
+                 == jnp.arange(d, dtype=jnp.int32)[:, None]
+                 ).astype(jnp.bfloat16)                          # (d, m)
+        code_sel = codes_f @ f_sel                               # (S, m)
+        go_m = (code_sel > bb_eff.astype(jnp.bfloat16)
+                ).astype(jnp.bfloat16)
+        go = jnp.sum(go_m * n_oh, axis=1) > 0.5
         node = 2 * node + go.astype(jnp.int32)
     return feat_heap, thr_heap, bin_heap, node
 
 
+def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
+                 n_bins: int, mode: str):
+    """Grow Tb complete-heap trees AT ONCE on the split-search sample.
+
+    The tree batch (configs × trees) lives flattened in the lane axis from
+    end to end — every intermediate is (S, Tb·m)-shaped with a large minor
+    dimension, because TPU arrays pad the minor-most dim to 128 lanes and a
+    (S, Tb, k≈2) layout wastes 64× HBM (measured OOM under the vmapped
+    per-tree grower).
+
+    codes_s: (S, d) shared int32 bin codes; sw_list: k arrays (S, Tb) — the
+    per-tree stat·rowweight products, one array per stat so no tiny-minor
+    array ever exists; fmasks: (Tb, d) feature subsets; cfg: dict of (Tb,)
+    per-tree scalars. Returns (feat (Tb,H), thresh (Tb,H), bins (Tb,H),
+    node_s (S, Tb))."""
+    S, d = codes_s.shape
+    Tb = sw_list[0].shape[1]
+    k = len(sw_list)
+    codes_f = codes_s.astype(jnp.bfloat16)
+    H = 2 ** depth - 1
+    feat_heap = jnp.zeros((Tb, H), jnp.int32)
+    thr_heap = jnp.full((Tb, H), jnp.inf, jnp.float32)
+    bin_heap = jnp.full((Tb, H), n_bins, jnp.int32)
+    node = jnp.zeros((S, Tb), jnp.int32)
+    sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
+    for level in range(depth):
+        m = 2 ** level
+        M = Tb * m
+        # lane layout t-major: lane = t*m + j (jnp.repeat = element repeat)
+        node_rep = jnp.repeat(node.astype(jnp.bfloat16), m, axis=1)  # (S, M)
+        j_iota = jnp.tile(jnp.arange(m, dtype=jnp.int32), Tb
+                          ).astype(jnp.bfloat16)
+        n_oh = (node_rep == j_iota[None, :]).astype(jnp.bfloat16)    # (S, M)
+        # one histogram call per stat keeps every operand (S, M)-shaped
+        hists = [hist_matmul(codes_s, n_oh * jnp.repeat(sw_bf[k_i], m, 1),
+                             n_bins) for k_i in range(k)]
+        hist = jnp.stack(hists, axis=-1).reshape(M, d, n_bins, k)
+        cum = jnp.cumsum(hist, axis=2)
+        total = cum[:, 0, -1, :]                       # (M, k) node totals
+        SL = cum[:, :, :-1, :]
+        SR = total[:, None, None, :] - SL
+        cfg_m = {key: jnp.repeat(v, m) for key, v in cfg.items()}
+        gain, valid = _split_gain(SL, SR, total, cfg_m, mode)
+        valid = valid & jnp.repeat(fmasks, m, axis=0)[:, :, None]
+        gain = jnp.where(valid, gain, -jnp.inf)
+        gflat = gain.reshape(M, d * (n_bins - 1))
+        best = jnp.argmax(gflat, axis=1)
+        bf = (best // (n_bins - 1)).astype(jnp.int32)
+        bb = (best % (n_bins - 1)).astype(jnp.int32)
+        bgain = jnp.take_along_axis(gflat, best[:, None], axis=1)[:, 0]
+        active = jnp.asarray(level, jnp.float32) < jnp.repeat(
+            cfg["max_depth"], m)
+        do_split = active & jnp.isfinite(bgain) & (bgain > cfg_m["min_info_gain"])
+        bf_eff = jnp.where(do_split, bf, 0)
+        bb_eff = jnp.where(do_split, bb, n_bins)
+        thr = jnp.where(do_split, edges[bf, bb], jnp.inf).astype(jnp.float32)
+        feat_heap = feat_heap.at[:, m - 1: 2 * m - 1].set(
+            bf_eff.reshape(Tb, m))
+        thr_heap = thr_heap.at[:, m - 1: 2 * m - 1].set(thr.reshape(Tb, m))
+        bin_heap = bin_heap.at[:, m - 1: 2 * m - 1].set(
+            bb_eff.reshape(Tb, m))
+        # feature-select routing: gather each node's split-feature code by a
+        # (d, M) one-hot matmul, compare against the bin threshold (sentinel
+        # n_bins ⇒ route left), select the row's node via the n_oh mask and
+        # a (M, Tb) group-sum matmul
+        sel = (bf_eff[None, :] == jnp.arange(d, dtype=jnp.int32)[:, None]
+               ).astype(jnp.bfloat16)                             # (d, M)
+        code_sel = codes_f @ sel                                  # (S, M)
+        go_lane = (code_sel > bb_eff.astype(jnp.bfloat16)
+                   ).astype(jnp.bfloat16)
+        G = ((jnp.arange(M, dtype=jnp.int32) // m)[:, None]
+             == jnp.arange(Tb, dtype=jnp.int32)[None, :]
+             ).astype(jnp.bfloat16)                               # (M, Tb)
+        go = (go_lane * n_oh) @ G                                 # (S, Tb)
+        node = 2 * node + (go > jnp.bfloat16(0.5)).astype(jnp.int32)
+    return feat_heap, thr_heap, bin_heap, node
+
+
+_DIAG_BLOCK = 16
+
+
+def _diag_leaf_hist(node_s: jnp.ndarray, A_cols: jnp.ndarray,
+                    L: int) -> jnp.ndarray:
+    """out[t, l] = Σ_s A_cols[s, t]·1[node_s[s, t] == l] — a per-tree
+    segment-sum through the histogram kernel (trees as 'features', leaves
+    as 'bins', stat columns = trees), diagonal extracted. Blocked in groups
+    of _DIAG_BLOCK trees so the cross-tree waste stays a constant factor
+    (full-width would be quadratic in the tree count)."""
+    Tb = node_s.shape[1]
+    outs = []
+    for lo in range(0, Tb, _DIAG_BLOCK):
+        hi = min(lo + _DIAG_BLOCK, Tb)
+        g = hi - lo
+        full = hist_matmul(node_s[:, lo:hi], A_cols[:, lo:hi], L)
+        outs.append(full.reshape(g, g, L)[jnp.arange(g), jnp.arange(g)])
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
 # ---------------------------------------------------------------------------
-# Batched fit drivers (lax.map over configurations)
+# Batched fit drivers (chunked vmap over configurations)
 # ---------------------------------------------------------------------------
+
 
 def _class_leaf(leaf_stats, leaf_w):
     """Per-leaf class probabilities from weighted counts."""
@@ -295,99 +385,169 @@ def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True):
     return samp, edges, binned, binned_s, stats, mode, w_scale
 
 
-@partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task"))
+@partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
+                                   "sweep"))
 def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
-                  depth, n_bins, num_classes, task):
+                  depth, n_bins, num_classes, task, sweep=False):
     d = X.shape[1]
+    B = weights.shape[0]
     samp, edges, binned, binned_s, stats, mode, w_scale = \
-        _prep_tree_inputs(X, y, n_bins, num_classes, task)
-    fmask = jnp.ones((d,), bool)
-    stats_s = stats[samp]
+        _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=not sweep)
+    stats_s = stats[samp]                                   # (S, k)
+    L = 2 ** depth
+    cb = max(1, min(B, _CFG_CHUNK_COLS // 2 ** (depth - 1)))
 
-    def grow_one(w, md, mi, mg):
+    def one_chunk(w_c, md, mi, mg):
+        """Grow cb single-tree configs in one tree-batched forest call."""
+        w_bs = w_c[:, samp].T * w_scale                     # (S, cb)
+        sw_list = [stats_s[:, k_i][:, None] * w_bs
+                   for k_i in range(stats_s.shape[1])]
         cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
-               "lam": 1e-6, "min_child_weight": 0.0}
-        return _grow_tree(binned_s, edges, stats_s, w[samp] * w_scale,
-                          fmask, cfg, depth=depth, n_bins=n_bins, mode=mode)
+               "lam": jnp.full((cb,), 1e-6, jnp.float32),
+               "min_child_weight": jnp.zeros((cb,), jnp.float32)}
+        fs, ths, bhs, node_s = _grow_forest(
+            binned_s, edges, sw_list, jnp.ones((cb, d), bool), cfg,
+            depth=depth, n_bins=n_bins, mode=mode)
+        if sweep:  # sample leaf stats (validation scoring only)
+            aug_cols = sw_list + [w_bs]
+            sums = jnp.stack(
+                [_diag_leaf_hist(node_s, c.astype(jnp.float32), L)
+                 for c in aug_cols], axis=-1)               # (cb, L, k+1)
+            ls, lw = sums[..., :-1], sums[..., -1]
+            leaf_c = (jax.vmap(_class_leaf)(ls, lw)
+                      if task == "classification"
+                      else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+        else:
+            leaf_c = jnp.zeros(
+                (cb, L, stats.shape[1] if task == "classification" else 1),
+                jnp.float32)
+        return fs, ths, bhs, leaf_c
 
-    feat, thr, bheap, _ = jax.vmap(grow_one)(
-        weights, max_depth, min_inst, min_gain)            # (B, H)
+    n_chunks = -(-B // cb)
+    B_pad = n_chunks * cb
+    args = (weights, max_depth, min_inst, min_gain)
+    if B_pad != B:
+        idx = jnp.arange(B_pad) % B
+        args = jax.tree_util.tree_map(lambda a: a[idx], args)
+    args = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_chunks, cb) + a.shape[1:]), args)
+    feat, thr, bheap, leaf = jax.lax.map(lambda ch: one_chunk(*ch), args)
+    feat, thr, bheap, leaf = jax.tree_util.tree_map(
+        lambda a: a.reshape((B_pad,) + a.shape[2:])[:B],
+        (feat, thr, bheap, leaf))
 
-    # exact full-data leaf stats, one config at a time (bounds memory)
-    def leaf_one(args):
-        f, bh, w = args
-        node = _route_codes(binned, f[None], bh[None], depth, n_bins, d)
-        ls, lw = _leaf_reduce_forest(node, stats, w, depth)
-        return (_class_leaf(ls[0], lw[0]) if task == "classification"
-                else _mean_leaf(ls[0], lw[0])[:, None])
+    if not sweep:  # EXACT full-data leaf stats via the fused descent kernel
+        def leaf_one(args):
+            f, bh, w = args
+            ls, lw = _exact_leaf_stats(binned, f[None], bh[None], stats, w,
+                                       depth, n_bins)
+            return (_class_leaf(ls[0], lw[0]) if task == "classification"
+                    else _mean_leaf(ls[0], lw[0])[:, None])
 
-    leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
+        leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
             "edges": edges}
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
-                                   "n_trees"))
+                                   "n_trees", "sweep"))
 def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                   subsample, seeds, *, depth, n_bins, num_classes, task,
-                  n_trees):
+                  n_trees, sweep=False):
     n, d = X.shape
     samp, edges, binned, binned_s, stats, mode, w_scale = \
-        _prep_tree_inputs(X, y, n_bins, num_classes, task)
+        _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=not sweep)
     # per-tree feature subset (Spark featureSubsetStrategy auto:
     # sqrt for classification, 1/3 for regression)
     p_feat = float(np.ceil(np.sqrt(d)) / d) if task == "classification" \
         else max(1.0 / 3.0, 1.0 / d)
     S = binned_s.shape[0]
+    k = stats.shape[1]
     stats_s = stats[samp]
+    L = 2 ** depth
+    B = weights.shape[0]
+    cb = max(1, min(B, _CFG_CHUNK_COLS // (n_trees * 2 ** (depth - 1))))
 
-    def one(args):
-        w, md, mi, mg, ss, seed = args
-        cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
-               "lam": 1e-6, "min_child_weight": 0.0}
-        base = jax.random.PRNGKey(seed.astype(jnp.uint32))
-        w_s = w[samp] * w_scale
+    def one_chunk(w_c, md, mi, mg, ss, seed):
+        """Grow a chunk of cb configs — cb·n_trees trees — in one
+        tree-batched forest call. Leading axes here are (cb,)."""
+        Tb = cb * n_trees
+        w_s = w_c[:, samp] * w_scale                        # (cb, S)
 
-        def grow_t(t):
-            # bootstrap the split-search sample (the forest's randomness
-            # lives in split selection; leaf stats are exact full-data
-            # class/mean statistics per grown tree)
-            k1, k2 = jax.random.split(jax.random.fold_in(base, t))
-            boot_s = jax.random.poisson(k1, ss, (S,)).astype(X.dtype)
-            fmask = jax.random.bernoulli(k2, p_feat, (d,))
-            f, th, bh, _ = _grow_tree(
-                binned_s, edges, stats_s, w_s * boot_s, fmask,
-                cfg, depth=depth, n_bins=n_bins, mode=mode)
-            return f, th, bh
+        def boots_one(seed_c, ss_c):
+            base = jax.random.PRNGKey(seed_c.astype(jnp.uint32))
 
-        fs, ths, bhs = jax.vmap(grow_t)(jnp.arange(n_trees))   # (T, H)
+            def per_tree(t):
+                k1, k2 = jax.random.split(jax.random.fold_in(base, t))
+                boot = jax.random.poisson(k1, ss_c, (S,)).astype(X.dtype)
+                fmask = jax.random.bernoulli(k2, p_feat, (d,))
+                return boot, fmask
 
-        # exact full-data leaf stats in chunks of _LEAF_CHUNK trees: the
-        # all-trees-at-once (n, T·L) leaf-one-hot peaks several GB at
-        # millions of rows; per-chunk it is (n, C·L) while the matmuls stay
-        # batched. Padded chunk slots carry sentinel heaps (all rows → leaf
-        # 0) and are dropped after.
-        C = _LEAF_CHUNK
-        T_pad = -(-n_trees // C) * C
-        fs_p = jnp.pad(fs, ((0, T_pad - n_trees), (0, 0)))
-        bhs_p = jnp.pad(bhs, ((0, T_pad - n_trees), (0, 0)),
-                        constant_values=n_bins)
+            return jax.vmap(per_tree)(jnp.arange(n_trees))
 
-        def leaf_chunk(args):
-            f_c, bh_c = args                                   # (C, H)
-            node = _route_codes(binned, f_c, bh_c, depth, n_bins, d)
-            ls, lw = _leaf_reduce_forest(node, stats, w, depth)
+        boots, fmasks = jax.vmap(boots_one)(seed, ss)   # (cb,T,S) (cb,T,d)
+        # per-tree row weight = config fold weight x bootstrap; flatten the
+        # (config, tree) axes into the lane dim: t-major lane = c*T + t
+        w_ts = (w_s[:, None, :] * boots).reshape(Tb, S).T   # (S, Tb)
+        sw_list = [stats_s[:, k_i][:, None] * w_ts for k_i in range(k)]
+        cfg = {"max_depth": jnp.repeat(md, n_trees),
+               "min_instances": jnp.repeat(mi, n_trees),
+               "min_info_gain": jnp.repeat(mg, n_trees),
+               "lam": jnp.full((Tb,), 1e-6, jnp.float32),
+               "min_child_weight": jnp.zeros((Tb,), jnp.float32)}
+        fs, ths, bhs, node_s = _grow_forest(
+            binned_s, edges, sw_list, fmasks.reshape(Tb, d), cfg,
+            depth=depth, n_bins=n_bins, mode=mode)
+
+        if sweep:
+            # sample leaf stats per config: trees of config c share its
+            # fold weights, so one (k+1)-column histogram per config
+            leaves = []
+            for c in range(cb):
+                nc = node_s[:, c * n_trees:(c + 1) * n_trees]
+                aug = jnp.concatenate(
+                    [stats_s * w_s[c][:, None], w_s[c][:, None]], axis=1)
+                out = hist_matmul(nc, aug.astype(jnp.float32), L)
+                out = out.reshape(k + 1, n_trees, L).transpose(1, 2, 0)
+                ls, lw = out[..., :-1], out[..., -1]
+                leaves.append(
+                    jax.vmap(_class_leaf)(ls, lw)
+                    if task == "classification"
+                    else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+            leaf_c = jnp.stack(leaves)                      # (cb, T, L, k')
+        else:
+            leaf_c = jnp.zeros(
+                (cb, n_trees, L, k if task == "classification" else 1),
+                jnp.float32)
+        Hp = fs.shape[-1]
+        return (fs.reshape(cb, n_trees, Hp), ths.reshape(cb, n_trees, Hp),
+                bhs.reshape(cb, n_trees, Hp), leaf_c)
+
+    n_chunks = -(-B // cb)
+    B_pad = n_chunks * cb
+    args = (weights, max_depth, min_inst, min_gain, subsample, seeds)
+    if B_pad != B:
+        idx = jnp.arange(B_pad) % B
+        args = jax.tree_util.tree_map(lambda a: a[idx], args)
+    args = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_chunks, cb) + a.shape[1:]), args)
+    feat, thr, bheap, leaf = jax.lax.map(lambda ch: one_chunk(*ch), args)
+    feat, thr, bheap, leaf = jax.tree_util.tree_map(
+        lambda a: a.reshape((B_pad,) + a.shape[2:])[:B],
+        (feat, thr, bheap, leaf))
+
+    if not sweep:
+        # EXACT full-data leaf stats per config (fused descent kernel is a
+        # pallas call — sequential per config, outside the batched grower)
+        def leaf_one(args):
+            f, bh, w = args
+            ls, lw = _exact_leaf_stats(binned, f, bh, stats, w, depth,
+                                       n_bins)
             return (jax.vmap(_class_leaf)(ls, lw)
                     if task == "classification"
                     else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
 
-        lv = jax.lax.map(leaf_chunk, (fs_p.reshape(T_pad // C, C, -1),
-                                      bhs_p.reshape(T_pad // C, C, -1)))
-        leaves = lv.reshape(T_pad, *lv.shape[2:])[:n_trees]    # (T, L, k)
-        return fs, ths, bhs, leaves
-
-    feat, thr, bheap, leaf = jax.lax.map(
-        one, (weights, max_depth, min_inst, min_gain, subsample, seeds))
+        leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
     tree_mask = (jnp.arange(n_trees)[None, :] <
                  num_trees[:, None]).astype(jnp.float32)
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
@@ -434,8 +594,11 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
             depth=depth, n_bins=n_bins, mode="gh")
         l_oh = (node_s[:, None]
                 == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+        # HIGHEST: default matmul precision truncates f32 operands to bf16;
+        # leaf Newton values -G/H must not round
         sums = jnp.einsum("sl,sk->lk", l_oh, st * w_b[:, None],
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
         leaf = -sums[:, 0] / (sums[:, 1] + lm + 1e-12)
         pred_s = leaf[node_s]
         return f, th, bh, leaf, pred_s
@@ -486,31 +649,28 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
 # Batched predict drivers
 # ---------------------------------------------------------------------------
 
-def _leaf_select(node, leaf_flat):
-    """(n, A) one-hot of node-with-offset → values; fused one-hot matmul.
-    node: (n, T) leaf ids; leaf_flat: (T·L, k) values. Returns (n, k) sums
-    over trees (leaf_flat rows carry any per-tree weighting)."""
-    n, T = node.shape
-    A, k = leaf_flat.shape
-    L = A // T
-    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
-    # f32 end to end: served predictions must match the exact leaf values
-    l_oh = (comb[:, :, None]
-            == jnp.arange(A, dtype=jnp.int32).reshape(1, T, L)
-            ).astype(jnp.float32).reshape(n, A)
-    return jnp.einsum("na,ak->nk", l_oh, leaf_flat.astype(jnp.float32),
-                      preferred_element_type=jnp.float32)
+def _forest_values(codes, feat_heaps, bin_heaps, leaf, *, depth, n_bins):
+    """Σ_t leaf[t, node(row, t), :] via the fused descent kernel, chunking
+    the tree axis at the kernel's cap. leaf: (T, L, k) with any per-tree
+    weighting baked in."""
+    T = feat_heaps.shape[0]
+    out = None
+    for lo in range(0, T, _PREDICT_TREE_CHUNK):
+        hi = min(lo + _PREDICT_TREE_CHUNK, T)
+        part = forest_predict(codes, feat_heaps[lo:hi], bin_heaps[lo:hi],
+                              leaf[lo:hi], depth=depth, n_bins=n_bins)
+        out = part if out is None else out + part
+    return out
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
-    d = X.shape[1]
     codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l = args
-        node = _route_codes(codes, f[None], bh[None], depth, n_bins, d)
-        return _leaf_select(node, l)                       # (n, k)
+        return _forest_values(codes, f[None], bh[None], l[None],
+                              depth=depth, n_bins=n_bins)  # (n, k)
 
     return jax.lax.map(one, (feat, bins, leaf))            # (B, n, k)
 
@@ -518,15 +678,12 @@ def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
                       n_bins):
-    d = X.shape[1]
     codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l, m = args                                 # (T,H) (T,L,k) (T,)
-        T, L, k = l.shape
-        node = _route_codes(codes, f, bh, depth, n_bins, d)
-        lw = (l * m[:, None, None]).reshape(T * L, k)
-        s = _leaf_select(node, lw)
+        s = _forest_values(codes, f, bh, l * m[:, None, None],
+                           depth=depth, n_bins=n_bins)
         return s / jnp.maximum(m.sum(), 1.0)
 
     return jax.lax.map(one, (feat, bins, leaf, tree_mask))  # (B, n, k)
@@ -535,21 +692,21 @@ def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_gbt_batch(feat, bins, leaf, f0, eta, tree_mask, edges, X, *,
                        depth, n_bins):
-    d = X.shape[1]
     codes = _bin_features(X, edges)
 
     def one(args):
         f, bh, l, f0b, etab, m = args     # (T,C,H), leaf (T,C,L), m (T,)
         T, C, H = f.shape
         L = l.shape[-1]
-        node = _route_codes(codes, f.reshape(T * C, H), bh.reshape(T * C, H),
-                            depth, n_bins, d)              # (n, T·C)
-        # class-routing matrix: value·one-hot(class) per (tree, class, leaf)
-        lv = (l * m[:, None, None]).reshape(T * C * L)
-        cls = jnp.tile(jnp.repeat(jnp.arange(C), L), T)
-        M = lv[:, None] * (cls[:, None]
-                           == jnp.arange(C)).astype(lv.dtype)  # (T·C·L, C)
-        contrib = _leaf_select(node, M)                    # (n, C)
+        # class-routing leaf table: value·one-hot(class) per (tree·class,
+        # leaf) so one descent over T·C trees yields per-class margins
+        lv = l * m[:, None, None]                          # (T, C, L)
+        cls_oh = (jnp.arange(C)[:, None]
+                  == jnp.arange(C)[None, :]).astype(lv.dtype)  # (C, C)
+        M = lv[:, :, :, None] * cls_oh[None, :, None, :]   # (T, C, L, C)
+        contrib = _forest_values(
+            codes, f.reshape(T * C, H), bh.reshape(T * C, H),
+            M.reshape(T * C, L, C), depth=depth, n_bins=n_bins)  # (n, C)
         return (f0b[None, :] + etab * contrib).T           # (C, n)
 
     return jax.lax.map(one, (feat, bins, leaf, f0, eta, tree_mask))
@@ -565,9 +722,16 @@ def _g(grid, key, default):
 
 
 class _TreeFamilyBase(ModelFamily):
-    #: config sweep runs under lax.map (sequential per chip), so the batch
-    #: axis cannot shard over the 'model' mesh axis; rows still shard.
+    #: config sweep runs under chunked lax.map (sequential per chip), so the
+    #: batch axis cannot shard over the 'model' mesh axis; rows still shard.
     shardable = False
+
+    def sweep_fit_batch(self, X, y, weights, grid, num_classes):
+        """CV-sweep fits: leaf values come from the split-search sample —
+        the sweep only scores validation rows with them, and the winner is
+        refit via plain ``fit_batch`` with EXACT full-data leaves (reference
+        ModelSelector.fit refits best on full prepared train :158-159)."""
+        return self.fit_batch(X, y, weights, grid, num_classes, sweep=True)
 
     task_of = staticmethod(lambda problem: "classification"
                            if problem in ("binary", "multiclass")
@@ -604,6 +768,66 @@ class _TreeFamilyBase(ModelFamily):
 _DEPTHS = (3, 6)
 
 
+def _embed_depth(params, d_small: int, d_max: int, n_bins: int,
+                 leaf_axis: int):
+    """Re-express a depth-``d_small`` fit in the depth-``d_max`` layout.
+
+    Complete heaps are level-ordered, so the small heap is a PREFIX of the
+    big one (remaining nodes: sentinel ⇒ route left), and a row at small
+    leaf l descends all-left to big leaf l·(L_max/L_small) — the embedding
+    is exact, letting mixed-maxDepth grids share one predict program while
+    each depth bucket pays only its own growth cost."""
+    if d_small == d_max:
+        return params
+    H_s, H_m = 2 ** d_small - 1, 2 ** d_max - 1
+    r = 2 ** (d_max - d_small)
+    out = dict(params)
+
+    def pad_last(a, value):
+        pad = [(0, 0)] * (a.ndim - 1) + [(0, H_m - H_s)]
+        return jnp.pad(a, pad, constant_values=value)
+
+    out["feat"] = pad_last(params["feat"], 0)
+    out["thresh"] = pad_last(params["thresh"], jnp.inf)
+    out["bins"] = pad_last(params["bins"], n_bins)
+    leaf = params["leaf"]
+    ax = leaf_axis % leaf.ndim
+    shape = list(leaf.shape)
+    shape[ax] = shape[ax] * r
+    idx = [slice(None)] * leaf.ndim
+    idx[ax] = slice(None, None, r)
+    out["leaf"] = jnp.zeros(shape, leaf.dtype).at[tuple(idx)].set(leaf)
+    return out
+
+
+def _fit_depth_grouped(grid, weights, fit_group, n_bins: int,
+                       leaf_axis: int):
+    """Partition the config batch by maxDepth and fit each bucket with its
+    own (cheap) depth program, embedding results into the deepest layout.
+    ``fit_group(sub_grid, sub_weights, depth) -> params``. maxDepth values
+    are host-side constants (grid arrays), so grouping is static."""
+    md = np.asarray(grid["maxDepth"], dtype=np.float64).reshape(-1)
+    uniq = sorted({int(v) for v in md})
+    d_max = uniq[-1]
+    if len(uniq) == 1:
+        return fit_group(grid, weights, d_max)
+    B = md.shape[0]
+    stitched = None
+    for u in uniq:
+        idx = np.nonzero(md == u)[0]
+        sub = {k: v[idx] for k, v in grid.items()}
+        p = _embed_depth(fit_group(sub, weights[idx], u), u, d_max,
+                         n_bins, leaf_axis)
+        if stitched is None:
+            stitched = {k: (v if k == "edges"
+                            else jnp.zeros((B,) + v.shape[1:], v.dtype))
+                        for k, v in p.items()}
+        for k, v in p.items():
+            if k != "edges":
+                stitched[k] = stitched[k].at[jnp.asarray(idx)].set(v)
+    return stitched
+
+
 class DecisionTreeFamilyBase(_TreeFamilyBase):
     """reference OpDecisionTreeClassifier/Regressor (grids per
     DefaultSelectorParams: maxDepth × minInstancesPerNode {10,100}
@@ -614,14 +838,18 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
                 for d in _DEPTHS for mi in (10, 100)
                 for mg in (0.001, 0.01, 0.1)]
 
-    def fit_batch(self, X, y, weights, grid, num_classes):
+    def fit_batch(self, X, y, weights, grid, num_classes, sweep=False):
         task = self._task(num_classes)
-        depth = int(np.max(np.asarray(grid["maxDepth"])))
-        return _fit_dt_batch(
-            X, y, weights, grid["maxDepth"], _g(grid, "minInstancesPerNode", 1.0),
-            _g(grid, "minInfoGain", 0.0),
-            depth=depth, n_bins=N_BINS,
-            num_classes=max(num_classes, 2), task=task)
+
+        def fit_group(g, w, depth):
+            return _fit_dt_batch(
+                X, y, w, g["maxDepth"], _g(g, "minInstancesPerNode", 1.0),
+                _g(g, "minInfoGain", 0.0),
+                depth=depth, n_bins=N_BINS,
+                num_classes=max(num_classes, 2), task=task, sweep=sweep)
+
+        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
+                                  leaf_axis=-2)
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
@@ -649,18 +877,24 @@ class RandomForestFamilyBase(_TreeFamilyBase):
                 for d in _DEPTHS for mi in (10, 100)
                 for mg in (0.001, 0.01, 0.1)]
 
-    def fit_batch(self, X, y, weights, grid, num_classes):
+    def fit_batch(self, X, y, weights, grid, num_classes, sweep=False):
         task = self._task(num_classes)
-        depth = int(np.max(np.asarray(grid["maxDepth"])))
         n_trees = int(np.max(np.asarray(_g(grid, "numTrees", 20.0))))
         B = weights.shape[0]
         seeds = jnp.arange(B, dtype=jnp.float32) + 7.0
-        return _fit_rf_batch(
-            X, y, weights, grid["maxDepth"],
-            _g(grid, "minInstancesPerNode", 1.0), _g(grid, "minInfoGain", 0.0),
-            _g(grid, "numTrees", 20.0), _g(grid, "subsamplingRate", 1.0),
-            seeds, depth=depth, n_bins=N_BINS,
-            num_classes=max(num_classes, 2), task=task, n_trees=n_trees)
+        grid = dict(grid, _seeds=seeds)
+
+        def fit_group(g, w, depth):
+            return _fit_rf_batch(
+                X, y, w, g["maxDepth"],
+                _g(g, "minInstancesPerNode", 1.0), _g(g, "minInfoGain", 0.0),
+                _g(g, "numTrees", 20.0), _g(g, "subsamplingRate", 1.0),
+                g["_seeds"], depth=depth, n_bins=N_BINS,
+                num_classes=max(num_classes, 2), task=task, n_trees=n_trees,
+                sweep=sweep)
+
+        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
+                                  leaf_axis=-2)
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
@@ -697,18 +931,24 @@ class GBTFamilyBase(_TreeFamilyBase):
             return "regression"
         return "multiclass" if num_classes > 2 else "binary"
 
-    def fit_batch(self, X, y, weights, grid, num_classes):
+    def fit_batch(self, X, y, weights, grid, num_classes, sweep=False):
+        # GBT trains entirely on the split-search sample: sweep and refit
+        # are the same program
         task = self._gbt_task(num_classes)
-        depth = int(np.max(np.asarray(grid["maxDepth"])))
         n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
-        return _fit_gbt_batch(
-            X, y, weights, grid["maxDepth"],
-            _g(grid, "minInstancesPerNode", 0.0), _g(grid, "minInfoGain", 0.0),
-            _g(grid, "maxIter", 20.0), _g(grid, "stepSize", 0.1),
-            _g(grid, "lambda", self.lam_default),
-            _g(grid, "minChildWeight", self.mcw_default),
-            depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
-            task=task, n_rounds=n_rounds)
+
+        def fit_group(g, w, depth):
+            return _fit_gbt_batch(
+                X, y, w, g["maxDepth"],
+                _g(g, "minInstancesPerNode", 0.0), _g(g, "minInfoGain", 0.0),
+                _g(g, "maxIter", 20.0), _g(g, "stepSize", 0.1),
+                _g(g, "lambda", self.lam_default),
+                _g(g, "minChildWeight", self.mcw_default),
+                depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
+                task=task, n_rounds=n_rounds)
+
+        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
+                                  leaf_axis=-1)
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-1])

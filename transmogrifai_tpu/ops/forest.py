@@ -1,0 +1,342 @@
+"""Pallas TPU kernel: fused multi-level forest descent.
+
+The full-data passes of tree fitting and scoring (models/trees.py) both do
+
+    node[s, t]  =  leaf reached by row s in tree t          (descent)
+    then either Σ_s aug[s, k]·1[node==l]                    (exact leaf stats)
+    or          Σ_t leaf[t, node[s,t], k]                   (prediction)
+
+Done per level in XLA this materializes (n, T·m) decision matrices and
+(n, T·L) leaf one-hots in HBM — at 1M rows × 50 trees that is gigabytes per
+config and was ~97% of the RandomForest sweep's wall clock (356 ms per
+config; the whole default RF grid 12.8 s). This kernel performs the whole
+descent for a row block in VMEM:
+
+- per level, the split feature's bin code is *gathered by matmul*: a (d, T·m)
+  one-hot of the level's split features against the row block's codes —
+  gathers are scatters' evil twin on TPU, but a gather whose index set is
+  shared by every row IS a matmul, and matmuls are what the MXU is for;
+- the go-right bit is one f32 compare against the level's bin thresholds
+  (sentinel bin = n_bins ⇒ always left, which also makes padded trees and
+  stopped nodes route to leaf 0 with zero extra logic);
+- the per-row node is selected from the (T·m) candidate bits by an equality
+  mask against a lane iota and a tiny (T·m, T) group-sum matmul;
+- the leaf one-hot for the final reduction never leaves VMEM: leaf sums are
+  accumulated into a (k, T·L) f32 block across the row grid; predictions are
+  a (R, T·L)×(T·L, k) matmul against the leaf-value table.
+
+HBM traffic per config drops to: read codes once (n·d int32), write either
+(T, L, k) sums or (n, k) predictions. No (n, T·m) intermediate exists.
+
+Replaces the reference's per-executor SparkML `Node.predictImpl` recursion
+and the XGBoost JNI predictor (reference: SURVEY §2.9) with a TPU-native
+kernel. Layout notes: lanes are j-major — lane = j·T_pad + t — because
+`pltpu.repeat` tiles whole vectors along lanes, so repeating the (R, T_pad)
+node vector m times lines tree t up with every candidate j at lane j·T_pad+t.
+
+Fallback: non-TPU backends (CPU test mesh, dry runs) and shapes outside the
+VMEM envelope (depth > 7 or > 128 trees) run the same math as XLA einsums.
+Dispatch reads the backend at trace time (see tree_hist.py note).
+"""
+from __future__ import annotations
+
+import math
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+from .tree_hist import _interpret, _pad_to, _use_pallas
+
+import os as _os
+
+_BLK_R = int(_os.environ.get("TG_FOREST_BLK_R", "128"))  # rows per VMEM block
+_MAX_DEPTH_PALLAS = 7  # beyond this the (R, T·m) block outgrows VMEM
+_MAX_TREES_PALLAS = 128
+
+
+def _t_pad(T: int, depth: int) -> int:
+    """Smallest tree-axis padding making every lane width a 128-multiple."""
+    m_max = 2 ** max(depth - 1, 0)
+    L = 2 ** depth
+    need = max(128 // math.gcd(m_max, 128), 128 // math.gcd(L, 128), 8)
+    return _pad_to(T, need)
+
+
+def _level_tables(feat_heap: jnp.ndarray, bin_heap: jnp.ndarray, depth: int,
+                  n_bins: int, T_pad: int):
+    """j-major per-level split tables, each level padded to m_max lanes.
+
+    Returns (depth, T_pad·m_max) int32 f_lvls / b_lvls with sentinel bins in
+    every padded slot (tree, level-width, or stopped node)."""
+    T = feat_heap.shape[0]
+    m_max = 2 ** (depth - 1)
+    f_rows, b_rows = [], []
+    for level in range(depth):
+        base, m = 2 ** level - 1, 2 ** level
+        f = jnp.pad(feat_heap[:, base:base + m],
+                    ((0, T_pad - T), (0, m_max - m)))
+        b = jnp.pad(bin_heap[:, base:base + m],
+                    ((0, T_pad - T), (0, m_max - m)),
+                    constant_values=n_bins)
+        # (T_pad, m_max) -> j-major flat: lane j*T_pad + t
+        f_rows.append(f.T.reshape(-1))
+        b_rows.append(b.T.reshape(-1))
+    return jnp.stack(f_rows).astype(jnp.int32), \
+        jnp.stack(b_rows).astype(jnp.int32)
+
+
+def _descend(codes_f, f_lvls_ref, b_lvls_ref, *, depth, T_pad, d_pad):
+    """In-kernel: (R, d_pad) f32 codes → (R, T_pad) int32 leaf ids."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = codes_f.shape[0]
+    m_max = 2 ** (depth - 1)
+    L2 = T_pad * m_max
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, L2), 1)
+    j_of_lane = lane // T_pad
+    # group-sum matrix: lane j*T_pad + t -> tree t
+    gl = jax.lax.broadcasted_iota(jnp.int32, (L2, T_pad), 0) % T_pad
+    gt = jax.lax.broadcasted_iota(jnp.int32, (L2, T_pad), 1)
+    G = (gl == gt).astype(jnp.bfloat16)
+    d_iota = jax.lax.broadcasted_iota(jnp.int32, (d_pad, L2), 0)
+
+    node = jnp.zeros((R, T_pad), jnp.int32)
+    for level in range(depth):
+        f_row = f_lvls_ref[level, :].reshape(1, L2)
+        b_row = b_lvls_ref[level, :].reshape(1, L2)
+        sel = (d_iota == f_row).astype(jnp.bfloat16)          # (d_pad, L2)
+        code_sel = jnp.dot(codes_f.astype(jnp.bfloat16), sel,
+                           preferred_element_type=jnp.float32)  # (R, L2)
+        go_lane = (code_sel > b_row.astype(jnp.float32)
+                   ).astype(jnp.bfloat16)
+        node_rep = pltpu.repeat(node, m_max, axis=1)          # (R, L2)
+        oh = (node_rep == j_of_lane).astype(jnp.bfloat16)
+        go = jnp.dot(go_lane * oh, G,
+                     preferred_element_type=jnp.float32)      # (R, T_pad)
+        node = 2 * node + (go > 0.5).astype(jnp.int32)
+    return node
+
+
+def _leaf_onehot(node, *, depth, T_pad):
+    """(R, T_pad) leaf ids → (R, T_pad·L) bf16 one-hot, lane = leaf·T_pad+t."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = node.shape[0]
+    L = 2 ** depth
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, T_pad * L), 1)
+    node_rep = pltpu.repeat(node, L, axis=1)
+    return (node_rep == lane // T_pad).astype(jnp.bfloat16)
+
+
+def _leaf_sums_pallas(codes, f_lvls, b_lvls, aug, *, depth, n_bins, T_pad):
+    from jax.experimental import pallas as pl
+
+    n, d = codes.shape
+    k = aug.shape[1]
+    d_pad = _pad_to(d, 128)
+    k_pad = _pad_to(k, 8)
+    L = 2 ** depth
+    blk_r = _BLK_R
+    n_pad = _pad_to(n, blk_r)
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, n_pad - n), (0, d_pad - d)))
+    aug_p = jnp.pad(aug.astype(jnp.float32),
+                    ((0, n_pad - n), (0, k_pad - k)))  # zero rows: no-op
+
+    def kernel(codes_ref, f_ref, b_ref, aug_ref, out_ref):
+        r = pl.program_id(0)
+        node = _descend(codes_ref[:].astype(jnp.float32), f_ref, b_ref,
+                        depth=depth, T_pad=T_pad, d_pad=d_pad)
+        l_oh = _leaf_onehot(node, depth=depth, T_pad=T_pad)
+        # (k, T_pad·L): lanes wide, accumulator small. precision=HIGHEST:
+        # default matmul precision truncates f32 operands to bf16 — exact for
+        # the 0/1 one-hot, NOT for the stat values (leaf stats serve
+        # predictions and must not round)
+        part = jax.lax.dot_general(
+            aug_ref[:], l_oh.astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+        @pl.when(r == 0)
+        def _():
+            out_ref[:] = part
+
+        @pl.when(r > 0)
+        def _():
+            out_ref[:] += part
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((k_pad, T_pad * L), jnp.float32),
+        grid=(n_pad // blk_r,),
+        in_specs=[
+            pl.BlockSpec((blk_r, d_pad), lambda r: (r, 0)),
+            pl.BlockSpec(f_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(b_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec((blk_r, k_pad), lambda r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec((k_pad, T_pad * L), lambda r: (0, 0)),
+        interpret=_interpret(),
+    )(codes_p, f_lvls, b_lvls, aug_p)
+    # (k, leaf·T_pad+t) -> (T_pad, L, k)
+    return out.reshape(k_pad, L, T_pad).transpose(2, 1, 0)[:, :, :k]
+
+
+def _predict_pallas(codes, f_lvls, b_lvls, leaf_flat, *, depth, n_bins,
+                    T_pad):
+    from jax.experimental import pallas as pl
+
+    n, d = codes.shape
+    k = leaf_flat.shape[1]
+    d_pad = _pad_to(d, 128)
+    k_pad = _pad_to(k, 128)
+    L = 2 ** depth
+    blk_r = _BLK_R
+    n_pad = _pad_to(n, blk_r)
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, n_pad - n), (0, d_pad - d)))
+    leaf_p = jnp.pad(leaf_flat.astype(jnp.float32),
+                     ((0, 0), (0, k_pad - k)))
+
+    def kernel(codes_ref, f_ref, b_ref, leaf_ref, out_ref):
+        node = _descend(codes_ref[:].astype(jnp.float32), f_ref, b_ref,
+                        depth=depth, T_pad=T_pad, d_pad=d_pad)
+        l_oh = _leaf_onehot(node, depth=depth, T_pad=T_pad)
+        out_ref[:] = jnp.dot(l_oh.astype(jnp.float32), leaf_ref[:],
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, k_pad), jnp.float32),
+        grid=(n_pad // blk_r,),
+        in_specs=[
+            pl.BlockSpec((blk_r, d_pad), lambda r: (r, 0)),
+            pl.BlockSpec(f_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(b_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(leaf_flat.shape[:1] + (k_pad,), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk_r, k_pad), lambda r: (r, 0)),
+        interpret=_interpret(),
+    )(codes_p, f_lvls, b_lvls, leaf_p)
+    return out[:n, :k]
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback: identical math, per-level feature-select matmuls
+# ---------------------------------------------------------------------------
+
+def route_codes_xla(codes: jnp.ndarray, feat_heap: jnp.ndarray,
+                    bin_heap: jnp.ndarray, depth: int,
+                    n_bins: int) -> jnp.ndarray:
+    """(n, T) leaf assignments via per-level feature-select matmuls.
+
+    The gather codes[s, feat] is a matmul against the (d, T·m) split-feature
+    one-hot — even in XLA this replaces the old (d·n_bins)-wide comparison
+    contraction (route_matmul) at 1/n_bins-th the FLOPs."""
+    n, d = codes.shape
+    T = feat_heap.shape[0]
+    codes_f = codes.astype(jnp.bfloat16)
+    node = jnp.zeros((n, T), jnp.int32)
+    for level in range(depth):
+        base, m = 2 ** level - 1, 2 ** level
+        f_lvl = feat_heap[:, base:base + m]                  # (T, m)
+        b_lvl = bin_heap[:, base:base + m]
+        sel = (f_lvl.reshape(-1)[None, :]
+               == jnp.arange(d, dtype=jnp.int32)[:, None]
+               ).astype(jnp.bfloat16)                        # (d, T·m)
+        code_sel = (codes_f @ sel).reshape(n, T, m)
+        go_all = code_sel > b_lvl[None].astype(jnp.bfloat16)
+        n_oh = node[:, :, None] == jnp.arange(m, dtype=jnp.int32)
+        go = jnp.any(go_all & n_oh, axis=2)
+        node = 2 * node + go.astype(jnp.int32)
+    return node
+
+
+def _leaf_sums_xla(codes, feat_heap, bin_heap, aug, *, depth, n_bins):
+    n = codes.shape[0]
+    T = feat_heap.shape[0]
+    L = 2 ** depth
+    node = route_codes_xla(codes, feat_heap, bin_heap, depth, n_bins)
+    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
+    l_oh = (comb[:, :, None]
+            == jnp.arange(T * L, dtype=jnp.int32).reshape(1, T, L)
+            ).astype(jnp.float32).reshape(n, T * L)
+    out = jnp.einsum("na,nk->ak", l_oh, aug.astype(jnp.float32),
+                     preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(T, L, -1)
+
+
+def _predict_xla(codes, feat_heap, bin_heap, leaf, *, depth, n_bins):
+    n = codes.shape[0]
+    T, L, k = leaf.shape
+    node = route_codes_xla(codes, feat_heap, bin_heap, depth, n_bins)
+    comb = node + (jnp.arange(T, dtype=jnp.int32) * L)[None, :]
+    l_oh = (comb[:, :, None]
+            == jnp.arange(T * L, dtype=jnp.int32).reshape(1, T, L)
+            ).astype(jnp.float32).reshape(n, T * L)
+    return jnp.einsum("na,ak->nk", l_oh, leaf.reshape(T * L, k),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+def _pallas_ok(depth: int, T: int) -> bool:
+    return (_use_pallas() and depth <= _MAX_DEPTH_PALLAS
+            and T <= _MAX_TREES_PALLAS)
+
+
+def forest_leaf_sums(codes: jnp.ndarray, feat_heap: jnp.ndarray,
+                     bin_heap: jnp.ndarray, aug: jnp.ndarray, *,
+                     depth: int, n_bins: int) -> jnp.ndarray:
+    """Exact leaf statistics for a forest in one fused pass.
+
+    codes: (n, d) int32 bin codes; feat_heap/bin_heap: (T, 2^depth−1)
+    complete-heap splits (sentinel bin ≥ n_bins ⇒ route left);
+    aug: (n, k) f32 per-row stats (pad rows with zeros — they add nothing).
+    Returns (T, L, k) f32 with L = 2^depth: sums of aug over rows landing in
+    each (tree, leaf).
+    """
+    T = feat_heap.shape[0]
+    if not _pallas_ok(depth, T):
+        return _leaf_sums_xla(codes, feat_heap, bin_heap, aug,
+                              depth=depth, n_bins=n_bins)
+    T_pad = _t_pad(T, depth)
+    fh = jnp.pad(feat_heap, ((0, T_pad - T), (0, 0)))
+    bh = jnp.pad(bin_heap, ((0, T_pad - T), (0, 0)),
+                 constant_values=n_bins)
+    f_lvls, b_lvls = _level_tables(fh, bh, depth, n_bins, T_pad)
+    out = _leaf_sums_pallas(codes, f_lvls, b_lvls, aug,
+                            depth=depth, n_bins=n_bins, T_pad=T_pad)
+    return out[:T]
+
+
+def forest_predict(codes: jnp.ndarray, feat_heap: jnp.ndarray,
+                   bin_heap: jnp.ndarray, leaf: jnp.ndarray, *,
+                   depth: int, n_bins: int) -> jnp.ndarray:
+    """Σ_t leaf[t, node(row, t), :] for every row, in one fused pass.
+
+    leaf: (T, L, k) f32 leaf values (any per-tree weighting baked into the
+    values; zero a tree's leaves to drop it). Returns (n, k) f32.
+    """
+    T, L, k = leaf.shape
+    if not _pallas_ok(depth, T):
+        return _predict_xla(codes, feat_heap, bin_heap, leaf,
+                            depth=depth, n_bins=n_bins)
+    T_pad = _t_pad(T, depth)
+    fh = jnp.pad(feat_heap, ((0, T_pad - T), (0, 0)))
+    bh = jnp.pad(bin_heap, ((0, T_pad - T), (0, 0)),
+                 constant_values=n_bins)
+    f_lvls, b_lvls = _level_tables(fh, bh, depth, n_bins, T_pad)
+    # (T, L, k) -> j-major rows: lane leaf·T_pad + t
+    leaf_flat = (jnp.pad(leaf.astype(jnp.float32),
+                         ((0, T_pad - T), (0, 0), (0, 0)))
+                 .transpose(1, 0, 2).reshape(T_pad * L, k))
+    return _predict_pallas(codes, f_lvls, b_lvls, leaf_flat,
+                           depth=depth, n_bins=n_bins, T_pad=T_pad)

@@ -191,130 +191,10 @@ def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
     return _make(n_bins)(codes, A)
 
 
-# ---------------------------------------------------------------------------
-# Fused routing: decision bits straight from bin codes
-# ---------------------------------------------------------------------------
-
-#: above this row count routing uses the XLA cmp-matrix contraction (see
-#: dispatch note in _make_route)
-_ROUTE_PALLAS_MAX_ROWS = 131072
-
-
-def _route_xla(codes: jnp.ndarray, feat: jnp.ndarray, bins: jnp.ndarray,
-               n_bins: int) -> jnp.ndarray:
-    """D[s, a] = 1[codes[s, feat[a]] > bins[a]] via the materialized cmp
-    matrix (reference contraction, non-TPU fallback)."""
-    S, d = codes.shape
-    cmp = (codes[:, :, None] > jnp.arange(n_bins, dtype=jnp.int32)
-           ).astype(jnp.bfloat16).reshape(S, d * n_bins)
-    fb = feat * n_bins + jnp.minimum(bins, n_bins - 1)
-    sel = ((fb[:, None] == jnp.arange(d * n_bins, dtype=jnp.int32))
-           & (bins < n_bins)[:, None]).astype(jnp.bfloat16)
-    return jnp.einsum("sf,af->sa", cmp, sel,
-                      preferred_element_type=jnp.bfloat16)
-
-
-def _route_pallas(codes: jnp.ndarray, feat: jnp.ndarray, bins: jnp.ndarray,
-                  n_bins: int) -> jnp.ndarray:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    S, d = codes.shape
-    A = feat.shape[0]
-    d_mult = 128 // math.gcd(n_bins, 128)
-    d_pad = _pad_to(d, d_mult)
-    if d_pad > 128:
-        d_pad = _pad_to(d_pad, 128)
-    lanes = n_bins * d_pad
-    blk_s = _BLK_S
-    while blk_s > 256 and blk_s * lanes * 2 > (4 << 20):
-        blk_s //= 2
-    s_pad = _pad_to(S, blk_s)
-    a_pad = _pad_to(A, 128)
-    # one selector block when it fits VMEM (≤4 MB): the comparison-bit
-    # expansion then happens once per row block instead of once per
-    # (row, selector) block pair
-    if a_pad * lanes * 2 <= (4 << 20):
-        blk_a = a_pad
-    else:
-        blk_a = min(1024, a_pad)
-        while a_pad % blk_a:
-            blk_a //= 2
-
-    codes_p = jnp.pad(codes.astype(jnp.int32), ((0, s_pad - S), (0, d_pad - d)),
-                      constant_values=-1)       # padded features: never > b
-    # bin-major selector rows, one-hot at lane b*d_pad + f; sentinel bins
-    # (>= n_bins, the "no split" heap value) give all-zero rows → decision 0
-    fb = (jnp.minimum(bins, n_bins - 1) * d_pad + feat).astype(jnp.int32)
-    sel = ((fb[:, None] == jnp.arange(lanes, dtype=jnp.int32))
-           & (bins < n_bins)[:, None]).astype(jnp.bfloat16)
-    sel_p = jnp.pad(sel, ((0, a_pad - A), (0, 0)))
-
-    def kernel(codes_ref, sel_ref, out_ref):
-        rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)    # (blk_s, lanes)
-        b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, lanes), 1)
-                  // d_pad)
-        gt = (rep > b_iota).astype(jnp.bfloat16)
-        out_ref[:] = jnp.dot(gt, sel_ref[:].T,
-                             preferred_element_type=jnp.float32
-                             ).astype(jnp.bfloat16)
-
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((s_pad, a_pad), jnp.bfloat16),
-        grid=(s_pad // blk_s, a_pad // blk_a),
-        in_specs=[
-            pl.BlockSpec((blk_s, d_pad), lambda s, a: (s, 0)),
-            pl.BlockSpec((blk_a, lanes), lambda s, a: (a, 0)),
-        ],
-        out_specs=pl.BlockSpec((blk_s, blk_a), lambda s, a: (s, a)),
-        interpret=_interpret(),
-    )(codes_p, sel_p)
-    return out[:S, :A]
-
-
-@lru_cache(maxsize=None)
-def _make_route(n_bins: int):
-    from jax.custom_batching import custom_vmap
-
-    @custom_vmap
-    def route(codes, feat, bins):
-        # pallas wins on the split-search sample (codes resident, expansion
-        # amortized); on multi-million-row leaf/predict passes the XLA
-        # contraction is faster (measured: RF leaf pass 4s vs 7s) — XLA
-        # fuses the in-call cmp expansion into the dot operand, so it reads
-        # the 64x smaller codes array too. Do NOT hoist the cmp build out of
-        # routing loops: a materialized loop-invariant cmp defeats that
-        # fusion and measures 5.5-5.9s on the same pass
-        if _use_pallas() and codes.shape[0] <= _ROUTE_PALLAS_MAX_ROWS:
-            return _route_pallas(codes, feat, bins, n_bins)
-        return _route_xla(codes, feat, bins, n_bins)
-
-    @route.def_vmap
-    def _rule(axis_size, in_batched, codes, feat, bins):
-        codes_b, feat_b, bins_b = in_batched
-        if codes_b or not (feat_b and bins_b):
-            raise NotImplementedError(
-                "route_matmul batches over (feat, bins) only; codes are "
-                "shared across the sweep")
-        A = feat.shape[1]
-        out = route(codes, feat.reshape(-1), bins.reshape(-1))  # (S, V*A)
-        return jnp.moveaxis(out.reshape(-1, axis_size, A), 1, 0), True
-
-    return route
-
-
-def route_matmul(codes: jnp.ndarray, feat: jnp.ndarray, bins: jnp.ndarray,
-                 n_bins: int) -> jnp.ndarray:
-    """Decision bits D[s, a] = 1[codes[s, feat[a]] > bins[a]] as bf16 (S, A).
-
-    The go-right test for heap node a at row s, for all rows and nodes at
-    once — tree routing as one MXU matmul against the in-VMEM expanded
-    comparison bits of the int32 bin codes. bins[a] >= n_bins is the
-    "no split" sentinel: its row decides 0 (route left) everywhere. vmap
-    over (feat, bins) widens the node axis of a single kernel call.
-    """
-    return _make_route(n_bins)(codes, feat, bins)
+# Routing no longer lives here: the per-level decision-bit contraction
+# (route_matmul) was replaced by the feature-select matmul inside
+# models/trees.py _grow_tree (1/n_bins-th the FLOPs) and by the fused
+# multi-level descent kernel in ops/forest.py for full-data passes.
 
 
 
