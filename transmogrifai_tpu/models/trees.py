@@ -36,10 +36,10 @@ XGBoost's C++:
   that stops early keeps threshold +inf so every row routes left — training
   and serving follow identical routing with zero dynamic shapes. Empty
   descendant leaves are unreachable by construction.
-* **The sweep**: hyperparameter × fold configurations run in chunks of
-  ``_CFG_CHUNK_COLS``-bounded vmaps (one wide histogram matmul per tree
-  level for the whole chunk) under an outer ``lax.map``; CV folds are 0/1
-  row weights exactly like the linear families.
+* **The sweep**: hyperparameter × fold configurations run in
+  ``_CFG_CHUNK_ELEMS``-bounded tree-batched chunks (one wide histogram
+  matmul per tree level for the whole chunk) under an outer ``lax.map``;
+  CV folds are 0/1 row weights exactly like the linear families.
 * Binned routing and raw-value routing agree exactly: bin(x) = #{edges < x},
   so (bin > b) ⇔ (x > edges[b]) even with tied edges.
 """
@@ -64,10 +64,16 @@ N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 #: (exact refit pass), sweep-time leaf values use the sample.
 _HIST_SAMPLE = 65536
 
+#: sweep-time sample cap: CV candidates grow from half the refit sample —
+#: split thresholds are order statistics and the CV ranking is robust to
+#: the extra estimator noise; the refit winner regrows at _HIST_SAMPLE
+_SWEEP_HIST_SAMPLE = 32768
+
 #: config-chunk sizing: batch configurations together until the deepest
-#: level's histogram node width (configs x trees x nodes) reaches this
-#: bound, then lax.map over chunks (bounds the (S, width) transients)
-_CFG_CHUNK_COLS = 16384
+#: level's (sample rows x configs x trees x nodes) transient reaches this
+#: element budget (~2 GB bf16), then lax.map over chunks — halving the
+#: sweep sample therefore doubles the configs per chunk
+_CFG_CHUNK_ELEMS = 1 << 30
 
 #: trees per fused-descent call (ops/forest.py pallas cap)
 _PREDICT_TREE_CHUNK = 128
@@ -91,11 +97,11 @@ def _bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return (X[:, :, None] > edges[None, :, :]).sum(axis=2, dtype=jnp.int32)
 
 
-def _sample_rows(n: int) -> np.ndarray:
+def _sample_rows(n: int, cap: int = _HIST_SAMPLE) -> np.ndarray:
     """Deterministic strided sample indices for split search (static)."""
-    if n <= _HIST_SAMPLE:
+    if n <= cap:
         return np.arange(n)
-    return np.linspace(0, n - 1, _HIST_SAMPLE).astype(np.int64)
+    return np.linspace(0, n - 1, cap).astype(np.int64)
 
 
 def _exact_leaf_stats(codes: jnp.ndarray, feat_heaps: jnp.ndarray,
@@ -276,10 +282,13 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         j_iota = jnp.tile(jnp.arange(m, dtype=jnp.int32), Tb
                           ).astype(jnp.bfloat16)
         n_oh = (node_rep == j_iota[None, :]).astype(jnp.bfloat16)    # (S, M)
-        # one histogram call per stat keeps every operand (S, M)-shaped
-        hists = [hist_matmul(codes_s, n_oh * jnp.repeat(sw_bf[k_i], m, 1),
-                             n_bins) for k_i in range(k)]
-        hist = jnp.stack(hists, axis=-1).reshape(M, d, n_bins, k)
+        # ONE histogram call per level: the k stats live k-major in the lane
+        # axis (every operand stays (S, ·)-shaped — no tiny minor dims)
+        A_cat = jnp.concatenate(
+            [n_oh * jnp.repeat(sw_bf[k_i], m, 1) for k_i in range(k)],
+            axis=1)                                                  # (S, kM)
+        hist = hist_matmul(codes_s, A_cat, n_bins)
+        hist = hist.reshape(k, M, d, n_bins).transpose(1, 2, 3, 0)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                       # (M, k) node totals
         SL = cum[:, :, :-1, :]
@@ -321,24 +330,37 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     return feat_heap, thr_heap, bin_heap, node
 
 
-_DIAG_BLOCK = 16
+_DIAG_BLOCK = 64
 
 
 def _diag_leaf_hist(node_s: jnp.ndarray, A_cols: jnp.ndarray,
                     L: int) -> jnp.ndarray:
-    """out[t, l] = Σ_s A_cols[s, t]·1[node_s[s, t] == l] — a per-tree
-    segment-sum through the histogram kernel (trees as 'features', leaves
-    as 'bins', stat columns = trees), diagonal extracted. Blocked in groups
-    of _DIAG_BLOCK trees so the cross-tree waste stays a constant factor
-    (full-width would be quadratic in the tree count)."""
-    Tb = node_s.shape[1]
+    """out[j, t, l] = Σ_s A_cols[s, j, t]·1[node_s[s, t] == l] — per-tree
+    segment-sums through the histogram kernel (trees as 'features', leaves
+    as 'bins'), diagonal extracted. ``A_cols``: (S, Tb) for one stat — or
+    (S, J, Tb) to reduce J stats against the same trees in ONE kernel call
+    (GBT's G and H sums). Blocked in groups of _DIAG_BLOCK trees so the
+    cross-tree waste stays a constant factor (full-width would be quadratic
+    in the tree count)."""
+    squeeze = A_cols.ndim == 2
+    if squeeze:
+        A_cols = A_cols[:, None, :]
+    S, J, Tb = A_cols.shape
+    g = _DIAG_BLOCK
+    Tp = -(-Tb // g) * g
+    if Tp != Tb:  # sentinel code L matches no leaf; zero stat columns
+        node_s = jnp.pad(node_s, ((0, 0), (0, Tp - Tb)), constant_values=L)
+        A_cols = jnp.pad(A_cols, ((0, 0), (0, 0), (0, Tp - Tb)))
     outs = []
-    for lo in range(0, Tb, _DIAG_BLOCK):
-        hi = min(lo + _DIAG_BLOCK, Tb)
-        g = hi - lo
-        full = hist_matmul(node_s[:, lo:hi], A_cols[:, lo:hi], L)
-        outs.append(full.reshape(g, g, L)[jnp.arange(g), jnp.arange(g)])
-    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    for lo in range(0, Tp, g):
+        blk = A_cols[:, :, lo:lo + g].reshape(S, J * g)     # stat-major rows
+        full = hist_matmul(node_s[:, lo:lo + g], blk, L,
+                           exact=True)                     # (J*g, g*L)
+        full = full.reshape(J, g, g, L)
+        outs.append(full[:, jnp.arange(g), jnp.arange(g)])  # (J, g, L)
+    out = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+    out = out[:, :Tb]
+    return out[0] if squeeze else out
 
 
 # ---------------------------------------------------------------------------
@@ -365,13 +387,16 @@ def _make_stats(y, num_classes: int, task: str):
     return jnp.stack([-y, ones, ones], axis=1), "gh"
 
 
-def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True):
+def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True,
+                      sweep=False):
     """Shared per-fit prep: sampled edges, full + sampled int32 bin codes
     (the operands of the fused histogram/routing kernels), per-row stats,
     and the n/S weight rescale. ``full_bin`` skips binning the full dataset
-    for fits that never touch it (GBT trains entirely on the sample)."""
+    for fits that never touch it (GBT trains entirely on the sample).
+    ``sweep`` halves the split-search sample (_SWEEP_HIST_SAMPLE)."""
     n = X.shape[0]
-    samp = jnp.asarray(_sample_rows(n))
+    samp = jnp.asarray(_sample_rows(
+        n, _SWEEP_HIST_SAMPLE if sweep else _HIST_SAMPLE))
     Xs = X[samp]
     edges = _quantile_edges(Xs, n_bins)
     if full_bin:
@@ -392,10 +417,12 @@ def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
     d = X.shape[1]
     B = weights.shape[0]
     samp, edges, binned, binned_s, stats, mode, w_scale = \
-        _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=not sweep)
+        _prep_tree_inputs(X, y, n_bins, num_classes, task,
+                          full_bin=not sweep, sweep=sweep)
     stats_s = stats[samp]                                   # (S, k)
     L = 2 ** depth
-    cb = max(1, min(B, _CFG_CHUNK_COLS // 2 ** (depth - 1)))
+    cb = max(1, min(B, _CFG_CHUNK_ELEMS
+                    // (binned_s.shape[0] * 2 ** (depth - 1))))
 
     def one_chunk(w_c, md, mi, mg):
         """Grow cb single-tree configs in one tree-batched forest call."""
@@ -456,7 +483,8 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                   n_trees, sweep=False):
     n, d = X.shape
     samp, edges, binned, binned_s, stats, mode, w_scale = \
-        _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=not sweep)
+        _prep_tree_inputs(X, y, n_bins, num_classes, task,
+                          full_bin=not sweep, sweep=sweep)
     # per-tree feature subset (Spark featureSubsetStrategy auto:
     # sqrt for classification, 1/3 for regression)
     p_feat = float(np.ceil(np.sqrt(d)) / d) if task == "classification" \
@@ -466,7 +494,8 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
     stats_s = stats[samp]
     L = 2 ** depth
     B = weights.shape[0]
-    cb = max(1, min(B, _CFG_CHUNK_COLS // (n_trees * 2 ** (depth - 1))))
+    cb = max(1, min(B, _CFG_CHUNK_ELEMS
+                    // (S * n_trees * 2 ** (depth - 1))))
 
     def one_chunk(w_c, md, mi, mg, ss, seed):
         """Grow a chunk of cb configs — cb·n_trees trees — in one
@@ -507,7 +536,8 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                 nc = node_s[:, c * n_trees:(c + 1) * n_trees]
                 aug = jnp.concatenate(
                     [stats_s * w_s[c][:, None], w_s[c][:, None]], axis=1)
-                out = hist_matmul(nc, aug.astype(jnp.float32), L)
+                out = hist_matmul(nc, aug.astype(jnp.float32), L,
+                                  exact=True)
                 out = out.reshape(k + 1, n_trees, L).transpose(1, 2, 0)
                 ls, lw = out[..., :-1], out[..., -1]
                 leaves.append(
@@ -556,25 +586,35 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
-                                   "n_rounds"))
+                                   "n_rounds", "sweep"))
 def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
                    step_size, lam, min_child_weight, *, depth, n_bins,
-                   num_classes, task, n_rounds):
+                   num_classes, task, n_rounds, sweep=False):
     """Gradient boosting: binary logistic / regression squared / multiclass
-    softmax (one tree per class per round, vmapped over the class axis)."""
+    softmax. Each round grows ONE tree-batched forest over all configs ×
+    classes (`_grow_forest`) — the per-round hist/route ops are Tb-wide
+    instead of |configs| narrow vmapped copies."""
     n, d = X.shape
     samp, edges, _, binned_s, _, _, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, "regression",
-                          full_bin=False)
-    fmask = jnp.ones((d,), bool)
+                          full_bin=False, sweep=sweep)
     C = num_classes if task == "multiclass" else 1
     B = weights.shape[0]
     S = binned_s.shape[0]
     L = 2 ** depth
+    Tb = B * C                                             # trees per round
     y_s = y[samp]
     Y1_s = (jax.nn.one_hot(y_s.astype(jnp.int32), max(C, 2), dtype=X.dtype)
             if task == "multiclass" else None)
     W_s = weights[:, samp] * w_scale                       # (B, S)
+    # per-tree (config, class) row weights / cfg: lane order t = b*C + c
+    w_tb = jnp.repeat(W_s, C, axis=0).T                    # (S, Tb)
+    rep = lambda v: jnp.repeat(v, C)                       # (B,) -> (Tb,)
+    cfg = {"max_depth": rep(max_depth), "min_instances": rep(min_inst),
+           "min_info_gain": rep(min_gain), "lam": rep(lam),
+           "min_child_weight": rep(min_child_weight)}
+    lam_t = rep(lam)
+    fmasks = jnp.ones((Tb, d), bool)
     # boosting state lives on the split-search sample: gradients, F and leaf
     # values all come from it (the XGBoost subsample design point); at 65k
     # rows and ≥2^depth≥8 leaves every leaf still averages 1000+ rows
@@ -583,61 +623,47 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
               / jnp.maximum(weights.sum(1), 1.0))[:, None]  # (B, 1)
     else:
         f0 = jnp.zeros((B, C), X.dtype)
-    F_init = jnp.broadcast_to(f0[:, None, :], (B, S, C))
+    F_init = jnp.broadcast_to(f0[:, :, None], (B, C, S))
 
-    def grow_bc(g, h, w_b, cfg, lm):
-        """One (config, class) tree on the sample; returns heaps, leaf
-        values, and per-sample-row predictions."""
-        st = jnp.stack([g, h, jnp.ones_like(g)], axis=1)   # (S, 3)
-        f, th, bh, node_s = _grow_tree(
-            binned_s, edges, st, w_b, fmask, cfg,
-            depth=depth, n_bins=n_bins, mode="gh")
-        l_oh = (node_s[:, None]
-                == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
-        # HIGHEST: default matmul precision truncates f32 operands to bf16;
-        # leaf Newton values -G/H must not round
-        sums = jnp.einsum("sl,sk->lk", l_oh, st * w_b[:, None],
-                          preferred_element_type=jnp.float32,
-                          precision=jax.lax.Precision.HIGHEST)
-        leaf = -sums[:, 0] / (sums[:, 1] + lm + 1e-12)
-        pred_s = leaf[node_s]
-        return f, th, bh, leaf, pred_s
-
-    def one_config_round(F_b, args):
-        """(S, C) state for one config → grown trees for each class."""
-        w_b, cfg, lm, eta_b, it_b, t = args
+    def round_step(F, t):                                   # F: (B, C, S)
         if task == "binary":
-            p = jax.nn.sigmoid(F_b[:, 0])
-            g = (p - y_s)[None, :]
-            h = jnp.maximum(p * (1 - p), 1e-6)[None, :]
+            p = jax.nn.sigmoid(F[:, 0, :])                  # (B, S)
+            g = (p - y_s[None, :])[:, None, :]
+            h = jnp.maximum(p * (1 - p), 1e-6)[:, None, :]
         elif task == "regression":
-            g = (F_b[:, 0] - y_s)[None, :]
-            h = jnp.ones((1, S), X.dtype)
+            g = F - y_s[None, None, :]
+            h = jnp.ones_like(g)
         else:
-            P = jax.nn.softmax(F_b, axis=1)
-            g = (P - Y1_s[:, :C]).T
-            h = jnp.maximum(P * (1 - P), 1e-6).T
-        f, th, bh, leaf, preds = jax.vmap(
-            grow_bc, in_axes=(0, 0, None, None, None))(g, h, w_b, cfg, lm)
-        active = (t.astype(jnp.float32) < it_b).astype(X.dtype)
-        return F_b + eta_b * active * preds.T, (f, th, bh, leaf)
-
-    def round_step(F, t):                                   # F: (B, S, C)
-        cfgs = {"max_depth": max_depth, "min_instances": min_inst,
-                "min_info_gain": min_gain, "lam": lam,
-                "min_child_weight": min_child_weight}
-        F_new, out = jax.vmap(one_config_round)(
-            F, (W_s, cfgs, lam, step_size, max_iter,
-                jnp.broadcast_to(t, (B,))))
-        return F_new, out
+            P = jax.nn.softmax(F, axis=1)                   # (B, C, S)
+            g = P - Y1_s.T[None, :C, :]
+            h = jnp.maximum(P * (1 - P), 1e-6)
+        g_tb = g.reshape(Tb, S).T                           # (S, Tb)
+        h_tb = h.reshape(Tb, S).T
+        sw_list = [(g_tb * w_tb), (h_tb * w_tb), w_tb]
+        fs, ths, bhs, node_s = _grow_forest(
+            binned_s, edges, sw_list, fmasks, cfg,
+            depth=depth, n_bins=n_bins, mode="gh")
+        # Newton leaves from per-tree G/H segment sums (f32 exact), both
+        # stats reduced in one histogram call
+        gh = _diag_leaf_hist(
+            node_s, jnp.stack([g_tb * w_tb, h_tb * w_tb], axis=1
+                              ).astype(jnp.float32), L)     # (2, Tb, L)
+        leaf = -gh[0] / (gh[1] + lam_t[:, None] + 1e-12)    # (Tb, L)
+        pred = jnp.take_along_axis(leaf, node_s.T, axis=1)  # (Tb, S)
+        active = rep((t.astype(jnp.float32) < max_iter).astype(X.dtype))
+        eta_t = rep(step_size)
+        scale = (eta_t * active).reshape(B, C)[:, :, None]
+        F_new = F + scale * pred.reshape(B, C, S)
+        return F_new, (fs, ths, bhs, leaf)
 
     _, (feat, thr, bheap, leaf) = jax.lax.scan(
         round_step, F_init, jnp.arange(n_rounds))
-    # (T, B, C, ...) → (B, T, C, ...)
-    feat = jnp.swapaxes(feat, 0, 1)
-    thr = jnp.swapaxes(thr, 0, 1)
-    bheap = jnp.swapaxes(bheap, 0, 1)
-    leaf = jnp.swapaxes(leaf, 0, 1)
+
+    # (rounds, Tb=B*C, ...) → (B, rounds, C, ...)
+    def to_bc(a):
+        return jnp.swapaxes(a.reshape(n_rounds, B, C, a.shape[-1]), 0, 1)
+
+    feat, thr, bheap, leaf = map(to_bc, (feat, thr, bheap, leaf))
     tree_mask = (jnp.arange(n_rounds)[None, :] <
                  max_iter[:, None]).astype(jnp.float32)
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
@@ -663,53 +689,70 @@ def _forest_values(codes, feat_heaps, bin_heaps, leaf, *, depth, n_bins):
     return out
 
 
+def _forest_values_grouped(codes, feat, bins, leaf, *, depth, n_bins):
+    """Per-config leaf-value sums for a BATCH of configs in shared descent
+    calls: a group's trees are concatenated and each config's leaf values
+    occupy their own block of output columns, so one kernel pass scores the
+    whole group (36 per-config launches → a handful; the summation over a
+    config's trees stays inside the kernel's final matmul because other
+    configs' columns are zero). feat/bins: (B, T, H); leaf: (B, T, L, k)
+    with per-tree weighting baked in. Returns (B, n, k)."""
+    B, T, H = feat.shape
+    L, k = leaf.shape[2], leaf.shape[3]
+    n = codes.shape[0]
+    g = max(1, min(B, 128 // max(k, 1)))   # ≤128 output columns per call
+    outs = []
+    for lo in range(0, B, g):
+        hi = min(lo + g, B)
+        gb = hi - lo
+        f_all = feat[lo:hi].reshape(gb * T, H)
+        b_all = bins[lo:hi].reshape(gb * T, H)
+        blocks = [jnp.pad(leaf[lo + c],
+                          ((0, 0), (0, 0), (c * k, (gb - 1 - c) * k)))
+                  for c in range(gb)]
+        lf = jnp.concatenate(blocks, axis=0)            # (gb*T, L, gb*k)
+        vals = _forest_values(codes, f_all, b_all, lf,
+                              depth=depth, n_bins=n_bins)  # (n, gb*k)
+        outs.append(vals.reshape(n, gb, k))
+    out = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+    return out.transpose(1, 0, 2)                       # (B, n, k)
+
+
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_dt_batch(feat, bins, leaf, edges, X, *, depth, n_bins):
     codes = _bin_features(X, edges)
-
-    def one(args):
-        f, bh, l = args
-        return _forest_values(codes, f[None], bh[None], l[None],
-                              depth=depth, n_bins=n_bins)  # (n, k)
-
-    return jax.lax.map(one, (feat, bins, leaf))            # (B, n, k)
+    return _forest_values_grouped(codes, feat[:, None], bins[:, None],
+                                  leaf[:, None], depth=depth,
+                                  n_bins=n_bins)           # (B, n, k)
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_rf_batch(feat, bins, leaf, tree_mask, edges, X, *, depth,
                       n_bins):
     codes = _bin_features(X, edges)
-
-    def one(args):
-        f, bh, l, m = args                                 # (T,H) (T,L,k) (T,)
-        s = _forest_values(codes, f, bh, l * m[:, None, None],
-                           depth=depth, n_bins=n_bins)
-        return s / jnp.maximum(m.sum(), 1.0)
-
-    return jax.lax.map(one, (feat, bins, leaf, tree_mask))  # (B, n, k)
+    lw = leaf * tree_mask[:, :, None, None]                # (B, T, L, k)
+    out = _forest_values_grouped(codes, feat, bins, lw,
+                                 depth=depth, n_bins=n_bins)
+    return out / jnp.maximum(tree_mask.sum(1), 1.0)[:, None, None]
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins"))
 def _predict_gbt_batch(feat, bins, leaf, f0, eta, tree_mask, edges, X, *,
                        depth, n_bins):
     codes = _bin_features(X, edges)
-
-    def one(args):
-        f, bh, l, f0b, etab, m = args     # (T,C,H), leaf (T,C,L), m (T,)
-        T, C, H = f.shape
-        L = l.shape[-1]
-        # class-routing leaf table: value·one-hot(class) per (tree·class,
-        # leaf) so one descent over T·C trees yields per-class margins
-        lv = l * m[:, None, None]                          # (T, C, L)
-        cls_oh = (jnp.arange(C)[:, None]
-                  == jnp.arange(C)[None, :]).astype(lv.dtype)  # (C, C)
-        M = lv[:, :, :, None] * cls_oh[None, :, None, :]   # (T, C, L, C)
-        contrib = _forest_values(
-            codes, f.reshape(T * C, H), bh.reshape(T * C, H),
-            M.reshape(T * C, L, C), depth=depth, n_bins=n_bins)  # (n, C)
-        return (f0b[None, :] + etab * contrib).T           # (C, n)
-
-    return jax.lax.map(one, (feat, bins, leaf, f0, eta, tree_mask))
+    B, T, C, H = feat.shape
+    L = leaf.shape[-1]
+    # class-routing leaf table: value·one-hot(class) per (tree·class, leaf)
+    # so one descent over T·C trees yields per-class margins
+    lv = leaf * tree_mask[:, :, None, None]                # (B, T, C, L)
+    cls_oh = (jnp.arange(C)[:, None]
+              == jnp.arange(C)[None, :]).astype(lv.dtype)  # (C, C)
+    M = lv[:, :, :, :, None] * cls_oh[None, None, :, None, :]
+    contrib = _forest_values_grouped(
+        codes, feat.reshape(B, T * C, H), bins.reshape(B, T * C, H),
+        M.reshape(B, T * C, L, C), depth=depth, n_bins=n_bins)  # (B, n, C)
+    return (f0[:, None, :] + eta[:, None, None] * contrib
+            ).transpose(0, 2, 1)                           # (B, C, n)
 
 
 # ---------------------------------------------------------------------------
@@ -937,18 +980,18 @@ class GBTFamilyBase(_TreeFamilyBase):
         task = self._gbt_task(num_classes)
         n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
 
-        def fit_group(g, w, depth):
-            return _fit_gbt_batch(
-                X, y, w, g["maxDepth"],
-                _g(g, "minInstancesPerNode", 0.0), _g(g, "minInfoGain", 0.0),
-                _g(g, "maxIter", 20.0), _g(g, "stepSize", 0.1),
-                _g(g, "lambda", self.lam_default),
-                _g(g, "minChildWeight", self.mcw_default),
-                depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
-                task=task, n_rounds=n_rounds)
-
-        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
-                                  leaf_axis=-1)
+        # no depth grouping here: boosting rounds are a sequential scan,
+        # and a second scan chain for shallow configs costs more than the
+        # wasted deep levels (their active-mask already stops splitting)
+        depth = int(np.max(np.asarray(grid["maxDepth"])))
+        return _fit_gbt_batch(
+            X, y, weights, grid["maxDepth"],
+            _g(grid, "minInstancesPerNode", 0.0), _g(grid, "minInfoGain", 0.0),
+            _g(grid, "maxIter", 20.0), _g(grid, "stepSize", 0.1),
+            _g(grid, "lambda", self.lam_default),
+            _g(grid, "minChildWeight", self.mcw_default),
+            depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
+            task=task, n_rounds=n_rounds, sweep=sweep)
 
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-1])

@@ -72,17 +72,20 @@ def _pad_to(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _hist_xla(codes: jnp.ndarray, A: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+def _hist_xla(codes: jnp.ndarray, A: jnp.ndarray, n_bins: int,
+              exact: bool = False) -> jnp.ndarray:
     """Reference contraction, feature-major (B, d*nb) f32."""
     S, d = codes.shape
+    dt = jnp.float32 if exact else jnp.bfloat16
     oh = (codes[:, :, None] == jnp.arange(n_bins, dtype=jnp.int32)
-          ).astype(jnp.bfloat16).reshape(S, d * n_bins)
-    return jnp.einsum("sa,sf->af", A.astype(jnp.bfloat16), oh,
-                      preferred_element_type=jnp.float32)
+          ).astype(dt).reshape(S, d * n_bins)
+    kw = ({"precision": jax.lax.Precision.HIGHEST} if exact else {})
+    return jnp.einsum("sa,sf->af", A.astype(dt), oh,
+                      preferred_element_type=jnp.float32, **kw)
 
 
 def _hist_pallas(codes: jnp.ndarray, A: jnp.ndarray,
-                 n_bins: int) -> jnp.ndarray:
+                 n_bins: int, exact: bool = False) -> jnp.ndarray:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -121,9 +124,17 @@ def _hist_pallas(codes: jnp.ndarray, A: jnp.ndarray,
         rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)    # (blk_s, nb*blk_d)
         b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, lanes), 1)
                   // blk_d)
-        oh = (rep == b_iota).astype(jnp.bfloat16)
-        part = jnp.dot(a_ref[:].T.astype(jnp.bfloat16), oh,
-                       preferred_element_type=jnp.float32)  # (blk_b, lanes)
+        if exact:
+            # f32 stat operands, HIGHEST precision: leaf-value reductions
+            # (served predictions) must not round to bf16
+            oh = (rep == b_iota).astype(jnp.float32)
+            part = jnp.dot(a_ref[:].T, oh,
+                           preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST)
+        else:
+            oh = (rep == b_iota).astype(jnp.bfloat16)
+            part = jnp.dot(a_ref[:].T.astype(jnp.bfloat16), oh,
+                           preferred_element_type=jnp.float32)
 
         @pl.when(s == 0)
         def _():
@@ -154,14 +165,14 @@ def _hist_pallas(codes: jnp.ndarray, A: jnp.ndarray,
 
 
 @lru_cache(maxsize=None)
-def _make(n_bins: int):
+def _make(n_bins: int, exact: bool = False):
     from jax.custom_batching import custom_vmap
 
     @custom_vmap
     def hist(codes, A):
         if _use_pallas() and A.shape[1] <= _HIST_PALLAS_MAX_B:
-            return _hist_pallas(codes, A, n_bins)
-        return _hist_xla(codes, A, n_bins)
+            return _hist_pallas(codes, A, n_bins, exact)
+        return _hist_xla(codes, A, n_bins, exact)
 
     @hist.def_vmap
     def _rule(axis_size, in_batched, codes, A):
@@ -180,15 +191,18 @@ def _make(n_bins: int):
 
 
 def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
-                n_bins: int) -> jnp.ndarray:
+                n_bins: int, exact: bool = False) -> jnp.ndarray:
     """hist[a, f*n_bins + b] = Σ_s A[s, a]·1[codes[s, f] == b], f32.
 
     codes: (S, d) int bin indices in [0, n_bins); values == n_bins are
     allowed and contribute nothing (sentinel). A: (S, B) per-row statistics.
     Returns (B, d*n_bins) feature-major. Batches over leading axes of A
     (vmap) by widening B — the whole sweep becomes one kernel call.
+    ``exact``: keep the stat operands f32 at HIGHEST precision (leaf-value
+    reductions — served predictions must not round to bf16); growth
+    histograms use the default bf16 operands by design.
     """
-    return _make(n_bins)(codes, A)
+    return _make(n_bins, exact)(codes, A)
 
 
 # Routing no longer lives here: the per-level decision-bit contraction
