@@ -1,69 +1,966 @@
-"""The published contract specs applied to a sample of stages — both
-validating the spec machinery and giving each stage the reference-style
-contract coverage (reference: every stage has a spec file extending
-OpTransformerSpec/OpEstimatorSpec)."""
-import numpy as np
+"""The published contract specs applied to EVERY concrete stage.
 
+Reference parity: the reference ships one spec file per stage (~70, each
+extending OpTransformerSpec/OpEstimatorSpec —
+features/src/main/scala/com/salesforce/op/test/OpEstimatorSpec.scala:55-142).
+Here every concrete public stage class has a spec (naming, wiring,
+columnar/row-dual parity, persistence round-trip), and
+``test_every_stage_has_a_spec`` walks the package and FAILS when a new stage
+class lands without one — coverage is enforced, not aspirational."""
+import importlib
+import inspect
+import pkgutil
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu
 from transmogrifai_tpu.features import FeatureBuilder
-from transmogrifai_tpu.impl.feature.bucketizers import NumericBucketizer
-from transmogrifai_tpu.impl.feature.scalers import FillMissingWithMean
-from transmogrifai_tpu.impl.feature.vectorizers import (
-    OneHotVectorizer, RealVectorizer,
-)
-from transmogrifai_tpu.impl.feature.math import BinaryMathOp
 from transmogrifai_tpu.table import FeatureTable
 from transmogrifai_tpu.test import OpEstimatorSpec, OpTransformerSpec
-from transmogrifai_tpu.types import PickList, Real
+from transmogrifai_tpu.types import (
+    Base64, Binary, Date, DateList, DateMap, Email, Geolocation,
+    GeolocationMap, Integral, MultiPickList, MultiPickListMap, OPVector,
+    Phone, PickList, Real, RealMap, RealNN, Text, TextArea, TextList,
+    TextMap, URL,
+)
 
+
+def _f(name, type_name):
+    return getattr(FeatureBuilder, type_name)(name).extract_field().as_predictor()
+
+
+def _resp(name="y"):
+    return FeatureBuilder.RealNN(name).extract_field().as_response()
+
+
+def _tbl(**cols):
+    return FeatureTable.from_columns(cols)
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/math.py
+# ---------------------------------------------------------------------------
 
 class TestBinaryMathOpSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import BinaryMathOp
+    stage_cls = BinaryMathOp
+
     @classmethod
     def build(cls):
-        a = FeatureBuilder.Real("a").extract_field().as_predictor()
-        b = FeatureBuilder.Real("b").extract_field().as_predictor()
-        stage = BinaryMathOp("/").set_input(a, b)
-        table = FeatureTable.from_columns({
-            "a": (Real, [6.0, 4.0, None]),
-            "b": (Real, [2.0, 0.0, 1.0]),
-        })
+        stage = cls.stage_cls("/").set_input(_f("a", "Real"), _f("b", "Real"))
+        table = _tbl(a=(Real, [6.0, 4.0, None]), b=(Real, [2.0, 0.0, 1.0]))
         return stage, table, [3.0, None, None]
 
 
-class TestNumericBucketizerSpec(OpTransformerSpec):
+class TestScalarOpSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import ScalarOp
+    stage_cls = ScalarOp
+
     @classmethod
     def build(cls):
-        f = FeatureBuilder.Real("x").extract_field().as_predictor()
-        stage = NumericBucketizer([0.0, 1.0, 2.0]).set_input(f)
-        table = FeatureTable.from_columns({"x": (Real, [0.5, 1.5, None])})
+        stage = cls.stage_cls("*", 2.0).set_input(_f("a", "Real"))
+        return stage, _tbl(a=(Real, [3.0, None])), [6.0, None]
+
+
+class TestNumericUnarySpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import _NumericUnary
+    stage_cls = _NumericUnary
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.impl.feature.math import Sqrt
+        stage = Sqrt().set_input(_f("a", "Real"))
+        return stage, _tbl(a=(Real, [4.0, None])), [2.0, None]
+
+
+class TestAliasTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import AliasTransformer
+    stage_cls = AliasTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("renamed").set_input(_f("a", "Real"))
+        return stage, _tbl(a=(Real, [1.5, None])), [1.5, None]
+
+
+class TestSubstringTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import SubstringTransformer
+    stage_cls = SubstringTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("a", "Text"), _f("b", "Text"))
+        table = _tbl(a=(Text, ["hello world", "abc", None]),
+                     b=(Text, ["world", "zz", "x"]))
+        return stage, table, [True, False, None]
+
+
+class TestTextLenTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import TextLenTransformer
+    stage_cls = TextLenTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        return stage, _tbl(t=(Text, ["abc", "", None])), [3, 0, 0]
+
+
+class TestToOccurTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import ToOccurTransformer
+    stage_cls = ToOccurTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("a", "Real"))
+        return stage, _tbl(a=(Real, [2.0, 0.0, None])), None
+
+
+class TestFilterMapSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import FilterMap
+    stage_cls = FilterMap
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(white_list_keys=("k1",)).set_input(
+            _f("m", "TextMap"))
+        table = _tbl(m=(TextMap, [{"k1": "a", "k2": "b"}, {"k2": "c"}, None]))
+        return stage, table, [{"k1": "a"}, None, None]  # {} == missing
+
+
+class TestJaccardSimilaritySpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import JaccardSimilarity
+    stage_cls = JaccardSimilarity
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("a", "MultiPickList"),
+                                          _f("b", "MultiPickList"))
+        table = _tbl(a=(MultiPickList, [["x", "y"], ["x"]]),
+                     b=(MultiPickList, [["y"], ["z"]]))
+        return stage, table, [0.5, 0.0]
+
+
+class TestNGramSimilaritySpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import NGramSimilarity
+    stage_cls = NGramSimilarity
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(2).set_input(_f("a", "Text"), _f("b", "Text"))
+        table = _tbl(a=(Text, ["abcd", "xy", None]),
+                     b=(Text, ["abcd", "ab", "q"]))
+        return stage, table, None
+
+
+class TestDropIndicesBySpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import DropIndicesByTransformer
+    stage_cls = DropIndicesByTransformer
+    #: the row dual deliberately raises — slot selection needs the vector
+    #: metadata only columnar inputs carry (documented in transform_row)
+    check_row_parity = False
+
+    @classmethod
+    def build(cls):
+        # the predicate consumes per-column vector metadata: build the input
+        # through a vectorizer so the column carries it
+        from transmogrifai_tpu.impl.feature.vectorizers import RealVectorizer
+        x = _f("x", "Real")
+        vec_est = RealVectorizer().set_input(x)
+        base = _tbl(x=(Real, [1.0, None, 3.0]))
+        model = vec_est.fit(base)
+        v_feat = model.get_output()
+        table = base.with_column(v_feat.name, model.transform_column(base))
+        stage = cls.stage_cls(
+            lambda c: getattr(c, "is_null_indicator", False)
+        ).set_input(v_feat)
+        return stage, table, [[1.0], [2.0], [3.0]]
+
+
+class TestOPListTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import OPListTransformer
+    stage_cls = OPListTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(lambda s: s.upper()).set_input(
+            _f("l", "TextList"))
+        table = _tbl(l=(TextList, [["a", "b"], [], None]))
+        return stage, table, [["A", "B"], None, None]  # [] == missing
+
+
+class TestOPSetTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import OPSetTransformer
+    stage_cls = OPSetTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(lambda s: s.lower()).set_input(
+            _f("s", "MultiPickList"))
+        return stage, _tbl(s=(MultiPickList, [["A"], []])), None
+
+
+class TestOPMapTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import OPMapTransformer
+    stage_cls = OPMapTransformer
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.types import TextMap as TM
+        stage = cls.stage_cls(lambda v: v.upper(), output_type=TM,
+                              input_type=TM).set_input(_f("m", "TextMap"))
+        table = _tbl(m=(TextMap, [{"k": "a"}, None]))
+        return stage, table, [{"k": "A"}, None]
+
+
+class TestTextListNullTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.math import TextListNullTransformer
+    stage_cls = TextListNullTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("a", "TextList"),
+                                          _f("b", "TextList"))
+        table = _tbl(a=(TextList, [["x"], None]),
+                     b=(TextList, [None, ["y"]]))
+        return stage, table, [[0.0, 1.0], [1.0, 0.0]]
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/bucketizers.py
+# ---------------------------------------------------------------------------
+
+class TestNumericBucketizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.bucketizers import NumericBucketizer
+    stage_cls = NumericBucketizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls([0.0, 1.0, 2.0]).set_input(_f("x", "Real"))
+        table = _tbl(x=(Real, [0.5, 1.5, None]))
         return stage, table, [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0],
                               [0.0, 0.0, 1.0]]
 
 
-class TestFillMissingWithMeanSpec(OpEstimatorSpec):
+class TestDecisionTreeNumericBucketizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.bucketizers import (
+        DecisionTreeNumericBucketizer)
+    stage_cls = DecisionTreeNumericBucketizer
+
     @classmethod
     def build(cls):
-        f = FeatureBuilder.Real("x").extract_field().as_predictor()
-        stage = FillMissingWithMean().set_input(f)
-        table = FeatureTable.from_columns({"x": (Real, [1.0, None, 3.0])})
-        return stage, table, [1.0, 2.0, 3.0]
+        stage = cls.stage_cls(max_depth=1, min_info_gain=0.0).set_input(
+            _resp(), _f("x", "Real"))
+        x = [0.1, 0.2, 0.3, 2.1, 2.2, 2.3] * 5
+        y = [0.0, 0.0, 0.0, 1.0, 1.0, 1.0] * 5
+        return stage, _tbl(y=(RealNN, y), x=(Real, x)), None
 
+
+class TestDecisionTreeNumericMapBucketizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.bucketizers import (
+        DecisionTreeNumericMapBucketizer)
+    stage_cls = DecisionTreeNumericMapBucketizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(max_depth=1, min_info_gain=0.0).set_input(
+            _resp(), _f("m", "RealMap"))
+        m = [{"a": 0.1, "b": 5.0}, {"a": 0.2, "b": 5.0},
+             {"a": 2.1, "b": 5.0}, {"a": 2.2}] * 5
+        y = [0.0, 0.0, 1.0, 1.0] * 5
+        return stage, _tbl(y=(RealNN, y), m=(RealMap, m)), None
+
+
+class TestPercentileCalibratorSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.bucketizers import PercentileCalibrator
+    stage_cls = PercentileCalibrator
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(buckets=4).set_input(_f("x", "Real"))
+        return stage, _tbl(x=(Real, [1.0, 2.0, 3.0, 4.0, 5.0, None])), None
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/dates.py
+# ---------------------------------------------------------------------------
+
+_DAY = 86_400_000
+
+
+class TestTimePeriodTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import TimePeriodTransformer
+    stage_cls = TimePeriodTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("DayOfWeek").set_input(_f("d", "Date"))
+        return stage, _tbl(d=(Date, [0, 3 * _DAY, None])), None
+
+
+class TestDateToUnitCircleSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import DateToUnitCircleTransformer
+    stage_cls = DateToUnitCircleTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(periods=("HourOfDay",)).set_input(
+            _f("d", "Date"))
+        return stage, _tbl(d=(Date, [12 * 3_600_000, None])), None
+
+
+class TestDateMapToUnitCircleSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import DateMapToUnitCircleVectorizer
+    stage_cls = DateMapToUnitCircleVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(period="HourOfDay").set_input(
+            _f("dm", "DateMap"))
+        table = _tbl(dm=(DateMap, [{"a": 6 * 3_600_000}, {"a": 0}]))
+        return stage, table, None
+
+
+class TestDateListVectorizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import DateListVectorizer
+    stage_cls = DateListVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("SinceLast", reference_date_ms=10 * _DAY
+                              ).set_input(_f("dl", "DateList"))
+        table = _tbl(dl=(DateList, [[2 * _DAY, 8 * _DAY], None]))
+        return stage, table, [[2.0, 0.0], [0.0, 1.0]]
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/scalers.py
+# ---------------------------------------------------------------------------
+
+class TestScalerTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.scalers import ScalerTransformer
+    stage_cls = ScalerTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("linear", 2.0, 1.0).set_input(_f("x", "Real"))
+        return stage, _tbl(x=(Real, [1.0, None])), [3.0, None]
+
+
+class TestDescalerTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.scalers import DescalerTransformer
+    stage_cls = DescalerTransformer
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.impl.feature.scalers import ScalerTransformer
+        x = _f("x", "Real")
+        scaled = ScalerTransformer("linear", 2.0, 0.0).set_input(x).get_output()
+        stage = cls.stage_cls().set_input(x, scaled)
+        table = _tbl(x=(Real, [3.0, None]))
+        # spec tables must contain the stage inputs: materialize scaled col
+        sc = scaled.origin_stage.transform_column(table)
+        table = table.with_column(scaled.name, sc)
+        return stage, table, None
+
+
+class TestFillMissingWithMeanSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.scalers import FillMissingWithMean
+    stage_cls = FillMissingWithMean
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("x", "Real"))
+        return stage, _tbl(x=(Real, [1.0, None, 3.0])), [1.0, 2.0, 3.0]
+
+
+class TestOpScalarStandardScalerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.scalers import OpScalarStandardScaler
+    stage_cls = OpScalarStandardScaler
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("x", "RealNN"))
+        return stage, _tbl(x=(RealNN, [1.0, 2.0, 3.0])), None
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/vectorizers.py
+# ---------------------------------------------------------------------------
 
 class TestRealVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import RealVectorizer
+    stage_cls = RealVectorizer
+
     @classmethod
     def build(cls):
-        f = FeatureBuilder.Real("x").extract_field().as_predictor()
-        stage = RealVectorizer().set_input(f)
-        table = FeatureTable.from_columns({"x": (Real, [1.0, None, 3.0])})
-        return stage, table, [[1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+        stage = cls.stage_cls().set_input(_f("x", "Real"))
+        return stage, _tbl(x=(Real, [1.0, None, 3.0])), \
+            [[1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+
+
+class TestIntegralVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import IntegralVectorizer
+    stage_cls = IntegralVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("x", "Integral"))
+        return stage, _tbl(x=(Integral, [1, 1, None, 3])), \
+            [[1.0, 0.0], [1.0, 0.0], [1.0, 1.0], [3.0, 0.0]]
+
+
+class TestBinaryVectorizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import BinaryVectorizer
+    stage_cls = BinaryVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("b", "Binary"))
+        return stage, _tbl(b=(Binary, [True, False, None])), None
+
+
+class TestRealNNVectorizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import RealNNVectorizer
+    stage_cls = RealNNVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("x", "RealNN"))
+        return stage, _tbl(x=(RealNN, [1.0, 2.0])), [[1.0], [2.0]]
 
 
 class TestOneHotVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import OneHotVectorizer
+    stage_cls = OneHotVectorizer
+
     @classmethod
     def build(cls):
-        f = FeatureBuilder.PickList("c").extract_field().as_predictor()
-        stage = OneHotVectorizer(top_k=2, min_support=1).set_input(f)
-        table = FeatureTable.from_columns(
-            {"c": (PickList, ["a", "b", "a", None])})
-        # columns: a, b, OTHER, null
+        stage = cls.stage_cls(top_k=2, min_support=1).set_input(
+            _f("c", "PickList"))
+        table = _tbl(c=(PickList, ["a", "b", "a", None]))
         return stage, table, [[1.0, 0.0, 0.0, 0.0], [0.0, 1.0, 0.0, 0.0],
                               [1.0, 0.0, 0.0, 0.0], [0.0, 0.0, 0.0, 1.0]]
+
+
+class TestTextTokenizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import TextTokenizer
+    stage_cls = TextTokenizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        table = _tbl(t=(Text, ["Hello World", None]))
+        return stage, table, [["hello", "world"], None]
+
+
+class TestHashingVectorizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import HashingVectorizer
+    stage_cls = HashingVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(num_hashes=16).set_input(_f("l", "TextList"))
+        return stage, _tbl(l=(TextList, [["a", "b"], [], None])), None
+
+
+class TestSmartTextVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import SmartTextVectorizer
+    stage_cls = SmartTextVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(max_cardinality=2, top_k=2, min_support=1,
+                              num_hashes=16).set_input(_f("t", "Text"))
+        table = _tbl(t=(Text, ["a b", "c d", "a b", None, "e f", "a b"]))
+        return stage, table, None
+
+
+class TestVectorsCombinerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.vectorizers import VectorsCombiner
+    stage_cls = VectorsCombiner
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("u", "OPVector"),
+                                          _f("v", "OPVector"))
+        table = _tbl(u=(OPVector, [[1.0], [2.0]]),
+                     v=(OPVector, [[3.0, 4.0], [5.0, 6.0]]))
+        return stage, table, [[1.0, 3.0, 4.0], [2.0, 5.0, 6.0]]
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/maps.py
+# ---------------------------------------------------------------------------
+
+class TestMapVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.maps import MapVectorizer
+    stage_cls = MapVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("m", "RealMap"))
+        table = _tbl(m=(RealMap, [{"a": 1.0, "b": 2.0}, {"a": 3.0}, None]))
+        return stage, table, None
+
+
+class TestTextMapPivotVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.maps import TextMapPivotVectorizer
+    stage_cls = TextMapPivotVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(top_k=2, min_support=1).set_input(
+            _f("m", "TextMap"))
+        table = _tbl(m=(TextMap, [{"k": "x"}, {"k": "y"}, {"k": "x"}, None]))
+        return stage, table, None
+
+
+class TestMultiPickListMapVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.maps import MultiPickListMapVectorizer
+    stage_cls = MultiPickListMapVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(top_k=2, min_support=1).set_input(
+            _f("m", "MultiPickListMap"))
+        table = _tbl(m=(MultiPickListMap,
+                        [{"k": ["a", "b"]}, {"k": ["a"]}, None]))
+        return stage, table, None
+
+
+class TestSmartTextMapVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.maps import SmartTextMapVectorizer
+    stage_cls = SmartTextMapVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(max_cardinality=2, top_k=2, min_support=1,
+                              num_hashes=16).set_input(_f("m", "TextMap"))
+        table = _tbl(m=(TextMap, [{"k": "a"}, {"k": "b"}, {"k": "a"}, None]))
+        return stage, table, None
+
+
+class TestTextMapNullEstimatorSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.maps import TextMapNullEstimator
+    stage_cls = TextMapNullEstimator
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("m", "TextMap"))
+        table = _tbl(m=(TextMap, [{"k": "a"}, {}, None]))
+        return stage, table, None
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/geo.py
+# ---------------------------------------------------------------------------
+
+class TestGeolocationVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.geo import GeolocationVectorizer
+    stage_cls = GeolocationVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("g", "Geolocation"))
+        table = _tbl(g=(Geolocation, [[37.4, -122.1, 5.0], None]))
+        return stage, table, None
+
+
+class TestGeolocationMapVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.geo import GeolocationMapVectorizer
+    stage_cls = GeolocationMapVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("gm", "GeolocationMap"))
+        table = _tbl(gm=(GeolocationMap,
+                         [{"home": [37.4, -122.1, 5.0]}, None]))
+        return stage, table, None
+
+
+# ---------------------------------------------------------------------------
+# impl/feature/text.py
+# ---------------------------------------------------------------------------
+
+class TestValidEmailSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import ValidEmailTransformer
+    stage_cls = ValidEmailTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("e", "Email"))
+        table = _tbl(e=(Email, ["a@x.com", "nope", None]))
+        return stage, table, [True, False, None]
+
+
+class TestEmailToPickListSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import EmailToPickList
+    stage_cls = EmailToPickList
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("e", "Email"))
+        table = _tbl(e=(Email, ["a@x.com", "bad", None]))
+        return stage, table, ["x.com", None, None]
+
+
+class TestUrlToDomainSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import UrlToDomain
+    stage_cls = UrlToDomain
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("u", "URL"))
+        table = _tbl(u=(URL, ["https://a.io/x", "bad", None]))
+        return stage, table, ["a.io", None, None]
+
+
+class TestIsValidUrlSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import IsValidUrl
+    stage_cls = IsValidUrl
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("u", "URL"))
+        return stage, _tbl(u=(URL, ["http://a.io", "bad", None])), \
+            [True, False, None]
+
+
+class TestPhoneNumberParserSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import PhoneNumberParser
+    stage_cls = PhoneNumberParser
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("p", "Phone"))
+        table = _tbl(p=(Phone, ["650-123-4567", "12", None]))
+        return stage, table, ["+16501234567", None, None]
+
+
+class TestIsValidPhoneSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import IsValidPhoneDefaultCountry
+    stage_cls = IsValidPhoneDefaultCountry
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("p", "Phone"))
+        return stage, _tbl(p=(Phone, ["650-123-4567", "12", None])), \
+            [True, False, None]
+
+
+class TestLangDetectorSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import LangDetector
+    stage_cls = LangDetector
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        return stage, _tbl(t=(Text, ["the quick brown fox and the dog",
+                                     None])), None
+
+
+class TestNameEntityRecognizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import NameEntityRecognizer
+    stage_cls = NameEntityRecognizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "TextArea"))
+        return stage, _tbl(t=(TextArea, ["Dr. John Smith went home", None])), \
+            None
+
+
+class TestMimeTypeDetectorSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import MimeTypeDetector
+    stage_cls = MimeTypeDetector
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("b", "Base64"))
+        return stage, _tbl(b=(Base64, ["iVBORw0KGgoAAA==", None])), None
+
+
+class TestOpNGramSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import OpNGram
+    stage_cls = OpNGram
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(2).set_input(_f("l", "TextList"))
+        table = _tbl(l=(TextList, [["a", "b", "c"], None]))
+        return stage, table, [["a b", "b c"], None]
+
+
+class TestOpStopWordsRemoverSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import OpStopWordsRemover
+    stage_cls = OpStopWordsRemover
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("l", "TextList"))
+        table = _tbl(l=(TextList, [["the", "fox"], None]))
+        return stage, table, [["fox"], None]
+
+
+class TestOpCountVectorizerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpCountVectorizer
+    stage_cls = OpCountVectorizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(vocab_size=8).set_input(_f("l", "TextList"))
+        table = _tbl(l=(TextList, [["a", "b", "a"], ["b"], None]))
+        return stage, table, None
+
+
+class TestOpStringIndexerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpStringIndexer
+    stage_cls = OpStringIndexer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        return stage, _tbl(t=(Text, ["b", "a", "b"])), [0.0, 1.0, 0.0]
+
+
+class TestOpStringIndexerNoFilterSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpStringIndexerNoFilter
+    stage_cls = OpStringIndexerNoFilter
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        return stage, _tbl(t=(Text, ["b", "a", "b", None])), \
+            [0.0, 2.0, 0.0, 1.0]
+
+
+class TestOpIndexToStringSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import OpIndexToString
+    stage_cls = OpIndexToString
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(["a", "b"]).set_input(_f("i", "RealNN"))
+        return stage, _tbl(i=(RealNN, [0.0, 1.0])), ["a", "b"]
+
+
+class TestOpIndexToStringNoFilterSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import OpIndexToStringNoFilter
+    stage_cls = OpIndexToStringNoFilter
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(["a", "b"]).set_input(_f("i", "RealNN"))
+        return stage, _tbl(i=(RealNN, [0.0, 5.0])), ["a", "UnseenLabel"]
+
+
+class TestOpWord2VecSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpWord2Vec
+    stage_cls = OpWord2Vec
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(vector_size=4, steps=20, min_count=1
+                              ).set_input(_f("l", "TextList"))
+        docs = [["cat", "dog"], ["dog", "cat"], ["cat", "mouse"], None] * 3
+        return stage, _tbl(l=(TextList, docs)), None
+
+
+class TestOpLDASpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpLDA
+    stage_cls = OpLDA
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(k=2, max_iter=5).set_input(_f("v", "OPVector"))
+        rng = np.random.RandomState(0)
+        vecs = rng.poisson(1.0, (8, 6)).astype(float).tolist()
+        return stage, _tbl(v=(OPVector, vecs)), None
+
+
+# ---------------------------------------------------------------------------
+# preparators / regression / selector / insights
+# ---------------------------------------------------------------------------
+
+class TestSanityCheckerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+    stage_cls = SanityChecker
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(check_sample=1.0, seed=0).set_input(
+            _resp(), _f("v", "OPVector"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(60)
+        y = (x + 0.4 * rng.randn(60) > 0).astype(float)
+        vecs = np.stack([x, rng.randn(60)], axis=1).tolist()
+        return stage, _tbl(y=(RealNN, y.tolist()), v=(OPVector, vecs)), None
+
+
+class TestIsotonicRegressionCalibratorSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.regression.isotonic import (
+        IsotonicRegressionCalibrator)
+    stage_cls = IsotonicRegressionCalibrator
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_resp(), _f("s", "RealNN"))
+        s = [0.1, 0.2, 0.4, 0.6, 0.8, 0.9]
+        y = [0.0, 0.0, 1.0, 0.0, 1.0, 1.0]
+        return stage, _tbl(y=(RealNN, y), s=(RealNN, s)), None
+
+
+class TestModelSelectorSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.selector.model_selector import ModelSelector
+    stage_cls = ModelSelector
+    #: the row dual emits prediction PARTS (dict) while the columnar path
+    #: emits the packed Prediction column; their parity is asserted
+    #: key-by-key in tests/test_model_selector.py::test_selector_row_dual...
+    check_row_parity = False
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.impl.selector.model_selector import (
+            ModelSelector)
+        from transmogrifai_tpu.impl.tuning.splitters import DataSplitter
+        from transmogrifai_tpu.impl.tuning.validators import (
+            OpTrainValidationSplit)
+        import transmogrifai_tpu.models.linear  # noqa: F401
+        stage = ModelSelector(
+            problem="binary",
+            validator=OpTrainValidationSplit(seed=0),
+            splitter=DataSplitter(reserve_test_fraction=0.0, seed=0),
+            models=[("OpLogisticRegression",
+                     [{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        ).set_input(_resp(), _f("v", "OPVector"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(40, 2)
+        y = (x[:, 0] > 0).astype(float)
+        return stage, _tbl(y=(RealNN, y.tolist()),
+                           v=(OPVector, x.tolist())), None
+
+
+def _loco_fixture():
+    """Tiny fitted SelectedModel + its scored table for the insights specs."""
+    from transmogrifai_tpu.impl.selector.model_selector import ModelSelector
+    from transmogrifai_tpu.impl.tuning.splitters import DataSplitter
+    from transmogrifai_tpu.impl.tuning.validators import OpTrainValidationSplit
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    y_f = _resp()
+    v_f = _f("v", "OPVector")
+    sel = ModelSelector(
+        problem="binary", validator=OpTrainValidationSplit(seed=0),
+        splitter=DataSplitter(reserve_test_fraction=0.0, seed=0),
+        models=[("OpLogisticRegression",
+                     [{"regParam": 0.01, "elasticNetParam": 0.0}])],
+    ).set_input(y_f, v_f)
+    rng = np.random.RandomState(1)
+    x = rng.randn(30, 3)
+    y = (x[:, 0] > 0).astype(float)
+    table = _tbl(y=(RealNN, y.tolist()), v=(OPVector, x.tolist()))
+    fitted = sel.fit(table)
+    scored = table.with_column(fitted.get_output().name,
+                               fitted.transform_column(table))
+    return fitted, v_f, table, scored
+
+
+class TestRecordInsightsLOCOSpec(OpTransformerSpec):
+    from transmogrifai_tpu.insights.record_insights import RecordInsightsLOCO
+    stage_cls = RecordInsightsLOCO
+    check_row_parity = False  # LOCO batches rows x zeroed-group variants
+
+    @classmethod
+    def build(cls):
+        fitted, v_f, table, scored = _loco_fixture()
+        stage = cls.stage_cls(fitted, top_k=3).set_input(v_f)
+        return stage, scored, None
+
+
+class TestRecordInsightsCorrSpec(OpTransformerSpec):
+    from transmogrifai_tpu.insights.record_insights import RecordInsightsCorr
+    stage_cls = RecordInsightsCorr
+    check_row_parity = False  # correlations are batch-level statistics
+
+    @classmethod
+    def build(cls):
+        fitted, v_f, table, scored = _loco_fixture()
+        stage = cls.stage_cls(fitted, top_k=3).set_input(v_f)
+        return stage, scored, None
+
+
+# ---------------------------------------------------------------------------
+# Coverage enforcement: every concrete stage class has a spec here
+# ---------------------------------------------------------------------------
+
+#: stage classes with no spec, each with the reason (audited, not ignored)
+EXCLUDED = {
+    # abstract/base machinery: exercised through every concrete spec above
+    "stages.base.OpPipelineStage": "abstract base",
+    "stages.base.Transformer": "abstract base",
+    "stages.base.Estimator": "abstract base",
+    "stages.base.UnaryTransformer": "generic arity base (lambda stage)",
+    "stages.base.BinaryTransformer": "generic arity base (lambda stage)",
+    "stages.base.TernaryTransformer": "generic arity base (lambda stage)",
+    "stages.base.QuaternaryTransformer": "generic arity base (lambda stage)",
+    "stages.base.SequenceTransformer": "generic arity base (lambda stage)",
+    "stages.base.BinarySequenceTransformer": "generic arity base",
+    "stages.base.UnaryEstimator": "generic arity base (lambda stage)",
+    "stages.base.BinaryEstimator": "generic arity base (lambda stage)",
+    "stages.base.TernaryEstimator": "generic arity base (lambda stage)",
+    "stages.base.QuaternaryEstimator": "generic arity base (lambda stage)",
+    "stages.base.SequenceEstimator": "generic arity base (lambda stage)",
+    "stages.base.BinarySequenceEstimator": "generic arity base",
+    "stages.base.FeatureGeneratorStage":
+        "raw-feature origin; no transform of its own (reader applies "
+        "extract_fn) — covered by tests/test_features.py",
+    "impl.feature.math.OPCollectionTransformer":
+        "generic base of OPList/OPSet/OPMapTransformer (each specced)",
+}
+
+#: fitted-model classes: the estimator's OpEstimatorSpec runs the FULL
+#: transformer contract on the fitted model (reference OpEstimatorSpec does
+#: exactly this), so a second standalone spec would be redundant
+_MODEL_SUFFIX = "Model"
+
+
+def _discover_stage_classes():
+    from transmogrifai_tpu.stages.base import OpPipelineStage
+    found = {}
+    for m in pkgutil.walk_packages(transmogrifai_tpu.__path__,
+                                   "transmogrifai_tpu."):
+        if any(x in m.name for x in (".examples", ".native", ".test")):
+            continue
+        mod = importlib.import_module(m.name)
+        for name, obj in vars(mod).items():
+            if (inspect.isclass(obj) and issubclass(obj, OpPipelineStage)
+                    and obj.__module__ == mod.__name__):
+                short = (obj.__module__.replace("transmogrifai_tpu.", "")
+                         + "." + name)
+                found[short] = obj
+    return found
+
+
+def test_every_stage_has_a_spec():
+    specs = {v.stage_cls for k, v in globals().items()
+             if isinstance(v, type) and hasattr(v, "stage_cls")}
+    missing = []
+    for short, cls in _discover_stage_classes().items():
+        name = short.rsplit(".", 1)[-1]
+        if short in EXCLUDED:
+            continue
+        if name.endswith(_MODEL_SUFFIX) or name.startswith("_"):
+            # fitted models ride their estimator's spec; private helpers
+            # are specced via their public subclass (e.g. _NumericUnary)
+            continue
+        if cls not in specs:
+            missing.append(short)
+    assert not missing, (
+        "stage classes without a contract spec (add a spec above or an "
+        f"audited EXCLUDED entry): {sorted(missing)}")
+
+
+def test_excluded_entries_exist():
+    found = set(_discover_stage_classes())
+    stale = [k for k in EXCLUDED if k not in found]
+    assert not stale, f"EXCLUDED entries for nonexistent stages: {stale}"

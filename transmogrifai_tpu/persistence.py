@@ -102,6 +102,13 @@ def _encode(v: Any, arrays: _Arrays) -> Any:
         if "<locals>" in qn or "<lambda>" in qn or isinstance(v, _t.MethodType):
             return {"__unresolved__": repr(v)}  # resolve from original workflow
         return {"__fn__": f"{v.__module__}:{qn}"}
+    # a stage held BY another stage (RecordInsightsLOCO.model_stage) is a
+    # reference into the workflow, not owned state: encode by uid —
+    # load_model re-links it against the plan's own stages (the stage graph
+    # is cyclic — features point back at their origin stages — so recursing
+    # would never end)
+    if isinstance(v, OpPipelineStage):
+        return {"__stage_ref__": v.uid}
     if hasattr(v, "__dict__"):  # plain objects + callable objects (FieldExtractor)
         return {"__obj__": _clsname(v),
                 "state": {k: _encode(x, arrays) for k, x in vars(v).items()}}
@@ -119,6 +126,17 @@ def _resolve_class(spec: str) -> type:
     for part in qual.split("."):
         obj = getattr(obj, part)
     return obj
+
+
+class _StageRef:
+    """Placeholder for a stage-valued attribute; load_model re-links it to
+    the loaded stage of the same uid."""
+
+    def __init__(self, uid: str):
+        self.uid = uid
+
+    def __repr__(self):
+        return f"_StageRef({self.uid!r})"
 
 
 class Unresolved:
@@ -163,8 +181,12 @@ def _decode(d: Any, arrays: Dict[str, np.ndarray]) -> Any:
         cls = _resolve_class(d["__obj__"])
         obj = cls.__new__(cls)
         for k, v in d["state"].items():
-            setattr(obj, k, _decode(v, arrays))
+            # frozen dataclasses (VectorMetadata, Column specs) refuse
+            # setattr — restore their fields the way dataclass internals do
+            object.__setattr__(obj, k, _decode(v, arrays))
         return obj
+    if "__stage_ref__" in d:
+        return _StageRef(d["__stage_ref__"])
     if "__unresolved__" in d:
         return Unresolved(d["__unresolved__"])
     raise ValueError(f"cannot decode {d!r}")
@@ -323,6 +345,17 @@ def load_model(path: str, workflow=None):
     for d in plan["stages"] + plan["rawFeatureGenerators"]:
         if d["uid"] not in stages:
             stages[d["uid"]] = stage_from_json(d, arrays)
+
+    # re-link stage-valued attributes to the loaded stages of the same uid
+    # (e.g. RecordInsightsLOCO.model_stage -> the loaded SelectedModel)
+    for stage in stages.values():
+        for k, v in list(vars(stage).items()):
+            if isinstance(v, _StageRef):
+                target = stages.get(v.uid)
+                if target is not None:
+                    setattr(stage, k, target)
+                else:
+                    setattr(stage, k, Unresolved(f"<stage ref {v.uid}>"))
 
     # patch unresolved state from the original workflow (by stage uid)
     wf_stages: Dict[str, OpPipelineStage] = {}

@@ -40,6 +40,17 @@ def _cell(col: Column, i: int) -> Any:
     return v.item() if isinstance(v, np.generic) else v
 
 
+def _norm_empty(v: Any) -> Any:
+    """The FeatureTable's missing semantics conflate empty collections with
+    null (table._is_missing) — row duals may surface []/{} where the columnar
+    path surfaces None; both mean "empty" (reference SomeValue). Applied ONLY
+    to the row/columnar parity comparison — explicit expected values stay
+    strict."""
+    if isinstance(v, (list, set, dict, tuple)) and len(v) == 0:
+        return None
+    return v
+
+
 def _approx_equal(a: Any, b: Any) -> bool:
     if a is None or b is None:
         return a is None and b is None
@@ -109,8 +120,8 @@ class OpTransformerSpec(_SpecBase):
             pytest.skip("row parity disabled for this stage")
         out = stage.transform_column(table)
         for i in range(len(table)):
-            row_val = stage.transform_row(table.row(i))
-            col_val = _cell(out, i)
+            row_val = _norm_empty(stage.transform_row(table.row(i)))
+            col_val = _norm_empty(_cell(out, i))
             assert _approx_equal(row_val, col_val), (
                 f"row {i}: transform_row={row_val!r} vs columnar={col_val!r}")
 
@@ -121,7 +132,7 @@ class OpTransformerSpec(_SpecBase):
         desc = stage_to_json(stage, arrays)
         loaded = stage_from_json(desc, arrays.store)
         unresolved = [k for k, v in vars(loaded).items()
-                      if type(v).__name__ == "Unresolved"]
+                      if type(v).__name__ in ("Unresolved", "_StageRef")]
         if unresolved:
             pytest.skip(f"stage holds unserializable state {unresolved} "
                         f"(resolved from the workflow at load time)")
@@ -169,7 +180,7 @@ class OpEstimatorSpec(_SpecBase):
         stage, table, _ = spec
         out = fitted.transform_column(table)
         for i in range(len(table)):
-            row_val = fitted.transform_row(table.row(i))
-            col_val = _cell(out, i)
+            row_val = _norm_empty(fitted.transform_row(table.row(i)))
+            col_val = _norm_empty(_cell(out, i))
             assert _approx_equal(row_val, col_val), (
                 f"row {i}: transform_row={row_val!r} vs columnar={col_val!r}")
