@@ -779,6 +779,94 @@ class TestOpLDASpec(OpEstimatorSpec):
         return stage, _tbl(v=(OPVector, vecs)), None
 
 
+class TestTimePeriodListTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import TimePeriodListTransformer
+    stage_cls = TimePeriodListTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("DayOfWeek").set_input(_f("dl", "DateList"))
+        table = _tbl(dl=(DateList, [[0, 3 * _DAY], [5 * _DAY, 6 * _DAY]]))
+        return stage, table, None
+
+
+class TestTimePeriodMapTransformerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.dates import TimePeriodMapTransformer
+    stage_cls = TimePeriodMapTransformer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls("DayOfWeek").set_input(_f("dm", "DateMap"))
+        table = _tbl(dm=(DateMap, [{"k": 3 * _DAY}, None]))
+        return stage, table, None
+
+
+class TestEmailToPrefixSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import EmailToPrefix
+    stage_cls = EmailToPrefix
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("e", "Email"))
+        table = _tbl(e=(Email, ["bob@x.com", "bad", None]))
+        return stage, table, ["bob", None, None]
+
+
+class TestUrlToProtocolSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import UrlToProtocol
+    stage_cls = UrlToProtocol
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("u", "URL"))
+        table = _tbl(u=(URL, ["https://a.io", "ftp://b.c", "bad"]))
+        return stage, table, ["https", "ftp", None]
+
+
+class TestTextToMultiPickListSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import TextToMultiPickList
+    stage_cls = TextToMultiPickList
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("t", "Text"))
+        return stage, _tbl(t=(Text, ["a", None])), [["a"], None]
+
+
+class TestRegexTokenizerSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import RegexTokenizer
+    stage_cls = RegexTokenizer
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls(r"[a-z]+").set_input(_f("t", "Text"))
+        table = _tbl(t=(Text, ["Ab-cd 12", None]))
+        return stage, table, [["ab", "cd"], None]
+
+
+class TestIsValidPhoneMapSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import IsValidPhoneMap
+    stage_cls = IsValidPhoneMap
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.types import PhoneMap
+        stage = cls.stage_cls().set_input(_f("pm", "PhoneMap"))
+        table = _tbl(pm=(PhoneMap, [{"h": "650-123-4567", "w": "12"}, None]))
+        return stage, table, [{"h": True, "w": False}, None]
+
+
+class TestOpIDFSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.feature.text import OpIDF
+    stage_cls = OpIDF
+
+    @classmethod
+    def build(cls):
+        stage = cls.stage_cls().set_input(_f("v", "OPVector"))
+        table = _tbl(v=(OPVector, [[1.0, 0.0], [2.0, 1.0], [0.0, 1.0]]))
+        return stage, table, None
+
+
 # ---------------------------------------------------------------------------
 # preparators / regression / selector / insights
 # ---------------------------------------------------------------------------

@@ -35,6 +35,11 @@ DF = pd.DataFrame({
     "mpl2": [["a"], ["c", "d"], ["b"], ["a", "b"]] * (N // 4),
     "tm": [{"k1": "v1", "k2": "v2"}, {"k1": "w"}, {}, {"k3": "z"}] * (N // 4),
     "b64": ["iVBORw0KGgoAAA==", "JVBERi0xLjQ=", None, "AAAA"] * (N // 4),
+    "tl": [["the", "cat", "sat"], ["cat", "dog"], [], ["dog", "ran"]]
+          * (N // 4),
+    "rm": [{"a": 1.0, "b": 2.0}, {"a": 3.0}, {}, {"b": 4.0}] * (N // 4),
+    "pm": [{"h": "650-123-4567"}, {"h": "12"}, {}, None] * (N // 4),
+    "dm": [{"k": i * 86_400_000} for i in range(N)],
 })
 
 
@@ -59,6 +64,10 @@ def feats():
         "mpl2": _f("mpl2", "MultiPickList").as_predictor(),
         "tm": _f("tm", "TextMap").as_predictor(),
         "b64": _f("b64", "Base64").as_predictor(),
+        "tl": _f("tl", "TextList").as_predictor(),
+        "rm": _f("rm", "RealMap").as_predictor(),
+        "pm": _f("pm", "PhoneMap").as_predictor(),
+        "dm": _f("dm", "DateMap").as_predictor(),
     }
 
 
@@ -104,6 +113,46 @@ BUILDERS = {
     "detect_languages": lambda F: F["t"].detect_languages(),
     "detect_mime_types": lambda F: F["b64"].detect_mime_types(),
     "recognize_entities": lambda F: F["t"].recognize_entities(),
+    # generic lifts
+    "map_values": lambda F: F["a"].map_values(lambda v: v * 10),
+    "exists": lambda F: F["a"].exists(lambda v: v > 5),
+    "filter_values": lambda F: F["a"].filter_values(lambda v: v > 5),
+    "replace_with": lambda F: F["pk"].replace_with("x", "xx"),
+    "occurs": lambda F: F["a"].occurs(),
+    # text extras
+    "to_multi_pick_list": lambda F: F["pk"].to_multi_pick_list(),
+    "indexed": lambda F: F["pk"].indexed(),
+    "deindexed": lambda F: F["pk"].indexed().deindexed(["x", "y", "z"]),
+    "tokenize_regex": lambda F: F["t"].tokenize_regex(r"[a-z]+"),
+    "to_email_prefix": lambda F: F["e"].to_email_prefix(),
+    "to_url_protocol": lambda F: F["u"].to_url_protocol(),
+    "parse_phone": lambda F: F["p"].parse_phone(),
+    # list / NLP
+    "tf": lambda F: F["tl"].tf(num_hashes=16),
+    "tfidf": lambda F: F["tl"].tfidf(num_hashes=16),
+    "idf": lambda F: F["tl"].tf(num_hashes=16).idf(),
+    "word2vec": lambda F: F["tl"].word2vec(vector_size=4, steps=10,
+                                           min_count=1),
+    "count_vec": lambda F: F["tl"].count_vec(vocab_size=8),
+    "ngram": lambda F: F["tl"].ngram(2),
+    "remove_stop_words": lambda F: F["tl"].remove_stop_words(),
+    "lda": lambda F: F["tl"].count_vec(vocab_size=8).lda(k=2, max_iter=3),
+    # dates
+    "to_date_list": lambda F: F["d"].to_date_list(),
+    # maps
+    "vectorize_map": lambda F: F["rm"].vectorize_map(
+        black_list_keys=("b",)),
+    "smart_vectorize_map": lambda F: F["tm"].smart_vectorize_map(
+        max_cardinality=2, top_k=2, min_support=1, num_hashes=16),
+    "pivot_map": lambda F: F["tm"].pivot_map(top_k=2, min_support=1),
+    "auto_bucketize_map": lambda F: F["rm"].auto_bucketize_map(F["y"]),
+    "is_valid_phone_map": lambda F: F["pm"].is_valid_phone_map(),
+    # vectors
+    "combine": lambda F: F["a"].vectorize().combine(F["rn"].vectorize()),
+    "drop_indices_by": lambda F: F["a"].vectorize().drop_indices_by(
+        lambda c: getattr(c, "is_null_indicator", False)),
+    "to_isotonic_calibrated": lambda F: F["rn"].to_isotonic_calibrated(
+        F["y"]),
 }
 
 

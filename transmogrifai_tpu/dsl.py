@@ -194,14 +194,162 @@ def _attach():
     def ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
         return NGramSimilarity(n).set_input(self, other).get_output()
 
+    # -- RichFeature generic lifts (reference RichFeature.scala map/exists/
+    # filter/replaceWith/occurs — user-lambda row transforms) ---------------
+    def map_values(self: Feature, fn: Callable[[Any], Any],
+                   output_type=None) -> Feature:
+        """Row-wise value map (reference RichFeature.map); None stays None."""
+        from .stages.base import UnaryTransformer
+        out_t = output_type or self.feature_type
+        return UnaryTransformer(
+            "map", transform_fn=lambda v: None if v is None else fn(v),
+            output_type=out_t).set_input(self).get_output()
+
+    def exists(self: Feature, predicate: Callable[[Any], bool]) -> Feature:
+        """Binary: value present AND predicate holds (RichFeature.exists)."""
+        from .stages.base import UnaryTransformer
+        from .types import Binary
+        return UnaryTransformer(
+            "exists",
+            transform_fn=lambda v: v is not None and bool(predicate(v)),
+            output_type=Binary).set_input(self).get_output()
+
+    def filter_values(self: Feature, predicate: Callable[[Any], bool],
+                      keep: bool = True) -> Feature:
+        """Keep the value only when predicate holds (RichFeature.filter /
+        filterNot with keep=False); otherwise missing."""
+        from .stages.base import UnaryTransformer
+        return UnaryTransformer(
+            "filter",
+            transform_fn=lambda v: v if (v is not None
+                                         and bool(predicate(v)) == keep)
+            else None,
+            output_type=self.feature_type).set_input(self).get_output()
+
+    def replace_with(self: Feature, old_val: Any, new_val: Any) -> Feature:
+        """Substitute one value for another (RichFeature.replaceWith)."""
+        from .stages.base import UnaryTransformer
+        return UnaryTransformer(
+            "replaced",
+            transform_fn=lambda v: new_val if v == old_val else v,
+            output_type=self.feature_type).set_input(self).get_output()
+
+    def occurs(self: Feature,
+               matches: Optional[Callable[[Any], bool]] = None) -> Feature:
+        return ToOccurTransformer(matches).set_input(self).get_output()
+
+    # -- RichTextFeature extras ----------------------------------------------
+    def to_multi_pick_list(self: Feature) -> Feature:
+        from .impl.feature.text import TextToMultiPickList
+        return TextToMultiPickList().set_input(self).get_output()
+
+    def indexed(self: Feature, handle_invalid: str = "keep") -> Feature:
+        """Text → frequency-ranked label index (RichTextFeature.indexed)."""
+        from .impl.feature.text import OpStringIndexer
+        return (OpStringIndexer(handle_invalid=handle_invalid)
+                .set_input(self).get_output())
+
+    def deindexed(self: Feature, labels: Sequence[str]) -> Feature:
+        """Index → label string (RichFeature.deindexed)."""
+        from .impl.feature.text import OpIndexToString
+        return OpIndexToString(labels).set_input(self).get_output()
+
+    def tokenize_regex(self: Feature, pattern: str = r"\w+",
+                       to_lowercase: bool = True,
+                       min_token_length: int = 1) -> Feature:
+        from .impl.feature.text import RegexTokenizer
+        return RegexTokenizer(pattern, to_lowercase, min_token_length
+                              ).set_input(self).get_output()
+
+    def to_email_prefix(self: Feature) -> Feature:
+        from .impl.feature.text import EmailToPrefix
+        return EmailToPrefix().set_input(self).get_output()
+
+    def to_url_protocol(self: Feature) -> Feature:
+        from .impl.feature.text import UrlToProtocol
+        return UrlToProtocol().set_input(self).get_output()
+
+    def parse_phone(self: Feature, region: str = "US") -> Feature:
+        from .impl.feature.text import PhoneNumberParser
+        return (PhoneNumberParser(default_region=region)
+                .set_input(self).get_output())
+
+    # -- RichListFeature (TextList) ------------------------------------------
+    def tf(self: Feature, num_hashes: int = 512) -> Feature:
+        """Term-frequency hashing vector (RichListFeature.tf)."""
+        from .impl.feature.vectorizers import HashingVectorizer
+        return HashingVectorizer(num_hashes=num_hashes
+                                 ).set_input(self).get_output()
+
+    def tfidf(self: Feature, num_hashes: int = 512,
+              min_doc_freq: int = 0) -> Feature:
+        """tf-idf weights (RichListFeature.tfidf = HashingTF → IDF)."""
+        from .impl.feature.text import OpIDF
+        tf_f = self.tf(num_hashes=num_hashes)
+        return OpIDF(min_doc_freq=min_doc_freq).set_input(tf_f).get_output()
+
+    def idf(self: Feature, min_doc_freq: int = 0) -> Feature:
+        """IDF weighting of an existing term-count vector."""
+        from .impl.feature.text import OpIDF
+        return OpIDF(min_doc_freq=min_doc_freq).set_input(self).get_output()
+
+    def word2vec(self: Feature, vector_size: int = 32, **kw) -> Feature:
+        from .impl.feature.text import OpWord2Vec
+        return (OpWord2Vec(vector_size=vector_size, **kw)
+                .set_input(self).get_output())
+
+    def count_vec(self: Feature, vocab_size: int = 512, min_df: int = 1,
+                  binary: bool = False) -> Feature:
+        from .impl.feature.text import OpCountVectorizer
+        return (OpCountVectorizer(vocab_size, min_df, binary)
+                .set_input(self).get_output())
+
+    def ngram(self: Feature, n: int = 2) -> Feature:
+        from .impl.feature.text import OpNGram
+        return OpNGram(n).set_input(self).get_output()
+
+    def remove_stop_words(self: Feature,
+                          stop_words: Optional[Sequence[str]] = None,
+                          case_sensitive: bool = False) -> Feature:
+        from .impl.feature.text import OpStopWordsRemover
+        return (OpStopWordsRemover(stop_words, case_sensitive)
+                .set_input(self).get_output())
+
+    def lda(self: Feature, k: int = 10, **kw) -> Feature:
+        """Topic mixture of a term-count vector (RichVectorFeature.lda)."""
+        from .impl.feature.text import OpLDA
+        return OpLDA(k=k, **kw).set_input(self).get_output()
+
     # -- RichDateFeature ------------------------------------------------------
     def to_unit_circle(self: Feature,
                        periods: Sequence[str] = DEFAULT_CIRCULAR_PERIODS
                        ) -> Feature:
+        from .types import DateMap
+        if issubclass(self.feature_type, DateMap):
+            from .impl.feature.dates import DateMapToUnitCircleVectorizer
+            from .impl.feature.vectorizers import VectorsCombiner
+            # one vectorizer per requested period, combined — the map stage
+            # encodes a single period (reference DateMapToUnitCircleVectorizer)
+            outs = [DateMapToUnitCircleVectorizer(period=p)
+                    .set_input(self).get_output() for p in periods]
+            if len(outs) == 1:
+                return outs[0]
+            return VectorsCombiner().set_input(*outs).get_output()
         return DateToUnitCircleTransformer(periods=periods
                                            ).set_input(self).get_output()
 
     def time_period(self: Feature, period: str = "DayOfWeek") -> Feature:
+        """Date/DateList/DateMap → extracted time period (reference
+        TimePeriod{,List,Map}Transformer dispatch by input kind)."""
+        from .types import DateList as DL, DateMap as DM
+        from .impl.feature.dates import (
+            TimePeriodListTransformer, TimePeriodMapTransformer)
+        if issubclass(self.feature_type, DL):
+            return (TimePeriodListTransformer(period)
+                    .set_input(self).get_output())
+        if issubclass(self.feature_type, DM):
+            return (TimePeriodMapTransformer(period)
+                    .set_input(self).get_output())
         return TimePeriodTransformer(period).set_input(self).get_output()
 
     def since_last(self: Feature, reference_date_ms: Optional[int] = None
@@ -210,10 +358,76 @@ def _attach():
             "SinceLast", reference_date_ms=reference_date_ms
         ).set_input(self).get_output()
 
+    def to_date_list(self: Feature) -> Feature:
+        """Date → one-element DateList (RichDateFeature.toDateList)."""
+        from .stages.base import UnaryTransformer
+        from .types import DateList as DL
+        return UnaryTransformer(
+            "toDateList",
+            transform_fn=lambda v: None if v is None else [int(v)],
+            output_type=DL).set_input(self).get_output()
+
     # -- RichMapFeature -------------------------------------------------------
     def filter_keys(self: Feature, white_list: Sequence[str] = (),
                     black_list: Sequence[str] = ()) -> Feature:
         return FilterMap(white_list, black_list).set_input(self).get_output()
+
+    def vectorize_map(self: Feature, white_list_keys: Sequence[str] = (),
+                      black_list_keys: Sequence[str] = (), **kw) -> Feature:
+        """Per-key map vectorization with key white/black lists (reference
+        RichMapFeature.vectorize overloads)."""
+        from .impl.feature.maps import MapVectorizer
+        return MapVectorizer(white_list_keys=white_list_keys,
+                             black_list_keys=black_list_keys, **kw
+                             ).set_input(self).get_output()
+
+    def smart_vectorize_map(self: Feature, **kw) -> Feature:
+        """Per-key cardinality-adaptive text-map vectorization (reference
+        RichMapFeature.smartVectorize)."""
+        from .impl.feature.maps import SmartTextMapVectorizer
+        return SmartTextMapVectorizer(**kw).set_input(self).get_output()
+
+    def pivot_map(self: Feature, top_k: int = 20,
+                  min_support: int = 10) -> Feature:
+        """Per-key top-K pivot of a TextMap (reference RichMapFeature
+        TextMap vectorize)."""
+        from .impl.feature.maps import TextMapPivotVectorizer
+        return (TextMapPivotVectorizer(top_k=top_k, min_support=min_support)
+                .set_input(self).get_output())
+
+    def auto_bucketize_map(self: Feature, label: Feature, max_depth: int = 2,
+                           min_info_gain: float = 0.01) -> Feature:
+        """Label-aware per-key bucketization of a numeric map (reference
+        RichMapFeature.autoBucketize)."""
+        from .impl.feature.bucketizers import DecisionTreeNumericMapBucketizer
+        return DecisionTreeNumericMapBucketizer(
+            max_depth=max_depth, min_info_gain=min_info_gain
+        ).set_input(label, self).get_output()
+
+    def is_valid_phone_map(self: Feature, region: str = "US") -> Feature:
+        from .impl.feature.text import IsValidPhoneMap
+        return (IsValidPhoneMap(default_region=region)
+                .set_input(self).get_output())
+
+    # -- RichVectorFeature ----------------------------------------------------
+    def combine(self: Feature, *others: Feature) -> Feature:
+        """Concatenate vectors (RichVectorFeature.combine)."""
+        from .impl.feature.vectorizers import VectorsCombiner
+        return VectorsCombiner().set_input(self, *others).get_output()
+
+    def drop_indices_by(self: Feature,
+                        predicate: Callable[[Any], bool]) -> Feature:
+        from .impl.feature.math import DropIndicesByTransformer
+        return (DropIndicesByTransformer(predicate)
+                .set_input(self).get_output())
+
+    def to_isotonic_calibrated(self: Feature, label: Feature,
+                               isotonic: bool = True) -> Feature:
+        """Calibrate a score against the label (RichNumericFeature
+        .toIsotonicCalibrated)."""
+        from .impl.regression.isotonic import IsotonicRegressionCalibrator
+        return (IsotonicRegressionCalibrator(isotonic=isotonic)
+                .set_input(label, self).get_output())
 
     # -- vectorize / sanity check ---------------------------------------------
     def vectorize(self: Feature) -> Feature:
@@ -248,6 +462,30 @@ def _attach():
         ("detect_languages", detect_languages),
         ("detect_mime_types", detect_mime_types),
         ("recognize_entities", recognize_entities),
+        # generic lifts
+        ("map_values", map_values), ("exists", exists),
+        ("filter_values", filter_values), ("replace_with", replace_with),
+        ("occurs", occurs),
+        # text extras
+        ("to_multi_pick_list", to_multi_pick_list), ("indexed", indexed),
+        ("deindexed", deindexed), ("tokenize_regex", tokenize_regex),
+        ("to_email_prefix", to_email_prefix),
+        ("to_url_protocol", to_url_protocol), ("parse_phone", parse_phone),
+        # list / NLP
+        ("tf", tf), ("tfidf", tfidf), ("idf", idf), ("word2vec", word2vec),
+        ("count_vec", count_vec), ("ngram", ngram),
+        ("remove_stop_words", remove_stop_words), ("lda", lda),
+        # dates
+        ("to_date_list", to_date_list),
+        # maps
+        ("vectorize_map", vectorize_map),
+        ("smart_vectorize_map", smart_vectorize_map),
+        ("pivot_map", pivot_map),
+        ("auto_bucketize_map", auto_bucketize_map),
+        ("is_valid_phone_map", is_valid_phone_map),
+        # vectors
+        ("combine", combine), ("drop_indices_by", drop_indices_by),
+        ("to_isotonic_calibrated", to_isotonic_calibrated),
     ]
     for name, fn in methods:
         setattr(F, name, fn)

@@ -90,6 +90,61 @@ class TimePeriodTransformer(UnaryTransformer):
                       None if col.mask is None else np.asarray(col.mask))
 
 
+class TimePeriodListTransformer(UnaryTransformer):
+    """DateList → OPVector of per-element time periods (reference
+    TimePeriodListTransformer.scala — each timestamp maps to its extracted
+    period value). The reference emits ragged per-row vectors; columnar
+    arrays are rectangular here, so rows pad/truncate to ``width`` elements
+    (pad value -1, never a real period value). Leave ``width=None`` ONLY
+    for exploratory one-batch use: the column then takes the batch's
+    longest list, which differs between train and score batches — set a
+    fixed width before feeding models."""
+
+    def __init__(self, period: str = "DayOfWeek",
+                 width: Optional[int] = None, uid=None):
+        def fn(v):
+            if v is None:
+                return None
+            arr = np.asarray(list(v), dtype=np.int64)
+            vals = [float(x) for x in time_period_values(arr, period)]
+            if width is not None:
+                vals = (vals + [-1.0] * width)[:width]
+            return vals
+        super().__init__(f"dateListToTimePeriod{period}", transform_fn=fn,
+                         output_type=OPVector, input_type=DateList, uid=uid)
+        self.period = period
+        self.width = width
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        valid = col.valid_mask()
+        rows = [self.transform_fn(col.values[i]) if valid[i] else None
+                for i in range(len(col))]
+        width = self.width or max((len(r) for r in rows if r), default=1)
+        mat = np.full((len(rows), width), -1.0, np.float32)
+        for i, r in enumerate(rows):
+            if r:
+                mat[i, :width] = (r + [-1.0] * width)[:width]
+        return Column(OPVector, mat, None)
+
+
+class TimePeriodMapTransformer(UnaryTransformer):
+    """DateMap → IntegralMap of per-key time periods (reference
+    TimePeriodMapTransformer.scala)."""
+
+    def __init__(self, period: str = "DayOfWeek", uid=None):
+        def fn(v):
+            if v is None:
+                return None
+            return {k: int(time_period_values(
+                np.array([t], dtype=np.int64), period)[0])
+                for k, t in v.items()}
+        from ...types import IntegralMap
+        super().__init__(f"dateMapToTimePeriod{period}", transform_fn=fn,
+                         output_type=IntegralMap, input_type=DateMap, uid=uid)
+        self.period = period
+
+
 #: reference TransmogrifierDefaults.CircularDateRepresentations
 DEFAULT_CIRCULAR_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
 

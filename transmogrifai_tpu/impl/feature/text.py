@@ -772,3 +772,126 @@ class IsValidUrl(UnaryTransformer):
             return bool(_URL_RE.match(str(v)))
         super().__init__("isValidUrl", transform_fn=fn, output_type=Binary,
                          input_type=URL, uid=uid)
+
+
+class EmailToPrefix(UnaryTransformer):
+    """Email → Text local part (reference RichTextFeature toEmailPrefix)."""
+
+    def __init__(self, uid=None):
+        def fn(v):
+            if v is None or not _EMAIL_RE.match(str(v)):
+                return None
+            return str(v).rsplit("@", 1)[0]
+        super().__init__("emailPrefix", transform_fn=fn, output_type=Text,
+                         input_type=Email, uid=uid)
+
+
+class UrlToProtocol(UnaryTransformer):
+    """URL → Text protocol (reference RichTextFeature toProtocol)."""
+
+    def __init__(self, uid=None):
+        def fn(v):
+            if v is None:
+                return None
+            m = _URL_RE.match(str(v))
+            return m.group(1).lower() if m else None
+        super().__init__("urlProtocol", transform_fn=fn, output_type=Text,
+                         input_type=URL, uid=uid)
+
+
+class TextToMultiPickList(UnaryTransformer):
+    """Text → MultiPickList singleton set (reference RichTextFeature
+    toMultiPickList — the text value as a one-element categorical set)."""
+
+    def __init__(self, uid=None):
+        from ...types import MultiPickList
+        def fn(v):
+            return None if v is None else [str(v)]
+        super().__init__("toMultiPickList", transform_fn=fn,
+                         output_type=MultiPickList, input_type=Text, uid=uid)
+
+
+class RegexTokenizer(UnaryTransformer):
+    """Text → TextList by a regex token pattern (reference RichTextFeature
+    tokenizeRegex — Lucene pattern analyzer replaced by re.findall)."""
+
+    def __init__(self, pattern: str = r"\w+", to_lowercase: bool = True,
+                 min_token_length: int = 1, uid=None):
+        rex = re.compile(pattern)
+
+        def fn(v):
+            if v is None:
+                return None
+            s = str(v).lower() if to_lowercase else str(v)
+            # finditer + group(0): full matches even when the user pattern
+            # contains capture groups (findall would return group contents)
+            return [m.group(0) for m in rex.finditer(s)
+                    if len(m.group(0)) >= min_token_length]
+
+        super().__init__("tokenizeRegex", transform_fn=fn,
+                         output_type=TextList, input_type=Text, uid=uid)
+        self.pattern = pattern
+        self.to_lowercase = to_lowercase
+        self.min_token_length = min_token_length
+
+
+class IsValidPhoneMap(UnaryTransformer):
+    """PhoneMap → BinaryMap per-key validity (reference
+    RichMapFeature.isValidPhoneDefaultCountryMap)."""
+
+    def __init__(self, default_region: str = "US", uid=None):
+        from ...types import BinaryMap
+
+        def fn(v):
+            if v is None:
+                return None
+            out = {}
+            for k, s in v.items():
+                r = parse_phone(s, default_region)
+                out[k] = bool(r is not None and r[1])
+            return out
+
+        from ...types import PhoneMap
+        super().__init__("isValidPhoneMap", transform_fn=fn,
+                         output_type=BinaryMap, input_type=PhoneMap, uid=uid)
+        self.default_region = default_region
+
+
+class OpIDF(Estimator):
+    """Seq[OPVector term counts] → OPVector tf-idf weights (reference
+    RichListFeature.tfidf wraps Spark ml.feature.IDF: idf(t) =
+    log((N + 1) / (df_t + 1)), applied multiplicatively)."""
+
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    def __init__(self, min_doc_freq: int = 0, uid=None):
+        super().__init__("idf", uid)
+        self.min_doc_freq = min_doc_freq
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        col = table[self.input_features[0].name]
+        tf = np.asarray(col.values, np.float64)
+        n_docs = tf.shape[0]
+        df = (tf > 0).sum(axis=0)
+        idf = np.log((n_docs + 1.0) / (df + 1.0))
+        idf[df < self.min_doc_freq] = 0.0
+        model = OpIDFModel(idf=idf.astype(np.float32))
+        model.summary_metadata = {"numDocs": int(n_docs)}
+        return self._finalize_model(model)
+
+
+class OpIDFModel(_VectorModelBase):
+    def __init__(self, idf: np.ndarray, uid=None):
+        super().__init__("idf", uid)
+        self.idf = np.asarray(idf, np.float32)
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        col = table[self.input_features[0].name]
+        mat = np.asarray(col.values, np.float32) * self.idf[None, :]
+        return Column(OPVector, mat, None, dict(col.metadata))
+
+    def transform_fn(self, v):
+        if v is None:
+            return None
+        return (np.asarray(v, np.float32) * self.idf).tolist()

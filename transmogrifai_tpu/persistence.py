@@ -305,7 +305,7 @@ def save_model(model, path: str) -> None:
 
 
 def _has_unresolved(v: Any, depth: int = 0) -> bool:
-    if isinstance(v, Unresolved):
+    if isinstance(v, (Unresolved, _StageRef)):
         return True
     if depth > 8:
         return False
@@ -316,6 +316,32 @@ def _has_unresolved(v: Any, depth: int = 0) -> bool:
     if hasattr(v, "__dict__") and not isinstance(v, type):
         return any(_has_unresolved(x, depth + 1) for x in vars(v).values())
     return False
+
+
+def _relink_stage_refs(v: Any, stages: Dict[str, OpPipelineStage],
+                       depth: int = 0) -> Any:
+    """Replace _StageRef placeholders anywhere inside ``v`` (nested lists/
+    dicts/objects, mirroring what _encode recursed into) with the loaded
+    stage of the same uid; unknown uids stay _StageRef and are counted
+    unresolved by _has_unresolved."""
+    if isinstance(v, _StageRef):
+        return stages.get(v.uid, v)
+    if depth > 8:
+        return v
+    if isinstance(v, list):
+        return [_relink_stage_refs(x, stages, depth + 1) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_relink_stage_refs(x, stages, depth + 1) for x in v)
+    if isinstance(v, dict):
+        return {k: _relink_stage_refs(x, stages, depth + 1)
+                for k, x in v.items()}
+    if (hasattr(v, "__dict__") and not isinstance(v, type)
+            and not isinstance(v, OpPipelineStage)):
+        for k, x in list(vars(v).items()):
+            nx = _relink_stage_refs(x, stages, depth + 1)
+            if nx is not x:
+                object.__setattr__(v, k, nx)
+    return v
 
 
 def _collect_unresolved(stage: OpPipelineStage) -> List[str]:
@@ -346,16 +372,15 @@ def load_model(path: str, workflow=None):
         if d["uid"] not in stages:
             stages[d["uid"]] = stage_from_json(d, arrays)
 
-    # re-link stage-valued attributes to the loaded stages of the same uid
-    # (e.g. RecordInsightsLOCO.model_stage -> the loaded SelectedModel)
+    # re-link stage-valued attributes (top-level OR nested in containers)
+    # to the loaded stages of the same uid (e.g. RecordInsightsLOCO
+    # .model_stage -> the loaded SelectedModel); refs to stages outside the
+    # plan stay placeholders and fall through to the workflow-patch path
     for stage in stages.values():
         for k, v in list(vars(stage).items()):
-            if isinstance(v, _StageRef):
-                target = stages.get(v.uid)
-                if target is not None:
-                    setattr(stage, k, target)
-                else:
-                    setattr(stage, k, Unresolved(f"<stage ref {v.uid}>"))
+            nv = _relink_stage_refs(v, stages)
+            if nv is not v:
+                setattr(stage, k, nv)
 
     # patch unresolved state from the original workflow (by stage uid)
     wf_stages: Dict[str, OpPipelineStage] = {}
