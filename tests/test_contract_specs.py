@@ -871,6 +871,25 @@ class TestOpIDFSpec(OpEstimatorSpec):
 # preparators / regression / selector / insights
 # ---------------------------------------------------------------------------
 
+class TestPredictionDeIndexerSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.impl.preparators.prediction_deindexer import (
+        PredictionDeIndexer)
+    stage_cls = PredictionDeIndexer
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.table import Column
+        resp = _resp("ri")
+        pred = _f("pi", "RealNN")
+        stage = cls.stage_cls().set_input(resp, pred)
+        table = _tbl(ri=(RealNN, [0.0, 1.0, 0.0]),
+                     pi=(RealNN, [1.0, 0.0, 9.0]))
+        # the response column carries the indexer's label metadata
+        table = table.with_column(
+            "ri", table["ri"].with_metadata(labels=["no", "yes"]))
+        return stage, table, ["yes", "no", "UnseenLabel"]
+
+
 class TestSanityCheckerSpec(OpEstimatorSpec):
     from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
     stage_cls = SanityChecker

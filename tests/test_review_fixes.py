@@ -93,3 +93,124 @@ def test_sanity_checker_flags_perfect_rule_confidence():
     rule_flags = [w for ws in sc.summary["reasons"].values() for w in ws
                   if "rule confidence" in w]
     assert rule_flags, "perfect rule confidence (==1.0) must be flagged"
+
+
+def test_prediction_deindexer_end_to_end():
+    """reference PredictionDeIndexer.scala:86 — labels ride the indexed
+    response column's metadata and decode predictions back to strings."""
+    from transmogrifai_tpu import FeatureBuilder, FeatureTable, Column
+    from transmogrifai_tpu.impl.feature.text import OpStringIndexer
+    from transmogrifai_tpu.impl.preparators import PredictionDeIndexer
+    from transmogrifai_tpu.types import RealNN, Text
+
+    resp_raw = FeatureBuilder.Text("label").extract_field().as_response()
+    tbl = FeatureTable({"label": Column.of_values(
+        Text, ["cat", "dog", "cat", "bird"])}, 4)
+    idx_model = OpStringIndexer().set_input(resp_raw).fit(tbl)
+    idx_col = idx_model.transform_column(tbl)
+    assert idx_col.metadata["labels"][0] == "cat"      # most frequent first
+    t2 = tbl.with_column("labelIdx", idx_col)
+    t2 = t2.with_column("pred", Column.of_values(RealNN, [1.0, 0.0, 99.0, 2.0]))
+    resp_i = FeatureBuilder.RealNN("labelIdx").extract_field().as_response()
+    pred_i = FeatureBuilder.RealNN("pred").extract_field().as_predictor()
+    model = PredictionDeIndexer().set_input(resp_i, pred_i).fit(t2)
+    out = model.transform_column(t2)
+    # labels rank by frequency then lexicographic: [cat, bird, dog]
+    assert list(out.values) == ["bird", "cat", "UnseenLabel", "dog"]
+    assert model.transform_row({"pred": 0.0}) == "cat"
+
+
+def test_vector_column_history():
+    """reference OpVectorColumnHistory.scala:56 — per-column origin raw
+    features + stage chain."""
+    import numpy as np
+    import pandas as pd
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.vector_metadata import column_history
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    derived = (a + 1.0).alias("shifted")
+    vec = derived.vectorize()
+    df = pd.DataFrame({"a": [1.0, 2.0, None]})
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(vec).train())
+    out = model.score(df=df)
+    vm = out[vec.name].metadata["vector_meta"]
+    hist = column_history(vm, [derived])
+    assert len(hist) == vm.size
+    h0 = hist[0]
+    assert h0.parent_feature_origins == ["a"]
+    assert "alias" in h0.parent_feature_stages or \
+        any("alias" in s for s in h0.parent_feature_stages)
+    d = h0.to_json()
+    from transmogrifai_tpu.vector_metadata import VectorColumnHistory
+    assert VectorColumnHistory.from_json(d) == h0
+
+
+def test_multiclass_threshold_metrics():
+    """reference OpMultiClassificationEvaluator.calculateThresholdMetrics
+    :154-232 — per-threshold top-N correct/incorrect/no-prediction counts."""
+    import numpy as np
+    from transmogrifai_tpu.evaluators import OpMultiClassificationEvaluator
+
+    ev = OpMultiClassificationEvaluator(top_ns=(1, 2))
+    prob = np.array([[0.9, 0.05, 0.05],     # confident correct
+                     [0.4, 0.35, 0.25],     # unconfident correct
+                     [0.2, 0.75, 0.05]])    # confident wrong (label 2)
+    label = np.array([0, 0, 2])
+    tm = ev.threshold_metrics(prob, label)
+    assert tm["thresholds"][0] == 0.0 and tm["thresholds"][-1] == 1.0
+    # at threshold 0 every row predicts: top1 correct = 2, incorrect = 1
+    assert tm["correctCounts"][1][0] == 2
+    assert tm["incorrectCounts"][1][0] == 1
+    assert tm["noPredictionCounts"][1][0] == 0
+    # at threshold 0.5 the 0.4-confidence row abstains
+    i5 = tm["thresholds"].index(0.5)
+    assert tm["noPredictionCounts"][1][i5] == 1
+    assert tm["correctCounts"][1][i5] == 1
+    # top2: row3's label 2 not in top-2 (0.75, 0.2) -> still incorrect
+    assert tm["correctCounts"][2][0] == 2
+    # counts are monotone non-increasing in the threshold
+    assert all(a >= b for a, b in zip(tm["correctCounts"][1],
+                                     tm["correctCounts"][1][1:]))
+
+
+def test_set_input_table_validation():
+    """weak #8: a user-supplied table is checked up front — missing columns
+    and kind mismatches fail fast instead of deep in the DAG."""
+    import pytest
+    from transmogrifai_tpu import Column, FeatureBuilder, FeatureTable
+    from transmogrifai_tpu.types import Real, Text
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    out = a + 1.0
+    wf = (OpWorkflow()
+          .set_input_table(FeatureTable(
+              {"wrong": Column.of_values(Real, [1.0])}, 1))
+          .set_result_features(out))
+    with pytest.raises(ValueError, match="missing raw feature column"):
+        wf.train()
+    wf2 = (OpWorkflow()
+           .set_input_table(FeatureTable(
+               {"a": Column.of_values(Text, ["x"])}, 1))
+           .set_result_features(out))
+    with pytest.raises(ValueError, match="kind mismatch"):
+        wf2.train()
+
+
+def test_word2vec_pair_cap():
+    """weak #7: host-side pair materialization is reservoir-capped."""
+    from transmogrifai_tpu import FeatureBuilder, FeatureTable, Column
+    from transmogrifai_tpu.impl.feature.text import OpWord2Vec
+    from transmogrifai_tpu.types import TextList
+
+    docs = [["a", "b", "c", "d", "e"] * 4] * 50
+    f = FeatureBuilder.TextList("l").extract_field().as_predictor()
+    tbl = FeatureTable({"l": Column.of_values(TextList, docs)}, len(docs))
+    w2v = OpWord2Vec(vector_size=4, steps=5, min_count=1, max_pairs=500)
+    model = w2v.set_input(f).fit(tbl)
+    out = model.transform_column(tbl)
+    import numpy as np
+    assert np.asarray(out.values).shape == (50, 4)

@@ -102,3 +102,77 @@ def test_sanity_checker_refuses_to_remove_everything():
         "features": Column(OPVector, X, None)}, n)
     with pytest.raises(ValueError, match="ALL feature columns"):
         _wire(SanityChecker()).fit(tbl)
+
+
+def test_sample_lower_limit_raises_tiny_fractions():
+    """reference SanityChecker.fraction :524-529 — the check_sample fraction
+    is clamped so the stats sample never drops below sample_lower_limit."""
+    tbl = _make_table(n=5000, seed=3)
+    model = _wire(SanityChecker(check_sample=0.01, sample_lower_limit=1000,
+                                seed=0)).fit(tbl)
+    assert model.summary["sampleSize"] == 1000      # 50 rows requested
+    # and the upper limit still caps from above
+    m2 = _wire(SanityChecker(check_sample=1.0, sample_upper_limit=2000,
+                             seed=0)).fit(tbl)
+    assert m2.summary["sampleSize"] == 2000
+
+
+def _shared_hash_table(n=300, seed=1):
+    """Text shared-hash slots + a leaky null indicator in the same feature
+    group (the canonical protect_text_shared_hash scenario)."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    hashes = rng.rand(n, 3).astype(np.float32)      # uninformative hash slots
+    null_ind = y.copy()                             # null pattern == label
+    good = (y + rng.randn(n)).astype(np.float32)    # survives either way
+    X = np.concatenate([hashes, null_ind[:, None], good[:, None]], axis=1)
+    vm = VectorMetadata.of("features", [
+        VectorColumnMetadata("t", "Text", "t", None, descriptor_value="hash_0"),
+        VectorColumnMetadata("t", "Text", "t", None, descriptor_value="hash_1"),
+        VectorColumnMetadata("t", "Text", "t", None, descriptor_value="hash_2"),
+        VectorColumnMetadata("t", "Text", "t", NULL_INDICATOR),
+        VectorColumnMetadata("age", "Real", "age", None),
+    ])
+    cols = {
+        "label": Column(RealNN, y, None),
+        "features": Column(OPVector, X, None, {"vector_meta": vm}),
+    }
+    return FeatureTable(cols, n)
+
+
+def test_protect_text_shared_hash_exempts_hash_slots():
+    """reference reasonsToRemove :821 + isTextSharedHash :840 — shared-hash
+    text columns are exempt from group propagation when protected."""
+    tbl = _shared_hash_table()
+    unprotected = _wire(SanityChecker(protect_text_shared_hash=False,
+                                      seed=0)).fit(tbl)
+    protected = _wire(SanityChecker(protect_text_shared_hash=True,
+                                    seed=0)).fit(tbl)
+    # the leaky null indicator goes either way
+    assert any(NULL_INDICATOR in d for d in unprotected.summary["dropped"])
+    assert any(NULL_INDICATOR in d for d in protected.summary["dropped"])
+    # unprotected: sibling propagation drags the hash slots; protected: kept
+    assert len(unprotected.summary["dropped"]) == 4    # all text columns
+    assert len(unprotected.keep_indices) == 1          # only 'age'
+    assert len(protected.summary["dropped"]) == 1      # just the indicator
+    assert len(protected.keep_indices) == 4
+
+
+def test_summary_schema_round_trip():
+    import json
+    from transmogrifai_tpu.impl.preparators.sanity_checker_metadata import (
+        SCHEMA_VERSION, SanityCheckerSummary)
+    tbl = _make_table()
+    model = _wire(SanityChecker(seed=0)).fit(tbl)
+    d = json.loads(json.dumps(model.summary.to_json()))
+    assert d["schemaVersion"] == SCHEMA_VERSION
+    back = SanityCheckerSummary.from_json(d)
+    assert back["dropped"] == model.summary["dropped"]
+    assert back["sampleSize"] == model.summary["sampleSize"]
+    assert back.stats.names == model.summary.stats.names
+    # round-1 loose dicts (no schemaVersion) upgrade
+    v1 = {"names": ["a"], "dropped": ["a"], "sampleSize": 7,
+          "reasons": {"a": ["why"]}, "cramersV": {}}
+    up = SanityCheckerSummary.from_json(v1)
+    assert up["sampleSize"] == 7 and up["dropped"] == ["a"]
+    assert up.schema_version == SCHEMA_VERSION

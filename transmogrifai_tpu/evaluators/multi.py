@@ -20,9 +20,13 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
     default_metric = "F1"
     larger_better = True
 
-    def __init__(self, top_ns=(1, 3), **kw):
+    def __init__(self, top_ns=(1, 3), thresholds=None, **kw):
         super().__init__(**kw)
         self.top_ns = tuple(top_ns)
+        #: reference default: 0.0 to 1.0 by 0.1
+        self.thresholds = tuple(
+            thresholds if thresholds is not None
+            else np.round(np.arange(0.0, 1.0001, 0.1), 2).tolist())
 
     def evaluate_all(self, table: FeatureTable) -> Dict[str, float]:
         label, parts = self._extract(table)
@@ -40,7 +44,36 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
                 topn = order[:, :n]
                 hit = (topn == label_idx[:, None]).any(axis=1)
                 out[f"TopN_{n}_Accuracy"] = float(hit.mean())
+            out["ThresholdMetrics"] = self.threshold_metrics(prob, label_idx)
         return out
+
+    def threshold_metrics(self, prob: np.ndarray,
+                          label_idx: np.ndarray) -> Dict[str, object]:
+        """Per-threshold top-N correct / incorrect / no-prediction counts
+        (reference calculateThresholdMetrics :154-232): a prediction is MADE
+        at threshold t when max prob ≥ t; a made prediction is correct for
+        topN when the true label ranks in the top N scores."""
+        prob = np.asarray(prob, dtype=np.float64)
+        label_idx = np.asarray(label_idx, dtype=np.int64)
+        thr = np.asarray(self.thresholds, dtype=np.float64)
+        made = prob.max(axis=1)[:, None] >= thr[None, :]      # (n, T)
+        order = np.argsort(-prob, axis=1)
+        correct = {}
+        incorrect = {}
+        no_pred = {}
+        n_rows = prob.shape[0]
+        for n in self.top_ns:
+            hit = (order[:, :n] == label_idx[:, None]).any(axis=1)[:, None]
+            correct[n] = (hit & made).sum(axis=0).tolist()
+            incorrect[n] = (~hit & made).sum(axis=0).tolist()
+            no_pred[n] = (n_rows - made.sum(axis=0)).tolist()
+        return {
+            "topNs": list(self.top_ns),
+            "thresholds": thr.tolist(),
+            "correctCounts": correct,
+            "incorrectCounts": incorrect,
+            "noPredictionCounts": no_pred,
+        }
 
     def evaluate_arrays(self, label, scores, probability=None) -> float:
         pred = np.asarray(scores, dtype=np.int32)

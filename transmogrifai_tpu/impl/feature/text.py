@@ -226,12 +226,20 @@ class OpStringIndexerModel(Transformer):
             return None
         raise ValueError(f"unseen label {v!r}")
 
+    def rendered_labels(self) -> List[str]:
+        """Labels with the trained-null entry rendered as 'null' (the
+        metadata/text representation; indices match self.labels)."""
+        return ["null" if t is None else t for t in self.labels]
+
     def transform_column(self, table: FeatureTable) -> Column:
         col = table[self.input_features[0].name]
         valid = col.valid_mask()
         vals = [self._index(col.values[i] if valid[i] else None)
                 for i in range(len(col))]
-        return Column.of_values(RealNN, vals)
+        # label/index mapping rides the column (the reference attaches it to
+        # the column schema metadata; PredictionDeIndexer reads it there)
+        return Column.of_values(RealNN, vals).with_metadata(
+            labels=self.rendered_labels())
 
     def transform_fn(self, v):
         return self._index(v)
@@ -344,7 +352,8 @@ class OpWord2Vec(Estimator):
     def __init__(self, vector_size: int = 32, window: int = 5,
                  min_count: int = 2, num_negatives: int = 4,
                  steps: int = 400, learning_rate: float = 0.5,
-                 max_vocab: int = 4096, seed: int = 42, uid=None):
+                 max_vocab: int = 4096, max_pairs: int = 2_000_000,
+                 seed: int = 42, uid=None):
         super().__init__("word2vec", uid)
         self.vector_size = vector_size
         self.window = window
@@ -352,6 +361,7 @@ class OpWord2Vec(Estimator):
         self.num_negatives = num_negatives
         self.steps = steps
         self.learning_rate = learning_rate
+        self.max_pairs = max_pairs
         self.max_vocab = max_vocab
         self.seed = seed
 
@@ -375,17 +385,31 @@ class OpWord2Vec(Estimator):
                                                      dtype=np.float32))
             return self._finalize_model(model)
 
-        # (center, context) pairs, host-side
+        # (center, context) pairs, host-side, reservoir-capped: an unbounded
+        # O(corpus x window) materialization would exhaust host memory on a
+        # real corpus — SGD samples minibatches anyway, so a uniform
+        # reservoir of max_pairs pairs trains the same objective
+        rng_res = np.random.RandomState(self.seed)
+        cap = self.max_pairs
         centers: List[int] = []
         contexts: List[int] = []
+        seen = 0
         for d in docs:
             ids = [index[t] for t in d if t in index]
             for i, c in enumerate(ids):
                 lo, hi = max(0, i - self.window), min(len(ids), i + self.window + 1)
                 for j in range(lo, hi):
-                    if j != i:
+                    if j == i:
+                        continue
+                    seen += 1
+                    if len(centers) < cap:
                         centers.append(c)
                         contexts.append(ids[j])
+                    else:  # reservoir sampling keeps a uniform subset
+                        r = rng_res.randint(0, seen)
+                        if r < cap:
+                            centers[r] = c
+                            contexts[r] = ids[j]
         if not centers:
             model = OpWord2VecModel(vocab=vocab,
                                     vectors=np.zeros((v, self.vector_size),

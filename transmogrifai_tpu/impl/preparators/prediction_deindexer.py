@@ -1,0 +1,78 @@
+"""PredictionDeIndexer — indexed predictions back to label strings.
+
+Mirrors the reference stage (reference:
+core/.../impl/preparators/PredictionDeIndexer.scala:86): a BinaryEstimator
+over (indexed response, indexed prediction) that reads the label/index
+mapping from the response COLUMN's metadata (attached by
+OpStringIndexerModel, the analog of the reference's NominalAttribute schema
+metadata) and emits the prediction's original string label; out-of-range
+predictions decode to the reserved unseen name."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...stages.base import AllowLabelAsInput, Estimator, Transformer
+from ...table import Column, FeatureTable
+from ...types import RealNN, Text
+
+
+class PredictionDeIndexer(AllowLabelAsInput, Estimator):
+    input_types = (RealNN, RealNN)
+    output_type = Text
+
+    def __init__(self, unseen_name: str = "UnseenLabel", uid=None):
+        super().__init__("idx2str", uid)
+        self.unseen_name = unseen_name
+
+    def fit(self, table: FeatureTable) -> Transformer:
+        resp_f = self.input_features[0]
+        labels = table[resp_f.name].metadata.get("labels")
+        if labels is None:
+            # fallback: the fitted indexer stage itself (pre-columnar wiring)
+            origin = getattr(resp_f, "origin_stage", None)
+            labels = getattr(origin, "summary_metadata", {}).get("labels") \
+                if origin is not None else None
+        if labels is None:
+            raise ValueError(
+                f"the feature {resp_f.name!r} does not carry any label/index "
+                f"mapping in its metadata — index it with OpStringIndexer "
+                f"first (reference PredictionDeIndexer error)")
+        # the fallback (stage summary) path may carry a literal None for a
+        # trained-null label; render it like the column-metadata path does
+        labels = ["null" if l is None else l for l in labels]
+        model = PredictionDeIndexerModel(labels=labels,
+                                         unseen_name=self.unseen_name)
+        model.summary_metadata = {"labels": list(labels)}
+        return self._finalize_model(model)
+
+
+class PredictionDeIndexerModel(AllowLabelAsInput, Transformer):
+    output_type = Text
+
+    def __init__(self, labels: List[str], unseen_name: str = "UnseenLabel",
+                 uid=None):
+        super().__init__("idx2str", uid)
+        self.labels = list(labels)
+        self.unseen_name = unseen_name
+
+    def _decode(self, v: Optional[float]) -> str:
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return self.unseen_name
+        i = int(v)
+        return self.labels[i] if 0 <= i < len(self.labels) \
+            else self.unseen_name
+
+    def transform_column(self, table: FeatureTable) -> Column:
+        pred_f = self.input_features[1]
+        col = table[pred_f.name]
+        valid = col.valid_mask()
+        raw = np.asarray(col.values, dtype=np.float64).reshape(-1)
+        out = [self._decode(raw[i] if valid[i] else None)
+               for i in range(len(raw))]
+        return Column.of_values(Text, out)
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        pred_f = self.input_features[1]
+        return self._decode(row.get(pred_f.name))

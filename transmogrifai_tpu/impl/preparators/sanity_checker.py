@@ -26,11 +26,35 @@ from ...ops.stats import (
 from ...stages.base import AllowLabelAsInput, Estimator, Transformer
 from ...table import Column, FeatureTable
 from ...types import OPVector, RealNN
-from ...vector_metadata import VectorMetadata
+from ...vector_metadata import VectorColumnMetadata, VectorMetadata
+from .sanity_checker_metadata import (
+    CategoricalGroupStats, ColumnStatistics, SanityCheckerSummary,
+)
+
+#: feature types whose shared-hash slots protect_text_shared_hash exempts
+_TEXT_PARENT_TYPES = ("Text", "TextArea", "TextMap", "TextAreaMap",
+                      "TextList")  # .tf()/HashingVectorizer slots
+
+
+def _is_text_shared_hash(c: VectorColumnMetadata) -> bool:
+    """Shared-hash text slot (reference SanityChecker.isTextSharedHash :840:
+    text-derived, not an indicator). In this codebase's metadata convention
+    hashed slots carry ``descriptor_value='hash_<j>'`` (and keep their
+    grouping so null-indicator siblings share the feature group), so the
+    test is: text parent, hash descriptor, no indicator value."""
+    return (c.parent_feature_type in _TEXT_PARENT_TYPES
+            and c.indicator_value is None
+            and (c.descriptor_value or "").startswith("hash_"))
 
 
 class SanityCheckerDefaults:
-    """(reference SanityCheckerParams defaults :59-226)"""
+    """(reference SanityCheckerParams defaults :59-226, object SanityChecker
+    :720-739 — ProtectTextSharedHash=False matches the reference object
+    default; round 1 of this build had it True, undocumented). One
+    deliberate deviation: RemoveBadFeatures defaults True here (False in
+    the reference object, but every reference example/selector flow turns
+    it on — removal is the stage's purpose in this framework's default
+    pipelines)."""
     CheckSample = 1.0
     SampleLowerLimit = 1_000
     SampleUpperLimit = 1_000_000
@@ -41,7 +65,7 @@ class SanityCheckerDefaults:
     MinRequiredRuleSupport = 1.0
     MaxRuleConfidence = 1.0
     RemoveFeatureGroup = True
-    ProtectTextSharedHash = True
+    ProtectTextSharedHash = False
     RemoveBadFeatures = True
     CorrelationTypeSpearman = False
 
@@ -55,7 +79,9 @@ class SanityChecker(AllowLabelAsInput, Estimator):
 
     def __init__(self,
                  check_sample: float = SanityCheckerDefaults.CheckSample,
+                 sample_lower_limit: int = SanityCheckerDefaults.SampleLowerLimit,
                  sample_upper_limit: int = SanityCheckerDefaults.SampleUpperLimit,
+                 protect_text_shared_hash: bool = SanityCheckerDefaults.ProtectTextSharedHash,
                  max_correlation: float = SanityCheckerDefaults.MaxCorrelation,
                  min_correlation: float = SanityCheckerDefaults.MinCorrelation,
                  max_cramers_v: float = SanityCheckerDefaults.MaxCramersV,
@@ -69,7 +95,9 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                  uid: Optional[str] = None):
         super().__init__("sanityCheck", uid)
         self.check_sample = check_sample
+        self.sample_lower_limit = sample_lower_limit
         self.sample_upper_limit = sample_upper_limit
+        self.protect_text_shared_hash = protect_text_shared_hash
         self.max_correlation = max_correlation
         self.min_correlation = min_correlation
         self.max_cramers_v = max_cramers_v
@@ -92,9 +120,13 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         Xd_all = jnp.asarray(col.values, dtype=jnp.float32)
         n, d = Xd_all.shape
 
-        # sampling (reference :524-529, capped :720-739)
-        target = min(int(n * self.check_sample) if self.check_sample < 1.0 else n,
-                     self.sample_upper_limit)
+        # sampling (reference fraction :524-529: the requested check_sample
+        # fraction is clamped so the sample never goes below
+        # sample_lower_limit rows nor above sample_upper_limit)
+        min_frac = min(1.0, self.sample_lower_limit / max(n, 1))
+        max_frac = max(0.0, self.sample_upper_limit / max(n, 1))
+        frac = max(min(self.check_sample, max_frac), min_frac)
+        target = min(int(round(n * frac)), n)
         if target < n:
             rng = np.random.RandomState(self.seed)
             idx = rng.choice(n, size=target, replace=False)
@@ -162,7 +194,10 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                         f"at/above max {self.max_rule_confidence} (leakage)")
 
         # feature-group propagation (reference: if one indicator of a pivot
-        # group leaks, the whole group goes)
+        # group leaks, the whole group goes). protect_text_shared_hash
+        # exempts shared-hash text columns — a hash slot aggregates many
+        # tokens, so a sibling's leak says nothing about it (reference
+        # reasonsToRemove :821 + isTextSharedHash :840)
         if self.remove_feature_group and vm is not None and reasons:
             groups = vm.index_of_group()
             leak = {i for i, why in reasons.items()
@@ -170,8 +205,12 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             for group, idxs in groups.items():
                 if leak.intersection(idxs):
                     for i in idxs:
-                        if i not in reasons:
-                            flag(i, f"sibling column in group '{group}' flagged for leakage")
+                        if i in reasons:
+                            continue
+                        if (self.protect_text_shared_hash
+                                and _is_text_shared_hash(vm.columns[i])):
+                            continue
+                        flag(i, f"sibling column in group '{group}' flagged for leakage")
 
         to_remove = sorted(reasons) if self.remove_bad_features else []
         keep = [i for i in range(d) if i not in set(to_remove)]
@@ -180,22 +219,26 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                 "SanityChecker would remove ALL feature columns — loosen thresholds")
 
         names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
-        summary = {
-            "names": names,
-            "count": stats["count"].tolist(),
-            "mean": stats["mean"].tolist(),
-            "variance": stats["variance"].tolist(),
-            "min": stats["min"].tolist(),
-            "max": stats["max"].tolist(),
-            "correlationsWithLabel": [None if np.isnan(c) else float(c) for c in corr],
-            "correlationType": "spearman" if self.correlation_type_spearman else "pearson",
-            "cramersV": {g: v for g, v in group_cramers.items()},
-            "dropped": [names[i] for i in to_remove],
-            "reasons": {names[i]: why for i, why in reasons.items()},
-            "sampleSize": int(len(ys)),
-        }
+        summary = SanityCheckerSummary(
+            stats=ColumnStatistics(
+                names=names,
+                count=stats["count"].tolist(),
+                mean=stats["mean"].tolist(),
+                variance=stats["variance"].tolist(),
+                min=stats["min"].tolist(),
+                max=stats["max"].tolist()),
+            categorical=CategoricalGroupStats(
+                cramers_v={g: v for g, v in group_cramers.items()}),
+            correlations_with_label=[None if np.isnan(c) else float(c)
+                                     for c in corr],
+            correlation_type=("spearman" if self.correlation_type_spearman
+                              else "pearson"),
+            dropped=[names[i] for i in to_remove],
+            reasons={names[i]: why for i, why in reasons.items()},
+            sample_size=int(len(ys)),
+        )
         model = SanityCheckerModel(keep_indices=keep, summary=summary)
-        model.summary_metadata = summary
+        model.summary_metadata = summary.to_json()
         return self._finalize_model(model)
 
 

@@ -1,0 +1,130 @@
+"""Typed, versioned SanityChecker summary.
+
+Mirrors the reference's typed metadata (de)serialization (reference:
+core/.../impl/preparators/SanityCheckerMetadata.scala — SanityCheckerSummary
+with named sub-records and a round-trippable schema): a dataclass schema
+with an explicit ``schemaVersion``, instead of the loose dict of round 1.
+Dict-style access (``summary["dropped"]``) is kept for compatibility with
+existing consumers (ModelInsights, tests, user code)."""
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: bump when the serialized layout changes; from_json upgrades older versions
+SCHEMA_VERSION = 2
+
+
+@dataclass
+class ColumnStatistics:
+    """Per-column stats (reference SanityCheckerMetadata ColumnStatistics)."""
+    names: List[str] = field(default_factory=list)
+    count: List[float] = field(default_factory=list)
+    mean: List[float] = field(default_factory=list)
+    variance: List[float] = field(default_factory=list)
+    min: List[float] = field(default_factory=list)
+    max: List[float] = field(default_factory=list)
+
+
+@dataclass
+class CategoricalGroupStats:
+    """Per-group contingency stats (reference CategoricalGroupStats)."""
+    cramers_v: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class SanityCheckerSummary:
+    """The full fitted summary (reference SanityCheckerSummary.scala)."""
+    stats: ColumnStatistics = field(default_factory=ColumnStatistics)
+    categorical: CategoricalGroupStats = field(
+        default_factory=CategoricalGroupStats)
+    correlations_with_label: List[Optional[float]] = field(
+        default_factory=list)
+    correlation_type: str = "pearson"
+    dropped: List[str] = field(default_factory=list)
+    reasons: Dict[str, List[str]] = field(default_factory=dict)
+    sample_size: int = 0
+    schema_version: int = SCHEMA_VERSION
+
+    # -- dict-compat view (consumers predate the typed schema) --------------
+    _ALIASES = {
+        "names": lambda s: s.stats.names,
+        "count": lambda s: s.stats.count,
+        "mean": lambda s: s.stats.mean,
+        "variance": lambda s: s.stats.variance,
+        "min": lambda s: s.stats.min,
+        "max": lambda s: s.stats.max,
+        "correlationsWithLabel": lambda s: s.correlations_with_label,
+        "correlationType": lambda s: s.correlation_type,
+        "cramersV": lambda s: s.categorical.cramers_v,
+        "dropped": lambda s: s.dropped,
+        "reasons": lambda s: s.reasons,
+        "sampleSize": lambda s: s.sample_size,
+        "schemaVersion": lambda s: s.schema_version,
+    }
+
+    def __getitem__(self, key: str) -> Any:
+        try:
+            return self._ALIASES[key](self)
+        except KeyError:
+            raise KeyError(key) from None
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._ALIASES
+
+    def keys(self):
+        return self._ALIASES.keys()
+
+    # -- (de)serialization ---------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "schemaVersion": self.schema_version,
+            "stats": asdict(self.stats),
+            "categorical": asdict(self.categorical),
+            "correlationsWithLabel": self.correlations_with_label,
+            "correlationType": self.correlation_type,
+            "dropped": list(self.dropped),
+            "reasons": dict(self.reasons),
+            "sampleSize": self.sample_size,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "SanityCheckerSummary":
+        version = d.get("schemaVersion", 1)
+        if version == 1:
+            # round-1 loose dict: flat stat arrays, camelCase keys
+            return cls(
+                stats=ColumnStatistics(
+                    names=list(d.get("names", [])),
+                    count=list(d.get("count", [])),
+                    mean=list(d.get("mean", [])),
+                    variance=list(d.get("variance", [])),
+                    min=list(d.get("min", [])),
+                    max=list(d.get("max", []))),
+                categorical=CategoricalGroupStats(
+                    cramers_v=dict(d.get("cramersV", {}))),
+                correlations_with_label=list(
+                    d.get("correlationsWithLabel", [])),
+                correlation_type=d.get("correlationType", "pearson"),
+                dropped=list(d.get("dropped", [])),
+                reasons=dict(d.get("reasons", {})),
+                sample_size=int(d.get("sampleSize", 0)),
+            )
+        if version == SCHEMA_VERSION:
+            return cls(
+                stats=ColumnStatistics(**d["stats"]),
+                categorical=CategoricalGroupStats(**d["categorical"]),
+                correlations_with_label=list(d["correlationsWithLabel"]),
+                correlation_type=d["correlationType"],
+                dropped=list(d["dropped"]),
+                reasons=dict(d["reasons"]),
+                sample_size=int(d["sampleSize"]),
+            )
+        raise ValueError(
+            f"unknown SanityChecker summary schemaVersion {version}")

@@ -101,3 +101,57 @@ class VectorMetadata:
         for m in metas:
             cols.extend(m.columns)
         return VectorMetadata.of(name, cols)
+
+
+@dataclass(frozen=True)
+class VectorColumnHistory:
+    """Full provenance of one vector slot: the column's immediate parent
+    feature plus the RAW features and STAGE chain that produced that parent
+    (reference features/.../spark/OpVectorColumnHistory.scala:56 +
+    OpVectorMetadata.getColumnHistory :120)."""
+    column_name: str
+    parent_feature_name: str
+    parent_feature_origins: List[str]
+    parent_feature_stages: List[str]
+    parent_feature_type: str
+    grouping: Optional[str]
+    indicator_value: Optional[str]
+    descriptor_value: Optional[str]
+    index: int
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "VectorColumnHistory":
+        return VectorColumnHistory(**d)
+
+
+def column_history(vm: VectorMetadata,
+                   parent_features: Sequence[Any]) -> List[VectorColumnHistory]:
+    """Per-column stage-history provenance: join each column's parent
+    feature name against the feature DAG — origin raw features from the
+    lineage walk, stage chain from parent_stages ordered by distance
+    (reference OpVectorMetadata.getColumnHistory :120)."""
+    by_name = {f.name: f for f in parent_features}
+    out: List[VectorColumnHistory] = []
+    for c in vm.columns:
+        f = by_name.get(c.parent_feature_name)
+        if f is not None:
+            origins = sorted({r.name for r in f.raw_features()})
+            stages = [s.operation_name for s, _dist in
+                      sorted(f.parent_stages().items(),
+                             key=lambda t: -t[1])]
+        else:
+            origins, stages = [c.parent_feature_name], []
+        out.append(VectorColumnHistory(
+            column_name=c.column_name(),
+            parent_feature_name=c.parent_feature_name,
+            parent_feature_origins=origins,
+            parent_feature_stages=stages,
+            parent_feature_type=c.parent_feature_type,
+            grouping=c.grouping,
+            indicator_value=c.indicator_value,
+            descriptor_value=c.descriptor_value,
+            index=c.index))
+    return out

@@ -53,13 +53,44 @@ class _WorkflowCore:
         self.parameters = dict(params)
         return self
 
+    #: OpWorkflow (training) demands response columns too; the fitted model
+    #: scores without them (reference: scoring never reads the label)
+    _require_response_columns = True
+
     def _generate_raw_table(self) -> FeatureTable:
         if self._input_table is not None:
+            self._validate_input_table(self._input_table)
             return self._input_table
         if self.reader is None:
             raise ValueError(
                 "no data source: call set_reader / set_input_dataset / set_input_table")
         return self.reader.generate_table(self.raw_features)
+
+    def _validate_input_table(self, table: FeatureTable) -> None:
+        """A user-supplied table bypasses reader-side feature extraction, so
+        check it up front: every raw feature needs a column of the matching
+        type kind — otherwise a stage fails deep in the DAG with an opaque
+        shape/dtype error."""
+        required = [f for f in self.raw_features
+                    if self._require_response_columns or not f.is_response]
+        missing = [f.name for f in required
+                   if f.name not in table.column_names]
+        if missing:
+            raise ValueError(
+                f"input table is missing raw feature column(s) {missing}; "
+                f"table has {sorted(table.column_names)}")
+        mismatched = []
+        for f in required:
+            col = table[f.name]
+            want = f.feature_type.column_kind
+            got = col.feature_type.column_kind
+            if want != got:
+                mismatched.append(f"{f.name}: feature is {f.type_name} "
+                                  f"({want}) but column holds "
+                                  f"{col.feature_type.__name__} ({got})")
+        if mismatched:
+            raise ValueError("input table column kind mismatch — "
+                             + "; ".join(mismatched))
 
     def _inject_stage_params(self, stages: Sequence[Any]) -> None:
         per_stage = self.parameters.get("stageParams", {})
@@ -338,6 +369,9 @@ class OpWorkflow(_WorkflowCore):
 
 class OpWorkflowModel(_WorkflowCore):
     """Fitted workflow (reference OpWorkflowModel.scala)."""
+
+    #: serve-time tables may omit the label column — scoring never reads it
+    _require_response_columns = False
 
     def __init__(self):
         super().__init__()
