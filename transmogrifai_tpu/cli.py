@@ -1,22 +1,35 @@
 """``op gen`` — project generator.
 
 Mirrors the reference CLI (reference: cli/src/main/scala/com/salesforce/op/cli/
-— ``op gen`` parses an Avro schema or infers one from CSV, asks about the
-problem kind and field roles, and renders a runnable project from the
-``templates/simple`` tree, cli/README.md:34-57). Here: infer the schema from a
-CSV with pandas, classify the problem from the response column, and emit a
-runnable python project (app.py + README + test) wired to this framework.
+— ``op gen`` parses an Avro schema (SchemaSource.scala, AvroField.scala) or
+infers one from CSV, asks about the problem kind and field roles (answers can
+come from a file, CommandParser.scala:98-101), and renders a runnable project
+from the ``templates/simple`` tree, cli/README.md:34-57). Here: take the
+schema from an Avro ``.avsc`` (--schema) or infer it from the data, apply
+--answers overrides, classify the problem from the response column, and emit
+a runnable python project (app.py + README + test) wired to this framework.
 
 Usage::
 
     python -m transmogrifai_tpu.cli gen --input data.csv --response y \
         --output my_project --name MyApp [--id-field id]
+    python -m transmogrifai_tpu.cli gen --input data.avro \
+        --schema schema.avsc --response survived --output proj \
+        [--answers answers.txt]
+
+Answers file (the reference's non-interactive answers mechanism): one
+``key=value`` per line —
+
+    problem=binary                 # binary | multiclass | regression
+    type.<field>=PickList          # override a field's inferred FeatureType
+    role.<field>=drop              # predictor (default) | id | drop
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def infer_schema(df, response: str, id_field: Optional[str]
@@ -53,6 +66,94 @@ def infer_schema(df, response: str, id_field: Optional[str]
     return problem, fields
 
 
+#: Avro primitive -> FeatureType (reference AvroField.scala:89-126; enums
+#: pivot as PickList, nullable unions unwrap — typeOfNullable :140-146)
+_AVRO_TYPES = {"int": "Integral", "long": "Integral", "boolean": "Binary",
+               "float": "Real", "double": "Real", "string": "Text"}
+
+
+def avro_schema_fields(schema_path: str) -> List[Tuple[str, str]]:
+    """Parse an Avro record schema (.avsc) into [(field, FeatureType)]
+    (the analog of the reference's SchemaSource.AvroSchemaFromFile)."""
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    if schema.get("type") != "record":
+        raise SystemExit(f"{schema_path}: top-level avro type must be "
+                         f"'record', got {schema.get('type')!r}")
+    out: List[Tuple[str, str]] = []
+    for f in schema.get("fields", []):
+        t = f["type"]
+        if isinstance(t, list):  # nullable union: unwrap the non-null arm
+            arms = [a for a in t if a != "null"]
+            if len(arms) != 1:
+                raise SystemExit(
+                    f"{schema_path}: field {f['name']!r} has a multi-type "
+                    f"union {t} — only nullable two-arm unions are supported")
+            t = arms[0]
+        if isinstance(t, dict):
+            if t.get("type") == "enum":
+                out.append((f["name"], "PickList"))
+                continue
+            t = t.get("type")
+        ft = _AVRO_TYPES.get(t)
+        if ft is None:
+            raise SystemExit(
+                f"{schema_path}: unsupported avro type {t!r} for field "
+                f"{f['name']!r} (supported: {sorted(_AVRO_TYPES)}, enum)")
+        out.append((f["name"], ft))
+    return out
+
+
+def parse_answers(path: str) -> Dict[str, str]:
+    """key=value answers file (reference answers mechanism,
+    CommandParser.scala:98-101)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise SystemExit(f"{path}:{ln}: expected key=value, "
+                                 f"got {line!r}")
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _apply_answers(problem: str, fields: List[Tuple[str, str]],
+                   answers: Dict[str, str]) -> Tuple[str, List[Tuple[str, str]]]:
+    problem = answers.get("problem", problem)
+    if problem not in ("binary", "multiclass", "regression"):
+        raise SystemExit(f"answers: problem must be binary|multiclass|"
+                         f"regression, got {problem!r}")
+    # reject typos up front: unknown field names and unknown feature types
+    # would otherwise surface only when the GENERATED app runs
+    from .types import FEATURE_TYPES
+    known = {c for c, _ in fields}
+    for k, v in answers.items():
+        if k.startswith(("role.", "type.")):
+            fld = k.split(".", 1)[1]
+            if fld not in known:
+                raise SystemExit(
+                    f"answers: {k} refers to unknown field {fld!r} "
+                    f"(fields: {sorted(known)})")
+        if k.startswith("type.") and v not in FEATURE_TYPES:
+            raise SystemExit(f"answers: {k}={v!r} is not a feature type")
+        if not k.startswith(("role.", "type.")) and k != "problem":
+            raise SystemExit(f"answers: unknown key {k!r}")
+    out: List[Tuple[str, str]] = []
+    for col, ft in fields:
+        role = answers.get(f"role.{col}", "predictor")
+        if role in ("drop", "id"):
+            continue
+        if role != "predictor":
+            raise SystemExit(f"answers: role.{col} must be "
+                             f"predictor|id|drop, got {role!r}")
+        out.append((col, answers.get(f"type.{col}", ft)))
+    return problem, out
+
+
 _APP_TEMPLATE = '''\
 """{name} — generated by `python -m transmogrifai_tpu.cli gen`.
 
@@ -86,7 +187,7 @@ prediction = ({selector}
 workflow = OpWorkflow().set_result_features(prediction)
 runner = OpWorkflowRunner(
     workflow,
-    train_reader=DataReaders.Simple.csv_auto(DATA_PATH),
+    train_reader={reader_expr},
 )
 
 if __name__ == "__main__":
@@ -126,13 +227,32 @@ def test_app_trains(tmp_path):
 
 
 def generate(input_csv: str, response: str, output: str, name: str,
-             id_field: Optional[str] = None) -> Dict[str, str]:
+             id_field: Optional[str] = None,
+             schema: Optional[str] = None,
+             answers: Optional[str] = None) -> Dict[str, str]:
     import pandas as pd
-    df = pd.read_csv(input_csv)
+    is_avro = input_csv.endswith(".avro")
+    if is_avro:
+        from .utils.avro import read_avro
+        df = pd.DataFrame(list(read_avro(input_csv)))
+        reader_expr = "DataReaders.Simple.avro(DATA_PATH)"
+    else:
+        df = pd.read_csv(input_csv)
+        reader_expr = "DataReaders.Simple.csv_auto(DATA_PATH)"
     if response not in df.columns:
         raise SystemExit(f"response column {response!r} not in {input_csv} "
                          f"(columns: {list(df.columns)})")
     problem, fields = infer_schema(df, response, id_field)
+    if schema is not None:
+        declared = avro_schema_fields(schema)
+        names = {c for c, _ in declared}
+        if response not in names:
+            raise SystemExit(f"response {response!r} not in schema {schema}")
+        fields = [(c, ft) for c, ft in declared
+                  if c != response and c != id_field]
+    if answers is not None:
+        problem, fields = _apply_answers(problem, fields,
+                                         parse_answers(answers))
     selector = {
         "binary": "BinaryClassificationModelSelector",
         "multiclass": "MultiClassificationModelSelector",
@@ -163,7 +283,8 @@ def generate(input_csv: str, response: str, output: str, name: str,
             f").as_response()")
     app = _APP_TEMPLATE.format(
         name=name, selector=selector, data_path=os.path.abspath(input_csv),
-        response_lines=response_lines, predictor_lines=predictor_lines)
+        response_lines=response_lines, predictor_lines=predictor_lines,
+        reader_expr=reader_expr)
     readme = _README_TEMPLATE.format(
         name=name, data_path=input_csv, problem=problem, response=response,
         n_predictors=len(fields))
@@ -179,15 +300,22 @@ def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="op",
                                 description="transmogrifai_tpu CLI")
     sub = p.add_subparsers(dest="command", required=True)
-    gen = sub.add_parser("gen", help="generate a project from a CSV schema")
-    gen.add_argument("--input", required=True, help="CSV file")
+    gen = sub.add_parser(
+        "gen", help="generate a project from a CSV or Avro schema")
+    gen.add_argument("--input", required=True, help="CSV or .avro data file")
     gen.add_argument("--response", required=True, help="response column")
     gen.add_argument("--output", required=True, help="output project dir")
     gen.add_argument("--name", default="GeneratedApp")
     gen.add_argument("--id-field", default=None)
+    gen.add_argument("--schema", default=None,
+                     help="Avro .avsc record schema declaring field types")
+    gen.add_argument("--answers", default=None,
+                     help="key=value answers file (problem=, type.<f>=, "
+                          "role.<f>=) for non-interactive generation")
     a = p.parse_args(argv)
     if a.command == "gen":
-        generate(a.input, a.response, a.output, a.name, a.id_field)
+        generate(a.input, a.response, a.output, a.name, a.id_field,
+                 schema=a.schema, answers=a.answers)
         print(f"generated project in {a.output}/ "
               f"(app.py, README.md, test_app.py)")
 

@@ -1,4 +1,6 @@
 from .random_data import (  # noqa: F401
-    RandomBinary, RandomData, RandomIntegral, RandomList, RandomMap,
-    RandomMultiPickList, RandomReal, RandomText, RandomVector,
+    InfiniteStream, RandomBinary, RandomCurrency, RandomData, RandomDateList,
+    RandomGeolocation, RandomIntegral, RandomList, RandomMap,
+    RandomMultiPickList, RandomReal, RandomStream, RandomText, RandomVector,
+    generator_of, random_table,
 )

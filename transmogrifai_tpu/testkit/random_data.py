@@ -21,6 +21,7 @@ class RandomData:
     ``take(n)``; ``with_probability_of_empty(p)`` injects Nones."""
 
     def __init__(self, seed: int = 42):
+        self._seed = int(seed)
         self._rng = np.random.RandomState(seed)
         self.probability_of_empty = 0.0
 
@@ -29,6 +30,7 @@ class RandomData:
         return self
 
     def reset(self, seed: int) -> "RandomData":
+        self._seed = int(seed)
         self._rng = np.random.RandomState(seed)
         return self
 
@@ -262,3 +264,225 @@ class RandomVector(RandomData):
 
     def _one(self) -> List[float]:
         return [v if v is not None else 0.0 for v in self.element.take(self.dim)]
+
+
+class InfiniteStream:
+    """Infinite transformed stream (reference InfiniteStream.scala:63):
+    wrap any iterator / generator fn, then ``map`` and ``take``."""
+
+    def __init__(self, it: Iterator[Any]):
+        self._it = it
+
+    @staticmethod
+    def of(fn: Callable[[int], Any]) -> "InfiniteStream":
+        def gen():
+            i = 0
+            while True:
+                yield fn(i)
+                i += 1
+        return InfiniteStream(gen())
+
+    def map(self, fn: Callable[[Any], Any]) -> "InfiniteStream":
+        return InfiniteStream(fn(v) for v in self._it)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._it
+
+    def __next__(self) -> Any:
+        return next(self._it)
+
+    def take(self, n: int) -> List[Any]:
+        return [next(self._it) for _ in range(n)]
+
+    limit = take
+
+
+class RandomStream(RandomData):
+    """Seeded stream from an arbitrary draw function (reference
+    RandomStream.scala:303 — the building block behind every Random* type):
+    ``RandomStream(lambda rng: ...)``. Composes via ``map`` / ``zip``."""
+
+    def __init__(self, draw: Callable[[np.random.RandomState], Any],
+                 seed: int = 42):
+        super().__init__(seed)
+        self._draw = draw
+
+    @staticmethod
+    def of(draw: Callable[[np.random.RandomState], Any],
+           seed: int = 42) -> "RandomStream":
+        return RandomStream(draw, seed)
+
+    @staticmethod
+    def random_between(lo: float, hi: float, seed: int = 42) -> "RandomStream":
+        return RandomStream(lambda r: float(r.uniform(lo, hi)), seed)
+
+    @staticmethod
+    def random_longs(lo: int, hi: int, seed: int = 42) -> "RandomStream":
+        return RandomStream(lambda r: int(r.randint(lo, hi)), seed)
+
+    def map(self, fn: Callable[[Any], Any]) -> "RandomStream":
+        # child seed derives from the parent SEED, never from the parent's
+        # live RNG — deriving a stream must not perturb the parent's
+        # deterministic sequence
+        draw = self._draw
+        return RandomStream(lambda r: fn(draw(r)),
+                            (self._seed * 1000003 + 1) % (2**31))
+
+    def zip(self, other: "RandomData") -> "RandomStream":
+        draw = self._draw
+        return RandomStream(lambda r: (draw(r), next(other)),
+                            (self._seed * 1000003 + 2) % (2**31))
+
+    def _one(self) -> Any:
+        return self._draw(self._rng)
+
+
+_STREETS = ("Main St,Oak Ave,Maple Dr,Cedar Ln,Pine Rd,Elm St,2nd Ave,"
+            "Park Blvd,Lake View Dr,Hill Crest Rd").split(",")
+_CITIES = ("Springfield,Riverton,Fairview,Georgetown,Arlington,Ashland,"
+           "Dover,Clinton,Salem,Madison").split(",")
+_STATES = "CA NY TX WA OR IL MA CO GA FL".split()
+
+
+class RandomGeolocation(RandomData):
+    """reference RandomList.ofGeolocations: (lat, lon, accuracy) triples."""
+
+    def __init__(self, seed: int = 42):
+        super().__init__(seed)
+
+    def _one(self) -> List[float]:
+        r = self._rng
+        return [float(r.uniform(-90, 90)), float(r.uniform(-180, 180)),
+                float(r.randint(1, 11))]
+
+
+class RandomCurrency(RandomReal):
+    """reference RandomReal.currency-style positive amounts (2 decimals)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1000.0, seed: int = 42):
+        super().__init__("uniform", seed, low=lo, high=hi)
+
+    def _one(self) -> float:
+        return round(super()._one(), 2)
+
+
+class RandomDateList(RandomList):
+    """reference RandomList.ofDates: sorted epoch-millis event lists."""
+
+    def __init__(self, start_ms: int = 1_500_000_000_000,
+                 span_days: int = 365, min_len: int = 0, max_len: int = 5,
+                 seed: int = 42):
+        super().__init__(RandomIntegral.dates(start_ms, span_days,
+                                              seed=seed + 1),
+                         min_len, max_len, seed)
+
+    def _one(self) -> List[int]:
+        return sorted(super()._one())
+
+
+# ---------------------------------------------------------------------------
+# Default generator per feature type — the testkit can produce EVERY type
+# ---------------------------------------------------------------------------
+
+def generator_of(feature_type: Any, seed: int = 42) -> RandomData:
+    """A sensible default generator for any of the 52 feature types
+    (reference testkit package object defaults). Text-ish types draw from
+    their domain tables; maps wrap the scalar generator under keys k0..k3."""
+    from ..types import FEATURE_TYPES
+    name = (feature_type if isinstance(feature_type, str)
+            else feature_type.__name__)
+    if name not in FEATURE_TYPES:
+        raise ValueError(f"unknown feature type {name!r}")
+    if name.endswith("Map") and name not in ("PickListMap",):
+        inner = generator_of(name[:-3], seed + 1)
+        return RandomMap(inner, keys=["k0", "k1", "k2", "k3"], seed=seed)
+
+    scalar: Dict[str, Callable[[], RandomData]] = {
+        "Real": lambda: RandomReal.normal(seed=seed),
+        "RealNN": lambda: RandomReal.normal(seed=seed),
+        "Currency": lambda: RandomCurrency(seed=seed),
+        "Percent": lambda: RandomReal.uniform(0.0, 1.0, seed=seed),
+        "Integral": lambda: RandomIntegral.integers(seed=seed),
+        "Date": lambda: RandomIntegral.dates(seed=seed),
+        "DateTime": lambda: RandomIntegral.dates(seed=seed),
+        "Binary": lambda: RandomBinary(seed=seed),
+        "Text": lambda: RandomText.strings(seed=seed),
+        "TextArea": lambda: RandomText.strings(words=30, seed=seed),
+        "Email": lambda: RandomText.emails(seed=seed),
+        "URL": lambda: RandomText.urls(seed=seed),
+        "Phone": lambda: RandomText.phones(seed=seed),
+        "ID": lambda: RandomText.ids(seed=seed),
+        "Base64": lambda: RandomText.base64(seed=seed),
+        "PickList": lambda: RandomText.pick_lists(
+            ["red", "green", "blue", "yellow"], seed=seed),
+        "PickListMap": lambda: RandomMap(
+            RandomText.pick_lists(["red", "green", "blue"], seed=seed + 1),
+            keys=["k0", "k1", "k2", "k3"], seed=seed),
+        "ComboBox": lambda: RandomText.pick_lists(
+            ["small", "medium", "large"], seed=seed),
+        "Country": lambda: RandomText.countries(seed=seed),
+        "State": lambda: RandomStream(lambda r: str(r.choice(_STATES)), seed),
+        "City": lambda: RandomStream(lambda r: str(r.choice(_CITIES)), seed),
+        "Street": lambda: RandomStream(
+            lambda r: f"{r.randint(1, 9999)} {r.choice(_STREETS)}", seed),
+        "PostalCode": lambda: RandomStream(
+            lambda r: f"{r.randint(10000, 99999)}", seed),
+        "TextList": lambda: RandomList(RandomText.strings(words=1,
+                                                          seed=seed + 1),
+                                       1, 5, seed),
+        "DateList": lambda: RandomDateList(seed=seed),
+        "DateTimeList": lambda: RandomDateList(seed=seed),
+        "MultiPickList": lambda: RandomMultiPickList(
+            ["a", "b", "c", "d"], seed=seed),
+        "Geolocation": lambda: RandomGeolocation(seed=seed),
+        "OPVector": lambda: RandomVector(8, seed=seed),
+        "Prediction": lambda: RandomStream(
+            lambda r: {"prediction": float(r.randint(0, 2))}, seed),
+    }
+    if name in scalar:
+        return scalar[name]()
+    raise ValueError(f"no default generator for feature type {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# Benchmark-scale table builder
+# ---------------------------------------------------------------------------
+
+def random_table(spec: Dict[str, Any], n: int, seed: int = 42):
+    """Build a FeatureTable from {column: FeatureType | (FeatureType, gen)}.
+
+    Numeric scalar types draw VECTORIZED (one numpy call for all n rows), so
+    benchmark-scale tables (millions of rows) build in milliseconds; host
+    types fall back to the per-row generator streams."""
+    from ..table import Column, FeatureTable
+    from ..types import FEATURE_TYPES
+    rng = np.random.RandomState(seed)
+    cols: Dict[str, Any] = {}
+    for i, (name, entry) in enumerate(spec.items()):
+        if isinstance(entry, tuple):
+            ftype, gen = entry
+        else:
+            ftype, gen = entry, None
+        if isinstance(ftype, str):
+            ftype = FEATURE_TYPES[ftype]
+        kind = ftype.column_kind
+        if gen is None and kind in ("real", "binary", "integral", "date"):
+            # vectorized fast path
+            if kind == "real":
+                vals = rng.randn(n).astype(np.float32)
+            elif kind == "binary":
+                vals = (rng.rand(n) < 0.5)
+            elif kind == "date":
+                vals = rng.randint(1_500_000_000_000,
+                                   1_530_000_000_000, size=n,
+                                   dtype=np.int64)
+            else:
+                vals = rng.randint(0, 100, size=n)
+            cols[name] = Column.of_values(ftype, vals.tolist())
+        elif gen is None and kind == "vector":
+            cols[name] = Column(ftype, rng.randn(n, 8).astype(np.float32),
+                                None)
+        else:
+            g = gen or generator_of(ftype, seed=seed + i)
+            cols[name] = Column.of_values(ftype, g.take(n))
+    return FeatureTable(cols, n)

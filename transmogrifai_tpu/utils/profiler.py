@@ -27,6 +27,7 @@ class StageProfiler:
         self._total = 0.0
         self._count = 0
         self._by_stage: Dict[str, float] = {}
+        self._by_layer: Dict[str, float] = {}
         self._by_op: Dict[str, float] = {}
 
     @contextmanager
@@ -48,16 +49,34 @@ class StageProfiler:
             self._count += 1
             self._by_stage[name] = self._by_stage.get(name, 0.0) + secs
             self._by_op[op] = self._by_op.get(op, 0.0) + secs
+            lk = f"layer_{layer}" if layer >= 0 else "unlayered"
+            self._by_layer[lk] = self._by_layer.get(lk, 0.0) + secs
 
-    # -- aggregation (reference AppMetrics) ----------------------------------
+    # -- aggregation (reference AppMetrics, OpSparkListener.scala:55-110) ----
     def app_metrics(self) -> Dict[str, Any]:
-        return {
+        # accumulated in track() (NOT derived from the bounded records ring,
+        # which would undercount runs past its maxlen)
+        by_layer = self._by_layer
+        out = {
             "appDurationSecs": time.time() - self.app_start,
             "stageSecondsTotal": self._total,
             "byStage": dict(sorted(self._by_stage.items(), key=lambda kv: -kv[1])),
             "byOp": dict(self._by_op),
+            "byLayer": dict(sorted(by_layer.items())),
             "numRecords": self._count,
         }
+        # device-side memory stats, best effort (the reference's analog is
+        # the listener's executor GC/spill metrics)
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            out["deviceMemory"] = {
+                k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "num_allocs")}
+        except Exception:
+            pass
+        return out
 
     def pretty(self, top_k: int = 15) -> str:
         m = self.app_metrics()
