@@ -101,7 +101,7 @@ def main():
         return best
 
     sweep()                                  # compile warmup
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    reps = int(os.environ.get("BENCH_REPS", 5))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()

@@ -98,7 +98,7 @@ def test_gen_from_reference_passenger_avro(tmp_path):
     schema produces a training project (reference SchemaSource.scala)."""
     avro_path = "/root/reference/test-data/PassengerDataAll.avro"
     avsc_path = "/root/reference/test-data/PassengerDataAll.avsc"
-    if not os.path.exists(avro_path):
+    if not (os.path.exists(avro_path) and os.path.exists(avsc_path)):
         pytest.skip("reference Passenger avro fixtures not present")
     answers = tmp_path / "answers.txt"
     answers.write_text(

@@ -122,7 +122,9 @@ def parse_answers(path: str) -> Dict[str, str]:
 
 
 def _apply_answers(problem: str, fields: List[Tuple[str, str]],
-                   answers: Dict[str, str]) -> Tuple[str, List[Tuple[str, str]]]:
+                   answers: Dict[str, str],
+                   reserved: Tuple[Optional[str], ...] = ()
+                   ) -> Tuple[str, List[Tuple[str, str]]]:
     problem = answers.get("problem", problem)
     if problem not in ("binary", "multiclass", "regression"):
         raise SystemExit(f"answers: problem must be binary|multiclass|"
@@ -131,9 +133,14 @@ def _apply_answers(problem: str, fields: List[Tuple[str, str]],
     # would otherwise surface only when the GENERATED app runs
     from .types import FEATURE_TYPES
     known = {c for c, _ in fields}
+    # answers may also (redundantly) mention the response/id columns the
+    # command line already assigned — consistent intent, not an error
+    reserved_names = {r for r in reserved if r}
     for k, v in answers.items():
         if k.startswith(("role.", "type.")):
             fld = k.split(".", 1)[1]
+            if fld in reserved_names:
+                continue
             if fld not in known:
                 raise SystemExit(
                     f"answers: {k} refers to unknown field {fld!r} "
@@ -145,6 +152,8 @@ def _apply_answers(problem: str, fields: List[Tuple[str, str]],
     out: List[Tuple[str, str]] = []
     for col, ft in fields:
         role = answers.get(f"role.{col}", "predictor")
+        if col in reserved_names:
+            continue
         if role in ("drop", "id"):
             continue
         if role != "predictor":
@@ -252,7 +261,8 @@ def generate(input_csv: str, response: str, output: str, name: str,
                   if c != response and c != id_field]
     if answers is not None:
         problem, fields = _apply_answers(problem, fields,
-                                         parse_answers(answers))
+                                         parse_answers(answers),
+                                         reserved=(response, id_field))
     selector = {
         "binary": "BinaryClassificationModelSelector",
         "multiclass": "MultiClassificationModelSelector",

@@ -98,11 +98,10 @@ class ModelFamily(abc.ABC):
         import jax
         return jax.tree_util.tree_map(lambda a: np.asarray(a[idx]), batched)
 
-    #: whether the CV sweep should score this family's configs on gathered
-    #: per-fold row partitions (saves F x predict+metric work when predict
-    #: is expensive — trees route every row through every tree) or on the
-    #: full row set with masks (single-matmul predicts: the row gather costs
-    #: more than it saves). See OpValidator.validate.
+    #: score CV candidates on their own fold's gathered rows (capped at
+    #: OpValidator.max_eval_rows) instead of full-row masked scoring; with
+    #: the cap this wins even for single-matmul predicts, and the fold
+    #: gather is shared across families. See OpValidator.validate.
     fold_sliced_predict: bool = True
 
     def slice_params(self, batched: Any, lo: int, hi: int) -> Any:

@@ -99,7 +99,6 @@ class GeneralizedLinearRegressionFamily(ModelFamily):
     {gaussian, poisson}, regParam per DefaultSelectorParams.Regularization)."""
 
     name = "OpGeneralizedLinearRegression"
-    fold_sliced_predict = False
     supports = frozenset({"regression"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:

@@ -184,7 +184,6 @@ class LogisticRegressionFamily(ModelFamily):
     elasticNetParam [0,0.5] — DefaultSelectorParams.scala)."""
 
     name = "OpLogisticRegression"
-    fold_sliced_predict = False
     supports = frozenset({"binary", "multiclass"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -335,7 +334,6 @@ class LinearRegressionFamily(ModelFamily):
     elasticNetParam [0,0.5])."""
 
     name = "OpLinearRegression"
-    fold_sliced_predict = False
     supports = frozenset({"regression"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -408,7 +406,6 @@ class LinearSVCFamily(ModelFamily):
     """reference OpLinearSVC (defaults: regParam [0.01,0.1,0.2])."""
 
     name = "OpLinearSVC"
-    fold_sliced_predict = False
     supports = frozenset({"binary"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -459,7 +456,6 @@ class NaiveBayesFamily(ModelFamily):
     """reference OpNaiveBayes (default smoothing 1.0)."""
 
     name = "OpNaiveBayes"
-    fold_sliced_predict = False
     supports = frozenset({"binary", "multiclass"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:

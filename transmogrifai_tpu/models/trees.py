@@ -505,10 +505,19 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
 
         def boots_one(seed_c, ss_c):
             base = jax.random.PRNGKey(seed_c.astype(jnp.uint32))
+            # Poisson(ss) bootstrap weights by inverse-CDF over uniforms,
+            # truncated at 7 (P[X>7 | lam<=1] < 1e-6) — 3x cheaper than
+            # jax.random.poisson's rejection sampling at these volumes
+            ks = jnp.arange(8, dtype=jnp.float32)
+            lam = jnp.maximum(ss_c.astype(jnp.float32), 1e-12)
+            log_pmf = (-lam + ks * jnp.log(lam)
+                       - jax.scipy.special.gammaln(ks + 1.0))
+            cdf = jnp.cumsum(jnp.exp(log_pmf))
 
             def per_tree(t):
                 k1, k2 = jax.random.split(jax.random.fold_in(base, t))
-                boot = jax.random.poisson(k1, ss_c, (S,)).astype(X.dtype)
+                u = jax.random.uniform(k1, (S,))
+                boot = (u[:, None] > cdf[None, :]).sum(-1).astype(X.dtype)
                 fmask = jax.random.bernoulli(k2, p_feat, (d,))
                 return boot, fmask
 
@@ -649,7 +658,22 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
             node_s, jnp.stack([g_tb * w_tb, h_tb * w_tb], axis=1
                               ).astype(jnp.float32), L)     # (2, Tb, L)
         leaf = -gh[0] / (gh[1] + lam_t[:, None] + 1e-12)    # (Tb, L)
-        pred = jnp.take_along_axis(leaf, node_s.T, axis=1)  # (Tb, S)
+        # per-row leaf values via one-hot einsum — a (Tb, S) take_along_axis
+        # gather measures ~3x slower on TPU; HIGHEST keeps the Newton values
+        # exact in the boosting state. Chunk the tree axis so the (S, tb, L)
+        # one-hot operand stays bounded (large multiclass sweeps would OOM
+        # materializing all Tb*L columns at once)
+        tb_chunk = max(1, 16384 // L)
+        preds = []
+        for lo in range(0, Tb, tb_chunk):
+            hi2 = min(lo + tb_chunk, Tb)
+            l_oh = (node_s[:, lo:hi2, None]
+                    == jnp.arange(L, dtype=jnp.int32)).astype(jnp.float32)
+            preds.append(jnp.einsum(
+                "stl,tl->ts", l_oh, leaf[lo:hi2],
+                precision=jax.lax.Precision.HIGHEST))
+        pred = jnp.concatenate(preds, axis=0) if len(preds) > 1 \
+            else preds[0]                                       # (Tb, S)
         active = rep((t.astype(jnp.float32) < max_iter).astype(X.dtype))
         eta_t = rep(step_size)
         scale = (eta_t * active).reshape(B, C)[:, :, None]

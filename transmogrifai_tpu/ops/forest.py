@@ -56,62 +56,74 @@ _MAX_TREES_PALLAS = 128
 
 
 def _t_pad(T: int, depth: int) -> int:
-    """Smallest tree-axis padding making every lane width a 128-multiple."""
-    m_max = 2 ** max(depth - 1, 0)
-    L = 2 ** depth
-    need = max(128 // math.gcd(m_max, 128), 128 // math.gcd(L, 128), 8)
-    return _pad_to(T, need)
+    """Tree-axis padding: a multiple of 64 keeps every RAGGED level's lane
+    width (T_pad × even node count) a 128-multiple AND an exact multiple of
+    T_pad, so `pltpu.repeat(node, m_eff)` lands each tree at lane
+    j·T_pad + t without any in-kernel pad."""
+    return max(64, _pad_to(T, 64))
+
+
+def _m_eff(level: int) -> int:
+    """Per-level node-lane count: the natural 2^level, floored at 2 so the
+    lane width stays a 128-multiple (T_pad is a multiple of 64)."""
+    return max(2, 2 ** level)
 
 
 def _level_tables(feat_heap: jnp.ndarray, bin_heap: jnp.ndarray, depth: int,
                   n_bins: int, T_pad: int):
-    """j-major per-level split tables, each level padded to m_max lanes.
+    """j-major RAGGED per-level split tables, concatenated flat.
 
-    Returns (depth, T_pad·m_max) int32 f_lvls / b_lvls with sentinel bins in
-    every padded slot (tree, level-width, or stopped node)."""
+    Level ``l`` occupies ``T_pad·_m_eff(l)`` lanes (lane = j·T_pad + t) —
+    ~3x fewer total lanes than padding every level to the deepest width.
+    Sentinel bins fill every padded slot (tree, level-width, stopped node).
+    Returns ((1, Σw) f_flat, (1, Σw) b_flat)."""
     T = feat_heap.shape[0]
-    m_max = 2 ** (depth - 1)
     f_rows, b_rows = [], []
     for level in range(depth):
         base, m = 2 ** level - 1, 2 ** level
+        m_eff = _m_eff(level)
         f = jnp.pad(feat_heap[:, base:base + m],
-                    ((0, T_pad - T), (0, m_max - m)))
+                    ((0, T_pad - T), (0, m_eff - m)))
         b = jnp.pad(bin_heap[:, base:base + m],
-                    ((0, T_pad - T), (0, m_max - m)),
+                    ((0, T_pad - T), (0, m_eff - m)),
                     constant_values=n_bins)
-        # (T_pad, m_max) -> j-major flat: lane j*T_pad + t
+        # (T_pad, m_eff) -> j-major flat: lane j*T_pad + t
         f_rows.append(f.T.reshape(-1))
         b_rows.append(b.T.reshape(-1))
-    return jnp.stack(f_rows).astype(jnp.int32), \
-        jnp.stack(b_rows).astype(jnp.int32)
+    return jnp.concatenate(f_rows)[None, :].astype(jnp.int32), \
+        jnp.concatenate(b_rows)[None, :].astype(jnp.int32)
 
 
-def _descend(codes_f, f_lvls_ref, b_lvls_ref, *, depth, T_pad, d_pad):
-    """In-kernel: (R, d_pad) f32 codes → (R, T_pad) int32 leaf ids."""
+def _descend(codes_f, f_flat_ref, b_flat_ref, *, depth, T_pad, d_pad):
+    """In-kernel: (R, d_pad) f32 codes → (R, T_pad) int32 leaf ids.
+
+    Ragged levels: level l reads its own T_pad·_m_eff(l)-lane slice of the
+    flat split tables, so early levels do 1/m_max-th the deepest level's
+    VPU/MXU work instead of padding up to it."""
     from jax.experimental.pallas import tpu as pltpu
 
     R = codes_f.shape[0]
-    m_max = 2 ** (depth - 1)
-    L2 = T_pad * m_max
-    lane = jax.lax.broadcasted_iota(jnp.int32, (R, L2), 1)
-    j_of_lane = lane // T_pad
-    # group-sum matrix: lane j*T_pad + t -> tree t
-    gl = jax.lax.broadcasted_iota(jnp.int32, (L2, T_pad), 0) % T_pad
-    gt = jax.lax.broadcasted_iota(jnp.int32, (L2, T_pad), 1)
-    G = (gl == gt).astype(jnp.bfloat16)
-    d_iota = jax.lax.broadcasted_iota(jnp.int32, (d_pad, L2), 0)
-
+    codes_bf = codes_f.astype(jnp.bfloat16)
     node = jnp.zeros((R, T_pad), jnp.int32)
+    off = 0
     for level in range(depth):
-        f_row = f_lvls_ref[level, :].reshape(1, L2)
-        b_row = b_lvls_ref[level, :].reshape(1, L2)
-        sel = (d_iota == f_row).astype(jnp.bfloat16)          # (d_pad, L2)
-        code_sel = jnp.dot(codes_f.astype(jnp.bfloat16), sel,
-                           preferred_element_type=jnp.float32)  # (R, L2)
+        m_eff = _m_eff(level)
+        w = T_pad * m_eff
+        f_row = f_flat_ref[0:1, off:off + w]                  # (1, w)
+        b_row = b_flat_ref[0:1, off:off + w]
+        off += w
+        d_iota = jax.lax.broadcasted_iota(jnp.int32, (d_pad, w), 0)
+        sel = (d_iota == f_row).astype(jnp.bfloat16)          # (d_pad, w)
+        code_sel = jnp.dot(codes_bf, sel,
+                           preferred_element_type=jnp.float32)  # (R, w)
         go_lane = (code_sel > b_row.astype(jnp.float32)
                    ).astype(jnp.bfloat16)
-        node_rep = pltpu.repeat(node, m_max, axis=1)          # (R, L2)
-        oh = (node_rep == j_of_lane).astype(jnp.bfloat16)
+        node_rep = pltpu.repeat(node, m_eff, axis=1)          # (R, w)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, w), 1)
+        oh = (node_rep == lane // T_pad).astype(jnp.bfloat16)
+        gl = jax.lax.broadcasted_iota(jnp.int32, (w, T_pad), 0) % T_pad
+        gt = jax.lax.broadcasted_iota(jnp.int32, (w, T_pad), 1)
+        G = (gl == gt).astype(jnp.bfloat16)                   # (w, T_pad)
         go = jnp.dot(go_lane * oh, G,
                      preferred_element_type=jnp.float32)      # (R, T_pad)
         node = 2 * node + (go > 0.5).astype(jnp.int32)

@@ -283,7 +283,11 @@ class InfiniteStream:
         return InfiniteStream(gen())
 
     def map(self, fn: Callable[[Any], Any]) -> "InfiniteStream":
-        return InfiniteStream(fn(v) for v in self._it)
+        """A NEW stream; the source keeps its own position (itertools.tee —
+        the reference's InfiniteStream is a pure value)."""
+        import itertools
+        self._it, branch = itertools.tee(self._it)
+        return InfiniteStream(fn(v) for v in branch)
 
     def __iter__(self) -> Iterator[Any]:
         return self._it
@@ -467,18 +471,20 @@ def random_table(spec: Dict[str, Any], n: int, seed: int = 42):
             ftype = FEATURE_TYPES[ftype]
         kind = ftype.column_kind
         if gen is None and kind in ("real", "binary", "integral", "date"):
-            # vectorized fast path
+            # vectorized fast path end to end: build the Column directly
+            # from the numpy draw (of_values' per-element loops would undo
+            # the vectorization at benchmark scale)
             if kind == "real":
                 vals = rng.randn(n).astype(np.float32)
             elif kind == "binary":
-                vals = (rng.rand(n) < 0.5)
+                vals = (rng.rand(n) < 0.5).astype(np.float32)
             elif kind == "date":
                 vals = rng.randint(1_500_000_000_000,
                                    1_530_000_000_000, size=n,
                                    dtype=np.int64)
             else:
-                vals = rng.randint(0, 100, size=n)
-            cols[name] = Column.of_values(ftype, vals.tolist())
+                vals = rng.randint(0, 100, size=n).astype(np.int64)
+            cols[name] = Column(ftype, vals, None)
         elif gen is None and kind == "vector":
             cols[name] = Column(ftype, rng.randn(n, 8).astype(np.float32),
                                 None)
