@@ -133,13 +133,22 @@ def _apply_answers(problem: str, fields: List[Tuple[str, str]],
     # would otherwise surface only when the GENERATED app runs
     from .types import FEATURE_TYPES
     known = {c for c, _ in fields}
-    # answers may also (redundantly) mention the response/id columns the
-    # command line already assigned — consistent intent, not an error
+    # answers may (redundantly) mention the response/id columns the command
+    # line already assigned — but only with CONSISTENT roles; a
+    # contradicting role would otherwise be silently dropped
+    response_name = reserved[0] if reserved else None
+    id_name = reserved[1] if len(reserved) > 1 else None
     reserved_names = {r for r in reserved if r}
     for k, v in answers.items():
         if k.startswith(("role.", "type.")):
             fld = k.split(".", 1)[1]
             if fld in reserved_names:
+                if k.startswith("role."):
+                    want = "response" if fld == response_name else "id"
+                    if v != want:
+                        raise SystemExit(
+                            f"answers: {k}={v!r} contradicts the command "
+                            f"line, which assigned {fld!r} as the {want}")
                 continue
             if fld not in known:
                 raise SystemExit(
@@ -152,8 +161,6 @@ def _apply_answers(problem: str, fields: List[Tuple[str, str]],
     out: List[Tuple[str, str]] = []
     for col, ft in fields:
         role = answers.get(f"role.{col}", "predictor")
-        if col in reserved_names:
-            continue
         if role in ("drop", "id"):
             continue
         if role != "predictor":

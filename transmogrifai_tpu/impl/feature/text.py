@@ -682,11 +682,15 @@ class MimeTypeDetector(UnaryTransformer):
 
 #: minimal per-region phone length table (reference uses libphonenumber; this
 #: validates country code + national-number length for common regions)
+#: region -> (country code, national significant lengths, trunk prefix):
+#: national formats in trunk-prefix countries are written with a leading
+#: '0' ('020 7946 0958') that E.164 drops (+44 20 7946 0958)
 _PHONE_REGIONS = {
-    "US": ("1", 10), "CA": ("1", 10), "GB": ("44", (9, 10)),
-    "FR": ("33", 9), "DE": ("49", (10, 11)), "IN": ("91", 10),
-    "AU": ("61", 9), "JP": ("81", (9, 10)), "BR": ("55", (10, 11)),
-    "MX": ("52", 10),
+    "US": ("1", 10, ""), "CA": ("1", 10, ""), "GB": ("44", (9, 10), "0"),
+    "FR": ("33", 9, "0"), "DE": ("49", (10, 11), "0"),
+    "IN": ("91", 10, "0"), "AU": ("61", 9, "0"),
+    "JP": ("81", (9, 10), "0"), "BR": ("55", (10, 11), "0"),
+    "MX": ("52", 10, ""),
 }
 
 
@@ -700,14 +704,19 @@ def parse_phone(v: Optional[str], default_region: str = "US"
     digits = digits.lstrip("+")
     if not digits:
         return None
-    cc, ln = _PHONE_REGIONS.get(default_region.upper(), ("1", 10))
+    cc, ln, trunk = _PHONE_REGIONS.get(default_region.upper(),
+                                       ("1", 10, ""))
     lens = (ln,) if isinstance(ln, int) else tuple(ln)
     if explicit_cc:
-        for region, (rcc, rln) in _PHONE_REGIONS.items():
+        for region, (rcc, rln, _tr) in _PHONE_REGIONS.items():
             rlens = (rln,) if isinstance(rln, int) else tuple(rln)
             if digits.startswith(rcc) and len(digits) - len(rcc) in rlens:
                 return ("+" + digits, True)
         return ("+" + digits, False)
+    # national format with the region's trunk prefix: strip it for E.164
+    if trunk and digits.startswith(trunk) \
+            and len(digits) - len(trunk) in lens:
+        return ("+" + cc + digits[len(trunk):], True)
     if len(digits) in lens:
         return ("+" + cc + digits, True)
     if digits.startswith(cc) and len(digits) - len(cc) in lens:

@@ -47,6 +47,28 @@ def _is_text_shared_hash(c: VectorColumnMetadata) -> bool:
             and (c.descriptor_value or "").startswith("hash_"))
 
 
+def _contingency_stats_np(t: np.ndarray) -> Dict[str, Any]:
+    """Association stats on a small (m, L) contingency table, host-side
+    (same math as ops.stats.contingency_stats — the tables are tiny, so
+    numpy beats a device dispatch per group)."""
+    t = t.astype(np.float64)
+    n = max(t.sum(), 1.0)
+    row = t.sum(axis=1)
+    col = t.sum(axis=0)
+    expected = row[:, None] * col[None, :] / n
+    chi2 = np.where(expected > 0,
+                    (t - expected) ** 2 / np.maximum(expected, 1e-30),
+                    0.0).sum()
+    min_dim = max(min((row > 0).sum(), (col > 0).sum()) - 1, 1)
+    conf = np.where(row[:, None] > 0,
+                    t / np.maximum(row[:, None], 1e-30), 0.0)
+    return {
+        "cramers_v": float(np.sqrt(chi2 / (n * min_dim))),
+        "max_rule_confidence": conf.max(axis=1),
+        "support": row / n,
+    }
+
+
 class SanityCheckerDefaults:
     """(reference SanityCheckerParams defaults :59-226, object SanityChecker
     :720-739 — ProtectTextSharedHash=False matches the reference object
@@ -153,21 +175,30 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             if is_binary_like:
                 label_idx = jnp.asarray(ys.astype(np.int32))
                 num_labels = int(ys.max()) + 1
-                for group, idxs in vm.index_of_group().items():
-                    cols_meta = [vm.columns[i] for i in idxs]
-                    # only indicator (0/1 pivot) groups get contingency stats
-                    if not all(c.indicator_value is not None for c in cols_meta):
-                        continue
-                    ind = Xd[:, np.asarray(idxs)]
-                    tbl = contingency_table(ind, label_idx, num_labels)
-                    cs = contingency_stats(tbl)
-                    group_cramers[group] = float(cs.cramers_v)
-                    mrc = np.asarray(cs.max_rule_confidence)
-                    sup = np.asarray(cs.support)
-                    for j, i_col in enumerate(idxs):
-                        cramers_by_col[i_col] = float(cs.cramers_v)
-                        rule_conf_by_col[i_col] = mrc[j]
-                        support_by_col[i_col] = sup[j]
+                # only indicator (0/1 pivot) groups get contingency stats
+                groups = [(g, idxs) for g, idxs in vm.index_of_group().items()
+                          if all(vm.columns[i].indicator_value is not None
+                                 for i in idxs)]
+                if groups:
+                    # ONE matmul for every indicator column's contingency
+                    # counts + ONE host sync; per-group association stats
+                    # then run on tiny (m, L) numpy tables — per-group
+                    # device calls would pay a link round-trip (and a
+                    # recompile per distinct group size) each
+                    all_idx = np.concatenate(
+                        [np.asarray(idxs) for _, idxs in groups])
+                    counts = np.asarray(contingency_table(
+                        Xd[:, jnp.asarray(all_idx)], label_idx, num_labels))
+                    off = 0
+                    for group, idxs in groups:
+                        m = len(idxs)
+                        cs = _contingency_stats_np(counts[off:off + m])
+                        off += m
+                        group_cramers[group] = cs["cramers_v"]
+                        for j, i_col in enumerate(idxs):
+                            cramers_by_col[i_col] = cs["cramers_v"]
+                            rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
+                            support_by_col[i_col] = cs["support"][j]
 
         # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
         reasons: Dict[int, List[str]] = {}

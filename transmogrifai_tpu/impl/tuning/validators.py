@@ -205,9 +205,10 @@ class OpValidator:
         if fold_sliced is None:
             fold_sliced = self.mesh is None
         fold_sliced = fold_sliced and self.mesh is None
-        # the fold gather is built lazily, only when a family opts in
-        # (fold_sliced_predict): single-matmul predicts are cheaper scored
-        # full-row than paying the row gather
+        # the fold gather is built lazily, on the first family that uses it
+        # (fold_sliced_predict, default on: with the max_eval_rows cap the
+        # gathered rows beat full-row masked scoring even for single-matmul
+        # predicts; the gather is shared across families)
         _fold_cache: Dict[str, Any] = {}
 
         def _fold_data():

@@ -268,26 +268,29 @@ class RandomVector(RandomData):
 
 class InfiniteStream:
     """Infinite transformed stream (reference InfiniteStream.scala:63):
-    wrap any iterator / generator fn, then ``map`` and ``take``."""
+    wrap an index function (``of``) or any iterator, then ``map``/``take``.
 
-    def __init__(self, it: Iterator[Any]):
-        self._it = it
+    Streams built with ``of`` are PURE VALUES like the reference's: ``map``
+    returns an independent stream and the source keeps its own position.
+    Streams wrapping a raw one-shot iterator cannot be re-created, so there
+    ``map`` consumes the source (documented deviation)."""
+
+    def __init__(self, it: Optional[Iterator[Any]] = None,
+                 factory: Optional[Callable[[], Iterator[Any]]] = None):
+        self._factory = factory
+        self._it = it if it is not None else factory()
 
     @staticmethod
     def of(fn: Callable[[int], Any]) -> "InfiniteStream":
-        def gen():
-            i = 0
-            while True:
-                yield fn(i)
-                i += 1
-        return InfiniteStream(gen())
+        import itertools
+        return InfiniteStream(
+            factory=lambda: (fn(i) for i in itertools.count()))
 
     def map(self, fn: Callable[[Any], Any]) -> "InfiniteStream":
-        """A NEW stream; the source keeps its own position (itertools.tee —
-        the reference's InfiniteStream is a pure value)."""
-        import itertools
-        self._it, branch = itertools.tee(self._it)
-        return InfiniteStream(fn(v) for v in branch)
+        if self._factory is not None:  # pure value: fresh source each time
+            fac = self._factory
+            return InfiniteStream(factory=lambda: (fn(v) for v in fac()))
+        return InfiniteStream(fn(v) for v in self._it)
 
     def __iter__(self) -> Iterator[Any]:
         return self._it
