@@ -1,0 +1,90 @@
+"""Round-3 ADVICE fixes (see ADVICE.md round 2): deindexer rounding,
+forest n_bins guard, TimePeriodListTransformer width locking, persistence
+dangling stage-ref warning, max_eval_rows surfaced in the selector summary."""
+import warnings
+
+import numpy as np
+import pytest
+
+
+def test_deindexer_rounds_float_noise():
+    """int(round(v)): 1.9999999 decodes to labels[2], -0.3 stays in-range 0,
+    -0.6 is out-of-range (ADVICE round 2 #3)."""
+    from transmogrifai_tpu.impl.preparators.prediction_deindexer import (
+        PredictionDeIndexerModel)
+    m = PredictionDeIndexerModel(labels=["a", "b", "c"])
+    assert m._decode(1.9999999) == "c"
+    assert m._decode(-0.3) == "a"
+    assert m._decode(-0.6) == m.unseen_name
+    assert m._decode(2.4) == "c"
+    assert m._decode(2.6) == m.unseen_name
+
+
+def test_forest_n_bins_guard():
+    """bf16 routing is exact only for codes <= 256; larger n_bins raises."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops.forest import forest_leaf_sums, forest_predict
+    codes = jnp.zeros((4, 2), jnp.int32)
+    fh = jnp.zeros((1, 1), jnp.int32)
+    bh = jnp.zeros((1, 1), jnp.int32)
+    with pytest.raises(ValueError, match="n_bins"):
+        forest_leaf_sums(codes, fh, bh, jnp.ones((4, 1)), depth=1, n_bins=512)
+    with pytest.raises(ValueError, match="n_bins"):
+        forest_predict(codes, fh, bh, jnp.ones((1, 2, 1)), depth=1,
+                       n_bins=512)
+
+
+def test_time_period_list_width_locks_on_first_batch():
+    """width=None locks to the first (train) batch's longest list so later
+    batches emit the same column width (ADVICE round 2 #4)."""
+    from transmogrifai_tpu.impl.feature.dates import TimePeriodListTransformer
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import DateList
+    from transmogrifai_tpu.features import FeatureBuilder
+
+    f = FeatureBuilder.DateList("d").extract_field().as_predictor()
+    t = TimePeriodListTransformer(period="DayOfWeek").set_input(f)
+    day = 86400000
+    train = FeatureTable(
+        {"d": Column.of_values(DateList, [[day, 2 * day, 3 * day], [day]])}, 2)
+    score = FeatureTable({"d": Column.of_values(DateList, [[day]])}, 1)
+    out_train = t.transform_column(train)
+    out_score = t.transform_column(score)
+    assert np.asarray(out_train.values).shape[1] == 3
+    assert np.asarray(out_score.values).shape[1] == 3  # not 1
+
+
+def test_save_model_warns_on_dangling_stage_ref(tmp_path):
+    """A stage attribute referencing a stage outside the saved plan warns at
+    save time instead of failing at load (ADVICE round 2 #5)."""
+    from transmogrifai_tpu import FeatureBuilder, FeatureTable, Column
+    from transmogrifai_tpu.types import Real
+    from transmogrifai_tpu.workflow import OpWorkflow
+    from transmogrifai_tpu.persistence import save_model
+    from transmogrifai_tpu.impl.feature.math import ScalarOp
+
+    a = FeatureBuilder.Real("a").extract_field().as_predictor()
+    out = a + 1.0
+    tbl = FeatureTable({"a": Column.of_values(Real, [1.0, 2.0])}, 2)
+    model = (OpWorkflow().set_input_table(tbl)
+             .set_result_features(out).train())
+    # sneak an out-of-plan stage reference onto a saved stage
+    stray = ScalarOp("+", 7.0)
+    model.stages[0]._stray = stray
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        save_model(model, str(tmp_path / "m"))
+    msgs = [str(x.message) for x in w]
+    assert any(stray.uid in m for m in msgs), msgs
+
+
+def test_selector_summary_surfaces_eval_row_cap():
+    """max_eval_rows lands in the summary JSON (ADVICE round 2 #1)."""
+    from transmogrifai_tpu.impl.selector.model_selector import (
+        ModelSelectorSummary)
+    s = ModelSelectorSummary(
+        validation_type="OpCrossValidation", validation_metric="AuPR",
+        problem="binary", best_model_type="OpLogisticRegression",
+        best_hyper={}, best_metric_value=0.9,
+        validation_eval_row_cap=131072)
+    assert s.to_json()["validationEvalRowCap"] == 131072

@@ -95,10 +95,9 @@ class TimePeriodListTransformer(UnaryTransformer):
     TimePeriodListTransformer.scala — each timestamp maps to its extracted
     period value). The reference emits ragged per-row vectors; columnar
     arrays are rectangular here, so rows pad/truncate to ``width`` elements
-    (pad value -1, never a real period value). Leave ``width=None`` ONLY
-    for exploratory one-batch use: the column then takes the batch's
-    longest list, which differs between train and score batches — set a
-    fixed width before feeding models."""
+    (pad value -1, never a real period value). With ``width=None`` the
+    width is locked to the FIRST batch's longest list (the train batch)
+    and reused for every later batch, so train and score columns agree."""
 
     def __init__(self, period: str = "DayOfWeek",
                  width: Optional[int] = None, uid=None):
@@ -107,8 +106,8 @@ class TimePeriodListTransformer(UnaryTransformer):
                 return None
             arr = np.asarray(list(v), dtype=np.int64)
             vals = [float(x) for x in time_period_values(arr, period)]
-            if width is not None:
-                vals = (vals + [-1.0] * width)[:width]
+            if self.width is not None:
+                vals = (vals + [-1.0] * self.width)[:self.width]
             return vals
         super().__init__(f"dateListToTimePeriod{period}", transform_fn=fn,
                          output_type=OPVector, input_type=DateList, uid=uid)
@@ -120,7 +119,10 @@ class TimePeriodListTransformer(UnaryTransformer):
         valid = col.valid_mask()
         rows = [self.transform_fn(col.values[i]) if valid[i] else None
                 for i in range(len(col))]
-        width = self.width or max((len(r) for r in rows if r), default=1)
+        if self.width is None:
+            # lock the width on first use so later batches match it
+            self.width = max((len(r) for r in rows if r), default=1)
+        width = self.width
         mat = np.full((len(rows), width), -1.0, np.float32)
         for i, r in enumerate(rows):
             if r:

@@ -60,7 +60,9 @@ class PredictionDeIndexerModel(AllowLabelAsInput, Transformer):
     def _decode(self, v: Optional[float]) -> str:
         if v is None or (isinstance(v, float) and np.isnan(v)):
             return self.unseen_name
-        i = int(v)
+        # round, not truncate: float noise (1.9999999) must decode to 2,
+        # and -0.3 must stay out-of-range rather than truncating to 0
+        i = int(round(float(v)))
         return self.labels[i] if 0 <= i < len(self.labels) \
             else self.unseen_name
 

@@ -40,6 +40,11 @@ class ModelSelectorSummary:
     train_evaluation: Dict[str, Any] = field(default_factory=dict)
     holdout_evaluation: Dict[str, Any] = field(default_factory=dict)
     splitter_summary: Dict[str, Any] = field(default_factory=dict)
+    #: validator's per-config validation-row cap (None = exact). Surfaced so
+    #: a selection difference vs the reference's full-row scoring is
+    #: explainable from the summary alone (the reference always scores every
+    #: validation row, OpValidator.scala:270-312).
+    validation_eval_row_cap: Optional[int] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -54,6 +59,7 @@ class ModelSelectorSummary:
             "trainEvaluation": self.train_evaluation,
             "holdoutEvaluation": self.holdout_evaluation,
             "splitterSummary": self.splitter_summary,
+            "validationEvalRowCap": self.validation_eval_row_cap,
         }
 
 
@@ -277,6 +283,8 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             larger_better=larger_better,
             validation_results=best.results,
             splitter_summary=dict(getattr(self.splitter, "summary", {}) or {}),
+            validation_eval_row_cap=getattr(self.validator, "max_eval_rows",
+                                            None),
         )
         model = SelectedModel(fitted=fitted, summary=summary,
                               label_mapping=prep.label_mapping)

@@ -304,6 +304,15 @@ def _pallas_ok(depth: int, T: int) -> bool:
             and T <= _MAX_TREES_PALLAS)
 
 
+def _check_bins(n_bins: int) -> None:
+    """Descent casts int32 bin codes to bf16, which represents integers
+    exactly only up to 256 — larger bin codes would silently misroute."""
+    if n_bins > 256:
+        raise ValueError(
+            f"n_bins={n_bins} > 256: bin codes are routed in bfloat16, "
+            f"which is exact only for codes <= 256")
+
+
 def forest_leaf_sums(codes: jnp.ndarray, feat_heap: jnp.ndarray,
                      bin_heap: jnp.ndarray, aug: jnp.ndarray, *,
                      depth: int, n_bins: int) -> jnp.ndarray:
@@ -315,6 +324,7 @@ def forest_leaf_sums(codes: jnp.ndarray, feat_heap: jnp.ndarray,
     Returns (T, L, k) f32 with L = 2^depth: sums of aug over rows landing in
     each (tree, leaf).
     """
+    _check_bins(n_bins)
     T = feat_heap.shape[0]
     if not _pallas_ok(depth, T):
         return _leaf_sums_xla(codes, feat_heap, bin_heap, aug,
@@ -337,6 +347,7 @@ def forest_predict(codes: jnp.ndarray, feat_heap: jnp.ndarray,
     leaf: (T, L, k) f32 leaf values (any per-tree weighting baked into the
     values; zero a tree's leaves to drop it). Returns (n, k) f32.
     """
+    _check_bins(n_bins)
     T, L, k = leaf.shape
     if not _pallas_ok(depth, T):
         return _predict_xla(codes, feat_heap, bin_heap, leaf,

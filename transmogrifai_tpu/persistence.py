@@ -299,9 +299,38 @@ def save_model(model, path: str) -> None:
         "parameters": _encode(model.parameters, arrays),
         "rffResults": _encode(getattr(model, "rff_results", None), arrays),
     }
+    # fail at save, not load: a __stage_ref__ pointing outside the saved
+    # plan can never be re-linked and would only surface later through the
+    # unresolved-state path with a vaguer error
+    saved_uids = ({s.uid for s in model.stages}
+                  | {f.origin_stage.uid for f in extra})
+    dangling = sorted(_collect_stage_ref_uids(stage_descs) - saved_uids)
+    if dangling:
+        import warnings
+        warnings.warn(
+            f"save_model: stage attribute(s) reference uid(s) {dangling} "
+            f"that are not among the stages being saved — they will load "
+            f"as permanent placeholders. Include those stages in the "
+            f"workflow or drop the references before saving.",
+            stacklevel=2)
     with open(os.path.join(path, PLAN_FILE), "w") as fh:
         json.dump(plan, fh, indent=2)
     np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays.store)
+
+
+def _collect_stage_ref_uids(v: Any) -> set:
+    """All __stage_ref__ uids inside an encoded (JSON-ready) plan fragment."""
+    out: set = set()
+    if isinstance(v, dict):
+        uid = v.get("__stage_ref__")
+        if isinstance(uid, str):
+            out.add(uid)
+        for x in v.values():
+            out |= _collect_stage_ref_uids(x)
+    elif isinstance(v, list):
+        for x in v:
+            out |= _collect_stage_ref_uids(x)
+    return out
 
 
 def _has_unresolved(v: Any, depth: int = 0) -> bool:
