@@ -107,7 +107,9 @@ def main():
         t0 = time.perf_counter()
         sweep()
         times.append(time.perf_counter() - t0)
-    dt = min(times)
+    # MEDIAN, not best-of: the recorded number must clear the target on a
+    # typical run, not only when the shared tunnel is quiet
+    dt = float(np.median(times))
 
     fits_per_sec = B / dt
     suffix = "" if mode == "dense" else f"_{mode}"

@@ -88,3 +88,32 @@ def test_selector_summary_surfaces_eval_row_cap():
         best_hyper={}, best_metric_value=0.9,
         validation_eval_row_cap=131072)
     assert s.to_json()["validationEvalRowCap"] == 131072
+
+
+def test_linear_fit_survives_fold_degenerate_columns():
+    """A column constant within a config's weighted rows (rare one-hot slot
+    whose nonzero rows all fall in the val fold) must not NaN the batched
+    solvers — dead columns get coefficient 0 (round-3 fix; previously every
+    CV sweep on Titanic returned constant LR/SVC scores)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.linear import (_fit_logreg_batch,
+                                                 _fit_svc_batch)
+    rng = np.random.RandomState(0)
+    n, d = 512, 8
+    X = rng.randn(n, d).astype(np.float32)
+    X[:, 3] = 0.0
+    X[:4, 3] = 1.0          # nonzero only in rows 0-3
+    y = (X[:, 0] > 0).astype(np.float32)
+    W = np.ones((2, n), np.float32)
+    W[:, :4] = 0.0          # ...which carry zero weight for every config
+    Xd, yd, Wd = jnp.asarray(X), jnp.asarray(y), jnp.asarray(W)
+    reg = jnp.asarray([0.01, 0.1], jnp.float32)
+    en = jnp.zeros(2, jnp.float32)
+    for sweep in (False, True):
+        coef, bias = _fit_logreg_batch(Xd, yd, Wd, reg, en, sweep=sweep)
+        assert bool(jnp.isfinite(coef).all()) and bool(jnp.isfinite(bias).all())
+        assert abs(float(coef[0, 3])) < 1e-6      # dead column: coef 0
+        assert float(jnp.abs(coef[0]).max()) > 0.1  # live columns learned
+        coef, bias = _fit_svc_batch(Xd, yd, Wd, reg, sweep=sweep)
+        assert bool(jnp.isfinite(coef).all()) and bool(jnp.isfinite(bias).all())
+        assert abs(float(coef[0, 3])) < 1e-6

@@ -176,3 +176,84 @@ def test_summary_schema_round_trip():
     up = SanityCheckerSummary.from_json(v1)
     assert up["sampleSize"] == 7 and up["dropped"] == ["a"]
     assert up.schema_version == SCHEMA_VERSION
+
+
+def test_mutual_info_and_pmi_vs_scipy():
+    """Group MI/PMI land in the summary and match an independent
+    computation (reference OpStatistics.contingencyStats:300)."""
+    n = 400
+    rng = np.random.RandomState(3)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    # noisy categorical: mostly tracks the label
+    flip = rng.rand(n) < 0.25
+    cls = np.where(flip, 1 - y, y)
+    cat = np.stack([(cls == 0).astype(np.float32),
+                    (cls == 1).astype(np.float32)], axis=1)
+    vm = VectorMetadata.of("features", [
+        VectorColumnMetadata("cat", "PickList", "cat", "a"),
+        VectorColumnMetadata("cat", "PickList", "cat", "b"),
+    ])
+    tbl = FeatureTable({
+        "label": Column(RealNN, y, None),
+        "features": Column(OPVector, cat, None, {"vector_meta": vm})}, n)
+    model = _wire(SanityChecker(remove_bad_features=False)).fit(tbl)
+    s = model.summary
+    (gkey,) = s["mutualInfo"].keys()
+    # independent MI from the contingency table (log base 2)
+    t = np.zeros((2, 2))
+    for j in range(2):
+        for l in range(2):
+            t[j, l] = ((cat[:, j] == 1) & (y == l)).sum()
+    p = t / t.sum()
+    pr, pc = p.sum(1, keepdims=True), p.sum(0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.where(p > 0, np.log2(p / (pr * pc)), 0.0)
+    mi = float((p * pmi).sum())
+    assert abs(s["mutualInfo"][gkey] - mi) < 1e-6
+    got_pmi = np.asarray(s["pointwiseMutualInfo"][gkey])
+    assert got_pmi.shape == (2, 2)
+    np.testing.assert_allclose(got_pmi, pmi, atol=1e-5)
+    # scipy cross-check of the entropy identity: MI = H(row)+H(col)-H(joint)
+    from scipy import stats as sps
+    h = (sps.entropy(pr.ravel(), base=2) + sps.entropy(pc.ravel(), base=2)
+         - sps.entropy(p.ravel(), base=2))
+    assert abs(s["mutualInfo"][gkey] - h) < 1e-6
+
+
+def test_full_correlation_matrix_mode():
+    """correlations='full' records the (d, d) feature matrix (reference
+    SanityChecker.scala:634-638 featureLabelCorrOnly=false)."""
+    tbl = _make_table()
+    model = _wire(SanityChecker(correlations="full",
+                                remove_bad_features=False)).fit(tbl)
+    fc = np.asarray(model.summary["featureCorrelations"], dtype=object)
+    assert fc.shape == (4, 4)
+    X = np.asarray(tbl["features"].values)
+    ref = np.corrcoef(X.T)
+    for i in range(4):
+        for j in range(4):
+            if fc[i][j] is None:
+                assert not np.isfinite(ref[i, j]) or X[:, i].std() == 0 \
+                    or X[:, j].std() == 0
+            else:
+                assert abs(float(fc[i][j]) - ref[i, j]) < 1e-3
+    # default mode records nothing
+    m2 = _wire(SanityChecker(remove_bad_features=False)).fit(tbl)
+    assert m2.summary["featureCorrelations"] is None
+    with pytest.raises(ValueError, match="correlations"):
+        SanityChecker(correlations="bogus")
+
+
+def test_summary_v2_upgrade_defaults_new_fields():
+    from transmogrifai_tpu.impl.preparators.sanity_checker_metadata import (
+        SanityCheckerSummary)
+    v2 = {"schemaVersion": 2,
+          "stats": {"names": ["a"], "count": [1.0], "mean": [0.0],
+                    "variance": [1.0], "min": [0.0], "max": [1.0]},
+          "categorical": {"cramers_v": {"g": 0.5}},
+          "correlationsWithLabel": [0.1], "correlationType": "pearson",
+          "dropped": [], "reasons": {}, "sampleSize": 1}
+    s = SanityCheckerSummary.from_json(v2)
+    assert s.categorical.mutual_info == {}
+    assert s.feature_correlations is None
+    assert s.categorical.cramers_v == {"g": 0.5}

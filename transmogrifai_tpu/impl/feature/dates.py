@@ -96,8 +96,10 @@ class TimePeriodListTransformer(UnaryTransformer):
     period value). The reference emits ragged per-row vectors; columnar
     arrays are rectangular here, so rows pad/truncate to ``width`` elements
     (pad value -1, never a real period value). With ``width=None`` the
-    width is locked to the FIRST batch's longest list (the train batch)
-    and reused for every later batch, so train and score columns agree."""
+    width is locked by the FIRST batch transformed — its longest list, or 1
+    if it is all-empty — and reused for every later batch, so every batch
+    emits the same column width. Pass an explicit ``width`` in production
+    pipelines where the first batch may not be representative."""
 
     def __init__(self, period: str = "DayOfWeek",
                  width: Optional[int] = None, uid=None):
@@ -120,12 +122,16 @@ class TimePeriodListTransformer(UnaryTransformer):
         rows = [self.transform_fn(col.values[i]) if valid[i] else None
                 for i in range(len(col))]
         if self.width is None:
-            # lock the width on first use so later batches match it
+            # lock on first use — even a degenerate all-empty batch, because
+            # that batch's (n, 1) output is already emitted and later batches
+            # must match it (explicit width exists for that case)
             self.width = max((len(r) for r in rows if r), default=1)
         width = self.width
         mat = np.full((len(rows), width), -1.0, np.float32)
         for i, r in enumerate(rows):
             if r:
+                # rows from transform_fn are already padded once width is
+                # locked; re-pad covers only the unlocked first batch
                 mat[i, :width] = (r + [-1.0] * width)[:width]
         return Column(OPVector, mat, None)
 

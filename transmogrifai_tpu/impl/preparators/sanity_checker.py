@@ -21,7 +21,7 @@ import numpy as np
 
 from ...ops.stats import (
     col_stats, contingency_stats, contingency_table, pearson_correlation,
-    spearman_correlation,
+    pearson_correlation_matrix, spearman_correlation,
 )
 from ...stages.base import AllowLabelAsInput, Estimator, Transformer
 from ...table import Column, FeatureTable
@@ -50,7 +50,9 @@ def _is_text_shared_hash(c: VectorColumnMetadata) -> bool:
 def _contingency_stats_np(t: np.ndarray) -> Dict[str, Any]:
     """Association stats on a small (m, L) contingency table, host-side
     (same math as ops.stats.contingency_stats — the tables are tiny, so
-    numpy beats a device dispatch per group)."""
+    numpy beats a device dispatch per group). Includes mutual information
+    and per-cell pointwise mutual information (reference
+    OpStatistics.contingencyStats:300)."""
     t = t.astype(np.float64)
     n = max(t.sum(), 1.0)
     row = t.sum(axis=1)
@@ -62,10 +64,17 @@ def _contingency_stats_np(t: np.ndarray) -> Dict[str, Any]:
     min_dim = max(min((row > 0).sum(), (col > 0).sum()) - 1, 1)
     conf = np.where(row[:, None] > 0,
                     t / np.maximum(row[:, None], 1e-30), 0.0)
+    p = t / n
+    denom = (row[:, None] / n) * (col[None, :] / n)
+    pmi = np.where((p > 0) & (denom > 0),
+                   np.log2(np.maximum(p, 1e-300)
+                           / np.maximum(denom, 1e-300)), 0.0)
     return {
         "cramers_v": float(np.sqrt(chi2 / (n * min_dim))),
         "max_rule_confidence": conf.max(axis=1),
         "support": row / n,
+        "mutual_info": float((p * pmi).sum()),
+        "pointwise_mutual_info": pmi,
     }
 
 
@@ -113,6 +122,7 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                  remove_bad_features: bool = SanityCheckerDefaults.RemoveBadFeatures,
                  remove_feature_group: bool = SanityCheckerDefaults.RemoveFeatureGroup,
                  correlation_type_spearman: bool = SanityCheckerDefaults.CorrelationTypeSpearman,
+                 correlations: str = "label",
                  seed: int = 42,
                  uid: Optional[str] = None):
         super().__init__("sanityCheck", uid)
@@ -129,6 +139,13 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         self.remove_bad_features = remove_bad_features
         self.remove_feature_group = remove_feature_group
         self.correlation_type_spearman = correlation_type_spearman
+        if correlations not in ("label", "full"):
+            raise ValueError(
+                f"correlations must be 'label' or 'full', got {correlations!r}")
+        #: "label" computes only label-vs-feature correlations; "full" also
+        #: records the (d, d) feature-feature matrix in the summary
+        #: (reference SanityChecker.scala:634-638 featureLabelCorrOnly)
+        self.correlations = correlations
         self.seed = seed
 
     # -- fit ------------------------------------------------------------------
@@ -161,6 +178,16 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             corr = spearman_correlation(Xd, yd)
         else:
             corr = pearson_correlation(Xd, yd)
+        feature_corr: Optional[np.ndarray] = None
+        if getattr(self, "correlations", "label") == "full":
+            # (d, d) feature-feature matrix on device (one MXU matmul);
+            # Spearman mode ranks the columns first, matching the label path
+            Xc = Xd
+            if self.correlation_type_spearman:
+                import jax as _jax
+                from ...ops.stats import _rank
+                Xc = _jax.vmap(_rank, in_axes=1, out_axes=1)(Xd)
+            feature_corr = np.asarray(pearson_correlation_matrix(Xc))
         stats = {k: np.asarray(v) for k, v in stats._asdict().items()}
         corr = np.asarray(corr)
 
@@ -169,6 +196,8 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         rule_conf_by_col = np.full(d, np.nan)
         support_by_col = np.full(d, np.nan)
         group_cramers: Dict[str, float] = {}
+        group_mi: Dict[str, float] = {}
+        group_pmi: Dict[str, List[List[float]]] = {}
         if vm is not None:
             labels = np.unique(ys)
             is_binary_like = len(labels) <= 20 and np.allclose(labels, labels.astype(int))
@@ -195,6 +224,10 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                         cs = _contingency_stats_np(counts[off:off + m])
                         off += m
                         group_cramers[group] = cs["cramers_v"]
+                        group_mi[group] = cs["mutual_info"]
+                        group_pmi[group] = [
+                            [round(float(x), 6) for x in r]
+                            for r in cs["pointwise_mutual_info"]]
                         for j, i_col in enumerate(idxs):
                             cramers_by_col[i_col] = cs["cramers_v"]
                             rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
@@ -259,7 +292,9 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                 min=stats["min"].tolist(),
                 max=stats["max"].tolist()),
             categorical=CategoricalGroupStats(
-                cramers_v={g: v for g, v in group_cramers.items()}),
+                cramers_v={g: v for g, v in group_cramers.items()},
+                mutual_info=group_mi,
+                pointwise_mutual_info=group_pmi),
             correlations_with_label=[None if np.isnan(c) else float(c)
                                      for c in corr],
             correlation_type=("spearman" if self.correlation_type_spearman
@@ -267,6 +302,7 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             dropped=[names[i] for i in to_remove],
             reasons={names[i]: why for i, why in reasons.items()},
             sample_size=int(len(ys)),
+            feature_correlations=feature_corr,
         )
         model = SanityCheckerModel(keep_indices=keep, summary=summary)
         model.summary_metadata = summary.to_json()

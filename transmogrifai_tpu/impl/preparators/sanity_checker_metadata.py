@@ -12,7 +12,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
 #: bump when the serialized layout changes; from_json upgrades older versions
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 @dataclass
@@ -28,8 +28,14 @@ class ColumnStatistics:
 
 @dataclass
 class CategoricalGroupStats:
-    """Per-group contingency stats (reference CategoricalGroupStats)."""
+    """Per-group contingency stats (reference CategoricalGroupStats:
+    Cramér's V, mutual information and per-cell pointwise mutual
+    information, reference OpStatistics.contingencyStats:300)."""
     cramers_v: Dict[str, float] = field(default_factory=dict)
+    mutual_info: Dict[str, float] = field(default_factory=dict)
+    #: per group: (m feature values, L labels) PMI matrix as nested lists
+    pointwise_mutual_info: Dict[str, List[List[float]]] = field(
+        default_factory=dict)
 
 
 @dataclass
@@ -44,7 +50,17 @@ class SanityCheckerSummary:
     dropped: List[str] = field(default_factory=list)
     reasons: Dict[str, List[str]] = field(default_factory=dict)
     sample_size: int = 0
+    #: full (d, d) feature-feature correlation matrix (np.ndarray, NaN for
+    #: constant columns), only populated when the checker ran with
+    #: correlations="full" (reference SanityChecker.scala:634-638
+    #: featureLabelCorrOnly=false). Persisted via the model's array store;
+    #: included in to_json only up to _JSON_CORR_MAX_D columns.
+    feature_correlations: Optional[Any] = None
     schema_version: int = SCHEMA_VERSION
+
+    #: widest matrix to inline in summary JSON (25M-element nested lists for
+    #: a 5k-column hashed-text vector would dominate plan.json)
+    _JSON_CORR_MAX_D = 512
 
     # -- dict-compat view (consumers predate the typed schema) --------------
     _ALIASES = {
@@ -57,6 +73,9 @@ class SanityCheckerSummary:
         "correlationsWithLabel": lambda s: s.correlations_with_label,
         "correlationType": lambda s: s.correlation_type,
         "cramersV": lambda s: s.categorical.cramers_v,
+        "mutualInfo": lambda s: s.categorical.mutual_info,
+        "pointwiseMutualInfo": lambda s: s.categorical.pointwise_mutual_info,
+        "featureCorrelations": lambda s: s._corr_json(),
         "dropped": lambda s: s.dropped,
         "reasons": lambda s: s.reasons,
         "sampleSize": lambda s: s.sample_size,
@@ -92,7 +111,19 @@ class SanityCheckerSummary:
             "dropped": list(self.dropped),
             "reasons": dict(self.reasons),
             "sampleSize": self.sample_size,
+            "featureCorrelations": self._corr_json(),
         }
+
+    def _corr_json(self) -> Optional[List[List[Optional[float]]]]:
+        fc = self.feature_correlations
+        if fc is None:
+            return None
+        import numpy as _np
+        fc = _np.asarray(fc, dtype=_np.float64)
+        if fc.shape[0] > self._JSON_CORR_MAX_D:
+            return None  # too wide to inline; the ndarray itself persists
+        return [[None if _np.isnan(v) else round(float(v), 6) for v in r]
+                for r in fc]
 
     @classmethod
     def from_json(cls, d: Dict[str, Any]) -> "SanityCheckerSummary":
@@ -116,7 +147,9 @@ class SanityCheckerSummary:
                 reasons=dict(d.get("reasons", {})),
                 sample_size=int(d.get("sampleSize", 0)),
             )
-        if version == SCHEMA_VERSION:
+        if version in (2, SCHEMA_VERSION):
+            # v2 → v3: categorical gained mutual_info/pointwise_mutual_info
+            # (default empty) and the optional featureCorrelations matrix
             return cls(
                 stats=ColumnStatistics(**d["stats"]),
                 categorical=CategoricalGroupStats(**d["categorical"]),
@@ -125,6 +158,7 @@ class SanityCheckerSummary:
                 dropped=list(d["dropped"]),
                 reasons=dict(d["reasons"]),
                 sample_size=int(d["sampleSize"]),
+                feature_correlations=d.get("featureCorrelations"),
             )
         raise ValueError(
             f"unknown SanityChecker summary schemaVersion {version}")

@@ -26,6 +26,7 @@ class DerivedColumnInsights:
     indicator_value: Optional[str] = None
     correlation: Optional[float] = None
     cramers_v: Optional[float] = None
+    mutual_info: Optional[float] = None
     variance: Optional[float] = None
     mean: Optional[float] = None
     min: Optional[float] = None
@@ -151,6 +152,7 @@ class ModelInsights:
         dropped = set(s.get("dropped", []))
         reasons: Dict[str, List[str]] = s.get("reasons", {})
         cramers: Dict[str, float] = s.get("cramersV", {})
+        mutual: Dict[str, float] = s.get("mutualInfo", {}) or {}
 
         # column → raw-feature attribution via the vector-slot name prefix
         # (vector metadata column names start with the parent feature name)
@@ -173,6 +175,8 @@ class ModelInsights:
                 gname = group.split("::")[0]
                 if parent == gname:
                     d.cramers_v = float(v)
+                    if group in mutual:
+                        d.mutual_info = float(mutual[group])
                     break
             fi = per_raw.setdefault(parent, FeatureInsights(
                 feature_name=parent,

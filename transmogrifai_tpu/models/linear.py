@@ -29,11 +29,15 @@ _PREC = jax.lax.Precision.HIGHEST
 
 
 def _standardize(X: jnp.ndarray, w: jnp.ndarray):
-    """Weighted feature standardization; returns (Xs, mean, scale)."""
+    """Weighted feature standardization; returns (Xs, mean, scale).
+
+    Columns constant within the weighted rows get a huge scale (Xs ≈ 0,
+    coefficient pinned at 0) instead of 1/sqrt(noise) — same dead-column
+    guard as _BatchStd, or the unscale step amplifies rounding noise 1e6x."""
     cnt = jnp.maximum(w.sum(), 1.0)
     mean = (X * w[:, None]).sum(0) / cnt
     var = ((X - mean) ** 2 * w[:, None]).sum(0) / cnt
-    scale = jnp.sqrt(jnp.maximum(var, 1e-12))
+    scale = jnp.where(var < 1e-8, 1e30, jnp.sqrt(jnp.maximum(var, 1e-12)))
     return (X - mean) / scale, mean, scale
 
 
@@ -78,7 +82,14 @@ class _BatchStd:
         mean = (self.Wt.T @ self.Xg) / self.cnt[:, None]     # (B, d)
         ex2 = (self.Wt.T @ (self.Xg * self.Xg)) / self.cnt[:, None]
         self.var = jnp.maximum(ex2 - mean ** 2, 1e-12)
-        self.mean, self.scale = mean, jnp.sqrt(self.var)     # (B, d)
+        # a column that is CONSTANT within a config's weighted rows (e.g. a
+        # rare one-hot slot whose nonzero rows all fell in the val fold) has
+        # var ≈ rounding noise; 1/sqrt(var) then blows the solve up to NaN.
+        # Give dead columns a huge scale instead: Xs ≈ 0, gradient 0, coef
+        # stays 0 — Spark's zero-variance standardization semantics.
+        dead = self.var < 1e-8
+        self.mean = mean
+        self.scale = jnp.where(dead, 1e30, jnp.sqrt(self.var))  # (B, d)
 
     def xs_dot(self, A):
         """Xs Aᵀ for A (B, d) → (n, B)."""
@@ -98,11 +109,38 @@ class _BatchStd:
         bias = bias_g - (coef * self.g_mean).sum(axis=1)
         return coef, bias
 
+    def typed_ops(self, cdt, Xg_c):
+        """(xs_dot_c, xs_t_dot_c) computing the standardized matmuls with
+        (n, B) intermediates in ``cdt`` (bf16 for CV sweeps) while every
+        REDUCTION accumulates f32. ``Xg_c`` is the pre-cast globally
+        standardized matrix so callers share one cast."""
+        def xs_dot_c(A):
+            """Xs Aᵀ → (n, B) cdt."""
+            At = (A / self.scale).astype(cdt)
+            off = (self.mean * (A / self.scale)).sum(axis=1).astype(cdt)
+            return (jnp.dot(Xg_c, At.T, preferred_element_type=cdt)
+                    - off[None, :])
 
-@partial(jax.jit, static_argnames=("newton_iters", "cg_iters"))
-def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=10, cg_iters=8):
+        def xs_t_dot_c(V):
+            """Xsᵀ V for V (n, B) cdt → (B, d) f32 (f32 accumulate)."""
+            vt = jnp.dot(V.T, Xg_c, preferred_element_type=jnp.float32)
+            return (vt - jnp.sum(V, axis=0, dtype=jnp.float32)[:, None]
+                    * self.mean) / self.scale
+
+        return xs_dot_c, xs_t_dot_c
+
+
+@partial(jax.jit, static_argnames=("newton_iters", "cg_iters", "sweep"))
+def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=10, cg_iters=8,
+                      sweep=False):
     """Fit B logistic regressions at once. W: (B, n) per-config row weights;
     reg/elastic_net: (B,). Returns (coef (B, d), bias (B,)) in original scale.
+
+    ``sweep``: keep the (n, B) elementwise temps (Z/P/R/S and the CG
+    Hessian-vector products) in bfloat16 — the fit is HBM-bound on those
+    temps at 1M rows, and CV candidates only need metric-ranking accuracy;
+    all gradient/Hessian REDUCTIONS still accumulate f32, and the winner's
+    refit runs with sweep=False (exact f32 temps).
     """
     nB = W.shape[0]
     d = X.shape[1]
@@ -111,24 +149,28 @@ def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=10, cg_iters=8):
     mean, var, scale = std.mean, std.var, std.scale
     l2 = reg * (1.0 - elastic_net)
     l1 = reg * elastic_net
-    yv = y[:, None]                                     # (n, 1)
-    xs_dot, xs_t_dot = std.xs_dot, std.xs_t_dot
+    cdt = jnp.bfloat16 if sweep else X.dtype
+    Xg_c = Xg.astype(cdt)
+    Wt_c = Wt.astype(cdt)
+    yv_c = y[:, None].astype(cdt)
+    xs_dot_c, xs_t_dot_c = std.typed_ops(cdt, Xg_c)
 
     def newton_step(carry, _):
         A, b = carry                                    # (B, d), (B,)
-        Z = xs_dot(A) + b[None, :]                      # (n, B)
+        Z = xs_dot_c(A) + b[None, :].astype(cdt)        # (n, B) cdt
         P = jax.nn.sigmoid(Z)
-        R = Wt * (P - yv)                               # (n, B)
-        S = Wt * jnp.maximum(P * (1 - P), 1e-6)         # (n, B)
-        g_A = xs_t_dot(R) / cnt[:, None] + l2[:, None] * A
-        g_b = R.sum(axis=0) / cnt
-        ssum = S.sum(axis=0)
+        R = Wt_c * (P - yv_c)                           # (n, B) cdt
+        S = Wt_c * jnp.maximum(P * (1 - P),
+                               jnp.asarray(1e-6, cdt))  # (n, B) cdt
+        g_A = xs_t_dot_c(R) / cnt[:, None] + l2[:, None] * A
+        g_b = jnp.sum(R, axis=0, dtype=jnp.float32) / cnt
+        ssum = jnp.sum(S, axis=0, dtype=jnp.float32)
 
         def hv(VA, vb):                                 # H·[v; v_b], all B
-            U = xs_dot(VA) + vb[None, :]
+            U = xs_dot_c(VA) + vb[None, :].astype(cdt)
             T = S * U
-            hA = xs_t_dot(T) / cnt[:, None] + (l2 + 1e-8)[:, None] * VA
-            hb = T.sum(axis=0) / cnt + 1e-8 * vb
+            hA = xs_t_dot_c(T) / cnt[:, None] + (l2 + 1e-8)[:, None] * VA
+            hb = jnp.sum(T, axis=0, dtype=jnp.float32) / cnt + 1e-8 * vb
             return hA, hb
 
         def cg_step(c, _):
@@ -156,8 +198,9 @@ def _fit_logreg_batch(X, y, W, reg, elastic_net, newton_iters=10, cg_iters=8):
         b = b - db
         # prox for L1 in the diagonal-Hessian metric:
         # diag(Hs) = (Sᵀ Xg² − 2 mean·(Sᵀ Xg) + Σ S·mean²) / var / cnt
-        StX = S.T @ Xg
-        StX2 = S.T @ (Xg * Xg)
+        StX = jnp.dot(S.T, Xg_c, preferred_element_type=jnp.float32)
+        StX2 = jnp.dot(S.T, Xg_c * Xg_c,
+                       preferred_element_type=jnp.float32)
         diag = (StX2 - 2 * mean * StX
                 + ssum[:, None] * mean ** 2) / var / cnt[:, None]
         thresh = l1[:, None] / jnp.maximum(diag, 1e-8)
@@ -198,6 +241,16 @@ class LogisticRegressionFamily(ModelFamily):
         W, b = _fit_softmax_batch(X, y.astype(jnp.int32), weights,
                                   grid["regParam"], num_classes)
         return {"W": W, "b": b}
+
+    def sweep_fit_batch(self, X, y, weights, grid, num_classes):
+        # CV candidates: bf16 (n, B) temps — metric-ranking accuracy only;
+        # the winner refits through fit_batch (exact f32 temps)
+        if num_classes <= 2:
+            coef, bias = _fit_logreg_batch(
+                X, y, weights, grid["regParam"], grid["elasticNetParam"],
+                sweep=True)
+            return {"coef": coef, "bias": bias}
+        return self.fit_batch(X, y, weights, grid, num_classes)
 
     def predict_batch(self, params, X, num_classes):
         if num_classes <= 2:
@@ -359,22 +412,28 @@ class LinearRegressionFamily(ModelFamily):
 # configs via the same shared-matmul standardization algebra as logistic.
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("iters",))
-def _fit_svc_batch(X, y, W, reg, iters=150):
+@partial(jax.jit, static_argnames=("iters", "sweep"))
+def _fit_svc_batch(X, y, W, reg, iters=150, sweep=False):
     """Fit B linear SVCs at once. W: (B, n) row weights; reg: (B,).
-    Each GD step is two shared (n,d)@(d,B) matmuls."""
+    Each GD step is two shared (n,d)@(d,B) matmuls. ``sweep``: bf16 (n, B)
+    margin/gradient temps (f32 reduction accumulates) — see
+    _fit_logreg_batch."""
     nB = W.shape[0]
     d = X.shape[1]
     std = _BatchStd(X, W)
     Wt, cnt = std.Wt, std.cnt
-    ypm = (2.0 * y - 1.0)[:, None]                      # (n, 1), {-1,+1}
+    cdt = jnp.bfloat16 if sweep else X.dtype
+    Wt_c = Wt.astype(cdt)
+    ypm_c = (2.0 * y - 1.0)[:, None].astype(cdt)        # (n, 1), {-1,+1}
+    xs_dot_c, xs_t_dot_c = std.typed_ops(cdt, std.Xg.astype(cdt))
 
     def loss_grad(A, b):
-        M = ypm * (std.xs_dot(A) + b[None, :])          # (n, B) margins
-        act = jnp.maximum(1.0 - M, 0.0)
-        G_m = -2.0 * act * ypm * Wt                     # (n, B)
-        g_A = std.xs_t_dot(G_m) / cnt[:, None] + reg[:, None] * A
-        g_b = G_m.sum(axis=0) / cnt
+        Z = xs_dot_c(A) + b[None, :].astype(cdt)
+        M = ypm_c * Z                                   # (n, B) margins
+        act = jnp.maximum(jnp.asarray(1.0, cdt) - M, jnp.asarray(0.0, cdt))
+        G_m = jnp.asarray(-2.0, cdt) * act * ypm_c * Wt_c   # (n, B)
+        g_A = xs_t_dot_c(G_m) / cnt[:, None] + reg[:, None] * A
+        g_b = jnp.sum(G_m, axis=0, dtype=jnp.float32) / cnt
         return g_A, g_b
 
     # Lipschitz ≈ 2·mean row-norm² (+ reg); standardized rows → ‖x‖² ≈ d
@@ -413,6 +472,11 @@ class LinearSVCFamily(ModelFamily):
 
     def fit_batch(self, X, y, weights, grid, num_classes):
         coef, bias = _fit_svc_batch(X, y, weights, grid["regParam"])
+        return {"coef": coef, "bias": bias}
+
+    def sweep_fit_batch(self, X, y, weights, grid, num_classes):
+        coef, bias = _fit_svc_batch(X, y, weights, grid["regParam"],
+                                    sweep=True)
         return {"coef": coef, "bias": bias}
 
     def predict_batch(self, params, X, num_classes):

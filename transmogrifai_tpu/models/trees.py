@@ -254,10 +254,11 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     """Grow Tb complete-heap trees AT ONCE on the split-search sample.
 
     The tree batch (configs × trees) lives flattened in the lane axis from
-    end to end — every intermediate is (S, Tb·m)-shaped with a large minor
-    dimension, because TPU arrays pad the minor-most dim to 128 lanes and a
-    (S, Tb, k≈2) layout wastes 64× HBM (measured OOM under the vmapped
-    per-tree grower).
+    end to end — every intermediate is (S, m·Tb)-shaped (j-major: lane =
+    j·Tb + t) with a large minor dimension, because TPU arrays pad the
+    minor-most dim to 128 lanes and a (S, Tb, k≈2) layout wastes 64× HBM
+    (measured OOM under the vmapped per-tree grower). J-major keeps every
+    per-tree group reduction a free (S, m, Tb) reshape + axis-1 sum.
 
     codes_s: (S, d) shared int32 bin codes; sw_list: k arrays (S, Tb) — the
     per-tree stat·rowweight products, one array per stat so no tiny-minor
@@ -274,58 +275,81 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     bin_heap = jnp.full((Tb, H), n_bins, jnp.int32)
     node = jnp.zeros((S, Tb), jnp.int32)
     sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
+    hist_prev = None
     for level in range(depth):
         m = 2 ** level
         M = Tb * m
-        # lane layout t-major: lane = t*m + j (jnp.repeat = element repeat)
-        node_rep = jnp.repeat(node.astype(jnp.bfloat16), m, axis=1)  # (S, M)
-        j_iota = jnp.tile(jnp.arange(m, dtype=jnp.int32), Tb
-                          ).astype(jnp.bfloat16)
-        n_oh = (node_rep == j_iota[None, :]).astype(jnp.bfloat16)    # (S, M)
-        # ONE histogram call per level: the k stats live k-major in the lane
-        # axis (every operand stays (S, ·)-shaped — no tiny minor dims)
-        A_cat = jnp.concatenate(
-            [n_oh * jnp.repeat(sw_bf[k_i], m, 1) for k_i in range(k)],
-            axis=1)                                                  # (S, kM)
-        hist = hist_matmul(codes_s, A_cat, n_bins)
-        hist = hist.reshape(k, M, d, n_bins).transpose(1, 2, 3, 0)
+        # lane layout J-MAJOR: lane = j*Tb + t, i.e. a (S, M) array is a
+        # no-copy reshape of (S, m, Tb) — the per-tree group sums in the
+        # routing step become an axis-1 reduction over sublane groups
+        # instead of a dense (S, M) @ (M, Tb) block-diagonal matmul.
+        # Sibling subtraction (the LightGBM/XGBoost-hist trick): per-tree
+        # row weights are constant across levels and a node's children
+        # partition its rows exactly, so only the LEFT child of every node
+        # needs a histogram — the right child is parent − left. Halves the
+        # histogram matmul FLOPs and the A_cat HBM traffic at every level.
+        if level == 0:
+            # root: node == 0 everywhere, the one-hot is all-ones
+            A_cat = jnp.concatenate(sw_bf, axis=1)                   # (S, kTb)
+            hist = hist_matmul(codes_s, A_cat, n_bins)
+            hist = hist.reshape(k, Tb, d, n_bins).transpose(1, 2, 3, 0)
+        else:
+            h = m // 2
+            # left-child one-hot, j-major: (S, h, Tb) vs node (S, 1, Tb)
+            j2 = (2 * jnp.arange(h, dtype=jnp.int32))[None, :, None]
+            n_oh_l = (node[:, None, :] == j2).astype(jnp.bfloat16
+                                                     ).reshape(S, h * Tb)
+            A_cat = jnp.concatenate(
+                [n_oh_l.reshape(S, h, Tb) * sw_bf[k_i][:, None, :]
+                 for k_i in range(k)], axis=1).reshape(S, k * h * Tb)
+            hist_l = hist_matmul(codes_s, A_cat, n_bins)
+            hist_l = hist_l.reshape(k, h * Tb, d, n_bins
+                                    ).transpose(1, 2, 3, 0)          # (h·Tb,…)
+            hist_r = hist_prev - hist_l
+            # interleave children j-major: row (2j'+parity)·Tb + t
+            hist = jnp.stack(
+                [hist_l.reshape(h, Tb, d, n_bins, k),
+                 hist_r.reshape(h, Tb, d, n_bins, k)],
+                axis=1).reshape(M, d, n_bins, k)
+        hist_prev = hist
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                       # (M, k) node totals
         SL = cum[:, :, :-1, :]
         SR = total[:, None, None, :] - SL
-        cfg_m = {key: jnp.repeat(v, m) for key, v in cfg.items()}
+        cfg_m = {key: jnp.tile(v, m) for key, v in cfg.items()}
         gain, valid = _split_gain(SL, SR, total, cfg_m, mode)
-        valid = valid & jnp.repeat(fmasks, m, axis=0)[:, :, None]
+        valid = valid & jnp.tile(fmasks, (m, 1))[:, :, None]
         gain = jnp.where(valid, gain, -jnp.inf)
         gflat = gain.reshape(M, d * (n_bins - 1))
         best = jnp.argmax(gflat, axis=1)
         bf = (best // (n_bins - 1)).astype(jnp.int32)
         bb = (best % (n_bins - 1)).astype(jnp.int32)
         bgain = jnp.take_along_axis(gflat, best[:, None], axis=1)[:, 0]
-        active = jnp.asarray(level, jnp.float32) < jnp.repeat(
+        active = jnp.asarray(level, jnp.float32) < jnp.tile(
             cfg["max_depth"], m)
         do_split = active & jnp.isfinite(bgain) & (bgain > cfg_m["min_info_gain"])
         bf_eff = jnp.where(do_split, bf, 0)
         bb_eff = jnp.where(do_split, bb, n_bins)
         thr = jnp.where(do_split, edges[bf, bb], jnp.inf).astype(jnp.float32)
+        # j-major (M,) -> heap order (Tb, m)
         feat_heap = feat_heap.at[:, m - 1: 2 * m - 1].set(
-            bf_eff.reshape(Tb, m))
-        thr_heap = thr_heap.at[:, m - 1: 2 * m - 1].set(thr.reshape(Tb, m))
+            bf_eff.reshape(m, Tb).T)
+        thr_heap = thr_heap.at[:, m - 1: 2 * m - 1].set(
+            thr.reshape(m, Tb).T)
         bin_heap = bin_heap.at[:, m - 1: 2 * m - 1].set(
-            bb_eff.reshape(Tb, m))
+            bb_eff.reshape(m, Tb).T)
         # feature-select routing: gather each node's split-feature code by a
         # (d, M) one-hot matmul, compare against the bin threshold (sentinel
-        # n_bins ⇒ route left), select the row's node via the n_oh mask and
-        # a (M, Tb) group-sum matmul
+        # n_bins ⇒ route left), select the row's node via the j-major node
+        # one-hot and reduce the j axis as an (S, m, Tb) sublane sum
         sel = (bf_eff[None, :] == jnp.arange(d, dtype=jnp.int32)[:, None]
                ).astype(jnp.bfloat16)                             # (d, M)
         code_sel = codes_f @ sel                                  # (S, M)
         go_lane = (code_sel > bb_eff.astype(jnp.bfloat16)
                    ).astype(jnp.bfloat16)
-        G = ((jnp.arange(M, dtype=jnp.int32) // m)[:, None]
-             == jnp.arange(Tb, dtype=jnp.int32)[None, :]
-             ).astype(jnp.bfloat16)                               # (M, Tb)
-        go = (go_lane * n_oh) @ G                                 # (S, Tb)
+        j_all = jnp.arange(m, dtype=jnp.int32)[None, :, None]
+        n_oh = (node[:, None, :] == j_all).astype(jnp.bfloat16)   # (S, m, Tb)
+        go = (go_lane.reshape(S, m, Tb) * n_oh).sum(axis=1)       # (S, Tb)
         node = 2 * node + (go > jnp.bfloat16(0.5)).astype(jnp.int32)
     return feat_heap, thr_heap, bin_heap, node
 
@@ -921,10 +945,18 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
         edges = self._edges_of(params)
+        task = self._task(num_classes)
+        leaf = params["leaf"]
+        if task == "classification" and num_classes <= 2:
+            # binary: p0 = 1 − p1, so only the class-1 column needs routing
+            # (halves the descent's output columns → 2x configs per call)
+            leaf = leaf[..., 1:]
         out = _predict_dt_batch(params["feat"], params["bins"],
-                                params["leaf"], edges, X, depth=depth,
+                                leaf, edges, X, depth=depth,
                                 n_bins=edges.shape[-1] + 1)
-        return _shape_scores(out, num_classes, self._task(num_classes))
+        if task == "classification" and num_classes <= 2:
+            return out[..., 0]
+        return _shape_scores(out, num_classes, task)
 
     def predict_one(self, fitted: FittedParams, X):
         params = {k: jnp.asarray(v)[None] for k, v in fitted.params.items()}
@@ -966,11 +998,18 @@ class RandomForestFamilyBase(_TreeFamilyBase):
     def predict_batch(self, params, X, num_classes):
         depth = _depth_of(params["leaf"].shape[-2])
         edges = self._edges_of(params)
+        task = self._task(num_classes)
+        leaf = params["leaf"]
+        if task == "classification" and num_classes <= 2:
+            # binary: route only the class-1 probability column (see DT)
+            leaf = leaf[..., 1:]
         out = _predict_rf_batch(params["feat"], params["bins"],
-                                params["leaf"], params["tree_mask"],
+                                leaf, params["tree_mask"],
                                 edges, X, depth=depth,
                                 n_bins=edges.shape[-1] + 1)
-        return _shape_scores(out, num_classes, self._task(num_classes))
+        if task == "classification" and num_classes <= 2:
+            return out[..., 0]
+        return _shape_scores(out, num_classes, task)
 
     def predict_one(self, fitted: FittedParams, X):
         params = {k: jnp.asarray(v)[None] for k, v in fitted.params.items()}

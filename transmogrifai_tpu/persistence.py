@@ -304,7 +304,9 @@ def save_model(model, path: str) -> None:
     # unresolved-state path with a vaguer error
     saved_uids = ({s.uid for s in model.stages}
                   | {f.origin_stage.uid for f in extra})
-    dangling = sorted(_collect_stage_ref_uids(stage_descs) - saved_uids)
+    dangling = sorted(_collect_stage_ref_uids(
+        [stage_descs, raw_stage_descs,
+         plan["parameters"], plan["rffResults"]]) - saved_uids)
     if dangling:
         import warnings
         warnings.warn(
