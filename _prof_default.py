@@ -1,0 +1,28 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.linear, transmogrifai_tpu.models.trees
+
+n, d, folds = 1_000_000, 64, 3
+rng = np.random.RandomState(0)
+X = rng.randn(n, d).astype(np.float32)
+y = (X @ rng.randn(d).astype(np.float32) + rng.randn(n) > 0).astype(np.float32)
+Xd, yd = jnp.asarray(X), jnp.asarray(y)
+fams = ("OpLogisticRegression", "OpRandomForestClassifier",
+        "OpGBTClassifier", "OpLinearSVC")
+for name in fams:
+    fam = MODEL_REGISTRY[name]
+    grid = fam.default_grid("binary")
+    def sweep():
+        cv = OpCrossValidation(num_folds=folds, seed=0)
+        best = cv.validate([(fam, grid)], Xd, yd, "binary", "AuROC", True, 2)
+        for r in best.results:
+            np.asarray(r.fold_metrics)
+    sweep()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); sweep(); ts.append(time.perf_counter() - t0)
+    B = len(grid) * folds
+    print(f"{name}: {np.median(ts):.3f}s for {B} fits ({[round(t,2) for t in ts]})")

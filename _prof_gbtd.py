@@ -1,0 +1,28 @@
+import time, os
+import numpy as np
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.trees as T
+
+n, d, folds = 1_000_000, 64, 3
+rng = np.random.RandomState(0)
+X = rng.randn(n, d).astype(np.float32)
+y = (X @ rng.randn(d).astype(np.float32) + rng.randn(n) > 0).astype(np.float32)
+Xd, yd = jnp.asarray(X), jnp.asarray(y)
+fam = MODEL_REGISTRY["OpGBTClassifier"]
+grid = fam.default_grid("binary")
+B = len(grid) * folds
+garr = fam.grid_to_arrays(grid * folds)
+W = (np.random.RandomState(1).rand(B, n) > 0.33).astype(np.float32)
+Wd = jnp.asarray(W); Wd.block_until_ready()
+def run():
+    p = fam.fit_batch(Xd, yd, Wd, garr, 2, sweep=True)
+    np.asarray(p["feat"][:1, :1])
+run(); run()
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter(); run(); ts.append(time.perf_counter() - t0)
+print(f"GBT default fit warm: {min(ts):.2f}s for {B} fits")
+os.makedirs("/tmp/jtrace4", exist_ok=True)
+with jax.profiler.trace("/tmp/jtrace4"):
+    run()

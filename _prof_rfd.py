@@ -1,0 +1,47 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.trees as T
+
+n, d, folds = 1_000_000, 64, 3
+rng = np.random.RandomState(0)
+X = rng.randn(n, d).astype(np.float32)
+y = (X @ rng.randn(d).astype(np.float32) + rng.randn(n) > 0).astype(np.float32)
+Xd, yd = jnp.asarray(X), jnp.asarray(y)
+fam = MODEL_REGISTRY["OpRandomForestClassifier"]
+grid = fam.default_grid("binary")
+B = len(grid) * folds
+garr = fam.grid_to_arrays(grid * folds)
+W = (np.random.RandomState(1).rand(B, n) > 0.33).astype(np.float32)
+Wd = jnp.asarray(W); Wd.block_until_ready()
+def run_fit():
+    p = fam.fit_batch(Xd, yd, Wd, garr, 2, sweep=True)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready() if hasattr(a, 'block_until_ready') else a, p)
+    np.asarray(p["feat"][:1, :1])
+    return p
+p = run_fit()
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter(); run_fit(); ts.append(time.perf_counter() - t0)
+print(f"RF default fit: {min(ts):.2f}s for {B} fits")
+
+ne = 131072
+Xe = Xd[:ne]
+def run_pred():
+    # fold-sliced: 3 slices of G=12 configs each
+    outs = []
+    for f in range(3):
+        pp = fam.slice_params(p, f * 12, (f + 1) * 12)
+        outs.append(fam.predict_batch(pp, Xe, 2))
+    np.asarray(outs[0][:1, :1]); np.asarray(outs[1][:1, :1]); np.asarray(outs[2][:1, :1])
+run_pred()
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter(); run_pred(); ts.append(time.perf_counter() - t0)
+print(f"RF default predict (3x12 cfg, {ne} rows): {min(ts):.2f}s")
+
+import os
+os.makedirs("/tmp/jtrace5", exist_ok=True)
+with jax.profiler.trace("/tmp/jtrace5"):
+    run_fit()

@@ -243,12 +243,13 @@ class LogisticRegressionFamily(ModelFamily):
         return {"W": W, "b": b}
 
     def sweep_fit_batch(self, X, y, weights, grid, num_classes):
-        # CV candidates: bf16 (n, B) temps — metric-ranking accuracy only;
-        # the winner refits through fit_batch (exact f32 temps)
+        # CV candidates: bf16 (n, B) temps and a shorter Newton-CG schedule
+        # — metric-ranking accuracy only; the winner refits through
+        # fit_batch (exact f32 temps, full 10x8 schedule)
         if num_classes <= 2:
             coef, bias = _fit_logreg_batch(
                 X, y, weights, grid["regParam"], grid["elasticNetParam"],
-                sweep=True)
+                newton_iters=8, cg_iters=6, sweep=True)
             return {"coef": coef, "bias": bias}
         return self.fit_batch(X, y, weights, grid, num_classes)
 
@@ -413,7 +414,7 @@ class LinearRegressionFamily(ModelFamily):
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("iters", "sweep"))
-def _fit_svc_batch(X, y, W, reg, iters=150, sweep=False):
+def _fit_svc_batch(X, y, W, reg, iters=100, sweep=False):
     """Fit B linear SVCs at once. W: (B, n) row weights; reg: (B,).
     Each GD step is two shared (n,d)@(d,B) matmuls. ``sweep``: bf16 (n, B)
     margin/gradient temps (f32 reduction accumulates) — see
@@ -454,7 +455,7 @@ def _fit_svc_batch(X, y, W, reg, iters=150, sweep=False):
     return std.unscale(A, b)
 
 
-def _fit_svc(X, y, w, reg, iters=150):
+def _fit_svc(X, y, w, reg, iters=100):
     """Single-config fit: the B=1 slice of the batched solver."""
     coef, bias = _fit_svc_batch(X, y, w[None, :], jnp.asarray([reg], X.dtype),
                                 iters=iters)
