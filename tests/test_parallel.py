@@ -134,3 +134,118 @@ def test_workflow_with_mesh_trains():
     scored = model.score(df=df)
     p = np.asarray(scored[pred.name].values)[:, 0]
     assert ((p == df["y"].values).mean()) > 0.9
+
+
+def test_full_mesh_train_matches_single_device():
+    """with_mesh shards the WHOLE train path (combiner upload, SanityChecker
+    stats, selector sweep) and still produces the same fitted model as the
+    single-device train (VERDICT r2 #3; reference SanityChecker.scala:574-576
+    distributed colStats). n is chosen non-divisible by the data axis so the
+    masked-pad path is exercised."""
+    import pandas as pd
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.impl.preparators.sanity_checker import (
+        SanityCheckerModel)
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(11)
+    n = 331  # not divisible by 4
+    x1, x2 = rng.randn(n), rng.randn(n)
+    df = pd.DataFrame({"x1": x1, "x2": x2,
+                       "c": rng.choice(["a", "b", "c"], n),
+                       "y": (x1 - 0.5 * x2 > 0).astype(float)})
+
+    def build():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        feats = [FeatureBuilder.Real("x1").extract_field().as_predictor(),
+                 FeatureBuilder.Real("x2").extract_field().as_predictor(),
+                 FeatureBuilder.PickList("c").extract_field().as_predictor()]
+        vec = tg.transmogrify(feats)
+        checked = label.transform_with(SanityChecker(seed=5), vec)
+        pred = (BinaryClassificationModelSelector.with_cross_validation(
+            seed=5, models=[("OpLogisticRegression", None)])
+            .set_input(label, checked).get_output())
+        return pred
+
+    plain = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(build()).train())
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = (OpWorkflow().set_input_dataset(df)
+               .set_result_features(build()).with_mesh(mesh).train())
+
+    # the sharded checker really ran its stats pass over the 'data' axis
+    sc = [s for s in sharded.stages if isinstance(s, SanityCheckerModel)][0]
+    assert sc._stats_input_sharding and "data" in sc._stats_input_sharding
+    sc_plain = [s for s in plain.stages
+                if isinstance(s, SanityCheckerModel)][0]
+    # identical column decisions + statistics
+    assert sc.keep_indices == sc_plain.keep_indices
+    np.testing.assert_allclose(sc.summary["mean"], sc_plain.summary["mean"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(sc.summary["variance"],
+                               sc_plain.summary["variance"], rtol=1e-4)
+
+    # identical predictions end to end
+    ps = sharded.score(df=df)
+    pp = plain.score(df=df)
+    name_s = [c for c in ps.column_names if "modelSelector" in c][0]
+    name_p = [c for c in pp.column_names if "modelSelector" in c][0]
+    np.testing.assert_allclose(
+        np.asarray(ps[name_s].values, dtype=np.float32),
+        np.asarray(pp[name_p].values, dtype=np.float32), atol=2e-3)
+
+
+def test_mesh_trained_model_saves_and_loads(tmp_path):
+    """A with_mesh-trained workflow (combiner + checker carry a Mesh attr)
+    must save/load — the mesh is runtime placement, not model state."""
+    import pandas as pd
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.persistence import load_model, save_model
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(2)
+    n = 160
+    x1 = rng.randn(n)
+    df = pd.DataFrame({"x1": x1, "c": rng.choice(["a", "b"], n),
+                       "y": (x1 > 0).astype(float)})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real("x1").extract_field().as_predictor(),
+             FeatureBuilder.PickList("c").extract_field().as_predictor()]
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        models=[("OpLogisticRegression", None)])
+        .set_input(label, tg.transmogrify(feats).sanity_check(label))
+        .get_output())
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(pred).with_mesh(mesh).train())
+    save_model(model, str(tmp_path / "m"))
+    loaded = load_model(str(tmp_path / "m"))
+    out = loaded.score(df=df)
+    name = [c for c in out.column_names if "modelSelector" in c][0]
+    assert np.isfinite(np.asarray(out[name].values, np.float32)).all()
+
+
+def test_real_vectorizer_mesh_fills_match_host():
+    """Mesh-sharded mean fills match the f64 host path even for columns with
+    mean >> std (anchored f32 device reduction)."""
+    from transmogrifai_tpu import Column, FeatureBuilder, FeatureTable
+    from transmogrifai_tpu.impl.feature.vectorizers import RealVectorizer
+    from transmogrifai_tpu.types import Real
+
+    rng = np.random.RandomState(4)
+    n = 2001
+    big = (1e6 + rng.randn(n) * 1e-2).astype(np.float64)
+    mask = rng.rand(n) > 0.1
+    f = FeatureBuilder.Real("v").extract_field().as_predictor()
+    tbl = FeatureTable({"v": Column(Real, big.astype(np.float64), mask)}, n)
+    host = RealVectorizer().set_input(f).fit(tbl).fills[0]
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = RealVectorizer().set_mesh(mesh).set_input(f).fit(tbl).fills[0]
+    assert abs(host - sharded) < 1e-6 * abs(host) / 1e3  # ~1e-9 relative

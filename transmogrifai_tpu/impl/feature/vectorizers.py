@@ -90,17 +90,49 @@ class RealVectorizer(Estimator):
         self.fill_with_mean = fill_with_mean
         self.fill_value = fill_value
         self.track_nulls = track_nulls
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> "RealVectorizer":
+        """Compute the mean fills over rows sharded on the mesh's 'data'
+        axis (reference: per-partition aggregation of the fill statistics,
+        SURVEY §2.10 P1)."""
+        self.mesh = mesh
+        return self
 
     def fit(self, table: FeatureTable) -> Transformer:
-        fills = []
-        for f in self.input_features:
-            col = table[f.name]
-            vals = np.asarray(col.values, dtype=np.float64)
-            m = col.valid_mask()
-            if self.fill_with_mean:
-                fills.append(float(vals[m].mean()) if m.any() else self.fill_value)
-            else:
-                fills.append(self.fill_value)
+        mesh = getattr(self, "mesh", None)
+        if self.fill_with_mean and mesh is not None and self.input_features:
+            from ...parallel.sharded import sharded_col_stats
+            cols = [table[f.name] for f in self.input_features]
+            mask = np.stack([c.valid_mask() for c in cols], axis=1)
+            vals64 = [np.asarray(c.values, dtype=np.float64).reshape(-1)
+                      for c in cols]
+            # anchor each column at a coarse host mean so the f32 device
+            # reduction works on deviations (error ~ eps·std, matching the
+            # f64 host path's fills to float precision even for columns with
+            # mean >> std); invalid slots are zeroed, inf still propagates
+            anchors = np.array(
+                [v[mask[:, i]][:1024].mean() if mask[:, i].any() else 0.0
+                 for i, v in enumerate(vals64)])
+            X = np.stack(
+                [np.where(mask[:, i], v - anchors[i], 0.0)
+                 for i, v in enumerate(vals64)], axis=1).astype(np.float32)
+            st = sharded_col_stats(X, mask, mesh)
+            cnt = np.asarray(st.count)
+            mean = np.asarray(st.mean)
+            fills = [float(anchors[i] + mean[i]) if cnt[i] > 0
+                     else self.fill_value for i in range(len(cols))]
+        else:
+            fills = []
+            for f in self.input_features:
+                col = table[f.name]
+                vals = np.asarray(col.values, dtype=np.float64)
+                m = col.valid_mask()
+                if self.fill_with_mean:
+                    fills.append(float(vals[m].mean()) if m.any()
+                                 else self.fill_value)
+                else:
+                    fills.append(self.fill_value)
         model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
         return self._finalize_model(model)
 
@@ -505,6 +537,14 @@ class VectorsCombiner(SequenceTransformer):
 
     def __init__(self, uid=None):
         super().__init__("combined", transform_fn=None, output_type=OPVector, uid=uid)
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> "VectorsCombiner":
+        """Upload the combined matrix row-sharded over the mesh's 'data'
+        axis, so every downstream consumer reads an already-distributed
+        buffer (SURVEY §2.10 P1)."""
+        self.mesh = mesh
+        return self
 
     def transform_column(self, table: FeatureTable) -> Column:
         blocks, metas = [], []
@@ -527,6 +567,16 @@ class VectorsCombiner(SequenceTransformer):
         # one host→device upload here; every downstream consumer
         # (SanityChecker, ModelSelector, scoring) reuses the device buffer
         import jax.numpy as jnp
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None and mat.shape[0] % mesh.shape["data"] == 0:
+            # row-sharded upload (only when rows split evenly — padding here
+            # would change the table's row count; consumers that need exact
+            # shards re-pad internally with masked rows, see shard_rows)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            arr = jax.device_put(jnp.asarray(mat),
+                                 NamedSharding(mesh, P("data", None)))
+            return Column(OPVector, arr, None, {"vector_meta": vm})
         return Column(OPVector, jnp.asarray(mat), None, {"vector_meta": vm})
 
     def transform_row(self, row: Dict[str, Any]) -> Any:

@@ -147,6 +147,16 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         #: (reference SanityChecker.scala:634-638 featureLabelCorrOnly)
         self.correlations = correlations
         self.seed = seed
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> "SanityChecker":
+        """Run the stats pass (colStats + correlations + contingency counts)
+        over rows sharded on the mesh's 'data' axis — the TPU-native analog
+        of the reference's distributed colStats/reduceByKey
+        (SanityChecker.scala:574-576, :433-440). XLA inserts the psum
+        collectives; pad rows carry mask=False."""
+        self.mesh = mesh
+        return self
 
     # -- fit ------------------------------------------------------------------
     def fit(self, table: FeatureTable) -> Transformer:
@@ -173,11 +183,18 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         else:
             Xd, ys = Xd_all, y
         yd = jnp.asarray(ys)
-        stats = col_stats(Xd)
+        mesh = getattr(self, "mesh", None)
+        row_mask = None
+        if mesh is not None:
+            from ...parallel.sharded import shard_rows
+            Xd, row_mask, _ = shard_rows(Xd, None, mesh)
+            yd, _, _ = shard_rows(yd, None, mesh)
+            self._stats_input_sharding = str(Xd.sharding)
+        stats = col_stats(Xd, row_mask)
         if self.correlation_type_spearman:
-            corr = spearman_correlation(Xd, yd)
+            corr = spearman_correlation(Xd, yd, row_mask)
         else:
-            corr = pearson_correlation(Xd, yd)
+            corr = pearson_correlation(Xd, yd, row_mask)
         feature_corr: Optional[np.ndarray] = None
         if getattr(self, "correlations", "label") == "full":
             # (d, d) feature-feature matrix on device (one MXU matmul);
@@ -187,7 +204,8 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                 import jax as _jax
                 from ...ops.stats import _rank
                 Xc = _jax.vmap(_rank, in_axes=1, out_axes=1)(Xd)
-            feature_corr = np.asarray(pearson_correlation_matrix(Xc))
+            feature_corr = np.asarray(pearson_correlation_matrix(Xc,
+                                                                 row_mask))
         stats = {k: np.asarray(v) for k, v in stats._asdict().items()}
         corr = np.asarray(corr)
 
@@ -202,7 +220,9 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             labels = np.unique(ys)
             is_binary_like = len(labels) <= 20 and np.allclose(labels, labels.astype(int))
             if is_binary_like:
-                label_idx = jnp.asarray(ys.astype(np.int32))
+                # yd is the (possibly mesh-padded) device label vector; pad
+                # rows are excluded via row_mask in the contingency matmul
+                label_idx = yd.astype(jnp.int32)
                 num_labels = int(ys.max()) + 1
                 # only indicator (0/1 pivot) groups get contingency stats
                 groups = [(g, idxs) for g, idxs in vm.index_of_group().items()
@@ -217,7 +237,8 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                     all_idx = np.concatenate(
                         [np.asarray(idxs) for _, idxs in groups])
                     counts = np.asarray(contingency_table(
-                        Xd[:, jnp.asarray(all_idx)], label_idx, num_labels))
+                        Xd[:, jnp.asarray(all_idx)], label_idx, num_labels,
+                        row_mask))
                     off = 0
                     for group, idxs in groups:
                         m = len(idxs)
@@ -306,6 +327,10 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         )
         model = SanityCheckerModel(keep_indices=keep, summary=summary)
         model.summary_metadata = summary.to_json()
+        # diagnostic: how the stats pass was placed (asserted by the
+        # multichip dryrun — 'data'-sharded under with_mesh)
+        model._stats_input_sharding = getattr(
+            self, "_stats_input_sharding", None)
         return self._finalize_model(model)
 
 

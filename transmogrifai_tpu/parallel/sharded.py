@@ -91,15 +91,34 @@ def sharded_fit_batch(family, X, y, weights, grid: Dict[str, jnp.ndarray],
     return params, scores, B  # B = original (unpadded) batch size
 
 
+def shard_rows(X, mask, mesh: Mesh):
+    """Row-shard (X, mask) over 'data', padding to an equal-shard length.
+
+    Pad rows carry mask=False so every masked kernel ignores them; callers
+    that had no mask get the synthetic validity mask back. Returns
+    (X_sharded, mask_sharded, original_n)."""
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    n_data = mesh.shape["data"]
+    n_pad = _pad_to(max(n, n_data), n_data)
+    if mask is None:
+        mask = jnp.ones((n,), bool)
+    mask = jnp.asarray(mask)
+    if n_pad != n:
+        X = jnp.pad(X, ((0, n_pad - n),) + ((0, 0),) * (X.ndim - 1))
+        mask = jnp.pad(mask, ((0, n_pad - n),)
+                       + ((0, 0),) * (mask.ndim - 1))
+    X = jax.device_put(X, NamedSharding(
+        mesh, P("data", *([None] * (X.ndim - 1)))))
+    mask = jax.device_put(mask, NamedSharding(
+        mesh, P("data", *([None] * (mask.ndim - 1)))))
+    return X, mask, n
+
+
 def sharded_col_stats(X, mask, mesh: Mesh):
     """colStats over row-sharded data — the reference's
     ``mllib.stat.Statistics.colStats`` (SanityChecker.scala:574-576) as one
     pjit program whose sums psum over ICI."""
     from ..ops.stats import col_stats
-    x_sh = NamedSharding(mesh, P("data", None))
-    X = jax.device_put(jnp.asarray(X), x_sh)
-    if mask is not None:
-        mask = jnp.asarray(mask)
-        spec = P("data", *([None] * (mask.ndim - 1)))
-        mask = jax.device_put(mask, NamedSharding(mesh, spec))
+    X, mask, _ = shard_rows(X, mask, mesh)
     return col_stats(X, mask)

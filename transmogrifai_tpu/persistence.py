@@ -37,7 +37,11 @@ ARRAYS_FILE = "arrays.npz"
 FORMAT_VERSION = 1
 
 #: stage attributes that carry DAG wiring, rebuilt from the feature graph
-_WIRING_ATTRS = ("input_features", "_output_feature")
+#: attributes that are workflow wiring / runtime placement, not model state:
+#: re-established by the loading context, never serialized ("mesh" holds a
+#: jax.sharding.Mesh of live Device objects — unpicklable and meaningless in
+#: another process)
+_WIRING_ATTRS = ("input_features", "_output_feature", "mesh")
 
 
 class _Arrays:
