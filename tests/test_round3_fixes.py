@@ -117,3 +117,115 @@ def test_linear_fit_survives_fold_degenerate_columns():
         coef, bias = _fit_svc_batch(Xd, yd, Wd, reg, sweep=sweep)
         assert bool(jnp.isfinite(coef).all()) and bool(jnp.isfinite(bias).all())
         assert abs(float(coef[0, 3])) < 1e-6
+
+
+def test_loco_device_side_bounded_variants():
+    """LOCO builds zeroed variants on device in bounded blocks — peak
+    variant bytes stay under the configured budget and results match the
+    unchunked math (VERDICT r2 #7)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.insights.record_insights import RecordInsightsLOCO
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY, FittedParams
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    from transmogrifai_tpu.impl.selector.model_selector import (
+        ModelSelectorSummary, SelectedModel)
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import OPVector
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                                   VectorMetadata)
+
+    rng = np.random.RandomState(0)
+    n, d = 64, 6
+    X = rng.randn(n, d).astype(np.float32)
+    coef = rng.randn(d).astype(np.float32)
+    fitted = FittedParams(family="OpLogisticRegression",
+                          params={"coef": coef, "bias": np.float32(0.1)},
+                          hyper={}, num_classes=2)
+    summary = ModelSelectorSummary(
+        validation_type="cv", validation_metric="AuPR", problem="binary",
+        best_model_type="OpLogisticRegression", best_hyper={},
+        best_metric_value=0.9)
+    sel = SelectedModel(fitted=fitted, summary=summary)
+    vm = VectorMetadata.of("v", [
+        VectorColumnMetadata(f"f{i}", "Real", f"f{i}", None)
+        for i in range(d)])
+    f = FeatureBuilder.OPVector("v").extract_field().as_predictor()
+    tbl = FeatureTable({"v": Column(OPVector, X, None,
+                                    {"vector_meta": vm})}, n)
+
+    loco = RecordInsightsLOCO(sel, top_k=3).set_input(f)
+    # force tiny blocks so chunking is exercised
+    loco.VARIANT_BLOCK_BYTES = 4 * 8 * d   # 8 variant rows at a time
+    out_chunked = loco.transform_column(tbl)
+    assert loco._peak_variant_bytes <= 4 * 8 * d
+
+    loco2 = RecordInsightsLOCO(sel, top_k=3).set_input(f)
+    out_full = loco2.transform_column(tbl)
+    assert loco2._peak_variant_bytes <= loco2.VARIANT_BLOCK_BYTES
+    for a, b in zip(out_chunked.values, out_full.values):
+        assert a == b
+
+
+def _titanic_like_model():
+    import pandas as pd
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(9)
+    n = 260
+    x1, x2 = rng.randn(n), rng.randn(n)
+    x3 = np.where(rng.rand(n) < 0.2, np.nan, rng.randn(n))
+    df = pd.DataFrame({"x1": x1, "x2": x2, "x3": x3,
+                       "c": rng.choice(["a", "b", "c"], n),
+                       "y": (x1 + 0.5 * x2 > 0).astype(float)})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real("x1").extract_field().as_predictor(),
+             FeatureBuilder.Real("x2").extract_field().as_predictor(),
+             FeatureBuilder.Real("x3").extract_field().as_predictor(),
+             FeatureBuilder.PickList("c").extract_field().as_predictor()]
+    checked = label.transform_with(SanityChecker(seed=3),
+                                   tg.transmogrify(feats))
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=3, models=[("OpLogisticRegression", None)])
+        .set_input(label, checked).get_output())
+    model = (OpWorkflow().set_input_dataset(df)
+             .set_result_features(pred, checked).train())
+    return model, df, pred
+
+
+def test_compiled_score_matches_plain():
+    """The fused one-program serve path produces the same scores as the
+    stage-by-stage path, across different micro-batch sizes that share the
+    padding bucket (VERDICT r2 #6)."""
+    from transmogrifai_tpu.local.scoring import compiled_score_function
+    model, df, pred = _titanic_like_model()
+    compiled = compiled_score_function(model)
+    for sl in (slice(0, 260), slice(0, 100), slice(40, 97)):
+        part = df.iloc[sl]
+        from transmogrifai_tpu.readers.readers import dataframe_to_table
+        tbl = dataframe_to_table(part, model.raw_features)
+        plain = model.score(table=tbl)
+        fused = compiled(tbl)
+        np.testing.assert_allclose(
+            np.asarray(fused[pred.name].values, np.float32),
+            np.asarray(plain[pred.name].values, np.float32), atol=1e-5)
+        # the checked vector column (a fused output) also matches
+        chk = [c for c in plain.column_names if "sanityCheck" in c][0]
+        np.testing.assert_allclose(
+            np.asarray(fused[chk].values, np.float32),
+            np.asarray(plain[chk].values, np.float32), atol=1e-5)
+
+
+def test_micro_batch_scorer_uses_compiled_path():
+    from transmogrifai_tpu.local.scoring import micro_batch_score_function
+    model, df, pred = _titanic_like_model()
+    fn = micro_batch_score_function(model)
+    rows = df.to_dict("records")[:9]
+    out = fn(rows)
+    assert len(out) == 9
+    assert all("prediction" in r[pred.name] for r in out)

@@ -110,10 +110,16 @@ class RealVectorizer(Estimator):
             # anchor each column at a coarse host mean so the f32 device
             # reduction works on deviations (error ~ eps·std, matching the
             # f64 host path's fills to float precision even for columns with
-            # mean >> std); invalid slots are zeroed, inf still propagates
+            # mean >> std); invalid slots are zeroed, inf still propagates.
+            # STRIDED sample — a head sample would misanchor sorted/trending
+            # columns (ids, timestamps)
+            def _anchor(v, m):
+                mv = v[m]
+                if not len(mv):
+                    return 0.0
+                return mv[::max(1, len(mv) // 1024)][:1024].mean()
             anchors = np.array(
-                [v[mask[:, i]][:1024].mean() if mask[:, i].any() else 0.0
-                 for i, v in enumerate(vals64)])
+                [_anchor(v, mask[:, i]) for i, v in enumerate(vals64)])
             X = np.stack(
                 [np.where(mask[:, i], v - anchors[i], 0.0)
                  for i, v in enumerate(vals64)], axis=1).astype(np.float32)
@@ -137,11 +143,31 @@ class RealVectorizer(Estimator):
         return self._finalize_model(model)
 
 
+def _device_fill_blocks(input_features, fills, track_nulls, env):
+    """Shared pure-jax fill+null-track dual used by the fused serve program
+    (local/scoring.compiled_score_function): env maps input feature name →
+    (values, mask-or-None) jnp arrays; ``fills`` yields one fill per input."""
+    import jax.numpy as jnp
+    blocks = []
+    for f, fill in zip(input_features, fills):
+        vals, mask = env[f.name]
+        vals = vals.reshape(-1).astype(jnp.float32)
+        m = jnp.ones(vals.shape, bool) if mask is None else mask
+        blocks.append(jnp.where(m, vals, jnp.float32(fill)))
+        if track_nulls:
+            blocks.append((~m).astype(jnp.float32))
+    return jnp.stack(blocks, axis=1), None
+
+
 class RealVectorizerModel(_VectorModelBase):
     def __init__(self, fills: List[float], track_nulls: bool, uid=None):
         super().__init__("vecReal", uid)
         self.fills = fills
         self.track_nulls = track_nulls
+
+    def device_columnar(self, env):
+        return _device_fill_blocks(self.input_features, self.fills,
+                                   self.track_nulls, env)
 
     def transform_column(self, table: FeatureTable) -> Column:
         blocks, meta = [], []
@@ -201,6 +227,12 @@ class BinaryVectorizer(SequenceTransformer):
         self.fill_value = fill_value
         self.track_nulls = track_nulls
 
+    def device_columnar(self, env):
+        fill = float(self.fill_value)
+        return _device_fill_blocks(
+            self.input_features, (fill for _ in self.input_features),
+            self.track_nulls, env)
+
     def transform_column(self, table: FeatureTable) -> Column:
         blocks, meta = [], []
         for f in self.input_features:
@@ -230,6 +262,13 @@ class RealNNVectorizer(SequenceTransformer):
 
     def __init__(self, uid=None):
         super().__init__("vecRealNN", transform_fn=None, output_type=OPVector, uid=uid)
+
+    def device_columnar(self, env):
+        """Pure-jax dual for the fused serve program (see RealVectorizerModel)."""
+        import jax.numpy as jnp
+        return jnp.stack(
+            [env[f.name][0].reshape(-1).astype(jnp.float32)
+             for f in self.input_features], axis=1), None
 
     def transform_column(self, table: FeatureTable) -> Column:
         blocks, meta = [], []
@@ -545,6 +584,16 @@ class VectorsCombiner(SequenceTransformer):
         buffer (SURVEY §2.10 P1)."""
         self.mesh = mesh
         return self
+
+    def device_columnar(self, env):
+        """Pure-jax dual for the fused serve program (see RealVectorizerModel)."""
+        import jax.numpy as jnp
+        blocks = []
+        for f in self.input_features:
+            vals, _ = env[f.name]
+            blocks.append(vals[:, None] if vals.ndim == 1
+                          else vals.astype(jnp.float32))
+        return jnp.concatenate(blocks, axis=1), None
 
     def transform_column(self, table: FeatureTable) -> Column:
         blocks, metas = [], []

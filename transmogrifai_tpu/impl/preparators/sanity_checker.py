@@ -345,6 +345,18 @@ class SanityCheckerModel(AllowLabelAsInput, Transformer):
         self.summary = summary
         self.summary_metadata = summary
 
+    def device_columnar(self, env):
+        """Pure-jax dual for the fused serve program
+        (local/scoring.compiled_score_function): index-keep slice."""
+        import jax.numpy as jnp
+        vals, mask = env[self.input_features[1].name]
+        return vals[:, jnp.asarray(self.keep_indices)], mask
+
+    def device_inputs(self):
+        """Only the vector input is read at serve time (the label feeds the
+        estimator, not the fitted filter)."""
+        return [self.input_features[1].name]
+
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features
         col = table[vec_f.name]

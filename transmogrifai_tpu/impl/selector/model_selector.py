@@ -174,8 +174,13 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         metric_name, larger_better = self.validation_metric
 
         val_masks = self.validator.make_splits(y)          # (F, n)
-        fold_results: List[List[Any]] = []
-        for f in range(val_masks.shape[0]):
+        F = val_masks.shape[0]
+        # pass 1: fit every fold's in-CV DAG copy and collect its feature
+        # matrix (fold-specific SanityCheckers may keep different columns).
+        # Matrices park on HOST between passes — holding F device copies
+        # would multiply peak HBM by the fold count at 1M×543 scale
+        fold_X: List[Optional[np.ndarray]] = []
+        for f in range(F):
             train_rows = np.nonzero(~val_masks[f])[0]
             full_tbl = sub
             for layer in during_layers:
@@ -190,11 +195,27 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             if vec_f.name not in full_tbl.column_names:
                 raise ValueError(
                     f"in-CV DAG did not produce feature '{vec_f.name}'")
-            Xf = jnp.asarray(full_tbl[vec_f.name].values, dtype=jnp.float32)
-            yd = jnp.asarray(y)
+            fold_X.append(np.asarray(full_tbl[vec_f.name].values,
+                                     dtype=np.float32))
+        # pass 2: pad every fold's matrix to the widest fold with zero
+        # columns (inert: dead-column standardization pins their linear
+        # coefficients to 0, constant columns never win a tree split), so
+        # all F validates share ONE compiled program per family instead of
+        # paying a full compile per fold-specific width (reference
+        # OpValidator.applyDAG :228-256 fits fold DAG copies concurrently;
+        # here the concurrency win is amortized compilation + queued device
+        # programs)
+        d_max = max(x.shape[1] for x in fold_X)
+        yd = jnp.asarray(y)
+        fold_results: List[List[Any]] = []
+        for f in range(F):
+            Xh = fold_X[f]
+            fold_X[f] = None          # one fold's matrix on device at a time
+            if Xh.shape[1] != d_max:
+                Xh = np.pad(Xh, ((0, 0), (0, d_max - Xh.shape[1])))
             fold_results.append(self.validator.validate(
-                self.models, Xf, yd, self.problem, metric_name, larger_better,
-                num_classes, val_masks=val_masks[f][None, :]))
+                self.models, jnp.asarray(Xh), yd, self.problem, metric_name,
+                larger_better, num_classes, val_masks=val_masks[f][None, :]))
 
         # average fold winners per (family, grid point)
         best: Optional[BestEstimator] = None

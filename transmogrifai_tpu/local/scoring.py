@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Sequence
 import numpy as np
 
 from ..table import Column, FeatureTable
+from ..types import OPVector as OPVectorType
 
 
 def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
@@ -40,12 +41,143 @@ def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
     return score
 
 
+def compiled_score_function(model):
+    """ONE jitted XLA program for the fitted transformer tail.
+
+    The TPU-first analog of the reference's layer fusion + MLeap serving
+    (reference FitStagesUtil.applyOpTransformations:96-119,
+    OpWorkflowModelLocal.scala:93-197): every stage exposing
+    ``device_columnar`` (numeric vectorizers → VectorsCombiner →
+    SanityChecker keep-slice) whose dataflow permits it compiles into a
+    single jit, reused across micro-batches via row bucket padding;
+    host-only stages (string pivots, tokenizers) run stage-by-stage before
+    it, and host stages consuming fused outputs (the winning model's
+    Prediction emission) run after, on device arrays.
+
+    Returns ``score(table: FeatureTable) -> FeatureTable`` with the result
+    features plus every column the retained host stages produce; fused
+    INTERMEDIATE columns not consumed downstream are not materialized
+    (unlike ``model.score``'s keep-everything default).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils.padding import bucket_for
+
+    stages = list(model.stages)
+    # dataflow partition (not list-suffix): fuse every device-capable stage
+    # unless it reads a column produced by a host stage that itself depends
+    # on a fused output (that host stage must run AFTER the fused program)
+    fused_set = {id(s) for s in stages if hasattr(s, "device_columnar")}
+
+    def _inputs(s):
+        return (s.device_inputs() if hasattr(s, "device_inputs")
+                else [f.name for f in s.input_features])
+
+    while True:
+        fused_out = {s.get_output().name for s in stages
+                     if id(s) in fused_set}
+        # host stages transitively downstream of a fused output
+        tainted_stages: set = set()
+        downstream = set(fused_out)
+        for s in stages:
+            if id(s) in fused_set:
+                continue
+            if any(f.name in downstream for f in s.input_features):
+                tainted_stages.add(id(s))
+                downstream.add(s.get_output().name)
+        demote = [s for s in stages if id(s) in fused_set
+                  and any(nm in downstream - fused_out
+                          for nm in _inputs(s))]
+        if not demote:
+            break
+        for s in demote:
+            fused_set.discard(id(s))
+    host_prefix = [s for s in stages
+                   if id(s) not in fused_set and id(s) not in tainted_stages]
+    tail_host = [s for s in stages if id(s) in tainted_stages]
+    fused = [s for s in stages if id(s) in fused_set]
+    if not fused:
+        return lambda table: model.score(table=table)
+
+    produced = {s.get_output().name for s in fused}
+    in_names: List[str] = []
+    for s in fused:
+        names = (s.device_inputs() if hasattr(s, "device_inputs")
+                 else [f.name for f in s.input_features])
+        for nm in names:
+            if nm not in produced and nm not in in_names:
+                in_names.append(nm)
+    out_needed = [s.get_output().name for s in fused]
+    # outputs consumed outside the fused region (or result features)
+    ext = {f.name for st in tail_host for f in st.input_features}
+    ext |= {f.name for f in model.result_features}
+    out_names = [nm for nm in out_needed if nm in ext]
+    if not out_names:        # at least expose the last fused output
+        out_names = [out_needed[-1]]
+
+    @jax.jit
+    def chain(vals_list, mask_list):
+        env = {nm: (v, m) for nm, v, m in
+               zip(in_names, vals_list, mask_list)}
+        for s in fused:
+            env[s.get_output().name] = s.device_columnar(env)
+        return tuple(env[nm][0] for nm in out_names)
+
+    # metadata for fused outputs is data-independent; captured lazily from
+    # one plain stage-by-stage pass on the first batch
+    meta_cache: Dict[str, Dict[str, Any]] = {}
+
+    def score(table: FeatureTable) -> FeatureTable:
+        tbl = table
+        for s in host_prefix:
+            tbl = s.transform(tbl)
+        if not meta_cache:
+            probe = tbl
+            for s in fused:
+                probe = s.transform(probe)
+                nm = s.get_output().name
+                meta_cache[nm] = {
+                    k2: v for k2, v in probe[nm].metadata.items()}
+        n = tbl.num_rows
+        n_pad = bucket_for(n)
+        vals_list, mask_list = [], []
+        for nm in in_names:
+            col = tbl[nm]
+            v = np.asarray(col.values, dtype=np.float32)
+            m = None if col.mask is None else np.asarray(col.mask)
+            if n_pad != n:
+                v = np.concatenate(
+                    [v, np.zeros((n_pad - n,) + v.shape[1:], v.dtype)])
+                if m is None:
+                    m = np.zeros(n_pad, bool)
+                    m[:n] = True
+                else:
+                    m = np.concatenate([m, np.zeros(n_pad - n, bool)])
+            vals_list.append(jnp.asarray(v))
+            mask_list.append(None if m is None else jnp.asarray(m))
+        outs = chain(tuple(vals_list), tuple(mask_list))
+        new_cols = dict(tbl._columns)
+        for nm, arr in zip(out_names, outs):
+            new_cols[nm] = Column(
+                OPVectorType, arr[:n], None, dict(meta_cache.get(nm, {})))
+        tbl = FeatureTable(new_cols, n, key=tbl.key)
+        for s in tail_host:
+            tbl = s.transform(tbl)
+        return tbl
+
+    return score
+
+
 def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], List[Dict[str, Any]]]:
     """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
     runs the columnar/jitted DAG pass — the serving path that keeps the TPU
-    busy (SURVEY §2.10 P4: streaming micro-batches)."""
+    busy (SURVEY §2.10 P4: streaming micro-batches). The numeric transformer
+    tail runs as ONE compiled XLA program reused across micro-batches
+    (compiled_score_function)."""
     raw_features = model.raw_features
     result_features = model.result_features
+    compiled = compiled_score_function(model)
 
     def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
         cols = {
@@ -54,7 +186,7 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
             for f in raw_features
         }
         table = FeatureTable(cols, len(rows))
-        scored = model.score(table=table)
+        scored = compiled(table)
         out: List[Dict[str, Any]] = []
         for i in range(len(rows)):
             rec: Dict[str, Any] = {}
