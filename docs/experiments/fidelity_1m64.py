@@ -1,0 +1,81 @@
+"""Sweep fidelity experiment (VERDICT r2 #4): default (sampled) vs exact
+sweep on 1M x 64 — winner agreement, Spearman rank corr, holdout delta."""
+import json, time
+import numpy as np
+import jax.numpy as jnp
+from scipy import stats as sps
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.linear, transmogrifai_tpu.models.trees
+from transmogrifai_tpu.ops.metrics import auroc_masked
+
+n, d, folds = 1_000_000, 64, 3
+rng = np.random.RandomState(0)
+X = rng.randn(n + 200_000, d).astype(np.float32)
+w_true = rng.randn(d).astype(np.float32)
+yy = (X @ w_true + rng.randn(len(X)) > 0).astype(np.float32)
+Xtr, ytr = X[:n], yy[:n]
+Xho, yho = X[n:], yy[n:]
+Xd, yd = jnp.asarray(Xtr), jnp.asarray(ytr)
+
+lr = [{"regParam": r, "elasticNetParam": e}
+      for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
+      for e in (0.0, 0.25, 0.5, 0.75, 1.0)]
+svc = [{"regParam": float(r)} for r in np.logspace(-4, 0, 20)]
+rf = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": mg,
+       "numTrees": 50, "subsamplingRate": 1.0}
+      for dd in (3, 6) for mi in (5, 10, 50, 100)
+      for mg in (0.001, 0.01, 0.1)]
+gbt = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": mg,
+        "maxIter": 20, "stepSize": ss}
+       for dd in (3, 6) for mi in (10, 100)
+       for mg in (0.001, 0.01, 0.1) for ss in (0.1, 0.3)]
+models = [(MODEL_REGISTRY["OpLogisticRegression"], lr),
+          (MODEL_REGISTRY["OpRandomForestClassifier"], rf),
+          (MODEL_REGISTRY["OpGBTClassifier"], gbt),
+          (MODEL_REGISTRY["OpLinearSVC"], svc)]
+
+def run(exact):
+    cv = OpCrossValidation(num_folds=folds, seed=0,
+                           max_eval_rows=None if exact else 131072,
+                           exact_sweep_fits=exact)
+    t0 = time.perf_counter()
+    best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+    dt = time.perf_counter() - t0
+    ranks = {r.family: np.asarray(r.mean_metrics) for r in best.results}
+    return best, ranks, dt
+
+b_def, r_def, t_def = run(False)
+b_ex, r_ex, t_ex = run(True)
+
+out = {"winner_default": [b_def.family_name, b_def.hyper],
+       "winner_exact": [b_ex.family_name, b_ex.hyper],
+       "winner_family_agree": b_def.family_name == b_ex.family_name,
+       "winner_config_agree": (b_def.family_name == b_ex.family_name
+                               and b_def.hyper == b_ex.hyper),
+       "time_default_s": round(t_def, 1), "time_exact_s": round(t_ex, 1)}
+per_fam = {}
+all_d, all_e = [], []
+for fam in r_def:
+    rho = sps.spearmanr(r_def[fam], r_ex[fam]).statistic
+    per_fam[fam] = round(float(rho), 4)
+    all_d += list(r_def[fam]); all_e += list(r_ex[fam])
+out["spearman_per_family"] = per_fam
+out["spearman_all_configs"] = round(float(sps.spearmanr(all_d, all_e).statistic), 4)
+
+# holdout AuROC of each run's selected model (fit exact on full train)
+def holdout_auroc(best):
+    fam = MODEL_REGISTRY[best.family_name]
+    garr = fam.grid_to_arrays([best.hyper])
+    W = jnp.ones((1, n), jnp.float32)
+    p = fam.fit_batch(Xd, yd, W, garr, 2)
+    s = np.asarray(fam.predict_batch(fam.slice_params(p, 0, 1), jnp.asarray(Xho), 2))[0]
+    mask = jnp.ones(len(yho), bool)
+    return float(np.asarray(auroc_masked(jnp.asarray(s), jnp.asarray(yho), mask)))
+
+a_def = holdout_auroc(b_def)
+a_ex = holdout_auroc(b_ex)
+out["holdout_auroc_default_winner"] = round(a_def, 5)
+out["holdout_auroc_exact_winner"] = round(a_ex, 5)
+out["holdout_auroc_delta"] = round(a_def - a_ex, 6)
+print(json.dumps(out, indent=1))

@@ -1,0 +1,80 @@
+"""Fidelity experiment #2: wide AutoML-style table (600k x 543 = 64 numeric +
+479 sparse one-hot-style binaries), generated on device."""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+from scipy import stats as sps
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+import transmogrifai_tpu.models.linear, transmogrifai_tpu.models.trees
+from transmogrifai_tpu.ops.metrics import auroc_masked
+
+n, n_ho, d_num, d_bin = 600_000, 100_000, 64, 479
+
+@jax.jit
+def synth(key):
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    N = n + n_ho
+    Xn = jax.random.normal(k1, (N, d_num), jnp.float32)
+    p = jnp.logspace(-3.3, -0.5, d_bin)          # zipf-ish sparsity
+    Xb = (jax.random.uniform(k2, (N, d_bin)) < p[None, :]).astype(jnp.float32)
+    w_n = jax.random.normal(k3, (d_num,)) * 0.5
+    w_b = jax.random.normal(k4, (d_bin,)) * (2.0 * jnp.sqrt(1.0 / jnp.maximum(p, 1e-3)))[...] * 0.05
+    logits = Xn @ w_n + Xb @ w_b + 0.5 * jax.random.normal(k5, (N,))
+    y = (logits > jnp.median(logits)).astype(jnp.float32)
+    return jnp.concatenate([Xn, Xb], axis=1), y
+
+Xall, yall = synth(jax.random.PRNGKey(0))
+Xd, yd = jnp.copy(Xall[:n]), jnp.copy(yall[:n])
+Xho, yho = jnp.copy(Xall[n:]), jnp.copy(yall[n:])
+del Xall, yall
+
+lr = [{"regParam": r, "elasticNetParam": e}
+      for r in (0.001, 0.01, 0.1, 0.3) for e in (0.0, 0.5)]          # 8
+svc = [{"regParam": float(r)} for r in np.logspace(-4, 0, 6)]        # 6
+rf = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": mg,
+       "numTrees": 50, "subsamplingRate": 1.0}
+      for dd in (3, 6) for mi in (10, 100) for mg in (0.001, 0.1)]   # 8
+gbt = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": 0.001,
+        "maxIter": 20, "stepSize": ss}
+       for dd in (3, 6) for mi in (10, 100) for ss in (0.1, 0.3)]    # 8
+models = [(MODEL_REGISTRY["OpLogisticRegression"], lr),
+          (MODEL_REGISTRY["OpRandomForestClassifier"], rf),
+          (MODEL_REGISTRY["OpGBTClassifier"], gbt),
+          (MODEL_REGISTRY["OpLinearSVC"], svc)]
+
+def run(exact):
+    cv = OpCrossValidation(num_folds=3, seed=0,
+                           max_eval_rows=None if exact else 131072,
+                           exact_sweep_fits=exact)
+    best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+    return best, {r.family: np.asarray(r.mean_metrics) for r in best.results}
+
+b_def, r_def = run(False)
+b_ex, r_ex = run(True)
+out = {"winner_default": [b_def.family_name, b_def.hyper],
+       "winner_exact": [b_ex.family_name, b_ex.hyper],
+       "winner_family_agree": b_def.family_name == b_ex.family_name,
+       "winner_config_agree": (b_def.family_name == b_ex.family_name
+                               and b_def.hyper == b_ex.hyper)}
+all_d, all_e, per = [], [], {}
+for fam in r_def:
+    per[fam] = round(float(sps.spearmanr(r_def[fam], r_ex[fam]).statistic), 4)
+    all_d += list(r_def[fam]); all_e += list(r_ex[fam])
+out["spearman_per_family"] = per
+out["spearman_all_configs"] = round(float(sps.spearmanr(all_d, all_e).statistic), 4)
+
+def holdout_auroc(best):
+    fam = MODEL_REGISTRY[best.family_name]
+    garr = fam.grid_to_arrays([best.hyper])
+    W = jnp.ones((1, n), jnp.float32)
+    p = fam.fit_batch(Xd, yd, W, garr, 2)
+    s = np.asarray(fam.predict_batch(fam.slice_params(p, 0, 1), Xho, 2))[0]
+    return float(np.asarray(auroc_masked(jnp.asarray(s), yho,
+                                         jnp.ones(n_ho, bool))))
+
+a_def, a_ex = holdout_auroc(b_def), holdout_auroc(b_ex)
+out["holdout_auroc_default_winner"] = round(a_def, 5)
+out["holdout_auroc_exact_winner"] = round(a_ex, 5)
+out["holdout_auroc_delta"] = round(a_def - a_ex, 6)
+print(json.dumps(out, indent=1))

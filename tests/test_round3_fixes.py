@@ -229,3 +229,49 @@ def test_micro_batch_scorer_uses_compiled_path():
     out = fn(rows)
     assert len(out) == 9
     assert all("prediction" in r[pred.name] for r in out)
+
+
+def test_sweep_fidelity_ranking_agreement():
+    """Sampled sweep (default max_eval_rows + sweep_fit_batch) ranks configs
+    consistently with the exact sweep (max_eval_rows=None +
+    exact_sweep_fits) — CI-scale version of the 1M-row experiment in
+    docs/benchmarks.md (VERDICT r2 #4)."""
+    import jax.numpy as jnp
+    from scipy import stats as sps
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear, transmogrifai_tpu.models.trees  # noqa
+
+    rng = np.random.RandomState(0)
+    n, d = 20_000, 16
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d).astype(np.float32)
+         + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    models = [
+        (MODEL_REGISTRY["OpLogisticRegression"],
+         [{"regParam": r, "elasticNetParam": e}
+          for r in (0.001, 0.01, 0.1) for e in (0.0, 0.5)]),
+        (MODEL_REGISTRY["OpRandomForestClassifier"],
+         [{"maxDepth": dd, "minInstancesPerNode": 10, "minInfoGain": mg,
+           "numTrees": 20, "subsamplingRate": 1.0}
+          for dd in (3, 5) for mg in (0.001, 0.1)]),
+    ]
+
+    def run(exact):
+        cv = OpCrossValidation(num_folds=3, seed=0,
+                               max_eval_rows=None if exact else 4096,
+                               exact_sweep_fits=exact)
+        best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+        return best, {r.family: np.asarray(r.mean_metrics)
+                      for r in best.results}
+
+    b_def, r_def = run(False)
+    b_ex, r_ex = run(True)
+    assert b_def.family_name == b_ex.family_name
+    all_d = np.concatenate([r_def[f] for f in r_def])
+    all_e = np.concatenate([r_ex[f] for f in r_def])
+    rho = sps.spearmanr(all_d, all_e).statistic
+    assert rho > 0.85, rho
+    # the sampled winner is within noise of the exact winner's metric
+    assert abs(b_def.metric_value - b_ex.metric_value) < 0.02

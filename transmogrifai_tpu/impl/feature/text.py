@@ -121,6 +121,11 @@ very was wasn't we were weren't what when where which while who whom why with
 won't would wouldn't you your yours yourself yourselves
 """.split())
 
+#: language → high-frequency function words. The profiles are DATA, not
+#: code (reference LangDetector.scala wraps Optimaize's ~70 n-gram
+#: profiles; ~20 languages here, each pinned by tests/test_nlp_accuracy.py
+#: fixtures). Accented/diacritic forms included where the tokenizer keeps
+#: them (it lowercases but preserves letters).
 _STOPWORD_PROFILES: Dict[str, frozenset] = {
     "en": ENGLISH_STOP_WORDS,
     "fr": frozenset("""le la les un une des et est dans pour que qui sur avec
@@ -133,6 +138,40 @@ _STOPWORD_PROFILES: Dict[str, frozenset] = {
  uber""".split()),
     "it": frozenset("""il la le lo gli un una e di che in per con non si su
  questo questa sono ma come anche piu o se del alla nel""".split()),
+    "pt": frozenset("""o a os as um uma de do da dos das e é em no na nos nas
+ para que não com por se mais mas como ou ao aos pelo pela isso está
+ são""".split()),
+    "nl": frozenset("""de het een en van in is dat op te met voor niet zijn
+ er aan ook als bij nog naar dan uit deze om maar hij wij jullie ze
+ wordt""".split()),
+    "sv": frozenset("""och att det som en på är av för med den till i inte
+ har de ett om men var sig så här vi han hon efter vid kan ska""".split()),
+    "no": frozenset("""og i det som en er på til av for med at ikke den har
+ de et om men var seg så her vi han hun etter ved kan skal fra""".split()),
+    "da": frozenset("""og i det som en er på til af for med at ikke den har
+ de et om men var sig så her vi han hun efter ved kan skal fra
+ ogsa""".split()),
+    "fi": frozenset("""ja on ei se että hän oli mutta joka ovat kun niin
+ myös kuin sen tämä ole mitä nyt vain siinä jo hänen kanssa""".split()),
+    "pl": frozenset("""i w na z że się nie jest to do jak po co tak ale o za
+ od przez dla przy był być są ten tym jego jej ich może""".split()),
+    "ru": frozenset("""и в не на я что он с как это по но они мы она из у за
+ то же вы так его её к был для при о а или если когда""".split()),
+    "uk": frozenset("""і в не на я що він з як це по але вони ми вона із у
+ за те ж ви так його її до був для при про а або якщо коли""".split()),
+    "tr": frozenset("""ve bir bu da de için ile olarak daha çok gibi ama en
+ kadar sonra olan var yok ben sen o biz siz onlar ne mi değil""".split()),
+    "ro": frozenset("""și în nu a cu de la pe este un o care mai să se din
+ dar ce el ea noi voi ei pentru sunt fost după până fără""".split()),
+    "cs": frozenset("""a v na je se že to s z do i o k ale jako po za by byl
+ jsou ten tato jeho její my vy oni když pro při nebo""".split()),
+    "hu": frozenset("""a az és hogy nem is egy ez de van volt mint csak meg
+ már el még mi ti ők ha lesz vagy azt aki ami ő mert""".split()),
+    "id": frozenset("""yang dan di dengan untuk dari pada ini itu adalah
+ tidak akan ke dalam juga bisa ada saya kamu dia kami mereka atau
+ sudah""".split()),
+    "vi": frozenset("""và của là có không được trong cho một người này các
+ với những để tôi bạn anh chị em chúng họ hoặc đã sẽ đang""".split()),
 }
 
 
@@ -584,7 +623,9 @@ class OpLDAModel(_VectorModelBase):
 
 class LangDetector(UnaryTransformer):
     """Text → RealMap of language scores (reference LangDetector.scala wraps
-    Optimaize; here: stopword-profile hit rates over a 5-language table)."""
+    Optimaize; here: stopword-profile hit rates over a 20-language table —
+    see _STOPWORD_PROFILES for the list, tests/test_nlp_accuracy.py for the
+    per-language fixture floors)."""
 
     def __init__(self, uid=None):
         def fn(v):
@@ -608,11 +649,41 @@ class LangDetector(UnaryTransformer):
 
 _NER_TITLES = frozenset({"mr", "mrs", "ms", "dr", "prof", "sir"})
 
+#: Title-case run ENDING in one of these → Organization (reference OpenNLP
+#: ships an organization model; suffix cues are the rule-based analog)
+_NER_ORG_SUFFIXES = frozenset(
+    """inc corp ltd llc plc gmbh ag co company corporation university
+    institute college bank group holdings labs laboratories foundation
+    association ministry department agency council committee""".split())
+
+#: strongly-locative preposition before a single Title-case token →
+#: Location even when the gazetteer misses it ("lives in Springfield");
+#: 'from'/'to'/'of' are excluded — they introduce persons and orgs too
+_NER_LOC_PREPS = frozenset({"in", "at", "near"})
+
+#: gazetteer of countries/major cities (lowercase, ';'-separated so
+#: multiword names stay whole); a Title-case run whose full text matches →
+#: Location regardless of context (reference OpenNLP location model;
+#: gazetteers are data, not code)
+_NER_LOC_LOOKUP = frozenset(e.strip() for e in """
+united states;united kingdom;france;germany;italy;spain;portugal;canada;
+mexico;brazil;argentina;china;japan;india;australia;russia;netherlands;
+belgium;sweden;norway;denmark;finland;poland;austria;switzerland;ireland;
+greece;turkey;egypt;nigeria;kenya;south africa;new zealand;singapore;
+london;paris;berlin;madrid;rome;lisbon;tokyo;beijing;shanghai;mumbai;
+delhi;sydney;melbourne;moscow;amsterdam;brussels;stockholm;oslo;
+copenhagen;helsinki;warsaw;vienna;zurich;dublin;athens;istanbul;cairo;
+lagos;nairobi;toronto;vancouver;montreal;chicago;boston;seattle;
+san francisco;new york;los angeles;washington;houston;atlanta;miami
+""".replace("\n", "").split(";") if e.strip())
+
 
 class NameEntityRecognizer(UnaryTransformer):
     """Text → MultiPickListMap of entities by tag (reference
-    NameEntityRecognizer.scala wraps OpenNLP's name finder; here a rule-based
-    recognizer: Title-case token runs → Person after a title, else Name)."""
+    NameEntityRecognizer.scala wraps OpenNLP's name finder; here a
+    rule-based recognizer over Title-case token runs: Organization by
+    corporate/institutional suffix, Location by gazetteer or preposition
+    cue, Person after a title or for multi-token runs, else Name)."""
 
     def __init__(self, uid=None):
         def fn(v):
@@ -634,8 +705,19 @@ class NameEntityRecognizer(UnaryTransformer):
                         run.append(tokens[j])
                         j += 1
                     prev = tokens[i - 1].lower().rstrip(".")
-                    tag = "Person" if prev in _NER_TITLES or len(run) > 1 else "Name"
-                    out.setdefault(tag, set()).add(" ".join(run))
+                    joined = " ".join(run)
+                    last = run[-1].lower().rstrip(".")
+                    if last in _NER_ORG_SUFFIXES and len(run) > 1:
+                        tag = "Organization"
+                    elif joined.lower() in _NER_LOC_LOOKUP:
+                        tag = "Location"
+                    elif prev in _NER_LOC_PREPS and len(run) == 1:
+                        tag = "Location"
+                    elif prev in _NER_TITLES or len(run) > 1:
+                        tag = "Person"
+                    else:
+                        tag = "Name"
+                    out.setdefault(tag, set()).add(joined)
                     i = j
                 else:
                     i += 1
@@ -644,17 +726,43 @@ class NameEntityRecognizer(UnaryTransformer):
                          input_type=Text, uid=uid)
 
 
+#: (magic bytes, offset, MIME). Reference Tika inspects hundreds of
+#: formats incl. containers; this table covers the common ones whose magic
+#: fits in the first 16 decoded bytes (offset 8 handles RIFF/ftyp family)
 _MAGIC = [
-    (b"%PDF", "application/pdf"),
-    (b"\x89PNG", "image/png"),
-    (b"\xff\xd8\xff", "image/jpeg"),
-    (b"GIF8", "image/gif"),
-    (b"PK\x03\x04", "application/zip"),
-    (b"\x1f\x8b", "application/gzip"),
-    (b"BM", "image/bmp"),
-    (b"{", "application/json"),
-    (b"<?xml", "application/xml"),
-    (b"<html", "text/html"),
+    (b"%PDF", 0, "application/pdf"),
+    (b"\x89PNG", 0, "image/png"),
+    (b"\xff\xd8\xff", 0, "image/jpeg"),
+    (b"GIF8", 0, "image/gif"),
+    (b"PK\x03\x04", 0, "application/zip"),
+    (b"\x1f\x8b", 0, "application/gzip"),
+    (b"BM", 0, "image/bmp"),
+    (b"WEBP", 8, "image/webp"),
+    (b"WAVE", 8, "audio/x-wav"),
+    (b"AVI ", 8, "video/x-msvideo"),
+    (b"ftyp", 4, "video/mp4"),
+    (b"II*\x00", 0, "image/tiff"),
+    (b"MM\x00*", 0, "image/tiff"),
+    (b"\x00\x00\x01\x00", 0, "image/vnd.microsoft.icon"),
+    (b"ID3", 0, "audio/mpeg"),
+    (b"\xff\xfb", 0, "audio/mpeg"),
+    (b"OggS", 0, "audio/ogg"),
+    (b"fLaC", 0, "audio/x-flac"),
+    (b"7z\xbc\xaf\x27\x1c", 0, "application/x-7z-compressed"),
+    (b"Rar!\x1a\x07", 0, "application/x-rar-compressed"),
+    (b"BZh", 0, "application/x-bzip2"),
+    (b"\xfd7zXZ\x00", 0, "application/x-xz"),
+    (b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1", 0, "application/x-tika-msoffice"),
+    (b"{\\rtf", 0, "application/rtf"),
+    (b"%!PS", 0, "application/postscript"),
+    (b"SQLite format 3", 0, "application/x-sqlite3"),
+    (b"\x7fELF", 0, "application/x-executable"),
+    (b"\xca\xfe\xba\xbe", 0, "application/java-vm"),
+    (b"wOFF", 0, "font/woff"),
+    (b"wOF2", 0, "font/woff2"),
+    (b"{", 0, "application/json"),
+    (b"<?xml", 0, "application/xml"),
+    (b"<html", 0, "text/html"),
 ]
 
 
@@ -667,11 +775,11 @@ class MimeTypeDetector(UnaryTransformer):
             if not v:
                 return None
             try:
-                head = _b64.b64decode(str(v)[:64] + "==", validate=False)[:16]
+                head = _b64.b64decode(str(v)[:64] + "==", validate=False)[:24]
             except Exception:
                 return None
-            for magic, mime in _MAGIC:
-                if head.startswith(magic):
+            for magic, off, mime in _MAGIC:
+                if head[off:off + len(magic)] == magic:
                     return mime
             if all(32 <= b < 127 or b in (9, 10, 13) for b in head[:16]):
                 return "text/plain"
@@ -691,6 +799,12 @@ _PHONE_REGIONS = {
     "IN": ("91", 10, "0"), "AU": ("61", 9, "0"),
     "JP": ("81", (9, 10), "0"), "BR": ("55", (10, 11), "0"),
     "MX": ("52", 10, ""),
+    "IT": ("39", (9, 10), ""), "ES": ("34", 9, ""),
+    "NL": ("31", 9, "0"), "SE": ("46", (7, 8, 9), "0"),
+    "CH": ("41", 9, "0"), "CN": ("86", (10, 11), "0"),
+    "KR": ("82", (8, 9, 10), "0"), "RU": ("7", 10, "8"),
+    "ZA": ("27", 9, "0"), "AR": ("54", 10, "0"),
+    "SG": ("65", 8, ""), "NZ": ("64", (8, 9), "0"),
 }
 
 

@@ -387,16 +387,59 @@ def tokenize_text(s: Optional[str], min_token_length: int = 1) -> List[str]:
     return [t for t in _TOKEN_SPLIT.split(s.lower()) if len(t) >= min_token_length]
 
 
+def porter_stem(w: str) -> str:
+    """Compact Porter-style English stemmer (the high-coverage rules of
+    steps 1-2: plurals, -ed/-ing, common suffixes — the analog of the
+    reference's Lucene per-language analyzers with stemming,
+    LuceneTextAnalyzer.scala:203; full Porter fidelity is not the goal,
+    stable feature collisions for inflected forms are)."""
+    if len(w) <= 3:
+        return w
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("s") and not w.endswith("ss") and len(w) > 3:
+        w = w[:-1]
+    for suf, rep in (("ational", "ate"), ("ization", "ize"),
+                     ("fulness", "ful"), ("ousness", "ous"),
+                     ("iveness", "ive"), ("tional", "tion"),
+                     ("biliti", "ble"), ("entli", "ent"),
+                     ("ation", "ate"), ("alism", "al"), ("aliti", "al"),
+                     ("ness", ""), ("ment", "")):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)] + rep
+    if w.endswith("ing") and len(w) > 5:
+        w = w[:-3]
+        if len(w) >= 3 and w[-1] == w[-2] and w[-1] not in "lsz":
+            w = w[:-1]  # running -> run
+        return w
+    if w.endswith("ed") and len(w) > 4:
+        w = w[:-2]
+        if len(w) >= 3 and w[-1] == w[-2] and w[-1] not in "lsz":
+            w = w[:-1]
+        return w
+    if w.endswith("ly") and len(w) > 4:
+        return w[:-2]
+    return w
+
+
 class TextTokenizer(UnaryTransformer):
-    """Text → TextList (reference TextTokenizer.scala:196)."""
+    """Text → TextList (reference TextTokenizer.scala:196). ``stemming``
+    applies the English Porter-style stemmer to every token (reference
+    Lucene analyzers stem per-language; non-English text passes through
+    mostly untouched since the rules key on English suffixes)."""
 
     def __init__(self, min_token_length: int = TransmogrifierDefaults.MinTokenLength,
-                 uid=None):
+                 stemming: bool = False, uid=None):
+        def fn(v):
+            toks = tokenize_text(v, min_token_length)
+            return [porter_stem(t) for t in toks] if stemming else toks
         super().__init__(
-            "tokenize",
-            transform_fn=lambda v: tokenize_text(v, min_token_length),
+            "tokenize", transform_fn=fn,
             output_type=TextList, input_type=Text, uid=uid)
         self.min_token_length = min_token_length
+        self.stemming = stemming
 
 
 def _hash_token(tok: str, num_hashes: int) -> int:

@@ -113,7 +113,8 @@ class OpValidator:
     parallel axes are mesh axes and XLA inserts the psum collectives."""
 
     def __init__(self, seed: int = 42, stratify: bool = False, mesh=None,
-                 max_eval_rows: "Optional[int]" = 131072):
+                 max_eval_rows: "Optional[int]" = 131072,
+                 exact_sweep_fits: bool = False):
         self.seed = seed
         self.stratify = stratify
         self.mesh = mesh
@@ -123,7 +124,15 @@ class OpValidator:
         #: evaluations always use full data. None = score every validation
         #: row (exact reference parity); the default trades ~1e-4 of AuROC
         #: estimator noise for an ~8x cut in sweep predict time at 1M+ rows.
+        #: Measured fidelity of the default vs the exact setting:
+        #: docs/benchmarks.md "Sweep fidelity".
         self.max_eval_rows = max_eval_rows
+        #: True = CV candidates fit through ``fit_batch`` (full precision /
+        #: full split-search sample) instead of ``sweep_fit_batch``'s
+        #: throughput approximations — exact reference semantics
+        #: (OpValidator.getSummary:270-312 full-data fits) at several times
+        #: the sweep cost
+        self.exact_sweep_fits = exact_sweep_fits
 
     # -- fold construction ---------------------------------------------------
     def make_splits(self, y: np.ndarray) -> np.ndarray:
@@ -278,7 +287,9 @@ class OpValidator:
                 tiled = {k: jax.device_put(v, NamedSharding(self.mesh,
                                                             P("model")))
                          for k, v in tiled.items()}
-            params = family.sweep_fit_batch(X, y, W, tiled, num_classes)
+            params = (family.fit_batch(X, y, W, tiled, num_classes)
+                      if self.exact_sweep_fits
+                      else family.sweep_fit_batch(X, y, W, tiled, num_classes))
             sliced = fold_sliced and getattr(family, "fold_sliced_predict",
                                              True)
             if sliced:

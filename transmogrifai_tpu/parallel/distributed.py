@@ -18,10 +18,13 @@ Typical pod usage (one process per host)::
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
 import jax
+
+logger = logging.getLogger(__name__)
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -35,8 +38,17 @@ def initialize(coordinator_address: Optional[str] = None,
     ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``. Idempotent: a second call in
     the same process is a no-op, and single-process runs (no coordinator
     discoverable) are left untouched."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    # already-initialized check WITHOUT touching jax.process_count(): that
+    # would initialize the XLA backend, after which jax.distributed refuses
+    # to start (it must run before any backend init). The probe reads a
+    # private jax module — guard it so a jax-internal rename degrades to
+    # "attempt init" instead of crashing every caller
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already initialized
+    except Exception:  # pragma: no cover - jax version drift
+        pass
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
@@ -44,12 +56,20 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
-        # TPU pod: fully env-discovered; plain single process: nothing to do
+        # TPU pod: fully env-discovered; plain single process: nothing to do.
+        # Failures here are LOGGED, not swallowed — a wedged pod bootstrap
+        # must be visible even though single-process fallback is legitimate
         try:
             jax.distributed.initialize()
-        except Exception:
-            pass
+        except Exception as e:  # pragma: no cover - env specific
+            logger.warning(
+                "jax.distributed auto-discovery failed (%s: %s); continuing "
+                "single-process. If this host is part of a pod, set "
+                "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
+                "JAX_PROCESS_ID explicitly.", type(e).__name__, e)
         return
+    # explicitly configured coordinator: fail loud — a typo'd address or a
+    # missing peer must never silently degrade a pod job to one host
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
