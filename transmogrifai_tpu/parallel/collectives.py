@@ -44,3 +44,62 @@ def host_gather(x) -> np.ndarray:
     """Fully replicate/gather a (small) device array back to the host — the
     analog of Spark ``collect()`` for summaries/vocabularies."""
     return np.asarray(jax.device_get(x))
+
+
+def ring_allreduce(x, axis_name: str = "data"):
+    """Bandwidth-optimal ring all-reduce built from ``ppermute`` hops.
+
+    The explicit form of what XLA's psum lowers to on an ICI ring (the
+    scaling-book recipe): reduce-scatter around the ring (N−1 hops, each
+    device accumulating one shard), then all-gather the reduced shards
+    (N−1 more hops). Shard-count = axis size; the leading axis of ``x``
+    must be divisible by it. Use inside ``shard_map``; prefer plain psum
+    unless you need to overlap the hops with compute — this exists so the
+    comm layer's semantics are testable against psum hop by hop.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    shards = jnp.reshape(x, (n,) + (x.shape[0] // n,) + x.shape[1:])
+    right = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter: after hop h, each device holds the running sum of
+    # shard (idx - h) from its h left neighbors
+    acc = shards
+    send = shards[(idx - 0) % n]
+    for h in range(1, n):
+        recv = jax.lax.ppermute(send, axis_name, right)
+        k = (idx - h) % n
+        summed = acc[k] + recv
+        acc = acc.at[k].set(summed)
+        send = summed
+    # device idx now owns the fully reduced shard (idx + 1) % n
+    own = (idx + 1) % n
+    # all-gather: circulate the reduced shards around the ring
+    out = acc
+    send = acc[own]
+    for h in range(1, n):
+        recv = jax.lax.ppermute(send, axis_name, right)
+        k = (own - h) % n
+        out = out.at[k].set(recv)
+        send = recv
+    return jnp.reshape(out, x.shape)
+
+
+def reduce_by_key(values, keys, num_keys: int, axis_name: str = "data"):
+    """Monoid ``reduceByKey`` over row-sharded data — the reference's
+    contingency/vocabulary pattern (SanityChecker.scala:433-440): each
+    device segment-sums its local rows by key, then one psum merges the
+    per-key partials across the mesh. values: (rows_local, ...) with
+    leading row axis; keys: (rows_local,) int32 in [0, num_keys)."""
+    local = jax.ops.segment_sum(values, keys, num_segments=num_keys)
+    return jax.lax.psum(local, axis_name)
+
+
+def broadcast_from_primary(x, axis_name: str = "data"):
+    """Value of ``x`` on device 0 of the axis, on every device — the analog
+    of a Spark driver broadcast (fitted vocab/thresholds out to workers)."""
+    idx = jax.lax.axis_index(axis_name)
+    zeroed = jnp.where(idx == 0, x, jnp.zeros_like(x))
+    return jax.lax.psum(zeroed, axis_name)
