@@ -23,9 +23,14 @@ class BinaryClassificationModelSelector:
     def with_cross_validation(num_folds: int = 3, seed: int = 42,
                               splitter: Optional[Splitter] = None,
                               models: Optional[Sequence[Tuple[Any, Optional[List[Dict]]]]] = None,
-                              evaluator=None, stratify: bool = False) -> ModelSelector:
+                              evaluator=None, stratify: bool = False,
+                              **validator_kw) -> ModelSelector:
+        # validator_kw passes through to OpCrossValidation — e.g.
+        # max_eval_rows=None, exact_sweep_fits=True for reference-exact
+        # sweep semantics (docs/benchmarks.md "Sweep fidelity")
         return _build("binary",
-                      OpCrossValidation(num_folds=num_folds, seed=seed, stratify=stratify),
+                      OpCrossValidation(num_folds=num_folds, seed=seed, stratify=stratify,
+                                        **validator_kw),
                       splitter if splitter is not None else DataBalancer(seed=seed),
                       models, evaluator)
 
@@ -33,10 +38,11 @@ class BinaryClassificationModelSelector:
     def with_train_validation_split(train_ratio: float = 0.75, seed: int = 42,
                                     splitter: Optional[Splitter] = None,
                                     models=None, evaluator=None,
-                                    stratify: bool = False) -> ModelSelector:
+                                    stratify: bool = False,
+                                    **validator_kw) -> ModelSelector:
         return _build("binary",
                       OpTrainValidationSplit(train_ratio=train_ratio, seed=seed,
-                                             stratify=stratify),
+                                             stratify=stratify, **validator_kw),
                       splitter if splitter is not None else DataBalancer(seed=seed),
                       models, evaluator)
 
@@ -49,9 +55,11 @@ class MultiClassificationModelSelector:
     def with_cross_validation(num_folds: int = 3, seed: int = 42,
                               splitter: Optional[Splitter] = None,
                               models=None, evaluator=None,
-                              stratify: bool = False) -> ModelSelector:
+                              stratify: bool = False,
+                              **validator_kw) -> ModelSelector:
         return _build("multiclass",
-                      OpCrossValidation(num_folds=num_folds, seed=seed, stratify=stratify),
+                      OpCrossValidation(num_folds=num_folds, seed=seed, stratify=stratify,
+                                        **validator_kw),
                       splitter if splitter is not None else DataCutter(seed=seed),
                       models, evaluator)
 
@@ -59,10 +67,11 @@ class MultiClassificationModelSelector:
     def with_train_validation_split(train_ratio: float = 0.75, seed: int = 42,
                                     splitter: Optional[Splitter] = None,
                                     models=None, evaluator=None,
-                                    stratify: bool = False) -> ModelSelector:
+                                    stratify: bool = False,
+                                    **validator_kw) -> ModelSelector:
         return _build("multiclass",
                       OpTrainValidationSplit(train_ratio=train_ratio, seed=seed,
-                                             stratify=stratify),
+                                             stratify=stratify, **validator_kw),
                       splitter if splitter is not None else DataCutter(seed=seed),
                       models, evaluator)
 
@@ -74,17 +83,21 @@ class RegressionModelSelector:
     @staticmethod
     def with_cross_validation(num_folds: int = 3, seed: int = 42,
                               splitter: Optional[Splitter] = None,
-                              models=None, evaluator=None) -> ModelSelector:
+                              models=None, evaluator=None,
+                              **validator_kw) -> ModelSelector:
         return _build("regression",
-                      OpCrossValidation(num_folds=num_folds, seed=seed),
+                      OpCrossValidation(num_folds=num_folds, seed=seed,
+                                        **validator_kw),
                       splitter if splitter is not None else DataSplitter(seed=seed),
                       models, evaluator)
 
     @staticmethod
     def with_train_validation_split(train_ratio: float = 0.75, seed: int = 42,
                                     splitter: Optional[Splitter] = None,
-                                    models=None, evaluator=None) -> ModelSelector:
+                                    models=None, evaluator=None,
+                                    **validator_kw) -> ModelSelector:
         return _build("regression",
-                      OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
+                      OpTrainValidationSplit(train_ratio=train_ratio, seed=seed,
+                                             **validator_kw),
                       splitter if splitter is not None else DataSplitter(seed=seed),
                       models, evaluator)

@@ -518,8 +518,11 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
     stats_s = stats[samp]
     L = 2 ** depth
     B = weights.shape[0]
+    # chunk budget covers BOTH the grower's bf16 (S, Tb·nodes) transients
+    # and the sweep leaf-stat path's f32 (S, k+1, Tb) A_cols tensor (f32
+    # counts double in the bf16-element budget)
     cb = max(1, min(B, _CFG_CHUNK_ELEMS
-                    // (S * n_trees * 2 ** (depth - 1))))
+                    // (S * n_trees * max(2 ** (depth - 1), 2 * (k + 1)))))
 
     def one_chunk(w_c, md, mi, mg, ss, seed):
         """Grow a chunk of cb configs — cb·n_trees trees — in one
@@ -562,22 +565,22 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
             depth=depth, n_bins=n_bins, mode=mode)
 
         if sweep:
-            # sample leaf stats per config: trees of config c share its
-            # fold weights, so one (k+1)-column histogram per config
-            leaves = []
-            for c in range(cb):
-                nc = node_s[:, c * n_trees:(c + 1) * n_trees]
-                aug = jnp.concatenate(
-                    [stats_s * w_s[c][:, None], w_s[c][:, None]], axis=1)
-                out = hist_matmul(nc, aug.astype(jnp.float32), L,
-                                  exact=True)
-                out = out.reshape(k + 1, n_trees, L).transpose(1, 2, 0)
-                ls, lw = out[..., :-1], out[..., -1]
-                leaves.append(
-                    jax.vmap(_class_leaf)(ls, lw)
-                    if task == "classification"
-                    else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
-            leaf_c = jnp.stack(leaves)                      # (cb, T, L, k')
+            # sample leaf stats for the WHOLE chunk in one blocked
+            # segment-sum: per-tree stat columns A[s, j, t] = stat_j(s) ·
+            # w_{config(t)}(s), reduced by _diag_leaf_hist's 64-tree blocks
+            # — replaces cb separate per-config histogram dispatches
+            # (~100ms/chunk of launch overhead at cb=20)
+            w_ts = jnp.repeat(w_s, n_trees, axis=0).T        # (S, Tb)
+            stats_aug = jnp.concatenate(
+                [stats_s, jnp.ones((S, 1), stats_s.dtype)], axis=1)
+            A_cols = stats_aug[:, :, None] * w_ts[:, None, :]  # (S, k+1, Tb)
+            sums = _diag_leaf_hist(node_s, A_cols.astype(jnp.float32), L)
+            sums = sums.transpose(1, 2, 0)                   # (Tb, L, k+1)
+            ls, lw = sums[..., :-1], sums[..., -1]
+            leaf_flat = (jax.vmap(_class_leaf)(ls, lw)
+                         if task == "classification"
+                         else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
+            leaf_c = leaf_flat.reshape((cb, n_trees) + leaf_flat.shape[1:])
         else:
             leaf_c = jnp.zeros(
                 (cb, n_trees, L, k if task == "classification" else 1),
