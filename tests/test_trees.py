@@ -182,3 +182,43 @@ def test_validator_sweep_with_trees():
     assert best.family_name == "OpRandomForestClassifier"
     assert best.metric_value > 0.8
     assert best.results[0].fold_metrics.shape == (2, 2)
+
+
+def test_grow_forest_leaf_stats_match_segment_sums():
+    """The sweep-time leaf stats read off the final level's histogram
+    (return_leaf_stats) equal the exact per-leaf segment sums over the
+    routed sample — pins the j-major cumsum/interleave layout (round 3)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.trees import (_diag_leaf_hist,
+                                                _grow_forest)
+
+    rng = np.random.RandomState(0)
+    S, d, Tb, depth, n_bins = 512, 6, 4, 3, 8
+    codes = jnp.asarray(rng.randint(0, n_bins, (S, d)), jnp.int32)
+    edges = jnp.asarray(np.sort(rng.randn(d, n_bins - 1), 1), jnp.float32)
+    # small integer-ish weights keep the bf16 histogram sums exact
+    sw = [jnp.asarray(rng.randint(0, 3, (S, Tb)), jnp.float32)
+          for _ in range(3)]
+    fmasks = jnp.ones((Tb, d), bool)
+    cfg = {"max_depth": jnp.full((Tb,), float(depth)),
+           "min_instances": jnp.full((Tb,), 1.0),
+           "min_info_gain": jnp.full((Tb,), 0.0),
+           "lam": jnp.full((Tb,), 1e-6),
+           "min_child_weight": jnp.zeros((Tb,))}
+    fs, ths, bhs, node_s, lst = _grow_forest(
+        codes, edges, sw, fmasks, cfg, depth=depth, n_bins=n_bins,
+        mode="gh", return_leaf_stats=True)
+    L = 2 ** depth
+    A_cols = jnp.stack(sw, axis=1)                  # (S, 3, Tb)
+    exact = _diag_leaf_hist(node_s, A_cols, L)      # (3, Tb, L)
+    np.testing.assert_allclose(np.asarray(lst),
+                               np.asarray(exact).transpose(1, 2, 0),
+                               atol=1e-3, rtol=1e-3)
+
+    # depth=0: root-leaf stats are the plain column sums
+    _, _, _, _, lst0 = _grow_forest(
+        codes, edges, sw, fmasks,
+        {k: v for k, v in cfg.items()}, depth=0, n_bins=n_bins,
+        mode="gh", return_leaf_stats=True)
+    want = np.stack([np.asarray(s).sum(0) for s in sw], -1)[:, None, :]
+    np.testing.assert_allclose(np.asarray(lst0), want, rtol=1e-5)

@@ -250,7 +250,7 @@ def _grow_tree(codes_s, edges, stats_s, w_s, feat_mask, cfg, *,
 
 
 def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
-                 n_bins: int, mode: str):
+                 n_bins: int, mode: str, return_leaf_stats: bool = False):
     """Grow Tb complete-heap trees AT ONCE on the split-search sample.
 
     The tree batch (configs × trees) lives flattened in the lane axis from
@@ -264,7 +264,11 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     per-tree stat·rowweight products, one array per stat so no tiny-minor
     array ever exists; fmasks: (Tb, d) feature subsets; cfg: dict of (Tb,)
     per-tree scalars. Returns (feat (Tb,H), thresh (Tb,H), bins (Tb,H),
-    node_s (S, Tb))."""
+    node_s (S, Tb)); with ``return_leaf_stats`` also a (Tb, 2^depth, k)
+    per-leaf stat-sum tensor read off the FINAL level's histogram — the
+    chosen split's left cumsum is the left child's total and the right
+    child is the node total minus it, so sweep-time leaf values cost no
+    extra histogram pass (stopped nodes route everything left)."""
     S, d = codes_s.shape
     Tb = sw_list[0].shape[1]
     k = len(sw_list)
@@ -276,6 +280,10 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     node = jnp.zeros((S, Tb), jnp.int32)
     sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
     hist_prev = None
+    # depth 0: one root leaf per tree, stats are the plain column sums
+    leaf_stats = jnp.stack(
+        [s.sum(axis=0, dtype=jnp.float32) for s in sw_list],
+        axis=-1)[:, None, :]                                # (Tb, 1, k)
     for level in range(depth):
         m = 2 ** level
         M = Tb * m
@@ -351,6 +359,21 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         n_oh = (node[:, None, :] == j_all).astype(jnp.bfloat16)   # (S, m, Tb)
         go = (go_lane.reshape(S, m, Tb) * n_oh).sum(axis=1)       # (S, Tb)
         node = 2 * node + (go > jnp.bfloat16(0.5)).astype(jnp.int32)
+        if return_leaf_stats and level == depth - 1:
+            # leaf stats off this level's histogram: left child = chosen
+            # split's left cumsum (node total when stopped), right = rest
+            k_st = hist.shape[-1]
+            SL_flat = SL.reshape(M, d * (n_bins - 1), k_st)
+            left = jnp.take_along_axis(
+                SL_flat, best[:, None, None], axis=1)[:, 0]       # (M, k)
+            left = jnp.where(do_split[:, None], left, total)
+            right = total - left
+            # j-major rows (j·Tb + t) → (Tb, L=2m, k), leaf id = 2j + parity
+            leaf_stats = jnp.stack(
+                [left.reshape(m, Tb, k_st), right.reshape(m, Tb, k_st)],
+                axis=1).transpose(2, 0, 1, 3).reshape(Tb, 2 * m, k_st)
+    if return_leaf_stats:
+        return feat_heap, thr_heap, bin_heap, node, leaf_stats
     return feat_heap, thr_heap, bin_heap, node
 
 
@@ -676,15 +699,27 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
         g_tb = g.reshape(Tb, S).T                           # (S, Tb)
         h_tb = h.reshape(Tb, S).T
         sw_list = [(g_tb * w_tb), (h_tb * w_tb), w_tb]
-        fs, ths, bhs, node_s = _grow_forest(
-            binned_s, edges, sw_list, fmasks, cfg,
-            depth=depth, n_bins=n_bins, mode="gh")
-        # Newton leaves from per-tree G/H segment sums (f32 exact), both
-        # stats reduced in one histogram call
-        gh = _diag_leaf_hist(
-            node_s, jnp.stack([g_tb * w_tb, h_tb * w_tb], axis=1
-                              ).astype(jnp.float32), L)     # (2, Tb, L)
-        leaf = -gh[0] / (gh[1] + lam_t[:, None] + 1e-12)    # (Tb, L)
+        if sweep:
+            # CV candidates take Newton leaves straight off the final
+            # level's histogram (bf16-summed, free); the refit winner
+            # (sweep=False) keeps the exact f32 segment-sum below since
+            # its leaves are SERVED predictions
+            fs, ths, bhs, node_s, lst = _grow_forest(
+                binned_s, edges, sw_list, fmasks, cfg,
+                depth=depth, n_bins=n_bins, mode="gh",
+                return_leaf_stats=True)
+            leaf = -lst[..., 0] / (lst[..., 1]
+                                   + lam_t[:, None] + 1e-12)  # (Tb, L)
+        else:
+            fs, ths, bhs, node_s = _grow_forest(
+                binned_s, edges, sw_list, fmasks, cfg,
+                depth=depth, n_bins=n_bins, mode="gh")
+            # Newton leaves from per-tree G/H segment sums (f32 exact),
+            # both stats reduced in one histogram call
+            gh = _diag_leaf_hist(
+                node_s, jnp.stack([g_tb * w_tb, h_tb * w_tb], axis=1
+                                  ).astype(jnp.float32), L)  # (2, Tb, L)
+            leaf = -gh[0] / (gh[1] + lam_t[:, None] + 1e-12)  # (Tb, L)
         # per-row leaf values via one-hot einsum — a (Tb, S) take_along_axis
         # gather measures ~3x slower on TPU; HIGHEST keeps the Newton values
         # exact in the boosting state. Chunk the tree axis so the (S, tb, L)
