@@ -275,3 +275,18 @@ def test_sweep_fidelity_ranking_agreement():
     assert rho > 0.85, rho
     # the sampled winner is within noise of the exact winner's metric
     assert abs(b_def.metric_value - b_ex.metric_value) < 0.02
+
+
+def test_factories_forward_validator_kwargs():
+    """Every selector factory forwards validator kwargs so the exact sweep
+    is reachable without hand-building validators."""
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector, MultiClassificationModelSelector,
+        RegressionModelSelector)
+    for fac in (BinaryClassificationModelSelector,
+                MultiClassificationModelSelector, RegressionModelSelector):
+        for ctor in (fac.with_cross_validation,
+                     fac.with_train_validation_split):
+            sel = ctor(max_eval_rows=None, exact_sweep_fits=True)
+            assert sel.validator.max_eval_rows is None
+            assert sel.validator.exact_sweep_fits is True
