@@ -1,0 +1,111 @@
+"""Regression tests for the round-4 advisor/review fixes: scale-exact
+dead-column detection, GBT sweep leaf noise clamp, date-list width locking,
+fused-path mask propagation, and the public distributed-init probe."""
+import inspect
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.models.linear import _standardize, _BatchStd
+
+
+def test_standardize_keeps_tiny_scale_and_huge_offset_columns():
+    """ADVICE r3: a genuinely informative column with natural scale 1e-4
+    (var 1e-8) or a huge-offset epoch-millis column (var/ex2 ~ 1e-10) must
+    NOT be treated as constant; an exactly-constant column must."""
+    rng = np.random.default_rng(0)
+    n = 256
+    tiny = (rng.standard_normal(n) * 1e-4).astype(np.float32)
+    epoch = (1.7e12 + rng.standard_normal(n) * 2.5e7).astype(np.float32)
+    const = np.full(n, 3.25, np.float32)
+    X = jnp.asarray(np.stack([tiny, epoch, const], 1))
+    w = jnp.ones(n)
+    _, _, scale = _standardize(X, w)
+    scale = np.asarray(scale)
+    assert scale[0] < 1e3          # tiny-scale column alive
+    assert scale[1] < 1e9          # epoch column alive
+    assert scale[2] >= 1e29        # constant column dead
+
+
+def test_standardize_range_test_respects_weights():
+    # column varies globally but is constant within the weighted rows
+    X = jnp.asarray(np.array([[1.0], [1.0], [9.0]], np.float32))
+    w = jnp.asarray(np.array([1.0, 1.0, 0.0]))
+    _, _, scale = _standardize(X, w)
+    assert float(scale[0]) >= 1e29
+
+
+def test_batchstd_relative_dead_guard():
+    """Within-config constant columns get the huge scale; varying ones keep a
+    finite scale even at small magnitudes."""
+    rng = np.random.default_rng(1)
+    n = 128
+    X = jnp.asarray(np.stack([
+        rng.standard_normal(n),
+        np.where(np.arange(n) < 64, 1.0, 0.0),     # constant in config 1
+    ], 1).astype(np.float32))
+    W = jnp.asarray(np.stack([
+        np.ones(n),                                # config 0: all rows
+        (np.arange(n) < 64).astype(np.float64),    # config 1: first half
+    ]))
+    bs = _BatchStd(X, W)
+    scale = np.asarray(bs.scale)
+    assert scale[0, 1] < 1e3                       # varies under config 0
+    assert scale[1, 1] >= 1e29                     # constant under config 1
+    assert scale[1, 0] < 1e3
+
+
+def test_time_period_list_row_path_locks_width():
+    """ADVICE r3: the row-wise path must emit a fixed width even before any
+    columnar batch, and numpy-array rows must not break the columnar lock."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.feature.dates import TimePeriodListTransformer
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import DateList
+
+    t = TimePeriodListTransformer(period="DayOfWeek")
+    t.set_input(FeatureBuilder.DateList("d").extract_field().as_predictor())
+    r1 = t.transform_fn([1577836800000, 1577923200000])
+    assert len(r1) == 2 and t.width == 2
+    r2 = t.transform_fn([1577836800000])
+    assert len(r2) == 2 and r2[1] == -1.0
+
+    # columnar lock from numpy-array rows (truthiness of arrays is ambiguous)
+    t2 = TimePeriodListTransformer(period="DayOfWeek")
+    t2.set_input(FeatureBuilder.DateList("d").extract_field().as_predictor())
+    col = Column.of_values(
+        DateList, [np.array([1577836800000, 1577923200000, 1578009600000]),
+                   None])
+    out = t2.transform_column(FeatureTable({"d": col}, 2))
+    assert np.asarray(out.values).shape == (2, 3)
+    assert t2.width == 3
+
+
+def test_distributed_module_has_no_private_jax_imports():
+    import transmogrifai_tpu.parallel.distributed as dmod
+    src = inspect.getsource(dmod)
+    assert "jax._src" not in src
+    # idempotent in-process
+    dmod.initialize()
+    dmod.initialize()
+
+
+def test_gbt_sweep_leaf_clamp_keeps_small_parents():
+    """The sweep-leaf noise clamp is parent-relative: H=1 under a parent of
+    H=30 (min_child_weight territory) survives; H below bf16 noise of a huge
+    parent is zeroed. Reproduces the clamp arithmetic on the (Tb, L) layout
+    used in models/trees.py round_step."""
+    lam = 0.1
+    h_leaf = jnp.asarray(np.array([[1.0, 29.0, 0.5, 1000.0]], np.float32))
+    g_leaf = jnp.asarray(np.array([[-0.5, 3.0, 2.0, -10.0]], np.float32))
+    L_ = 4
+    h_sib = h_leaf.reshape(-1, L_ // 2, 2)[..., ::-1].reshape(h_leaf.shape)
+    h_parent = h_leaf + h_sib
+    raw = -g_leaf / (h_leaf + lam + 1e-12)
+    leaf = np.asarray(jnp.where(h_leaf < 2 ** -8 * h_parent,
+                                jnp.zeros_like(raw), raw))
+    assert leaf[0, 0] != 0.0       # H=1 under parent 30: alive
+    assert leaf[0, 1] != 0.0
+    assert leaf[0, 2] == 0.0       # H=0.5 under parent 1000.5: noise, zeroed
+    assert leaf[0, 3] != 0.0

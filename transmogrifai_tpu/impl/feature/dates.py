@@ -10,6 +10,7 @@ blocks for the device.
 """
 from __future__ import annotations
 
+import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -98,8 +99,14 @@ class TimePeriodListTransformer(UnaryTransformer):
     (pad value -1, never a real period value). With ``width=None`` the
     width is locked by the FIRST batch transformed — its longest list, or 1
     if it is all-empty — and reused for every later batch, so every batch
-    emits the same column width. Pass an explicit ``width`` in production
-    pipelines where the first batch may not be representative."""
+    emits the same column width; row-wise serving locks from the first ROW
+    instead (thread-safe via a class lock). Pass an explicit ``width`` in
+    production pipelines where the first batch/row may not be
+    representative."""
+
+    #: class-level (hence never serialized) lock guarding the width lock-in
+    #: under concurrent serving threads
+    _WIDTH_LOCK = threading.Lock()
 
     def __init__(self, period: str = "DayOfWeek",
                  width: Optional[int] = None, uid=None):
@@ -108,24 +115,37 @@ class TimePeriodListTransformer(UnaryTransformer):
                 return None
             arr = np.asarray(list(v), dtype=np.int64)
             vals = [float(x) for x in time_period_values(arr, period)]
-            if self.width is not None:
-                vals = (vals + [-1.0] * self.width)[:self.width]
-            return vals
+            # row path locks the width too (first row seen), so row-wise
+            # serving before any columnar batch still emits a fixed width
+            width = self._lock_width(len(vals))
+            return (vals + [-1.0] * width)[:width]
         super().__init__(f"dateListToTimePeriod{period}", transform_fn=fn,
                          output_type=OPVector, input_type=DateList, uid=uid)
         self.period = period
         self.width = width
 
+    def _lock_width(self, observed: int) -> int:
+        if self.width is None:
+            with self._WIDTH_LOCK:
+                if self.width is None:
+                    self.width = max(int(observed), 1)
+        return self.width
+
     def transform_column(self, table: FeatureTable) -> Column:
         col = table[self.input_features[0].name]
         valid = col.valid_mask()
-        rows = [self.transform_fn(col.values[i]) if valid[i] else None
-                for i in range(len(col))]
         if self.width is None:
             # lock on first use — even a degenerate all-empty batch, because
             # that batch's (n, 1) output is already emitted and later batches
-            # must match it (explicit width exists for that case)
-            self.width = max((len(r) for r in rows if r), default=1)
+            # must match it (explicit width exists for that case). Lock from
+            # the raw list lengths BEFORE running transform_fn (which itself
+            # pads to the locked width)
+            lens = [len(col.values[i])
+                    if valid[i] and col.values[i] is not None else 0
+                    for i in range(len(col))]
+            self._lock_width(max(lens, default=1))
+        rows = [self.transform_fn(col.values[i]) if valid[i] else None
+                for i in range(len(col))]
         width = self.width
         mat = np.full((len(rows), width), -1.0, np.float32)
         for i, r in enumerate(rows):

@@ -77,15 +77,22 @@ def compiled_score_function(model):
     while True:
         fused_out = {s.get_output().name for s in stages
                      if id(s) in fused_set}
-        # host stages transitively downstream of a fused output
+        # host stages transitively downstream of a fused output — iterated
+        # to a fixpoint so correctness does not depend on model.stages being
+        # topologically ordered (a single forward pass would mis-place a
+        # fused-output consumer appearing before its producer in the list)
         tainted_stages: set = set()
         downstream = set(fused_out)
-        for s in stages:
-            if id(s) in fused_set:
-                continue
-            if any(f.name in downstream for f in s.input_features):
-                tainted_stages.add(id(s))
-                downstream.add(s.get_output().name)
+        changed = True
+        while changed:
+            changed = False
+            for s in stages:
+                if id(s) in fused_set or id(s) in tainted_stages:
+                    continue
+                if any(f.name in downstream for f in s.input_features):
+                    tainted_stages.add(id(s))
+                    downstream.add(s.get_output().name)
+                    changed = True
         demote = [s for s in stages if id(s) in fused_set
                   and any(nm in downstream - fused_out
                           for nm in _inputs(s))]
@@ -122,7 +129,7 @@ def compiled_score_function(model):
                zip(in_names, vals_list, mask_list)}
         for s in fused:
             env[s.get_output().name] = s.device_columnar(env)
-        return tuple(env[nm][0] for nm in out_names)
+        return tuple((env[nm][0], env[nm][1]) for nm in out_names)
 
     # metadata for fused outputs is data-independent; captured lazily from
     # one plain stage-by-stage pass on the first batch
@@ -158,9 +165,15 @@ def compiled_score_function(model):
             mask_list.append(None if m is None else jnp.asarray(m))
         outs = chain(tuple(vals_list), tuple(mask_list))
         new_cols = dict(tbl._columns)
-        for nm, arr in zip(out_names, outs):
+        for nm, (arr, msk) in zip(out_names, outs):
+            # keep the validity mask the stage-by-stage path would have
+            # propagated (sliced back to the unpadded row count)
+            msk_np = None if msk is None else np.asarray(msk)[:n]
+            if msk_np is not None and msk_np.all():
+                msk_np = None
             new_cols[nm] = Column(
-                OPVectorType, arr[:n], None, dict(meta_cache.get(nm, {})))
+                OPVectorType, arr[:n], msk_np,
+                dict(meta_cache.get(nm, {})))
         tbl = FeatureTable(new_cols, n, key=tbl.key)
         for s in tail_host:
             tbl = s.transform(tbl)

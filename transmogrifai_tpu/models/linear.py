@@ -37,7 +37,16 @@ def _standardize(X: jnp.ndarray, w: jnp.ndarray):
     cnt = jnp.maximum(w.sum(), 1.0)
     mean = (X * w[:, None]).sum(0) / cnt
     var = ((X - mean) ** 2 * w[:, None]).sum(0) / cnt
-    scale = jnp.where(var < 1e-8, 1e30, jnp.sqrt(jnp.maximum(var, 1e-12)))
+    # dead = EXACTLY constant within the weighted rows (weighted range 0) —
+    # matches Spark zeroing only zero-variance columns. An informative column
+    # whose natural scale is tiny (std 1e-4 → var 1e-8) or whose offset is
+    # huge (epoch-millis: var/ex2 ~ 1e-10) must NOT be pinned to 0, so no
+    # variance threshold can be used here; the range test is exact
+    active = w[:, None] > 0
+    hi = jnp.where(active, X, -jnp.inf).max(0)
+    lo = jnp.where(active, X, jnp.inf).min(0)
+    dead = hi <= lo
+    scale = jnp.where(dead, 1e30, jnp.sqrt(jnp.maximum(var, 1e-30)))
     return (X - mean) / scale, mean, scale
 
 
@@ -81,13 +90,17 @@ class _BatchStd:
         self.cnt = jnp.maximum(W.sum(axis=1), 1.0)           # (B,)
         mean = (self.Wt.T @ self.Xg) / self.cnt[:, None]     # (B, d)
         ex2 = (self.Wt.T @ (self.Xg * self.Xg)) / self.cnt[:, None]
-        self.var = jnp.maximum(ex2 - mean ** 2, 1e-12)
+        var_raw = ex2 - mean ** 2
+        self.var = jnp.maximum(var_raw, 1e-12)
         # a column that is CONSTANT within a config's weighted rows (e.g. a
         # rare one-hot slot whose nonzero rows all fell in the val fold) has
         # var ≈ rounding noise; 1/sqrt(var) then blows the solve up to NaN.
         # Give dead columns a huge scale instead: Xs ≈ 0, gradient 0, coef
-        # stays 0 — Spark's zero-variance standardization semantics.
-        dead = self.var < 1e-8
+        # stays 0 — Spark's zero-variance standardization semantics. The
+        # test is RELATIVE to ex2 (one-pass cancellation noise is eps·ex2,
+        # eps≈6e-8 f32) so a genuinely tiny-but-varying column stays alive;
+        # the absolute floor catches columns constant at ≈0 within the config
+        dead = var_raw < jnp.maximum(1e-6 * ex2, 1e-10)
         self.mean = mean
         self.scale = jnp.where(dead, 1e30, jnp.sqrt(self.var))  # (B, d)
 

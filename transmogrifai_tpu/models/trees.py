@@ -708,8 +708,26 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
                 binned_s, edges, sw_list, fmasks, cfg,
                 depth=depth, n_bins=n_bins, mode="gh",
                 return_leaf_stats=True)
-            leaf = -lst[..., 0] / (lst[..., 1]
-                                   + lam_t[:, None] + 1e-12)  # (Tb, L)
+            # bf16 sibling-subtracted histograms leave cancellation noise in
+            # near-empty leaves' H; with small lam -G/H can then be huge and
+            # wrong-signed, polluting later boosting rounds. The subtraction
+            # error is ~eps_bf16·(parent H), so zero a leaf only when its H
+            # is below that PARENT-relative floor (parent = leaf + heap
+            # sibling) — a legitimately small leaf under a small parent
+            # (min_child_weight territory) stays alive, unlike a
+            # root-relative cutoff which would override the grid's
+            # minChildWeight for deep trees
+            h_leaf = lst[..., 1]                              # (Tb, L)
+            L_ = h_leaf.shape[-1]
+            if L_ >= 2:
+                h_sib = h_leaf.reshape(-1, L_ // 2, 2)[..., ::-1].reshape(
+                    h_leaf.shape)
+                h_parent = h_leaf + h_sib
+            else:
+                h_parent = h_leaf
+            raw = -lst[..., 0] / (h_leaf + lam_t[:, None] + 1e-12)
+            leaf = jnp.where(h_leaf < 2 ** -8 * h_parent,
+                             jnp.zeros_like(raw), raw)        # (Tb, L)
         else:
             fs, ths, bhs, node_s = _grow_forest(
                 binned_s, edges, sw_list, fmasks, cfg,

@@ -40,15 +40,11 @@ def initialize(coordinator_address: Optional[str] = None,
     discoverable) are left untouched."""
     # already-initialized check WITHOUT touching jax.process_count(): that
     # would initialize the XLA backend, after which jax.distributed refuses
-    # to start (it must run before any backend init). The probe reads a
-    # private jax module — guard it so a jax-internal rename degrades to
-    # "attempt init" instead of crashing every caller
-    try:
-        from jax._src import distributed as _dist
-        if getattr(_dist.global_state, "client", None) is not None:
-            return  # already initialized
-    except Exception:  # pragma: no cover - jax version drift
-        pass
+    # to start (it must run before any backend init). jax>=0.4.34 exposes a
+    # public probe; fall back to attempting init on older versions
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return  # already initialized
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
@@ -69,10 +65,22 @@ def initialize(coordinator_address: Optional[str] = None,
                 "JAX_PROCESS_ID explicitly.", type(e).__name__, e)
         return
     # explicitly configured coordinator: fail loud — a typo'd address or a
-    # missing peer must never silently degrade a pod job to one host
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    # missing peer must never silently degrade a pod job to one host. The
+    # one exception keeps initialize() idempotent on jax versions without
+    # is_initialized(): a repeat call surfaces as jax's own
+    # "already initialized" RuntimeError, which is a successful no-op here
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:  # pragma: no cover - jax version drift
+        # jax's double-init message: "distributed.initialize should only be
+        # called once."; older variants say "already initialized"
+        msg = str(e).lower()
+        if is_init is None and ("only be called once" in msg
+                                or "already initialized" in msg):
+            return
+        raise
 
 
 def is_primary() -> bool:
