@@ -1,0 +1,241 @@
+"""Slot-chain ("leaf budget") deep trees: the depth-12 path of the default
+grids (reference DefaultSelectorParams.scala:37 sweeps maxDepth {3, 6, 12};
+a complete heap caps out near depth 8, so deeper trees grow level-wise with
+a gain-ranked frontier of n_slots leaves — VERDICT r3 missing #1)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+from transmogrifai_tpu.models import trees as T
+from transmogrifai_tpu.ops.forest import (
+    forest_predict_chain, forest_leaf_sums_chain, route_codes_chain_xla,
+    route_codes_xla,
+)
+
+
+def _binary_data(n=600, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0.5)).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _acc(scores, y):
+    return ((np.asarray(scores) > 0.5).astype(int)
+            == np.asarray(y)).mean()
+
+
+def _fit(fam_name, grid, X, y, num_classes=2, sweep=False):
+    fam = MODEL_REGISTRY[fam_name]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((len(grid), X.shape[0]), jnp.float32)
+    return fam, fam.fit_batch(X, y, w, garr, num_classes, sweep=sweep)
+
+
+# ---------------------------------------------------------------------------
+# Chain layout is an exact re-expression of complete heaps
+# ---------------------------------------------------------------------------
+
+def test_heap_embedding_routes_identically():
+    """A depth-3 heap converted via _heap_to_chain at depth 12 must route
+    every row to the same leaf id the heap descent computes."""
+    rng = np.random.RandomState(3)
+    n_bins, d, Tn, dh = 32, 6, 4, 3
+    codes = jnp.asarray(rng.randint(0, n_bins, size=(300, d), dtype=np.int32))
+    H = 2 ** dh - 1
+    feat = jnp.asarray(rng.randint(0, d, size=(Tn, H), dtype=np.int32))
+    bins = jnp.asarray(rng.randint(0, n_bins - 1, size=(Tn, H),
+                                   dtype=np.int32))
+    # stop some nodes (sentinel) to exercise the route-left semantics
+    bins = bins.at[:, 4].set(n_bins)
+    leaf = jnp.asarray(rng.randn(Tn, 2 ** dh, 2).astype(np.float32))
+    params = {"feat": feat, "bins": bins,
+              "thresh": jnp.zeros((Tn, H), jnp.float32), "leaf": leaf}
+    chain = T._heap_to_chain(params, dh, 12, 64, n_bins, leaf_axis=-2)
+    node_heap = np.asarray(route_codes_xla(codes, feat, bins, dh, n_bins))
+    node_chain = np.asarray(route_codes_chain_xla(
+        codes, chain["feat_lv"], chain["bins_lv"], chain["base_lv"], n_bins))
+    np.testing.assert_array_equal(node_heap, node_chain)
+    # and the chain predict returns exactly the heap-selected leaf values
+    pred = np.asarray(forest_predict_chain(
+        codes, chain["feat_lv"], chain["bins_lv"], chain["base_lv"],
+        chain["leaf"], n_bins=n_bins))
+    expect = np.asarray(leaf)[np.arange(Tn)[None, :], node_heap].sum(1)
+    np.testing.assert_allclose(pred, expect, rtol=1e-6)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_chain_kernels_match_xla(use_pallas, monkeypatch):
+    """Pallas chain descent (interpret mode on CPU) == the XLA fallback, for
+    predict and leaf sums."""
+    monkeypatch.setenv("TG_TREE_PALLAS", "1" if use_pallas else "0")
+    jax.clear_caches()
+    rng = np.random.RandomState(7)
+    n_bins, d, Tn, depth, W = 16, 5, 3, 10, 32
+    codes = jnp.asarray(rng.randint(0, n_bins, size=(257, d), dtype=np.int32))
+    # random but CONSISTENT chain: base pointers within next level's width
+    feat = jnp.asarray(rng.randint(0, d, size=(Tn, depth, W), dtype=np.int32))
+    bins_ = rng.randint(0, n_bins - 1, size=(Tn, depth, W)).astype(np.int32)
+    base = np.zeros((Tn, depth, W), np.int32)
+    for lv in range(depth):
+        Wl = min(2 ** lv, W)
+        Wn = min(2 ** (lv + 1), W)
+        base[:, lv, :Wl] = rng.randint(0, max(Wn - 1, 1), size=(Tn, Wl))
+        # make some slots leaves (sentinel bin)
+        stop = rng.rand(Tn, Wl) < 0.3
+        bins_[:, lv, :Wl] = np.where(stop, n_bins, bins_[:, lv, :Wl])
+    bins_ = jnp.asarray(bins_)
+    base = jnp.asarray(base)
+    W_out = min(2 ** depth, W)
+    leaf = jnp.asarray(rng.randn(Tn, W_out, 3).astype(np.float32))
+    aug = jnp.asarray(rng.randn(257, 3).astype(np.float32))
+    pred = np.asarray(forest_predict_chain(codes, feat, bins_, base, leaf,
+                                           n_bins=n_bins))
+    sums = np.asarray(forest_leaf_sums_chain(codes, feat, bins_, base, aug,
+                                             n_bins=n_bins))
+    # ground truth by per-row python descent
+    cn = np.asarray(codes)
+    fn_, bn, an = np.asarray(feat), np.asarray(bins_), np.asarray(base)
+    slots = np.zeros((257, Tn), np.int64)
+    for lv in range(depth):
+        for t in range(Tn):
+            s = slots[:, t]
+            go = cn[np.arange(257), fn_[t, lv, s]] > bn[t, lv, s]
+            slots[:, t] = an[t, lv, s] + go
+    expect_pred = np.asarray(leaf)[np.arange(Tn)[None, :], slots].sum(1)
+    np.testing.assert_allclose(pred, expect_pred, rtol=1e-5, atol=1e-5)
+    expect_sums = np.zeros((Tn, W_out, 3), np.float32)
+    for t in range(Tn):
+        np.add.at(expect_sums[t], slots[:, t], np.asarray(aug))
+    np.testing.assert_allclose(sums, expect_sums, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Capped grower
+# ---------------------------------------------------------------------------
+
+def test_capped_grower_matches_heap_when_uncapped():
+    """With n_slots ≥ 2^depth the cap never binds: the capped grower must
+    find the same trees (checked via predictions) as the heap grower."""
+    X, y = _binary_data()
+    fam = MODEL_REGISTRY["OpDecisionTreeClassifier"]
+    grid = [{"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001}]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((1, X.shape[0]), jnp.float32)
+    p_heap = T._fit_dt_batch(
+        X, y, w, garr["maxDepth"], garr["minInstancesPerNode"],
+        garr["minInfoGain"], depth=3, n_bins=T.N_BINS, num_classes=2,
+        task="classification")
+    p_chain = T._fit_dt_batch(
+        X, y, w, garr["maxDepth"], garr["minInstancesPerNode"],
+        garr["minInfoGain"], depth=3, n_bins=T.N_BINS, num_classes=2,
+        task="classification", n_slots=8)
+    s_heap = fam.predict_batch(p_heap, X, 2)
+    s_chain = fam.predict_batch(p_chain, X, 2)
+    np.testing.assert_allclose(np.asarray(s_heap), np.asarray(s_chain),
+                               atol=1e-5)
+
+
+def test_leaf_budget_caps_leaf_count():
+    """depth 12 with a tiny budget: the final sample slots stay within the
+    budget and the tree still learns."""
+    X, y = _binary_data(n=800)
+    fam = MODEL_REGISTRY["OpDecisionTreeClassifier"]
+    grid = [{"maxDepth": 12, "minInstancesPerNode": 2, "minInfoGain": 1e-4}]
+    garr = fam.grid_to_arrays(grid)
+    w = jnp.ones((1, X.shape[0]), jnp.float32)
+    params = fam.fit_batch(X, y, w, garr, 2)
+    assert "base_lv" in params
+    assert params["feat_lv"].shape[-2:] == (12, T._REFIT_SLOTS)
+    scores = fam.predict_batch(params, X, 2)
+    assert _acc(scores[0], y) > 0.9
+
+
+@pytest.mark.parametrize("fam_name,extra", [
+    ("OpDecisionTreeClassifier", {}),
+    ("OpRandomForestClassifier", {"numTrees": 10, "subsamplingRate": 1.0}),
+    ("OpGBTClassifier", {"maxIter": 10, "stepSize": 0.3}),
+])
+def test_depth12_learns_binary(fam_name, extra):
+    X, y = _binary_data()
+    grid = [{"maxDepth": 12, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+             **extra}]
+    fam, params = _fit(fam_name, grid, X, y)
+    scores = fam.predict_batch(params, X, 2)
+    acc = _acc(scores[0], y)
+    assert acc > 0.9, f"{fam_name} depth-12 accuracy {acc}"
+
+
+@pytest.mark.parametrize("fam_name,extra,leaf_axis", [
+    ("OpDecisionTreeClassifier", {}, -2),
+    ("OpRandomForestClassifier", {"numTrees": 8, "subsamplingRate": 1.0}, -2),
+    ("OpGBTClassifier", {"maxIter": 6, "stepSize": 0.3}, -1),
+])
+def test_mixed_depth_grid_stitches_exactly(fam_name, extra, leaf_axis):
+    """In a (3, 12) grid the shallow config rides the heap grower and is
+    converted to the chain layout — its predictions must match a pure
+    shallow fit."""
+    X, y = _binary_data()
+    shallow = {"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+               **extra}
+    deep = dict(shallow, maxDepth=12)
+    fam, p_mixed = _fit(fam_name, [shallow, deep], X, y)
+    assert "base_lv" in p_mixed
+    _, p_shallow = _fit(fam_name, [shallow], X, y)
+    s_mixed = np.asarray(fam.predict_batch(p_mixed, X, 2))
+    s_shallow = np.asarray(fam.predict_batch(p_shallow, X, 2))
+    np.testing.assert_allclose(s_mixed[0], s_shallow[0], atol=2e-4)
+    # the deep config learns at least as well as chance
+    assert _acc(s_mixed[1], y) > 0.85
+
+
+def test_sweep_mode_deep_trees():
+    """sweep=True deep fits use the sweep leaf budget and score validation
+    rows sanely (validator contract)."""
+    X, y = _binary_data()
+    grid = [{"maxDepth": 12, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+             "numTrees": 8, "subsamplingRate": 1.0}]
+    fam, params = _fit("OpRandomForestClassifier", grid, X, y, sweep=True)
+    assert params["feat_lv"].shape[-1] == T._SWEEP_SLOTS
+    scores = fam.predict_batch(params, X, 2)
+    assert _acc(scores[0], y) > 0.85
+
+
+def test_depth8_mixes_with_deep():
+    """A heap bucket at depth 7-8 has more leaves than the sweep budget;
+    the shared chain width must grow to hold it (review r4 finding)."""
+    X, y = _binary_data(n=300)
+    grid = [{"maxDepth": 8, "minInstancesPerNode": 5, "minInfoGain": 0.001},
+            {"maxDepth": 12, "minInstancesPerNode": 5, "minInfoGain": 0.001}]
+    fam, params = _fit("OpDecisionTreeClassifier", grid, X, y, sweep=True)
+    assert params["feat_lv"].shape[-1] >= 256
+    scores = fam.predict_batch(params, X, 2)
+    assert scores.shape == (2, X.shape[0])
+
+
+def test_chain_feature_importances():
+    """Deep (slot-chain) winners still surface split-frequency importances,
+    and sentinel entries do not count toward feature 0."""
+    from transmogrifai_tpu.models.api import FittedParams
+    X, y = _binary_data()
+    grid = [{"maxDepth": 12, "minInstancesPerNode": 5, "minInfoGain": 0.01}]
+    fam, params = _fit("OpDecisionTreeClassifier", grid, X, y)
+    one = fam.select_params(params, 0)
+    fitted = FittedParams(family=fam.name, params=one, hyper=grid[0],
+                          num_classes=2)
+    imp = fam.feature_importances(fitted)
+    assert imp is not None and imp.sum() > 0
+    # features 0/1 carry the signal; sentinel slots must not drown them
+    assert imp[0] + imp[1] > 0.5, imp
+
+
+def test_default_grids_include_depth12():
+    """Default tree grids match the reference's maxDepth {3, 6, 12}
+    (DefaultSelectorParams.scala:37)."""
+    for name in ("OpDecisionTreeClassifier", "OpRandomForestClassifier",
+                 "OpGBTClassifier"):
+        fam = MODEL_REGISTRY[name]
+        depths = sorted({g["maxDepth"] for g in fam.default_grid("binary")})
+        assert depths == [3, 6, 12], (name, depths)

@@ -84,8 +84,16 @@ class ModelFamily(abc.ABC):
                 return np.abs(np.asarray(p["coef"])).reshape(-1)
             if "W" in p:
                 return np.abs(np.asarray(p["W"])).mean(axis=-1).reshape(-1)
-            if "feat" in p:  # tree ensembles: how often each feature splits
-                feats = np.asarray(p["feat"]).reshape(-1).astype(np.int64)
+            if "feat" in p or "feat_lv" in p:
+                # tree ensembles (heap or slot-chain layout): how often each
+                # feature splits; sentinel-binned entries are stopped/padded
+                # nodes, not real splits, and must not count toward slot 0
+                fk, bk = (("feat", "bins") if "feat" in p
+                          else ("feat_lv", "bins_lv"))
+                feats = np.asarray(p[fk]).reshape(-1).astype(np.int64)
+                if bk in p and "edges" in p:
+                    nb = np.asarray(p["edges"]).shape[-1] + 1
+                    feats = feats[np.asarray(p[bk]).reshape(-1) < nb]
                 feats = feats[feats >= 0]
                 d = int(np.asarray(p.get("num_features", feats.max() + 1 if
                                          feats.size else 1)))

@@ -52,7 +52,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.forest import forest_leaf_sums, forest_predict
+from ..ops.forest import (
+    forest_leaf_sums, forest_leaf_sums_chain, forest_predict,
+    forest_predict_chain,
+)
 from ..ops.tree_hist import hist_matmul
 from .api import FittedParams, ModelFamily, register_family
 
@@ -377,6 +380,115 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     return feat_heap, thr_heap, bin_heap, node
 
 
+def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
+                        n_bins: int, mode: str, n_slots: int):
+    """Grow Tb slot-chain ("leaf budget") trees at once — arbitrary depth at
+    a bounded per-level width.
+
+    The complete-heap grower's per-level histogram is (2^level·Tb, d, nb, k),
+    which caps practical depth at ~8; the reference's default grids sweep
+    maxDepth 12 (DefaultSelectorParams.scala:37). Here each level holds at
+    most ``n_slots`` live nodes: every valid candidate split is ranked by
+    gain per tree and the top (budget) splits are performed — each split
+    adds exactly one net slot, so ``n_slots`` is precisely a leaf budget
+    (the XGBoost 'lossguide' / LightGBM num_leaves design point). Unsplit
+    nodes carry forward as leaves (they keep competing at later levels, and
+    re-lose deterministically once stopped — same rows ⇒ same gain). Slots
+    are compact by construction: level l holds slots [0, n_live_t) with
+    n_live ≤ min(2^l, n_slots).
+
+    Emits per-level tables (Tb, depth, W): split feature, bin threshold
+    (sentinel ``n_bins`` ⇒ route left), raw threshold, and the child base
+    pointer — routing is ``slot' = base[slot] + go`` (ops/forest.py chain
+    kernels). Returns (feat_lv, thr_lv, bin_lv, base_lv, node_s) with
+    node_s (S, Tb) the final sample leaf slot in [0, min(2^depth, W))."""
+    from ..ops.forest import _chain_widths, _check_slots
+    _check_slots(n_slots)
+    S, d = codes_s.shape
+    Tb = sw_list[0].shape[1]
+    k = len(sw_list)
+    W = n_slots
+    codes_f = codes_s.astype(jnp.bfloat16)
+    sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
+    feat_lv = jnp.zeros((Tb, depth, W), jnp.int32)
+    thr_lv = jnp.full((Tb, depth, W), jnp.inf, jnp.float32)
+    bin_lv = jnp.full((Tb, depth, W), n_bins, jnp.int32)
+    base_lv = jnp.zeros((Tb, depth, W), jnp.int32)
+    node = jnp.zeros((S, Tb), jnp.int32)          # slot at current level
+    n_live = jnp.ones((Tb,), jnp.int32)
+    widths = _chain_widths(depth, W)
+    for level in range(depth):
+        Wl = widths[level]
+        Wn = widths[level + 1] if level + 1 < depth else min(2 ** depth, W)
+        M = Wl * Tb
+        # slot one-hot, j-major lanes (lane = j·Tb + t) like _grow_forest
+        j_all = jnp.arange(Wl, dtype=jnp.int32)[None, :, None]
+        n_oh = (node[:, None, :] == j_all).astype(jnp.bfloat16)  # (S, Wl, Tb)
+        A_cat = jnp.concatenate(
+            [n_oh * sw_bf[ki][:, None, :] for ki in range(k)],
+            axis=1).reshape(S, k * M)
+        hist = hist_matmul(codes_s, A_cat, n_bins)
+        hist = hist.reshape(k, M, d, n_bins).transpose(1, 2, 3, 0)
+        cum = jnp.cumsum(hist, axis=2)
+        total = cum[:, 0, -1, :]                       # (M, k) node totals
+        SL = cum[:, :, :-1, :]
+        SR = total[:, None, None, :] - SL
+        cfg_m = {key: jnp.tile(v, Wl) for key, v in cfg.items()}
+        gain, valid = _split_gain(SL, SR, total, cfg_m, mode)
+        valid = valid & jnp.tile(fmasks, (Wl, 1))[:, :, None]
+        gain = jnp.where(valid, gain, -jnp.inf)
+        gflat = gain.reshape(M, d * (n_bins - 1))
+        best = jnp.argmax(gflat, axis=1)
+        bf = (best // (n_bins - 1)).astype(jnp.int32)
+        bb = (best % (n_bins - 1)).astype(jnp.int32)
+        bgain = jnp.take_along_axis(gflat, best[:, None], axis=1)[:, 0]
+        active = jnp.asarray(level, jnp.float32) < jnp.tile(
+            cfg["max_depth"], Wl)
+        cand = active & jnp.isfinite(bgain) & (bgain > cfg_m["min_info_gain"])
+        # live slots are [0, n_live) per tree; dead lanes must not split
+        j_2d = jnp.arange(Wl, dtype=jnp.int32)[:, None]          # (Wl, 1)
+        live = j_2d < n_live[None, :]                            # (Wl, Tb)
+        cand_2d = cand.reshape(Wl, Tb) & live
+        # leaf-budget cap: each split adds one net slot, so at most
+        # q = W_next − n_live splits may run this level; keep the q best
+        # by gain (rank via double argsort, −inf keys sort last)
+        key = jnp.where(cand_2d, bgain.reshape(Wl, Tb), -jnp.inf)
+        order = jnp.argsort(-key, axis=0)
+        rank = jnp.argsort(order, axis=0)                        # (Wl, Tb)
+        q = jnp.maximum(Wn - n_live, 0)[None, :]
+        kept = cand_2d & (rank < q)
+        n_split = kept.sum(axis=0).astype(jnp.int32)             # (Tb,)
+        # child base for kept splits: 2·gain-rank (kept ⊆ top-q candidates,
+        # so their candidate rank IS their split rank); carried live slots
+        # land after the children in slot order
+        carried = live & ~kept
+        c_rank = jnp.cumsum(carried.astype(jnp.int32), axis=0) - 1
+        base_2d = jnp.where(
+            kept, 2 * rank,
+            jnp.where(carried, 2 * n_split[None, :] + c_rank, 0))
+        kept_f = kept.reshape(M)
+        bf_eff = jnp.where(kept_f, bf, 0)
+        bb_eff = jnp.where(kept_f, bb, n_bins)
+        thr = jnp.where(kept_f, edges[bf, bb], jnp.inf).astype(jnp.float32)
+        # j-major (M,) → (Tb, Wl) table rows
+        feat_lv = feat_lv.at[:, level, :Wl].set(bf_eff.reshape(Wl, Tb).T)
+        thr_lv = thr_lv.at[:, level, :Wl].set(thr.reshape(Wl, Tb).T)
+        bin_lv = bin_lv.at[:, level, :Wl].set(bb_eff.reshape(Wl, Tb).T)
+        base_lv = base_lv.at[:, level, :Wl].set(base_2d.T)
+        # route: slot' = base[slot] + go (sentinel bin ⇒ go 0); base ≤ W−1
+        # and W ≤ 256, so the bf16 lane accumulation is exact
+        sel = (bf_eff[None, :] == jnp.arange(d, dtype=jnp.int32)[:, None]
+               ).astype(jnp.bfloat16)                             # (d, M)
+        code_sel = codes_f @ sel                                  # (S, M)
+        go_lane = (code_sel > bb_eff.astype(jnp.bfloat16)
+                   ).astype(jnp.bfloat16)
+        val_lane = go_lane + base_2d.reshape(M).astype(jnp.bfloat16)[None, :]
+        nxt = (val_lane.reshape(S, Wl, Tb) * n_oh).sum(axis=1)    # (S, Tb)
+        node = jnp.round(nxt.astype(jnp.float32)).astype(jnp.int32)
+        n_live = n_live + n_split
+    return feat_lv, thr_lv, bin_lv, base_lv, node
+
+
 _DIAG_BLOCK = 64
 
 
@@ -457,19 +569,32 @@ def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True,
     return samp, edges, binned, binned_s, stats, mode, w_scale
 
 
+def _exact_leaf_stats_chain(codes, feat_lv, bin_lv, base_lv, stats,
+                            w: jnp.ndarray, n_bins: int):
+    """Chain-format analog of :func:`_exact_leaf_stats` (full-data leaf
+    sums via the fused chain descent kernel, f32 end to end)."""
+    aug = jnp.concatenate([stats * w[:, None], w[:, None]], axis=1)
+    out = forest_leaf_sums_chain(codes, feat_lv, bin_lv, base_lv, aug,
+                                 n_bins=n_bins)
+    return out[..., :-1], out[..., -1]
+
+
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
-                                   "sweep"))
+                                   "sweep", "n_slots"))
 def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
-                  depth, n_bins, num_classes, task, sweep=False):
+                  depth, n_bins, num_classes, task, sweep=False, n_slots=0):
     d = X.shape[1]
     B = weights.shape[0]
     samp, edges, binned, binned_s, stats, mode, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, task,
                           full_bin=not sweep, sweep=sweep)
     stats_s = stats[samp]                                   # (S, k)
-    L = 2 ** depth
-    cb = max(1, min(B, _CFG_CHUNK_ELEMS
-                    // (binned_s.shape[0] * 2 ** (depth - 1))))
+    k = stats.shape[1]
+    deep = n_slots > 0
+    L = min(2 ** depth, n_slots) if deep else 2 ** depth
+    lane_w = (min(2 ** (depth - 1), n_slots) * k if deep
+              else 2 ** (depth - 1))
+    cb = max(1, min(B, _CFG_CHUNK_ELEMS // (binned_s.shape[0] * lane_w)))
 
     def one_chunk(w_c, md, mi, mg):
         """Grow cb single-tree configs in one tree-batched forest call."""
@@ -479,9 +604,15 @@ def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
         cfg = {"max_depth": md, "min_instances": mi, "min_info_gain": mg,
                "lam": jnp.full((cb,), 1e-6, jnp.float32),
                "min_child_weight": jnp.zeros((cb,), jnp.float32)}
-        fs, ths, bhs, node_s = _grow_forest(
-            binned_s, edges, sw_list, jnp.ones((cb, d), bool), cfg,
-            depth=depth, n_bins=n_bins, mode=mode)
+        if deep:
+            fs, ths, bhs, abs_, node_s = _grow_forest_capped(
+                binned_s, edges, sw_list, jnp.ones((cb, d), bool), cfg,
+                depth=depth, n_bins=n_bins, mode=mode, n_slots=n_slots)
+        else:
+            fs, ths, bhs, node_s = _grow_forest(
+                binned_s, edges, sw_list, jnp.ones((cb, d), bool), cfg,
+                depth=depth, n_bins=n_bins, mode=mode)
+            abs_ = jnp.zeros((cb, 0), jnp.int32)
         if sweep:  # sample leaf stats (validation scoring only)
             aug_cols = sw_list + [w_bs]
             sums = jnp.stack(
@@ -495,7 +626,7 @@ def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
             leaf_c = jnp.zeros(
                 (cb, L, stats.shape[1] if task == "classification" else 1),
                 jnp.float32)
-        return fs, ths, bhs, leaf_c
+        return fs, ths, bhs, abs_, leaf_c
 
     n_chunks = -(-B // cb)
     B_pad = n_chunks * cb
@@ -505,29 +636,40 @@ def _fit_dt_batch(X, y, weights, max_depth, min_inst, min_gain, *,
         args = jax.tree_util.tree_map(lambda a: a[idx], args)
     args = jax.tree_util.tree_map(
         lambda a: a.reshape((n_chunks, cb) + a.shape[1:]), args)
-    feat, thr, bheap, leaf = jax.lax.map(lambda ch: one_chunk(*ch), args)
-    feat, thr, bheap, leaf = jax.tree_util.tree_map(
+    feat, thr, bheap, bases, leaf = jax.lax.map(
+        lambda ch: one_chunk(*ch), args)
+    feat, thr, bheap, bases, leaf = jax.tree_util.tree_map(
         lambda a: a.reshape((B_pad,) + a.shape[2:])[:B],
-        (feat, thr, bheap, leaf))
+        (feat, thr, bheap, bases, leaf))
 
     if not sweep:  # EXACT full-data leaf stats via the fused descent kernel
         def leaf_one(args):
-            f, bh, w = args
-            ls, lw = _exact_leaf_stats(binned, f[None], bh[None], stats, w,
-                                       depth, n_bins)
+            if deep:
+                f, bh, ab, w = args
+                ls, lw = _exact_leaf_stats_chain(
+                    binned, f[None], bh[None], ab[None], stats, w, n_bins)
+            else:
+                f, bh, w = args
+                ls, lw = _exact_leaf_stats(binned, f[None], bh[None], stats,
+                                           w, depth, n_bins)
             return (_class_leaf(ls[0], lw[0]) if task == "classification"
                     else _mean_leaf(ls[0], lw[0])[:, None])
 
-        leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
+        leaf = jax.lax.map(
+            leaf_one, ((feat, bheap, bases, weights) if deep
+                       else (feat, bheap, weights)))
+    if deep:
+        return {"feat_lv": feat, "thresh_lv": thr, "bins_lv": bheap,
+                "base_lv": bases, "leaf": leaf, "edges": edges}
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
             "edges": edges}
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
-                                   "n_trees", "sweep"))
+                                   "n_trees", "sweep", "n_slots"))
 def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                   subsample, seeds, *, depth, n_bins, num_classes, task,
-                  n_trees, sweep=False):
+                  n_trees, sweep=False, n_slots=0):
     n, d = X.shape
     samp, edges, binned, binned_s, stats, mode, w_scale = \
         _prep_tree_inputs(X, y, n_bins, num_classes, task,
@@ -539,13 +681,17 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
     S = binned_s.shape[0]
     k = stats.shape[1]
     stats_s = stats[samp]
-    L = 2 ** depth
+    deep = n_slots > 0
+    L = min(2 ** depth, n_slots) if deep else 2 ** depth
     B = weights.shape[0]
     # chunk budget covers BOTH the grower's bf16 (S, Tb·nodes) transients
     # and the sweep leaf-stat path's f32 (S, k+1, Tb) A_cols tensor (f32
-    # counts double in the bf16-element budget)
+    # counts double in the bf16-element budget); the capped grower's level
+    # width is n_slots·k (no sibling subtraction, k stat planes per slot)
+    lane_w = (min(2 ** (depth - 1), n_slots) * k if deep
+              else 2 ** (depth - 1))
     cb = max(1, min(B, _CFG_CHUNK_ELEMS
-                    // (S * n_trees * max(2 ** (depth - 1), 2 * (k + 1)))))
+                    // (S * n_trees * max(lane_w, 2 * (k + 1)))))
 
     def one_chunk(w_c, md, mi, mg, ss, seed):
         """Grow a chunk of cb configs — cb·n_trees trees — in one
@@ -583,9 +729,15 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
                "min_info_gain": jnp.repeat(mg, n_trees),
                "lam": jnp.full((Tb,), 1e-6, jnp.float32),
                "min_child_weight": jnp.zeros((Tb,), jnp.float32)}
-        fs, ths, bhs, node_s = _grow_forest(
-            binned_s, edges, sw_list, fmasks.reshape(Tb, d), cfg,
-            depth=depth, n_bins=n_bins, mode=mode)
+        if deep:
+            fs, ths, bhs, abs_, node_s = _grow_forest_capped(
+                binned_s, edges, sw_list, fmasks.reshape(Tb, d), cfg,
+                depth=depth, n_bins=n_bins, mode=mode, n_slots=n_slots)
+        else:
+            fs, ths, bhs, node_s = _grow_forest(
+                binned_s, edges, sw_list, fmasks.reshape(Tb, d), cfg,
+                depth=depth, n_bins=n_bins, mode=mode)
+            abs_ = jnp.zeros((Tb, 0), jnp.int32)
 
         if sweep:
             # sample leaf stats for the WHOLE chunk in one blocked
@@ -608,9 +760,11 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
             leaf_c = jnp.zeros(
                 (cb, n_trees, L, k if task == "classification" else 1),
                 jnp.float32)
-        Hp = fs.shape[-1]
-        return (fs.reshape(cb, n_trees, Hp), ths.reshape(cb, n_trees, Hp),
-                bhs.reshape(cb, n_trees, Hp), leaf_c)
+        tail = fs.shape[1:]
+        return (fs.reshape((cb, n_trees) + tail),
+                ths.reshape((cb, n_trees) + tail),
+                bhs.reshape((cb, n_trees) + tail),
+                abs_.reshape((cb, n_trees) + abs_.shape[1:]), leaf_c)
 
     n_chunks = -(-B // cb)
     B_pad = n_chunks * cb
@@ -620,35 +774,47 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
         args = jax.tree_util.tree_map(lambda a: a[idx], args)
     args = jax.tree_util.tree_map(
         lambda a: a.reshape((n_chunks, cb) + a.shape[1:]), args)
-    feat, thr, bheap, leaf = jax.lax.map(lambda ch: one_chunk(*ch), args)
-    feat, thr, bheap, leaf = jax.tree_util.tree_map(
+    feat, thr, bheap, bases, leaf = jax.lax.map(
+        lambda ch: one_chunk(*ch), args)
+    feat, thr, bheap, bases, leaf = jax.tree_util.tree_map(
         lambda a: a.reshape((B_pad,) + a.shape[2:])[:B],
-        (feat, thr, bheap, leaf))
+        (feat, thr, bheap, bases, leaf))
 
     if not sweep:
         # EXACT full-data leaf stats per config (fused descent kernel is a
         # pallas call — sequential per config, outside the batched grower)
         def leaf_one(args):
-            f, bh, w = args
-            ls, lw = _exact_leaf_stats(binned, f, bh, stats, w, depth,
-                                       n_bins)
+            if deep:
+                f, bh, ab, w = args
+                ls, lw = _exact_leaf_stats_chain(binned, f, bh, ab, stats,
+                                                 w, n_bins)
+            else:
+                f, bh, w = args
+                ls, lw = _exact_leaf_stats(binned, f, bh, stats, w, depth,
+                                           n_bins)
             return (jax.vmap(_class_leaf)(ls, lw)
                     if task == "classification"
                     else jax.vmap(_mean_leaf)(ls, lw)[:, :, None])
 
-        leaf = jax.lax.map(leaf_one, (feat, bheap, weights))
+        leaf = jax.lax.map(
+            leaf_one, ((feat, bheap, bases, weights) if deep
+                       else (feat, bheap, weights)))
     tree_mask = (jnp.arange(n_trees)[None, :] <
                  num_trees[:, None]).astype(jnp.float32)
+    if deep:
+        return {"feat_lv": feat, "thresh_lv": thr, "bins_lv": bheap,
+                "base_lv": bases, "leaf": leaf, "tree_mask": tree_mask,
+                "edges": edges}
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
             "tree_mask": tree_mask,
             "edges": edges}
 
 
 @partial(jax.jit, static_argnames=("depth", "n_bins", "num_classes", "task",
-                                   "n_rounds", "sweep"))
+                                   "n_rounds", "sweep", "n_slots"))
 def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
                    step_size, lam, min_child_weight, *, depth, n_bins,
-                   num_classes, task, n_rounds, sweep=False):
+                   num_classes, task, n_rounds, sweep=False, n_slots=0):
     """Gradient boosting: binary logistic / regression squared / multiclass
     softmax. Each round grows ONE tree-batched forest over all configs ×
     classes (`_grow_forest`) — the per-round hist/route ops are Tb-wide
@@ -660,7 +826,8 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
     C = num_classes if task == "multiclass" else 1
     B = weights.shape[0]
     S = binned_s.shape[0]
-    L = 2 ** depth
+    deep = n_slots > 0
+    L = min(2 ** depth, n_slots) if deep else 2 ** depth
     Tb = B * C                                             # trees per round
     y_s = y[samp]
     Y1_s = (jax.nn.one_hot(y_s.astype(jnp.int32), max(C, 2), dtype=X.dtype)
@@ -699,7 +866,19 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
         g_tb = g.reshape(Tb, S).T                           # (S, Tb)
         h_tb = h.reshape(Tb, S).T
         sw_list = [(g_tb * w_tb), (h_tb * w_tb), w_tb]
-        if sweep:
+        if deep:
+            # slot-chain trees (maxDepth > heap practical limit): leaves
+            # via the f32-exact per-tree segment sum — the last-level
+            # histogram trick below does not apply (leaves settle at many
+            # levels), and the f32 path needs no bf16 noise clamp
+            fs, ths, bhs, abs_, node_s = _grow_forest_capped(
+                binned_s, edges, sw_list, fmasks, cfg,
+                depth=depth, n_bins=n_bins, mode="gh", n_slots=n_slots)
+            gh = _diag_leaf_hist(
+                node_s, jnp.stack([g_tb * w_tb, h_tb * w_tb], axis=1
+                                  ).astype(jnp.float32), L)  # (2, Tb, L)
+            leaf = -gh[0] / (gh[1] + lam_t[:, None] + 1e-12)  # (Tb, L)
+        elif sweep:
             # CV candidates take Newton leaves straight off the final
             # level's histogram (bf16-summed, free); the refit winner
             # (sweep=False) keeps the exact f32 segment-sum below since
@@ -708,6 +887,7 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
                 binned_s, edges, sw_list, fmasks, cfg,
                 depth=depth, n_bins=n_bins, mode="gh",
                 return_leaf_stats=True)
+            abs_ = jnp.zeros((Tb, 0), jnp.int32)
             # bf16 sibling-subtracted histograms leave cancellation noise in
             # near-empty leaves' H; with small lam -G/H can then be huge and
             # wrong-signed, polluting later boosting rounds. The subtraction
@@ -732,6 +912,7 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
             fs, ths, bhs, node_s = _grow_forest(
                 binned_s, edges, sw_list, fmasks, cfg,
                 depth=depth, n_bins=n_bins, mode="gh")
+            abs_ = jnp.zeros((Tb, 0), jnp.int32)
             # Newton leaves from per-tree G/H segment sums (f32 exact),
             # both stats reduced in one histogram call
             gh = _diag_leaf_hist(
@@ -758,18 +939,24 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
         eta_t = rep(step_size)
         scale = (eta_t * active).reshape(B, C)[:, :, None]
         F_new = F + scale * pred.reshape(B, C, S)
-        return F_new, (fs, ths, bhs, leaf)
+        return F_new, (fs, ths, bhs, abs_, leaf)
 
-    _, (feat, thr, bheap, leaf) = jax.lax.scan(
+    _, (feat, thr, bheap, bases, leaf) = jax.lax.scan(
         round_step, F_init, jnp.arange(n_rounds))
 
     # (rounds, Tb=B*C, ...) → (B, rounds, C, ...)
     def to_bc(a):
-        return jnp.swapaxes(a.reshape(n_rounds, B, C, a.shape[-1]), 0, 1)
+        return jnp.swapaxes(
+            a.reshape((n_rounds, B, C) + a.shape[2:]), 0, 1)
 
-    feat, thr, bheap, leaf = map(to_bc, (feat, thr, bheap, leaf))
+    feat, thr, bheap, bases, leaf = map(
+        to_bc, (feat, thr, bheap, bases, leaf))
     tree_mask = (jnp.arange(n_rounds)[None, :] <
                  max_iter[:, None]).astype(jnp.float32)
+    if deep:
+        return {"feat_lv": feat, "thresh_lv": thr, "bins_lv": bheap,
+                "base_lv": bases, "leaf": leaf, "f0": f0, "eta": step_size,
+                "tree_mask": tree_mask, "edges": edges}
     return {"feat": feat, "thresh": thr, "bins": bheap, "leaf": leaf,
             "f0": f0, "eta": step_size, "tree_mask": tree_mask,
             "edges": edges}
@@ -859,6 +1046,71 @@ def _predict_gbt_batch(feat, bins, leaf, f0, eta, tree_mask, edges, X, *,
             ).transpose(0, 2, 1)                           # (B, C, n)
 
 
+# -- slot-chain predict drivers ---------------------------------------------
+
+def _forest_values_grouped_chain(codes, feat, bins, bases, leaf, *, n_bins):
+    """Chain-format analog of `_forest_values_grouped`: per-config leaf-value
+    sums for a batch of slot-chain configs in shared descent calls.
+    feat/bins/bases: (B, T, depth, W); leaf: (B, T, W_out, k)."""
+    B, T, depth, W = feat.shape
+    W_out, k = leaf.shape[2], leaf.shape[3]
+    n = codes.shape[0]
+    g = max(1, min(B, 128 // max(k, 1)))
+    outs = []
+    for lo in range(0, B, g):
+        hi = min(lo + g, B)
+        gb = hi - lo
+        f_all = feat[lo:hi].reshape(gb * T, depth, W)
+        b_all = bins[lo:hi].reshape(gb * T, depth, W)
+        a_all = bases[lo:hi].reshape(gb * T, depth, W)
+        blocks = [jnp.pad(leaf[lo + c],
+                          ((0, 0), (0, 0), (c * k, (gb - 1 - c) * k)))
+                  for c in range(gb)]
+        lf = jnp.concatenate(blocks, axis=0)            # (gb*T, W_out, gb*k)
+        vals = forest_predict_chain(codes, f_all, b_all, a_all, lf,
+                                    n_bins=n_bins)      # (n, gb*k)
+        outs.append(vals.reshape(n, gb, k))
+    out = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+    return out.transpose(1, 0, 2)                       # (B, n, k)
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _predict_dt_chain_batch(feat, bins, bases, leaf, edges, X, *, n_bins):
+    codes = _bin_features(X, edges)
+    return _forest_values_grouped_chain(
+        codes, feat[:, None], bins[:, None], bases[:, None], leaf[:, None],
+        n_bins=n_bins)                                      # (B, n, k)
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _predict_rf_chain_batch(feat, bins, bases, leaf, tree_mask, edges, X, *,
+                            n_bins):
+    codes = _bin_features(X, edges)
+    lw = leaf * tree_mask[:, :, None, None]                # (B, T, W_out, k)
+    out = _forest_values_grouped_chain(codes, feat, bins, bases, lw,
+                                       n_bins=n_bins)
+    return out / jnp.maximum(tree_mask.sum(1), 1.0)[:, None, None]
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def _predict_gbt_chain_batch(feat, bins, bases, leaf, f0, eta, tree_mask,
+                             edges, X, *, n_bins):
+    codes = _bin_features(X, edges)
+    B, T, C, depth, W = feat.shape
+    W_out = leaf.shape[-1]
+    lv = leaf * tree_mask[:, :, None, None]                # (B, T, C, W_out)
+    cls_oh = (jnp.arange(C)[:, None]
+              == jnp.arange(C)[None, :]).astype(lv.dtype)  # (C, C)
+    M = lv[:, :, :, :, None] * cls_oh[None, None, :, None, :]
+    contrib = _forest_values_grouped_chain(
+        codes, feat.reshape(B, T * C, depth, W),
+        bins.reshape(B, T * C, depth, W),
+        bases.reshape(B, T * C, depth, W),
+        M.reshape(B, T * C, W_out, C), n_bins=n_bins)      # (B, n, C)
+    return (f0[:, None, :] + eta[:, None, None] * contrib
+            ).transpose(0, 2, 1)                           # (B, C, n)
+
+
 # ---------------------------------------------------------------------------
 # Model families
 # ---------------------------------------------------------------------------
@@ -909,10 +1161,94 @@ class _TreeFamilyBase(ModelFamily):
         return e[0] if e.ndim == 3 else e
 
 
-#: reference DefaultSelectorParams.MaxDepth is {3, 6, 12}; the default grid
-#: here stops at 6 because a complete-heap tree allocates 2^depth leaves —
-#: depth 12 is fully supported, pass it explicitly when wanted.
-_DEPTHS = (3, 6)
+#: reference DefaultSelectorParams.MaxDepth {3, 6, 12}
+#: (DefaultSelectorParams.scala:37). Depths ≤ _MAX_HEAP_DEPTH grow/serve as
+#: complete heaps; deeper ones as slot-chain ("leaf budget") trees.
+_DEPTHS = (3, 6, 12)
+
+#: beyond this depth a complete heap's 2^depth layout outgrows HBM/VMEM and
+#: the slot-chain representation takes over
+_MAX_HEAP_DEPTH = 8
+
+#: slot-chain leaf budgets: CV-sweep candidates rank configs (LightGBM-scale
+#: num_leaves suffices — the winner is regrown exactly), served refits get
+#: the full budget
+_SWEEP_SLOTS = 64
+_REFIT_SLOTS = 256
+
+
+def _heap_to_chain(params, d_heap: int, depth: int, W: int, n_bins: int,
+                   leaf_axis: int):
+    """EXACT re-expression of complete-heap trees in the slot-chain layout.
+
+    A heap node j at level l maps to chain slot j with child base 2j (the
+    positional child rule); levels past the heap's depth are identity
+    carries (sentinel bin ⇒ go 0, base = slot), so a row reaching heap leaf
+    j stays at slot j through the remaining levels. Requires 2^d_heap ≤ W.
+    Non-tree entries (edges, tree_mask, f0, eta) pass through."""
+    if 2 ** d_heap > W:
+        raise ValueError(f"heap depth {d_heap} needs {2 ** d_heap} slots, "
+                         f"chain budget is {W}")
+    feat, bins = params["feat"], params["bins"]
+    thr, leaf = params["thresh"], params["leaf"]
+    lead = feat.shape[:-1]
+    W_out = min(2 ** depth, W)
+    f_lv = jnp.zeros(lead + (depth, W), jnp.int32)
+    b_lv = jnp.full(lead + (depth, W), n_bins, jnp.int32)
+    t_lv = jnp.full(lead + (depth, W), jnp.inf, jnp.float32)
+    a_lv = jnp.zeros(lead + (depth, W), jnp.int32)
+    for level in range(depth):
+        Wl = min(2 ** level, W)
+        if level < d_heap:
+            base_i, m = 2 ** level - 1, 2 ** level
+            f_lv = f_lv.at[..., level, :m].set(feat[..., base_i:base_i + m])
+            b_lv = b_lv.at[..., level, :m].set(bins[..., base_i:base_i + m])
+            t_lv = t_lv.at[..., level, :m].set(thr[..., base_i:base_i + m])
+            a_lv = a_lv.at[..., level, :m].set(
+                2 * jnp.arange(m, dtype=jnp.int32))
+        else:
+            a_lv = a_lv.at[..., level, :Wl].set(
+                jnp.arange(Wl, dtype=jnp.int32))
+    ax = leaf_axis % leaf.ndim
+    pad = [(0, 0)] * leaf.ndim
+    pad[ax] = (0, W_out - leaf.shape[ax])
+    out = {k: v for k, v in params.items()
+           if k not in ("feat", "bins", "thresh", "leaf")}
+    out.update({"feat_lv": f_lv, "bins_lv": b_lv, "thresh_lv": t_lv,
+                "base_lv": a_lv, "leaf": jnp.pad(leaf, pad)})
+    return out
+
+
+def _pad_chain_depth(params, d_small: int, depth: int, n_bins: int,
+                     leaf_axis: int):
+    """Extend chain tables from d_small to depth levels with identity
+    carries, and pad the leaf axis to the deeper W_out. Exact."""
+    if d_small == depth:
+        return params
+    f_lv = params["feat_lv"]
+    lead, W = f_lv.shape[:-2], f_lv.shape[-1]
+    W_out = min(2 ** depth, W)
+    ext = depth - d_small
+    out = dict(params)
+
+    def pad_levels(a, fill):
+        pad = [(0, 0)] * (a.ndim - 2) + [(0, ext), (0, 0)]
+        return jnp.pad(a, pad, constant_values=fill)
+
+    out["feat_lv"] = pad_levels(f_lv, 0)
+    out["bins_lv"] = pad_levels(params["bins_lv"], n_bins)
+    out["thresh_lv"] = pad_levels(params["thresh_lv"], jnp.inf)
+    a_lv = pad_levels(params["base_lv"], 0)
+    for level in range(d_small, depth):
+        Wl = min(2 ** level, W)
+        a_lv = a_lv.at[..., level, :Wl].set(jnp.arange(Wl, dtype=jnp.int32))
+    out["base_lv"] = a_lv
+    leaf = params["leaf"]
+    ax = leaf_axis % leaf.ndim
+    pad = [(0, 0)] * leaf.ndim
+    pad[ax] = (0, W_out - leaf.shape[ax])
+    out["leaf"] = jnp.pad(leaf, pad)
+    return out
 
 
 def _embed_depth(params, d_small: int, d_max: int, n_bins: int,
@@ -948,23 +1284,44 @@ def _embed_depth(params, d_small: int, d_max: int, n_bins: int,
 
 
 def _fit_depth_grouped(grid, weights, fit_group, n_bins: int,
-                       leaf_axis: int):
+                       leaf_axis: int, fit_group_deep=None, n_slots: int = 0):
     """Partition the config batch by maxDepth and fit each bucket with its
     own (cheap) depth program, embedding results into the deepest layout.
     ``fit_group(sub_grid, sub_weights, depth) -> params``. maxDepth values
-    are host-side constants (grid arrays), so grouping is static."""
+    are host-side constants (grid arrays), so grouping is static.
+
+    Depths past ``_MAX_HEAP_DEPTH`` fit via ``fit_group_deep`` (slot-chain
+    grower, ``n_slots`` leaf budget); when any bucket is deep, every bucket
+    is re-expressed in the chain layout (exact for heaps) so the whole batch
+    shares one predict program."""
     md = np.asarray(grid["maxDepth"], dtype=np.float64).reshape(-1)
     uniq = sorted({int(v) for v in md})
     d_max = uniq[-1]
+    any_deep = d_max > _MAX_HEAP_DEPTH
     if len(uniq) == 1:
-        return fit_group(grid, weights, d_max)
+        return (fit_group_deep(grid, weights, d_max, n_slots) if any_deep
+                else fit_group(grid, weights, d_max))
+    # the shared chain width must hold the DEEPEST heap bucket's full leaf
+    # layer (a depth-8 heap has 256 leaves — more than the sweep budget)
+    if any_deep:
+        d_heap_max = max([u for u in uniq if u <= _MAX_HEAP_DEPTH],
+                         default=0)
+        n_slots = max(n_slots, 2 ** d_heap_max)
     B = md.shape[0]
     stitched = None
     for u in uniq:
         idx = np.nonzero(md == u)[0]
         sub = {k: v[idx] for k, v in grid.items()}
-        p = _embed_depth(fit_group(sub, weights[idx], u), u, d_max,
-                         n_bins, leaf_axis)
+        if u > _MAX_HEAP_DEPTH:
+            p = _pad_chain_depth(fit_group_deep(sub, weights[idx], u,
+                                                n_slots), u,
+                                 d_max, n_bins, leaf_axis)
+        elif any_deep:
+            p = _heap_to_chain(fit_group(sub, weights[idx], u), u, d_max,
+                               n_slots, n_bins, leaf_axis)
+        else:
+            p = _embed_depth(fit_group(sub, weights[idx], u), u, d_max,
+                             n_bins, leaf_axis)
         if stitched is None:
             stitched = {k: (v if k == "edges"
                             else jnp.zeros((B,) + v.shape[1:], v.dtype))
@@ -987,19 +1344,22 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
 
     def fit_batch(self, X, y, weights, grid, num_classes, sweep=False):
         task = self._task(num_classes)
+        n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
-        def fit_group(g, w, depth):
+        def fit_group(g, w, depth, slots=0):
             return _fit_dt_batch(
                 X, y, w, g["maxDepth"], _g(g, "minInstancesPerNode", 1.0),
                 _g(g, "minInfoGain", 0.0),
                 depth=depth, n_bins=N_BINS,
-                num_classes=max(num_classes, 2), task=task, sweep=sweep)
+                num_classes=max(num_classes, 2), task=task, sweep=sweep,
+                n_slots=slots)
 
-        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
-                                  leaf_axis=-2)
+        return _fit_depth_grouped(
+            grid, weights, fit_group, N_BINS, leaf_axis=-2,
+            fit_group_deep=lambda g, w, d, s: fit_group(g, w, d, s),
+            n_slots=n_slots)
 
     def predict_batch(self, params, X, num_classes):
-        depth = _depth_of(params["leaf"].shape[-2])
         edges = self._edges_of(params)
         task = self._task(num_classes)
         leaf = params["leaf"]
@@ -1007,9 +1367,15 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
             # binary: p0 = 1 − p1, so only the class-1 column needs routing
             # (halves the descent's output columns → 2x configs per call)
             leaf = leaf[..., 1:]
-        out = _predict_dt_batch(params["feat"], params["bins"],
-                                leaf, edges, X, depth=depth,
-                                n_bins=edges.shape[-1] + 1)
+        if "base_lv" in params:
+            out = _predict_dt_chain_batch(
+                params["feat_lv"], params["bins_lv"], params["base_lv"],
+                leaf, edges, X, n_bins=edges.shape[-1] + 1)
+        else:
+            depth = _depth_of(params["leaf"].shape[-2])
+            out = _predict_dt_batch(params["feat"], params["bins"],
+                                    leaf, edges, X, depth=depth,
+                                    n_bins=edges.shape[-1] + 1)
         if task == "classification" and num_classes <= 2:
             return out[..., 0]
         return _shape_scores(out, num_classes, task)
@@ -1038,31 +1404,40 @@ class RandomForestFamilyBase(_TreeFamilyBase):
         B = weights.shape[0]
         seeds = jnp.arange(B, dtype=jnp.float32) + 7.0
         grid = dict(grid, _seeds=seeds)
+        n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
-        def fit_group(g, w, depth):
+        def fit_group(g, w, depth, slots=0):
             return _fit_rf_batch(
                 X, y, w, g["maxDepth"],
                 _g(g, "minInstancesPerNode", 1.0), _g(g, "minInfoGain", 0.0),
                 _g(g, "numTrees", 20.0), _g(g, "subsamplingRate", 1.0),
                 g["_seeds"], depth=depth, n_bins=N_BINS,
                 num_classes=max(num_classes, 2), task=task, n_trees=n_trees,
-                sweep=sweep)
+                sweep=sweep, n_slots=slots)
 
-        return _fit_depth_grouped(grid, weights, fit_group, N_BINS,
-                                  leaf_axis=-2)
+        return _fit_depth_grouped(
+            grid, weights, fit_group, N_BINS, leaf_axis=-2,
+            fit_group_deep=lambda g, w, d, s: fit_group(g, w, d, s),
+            n_slots=n_slots)
 
     def predict_batch(self, params, X, num_classes):
-        depth = _depth_of(params["leaf"].shape[-2])
         edges = self._edges_of(params)
         task = self._task(num_classes)
         leaf = params["leaf"]
         if task == "classification" and num_classes <= 2:
             # binary: route only the class-1 probability column (see DT)
             leaf = leaf[..., 1:]
-        out = _predict_rf_batch(params["feat"], params["bins"],
-                                leaf, params["tree_mask"],
-                                edges, X, depth=depth,
-                                n_bins=edges.shape[-1] + 1)
+        if "base_lv" in params:
+            out = _predict_rf_chain_batch(
+                params["feat_lv"], params["bins_lv"], params["base_lv"],
+                leaf, params["tree_mask"], edges, X,
+                n_bins=edges.shape[-1] + 1)
+        else:
+            depth = _depth_of(params["leaf"].shape[-2])
+            out = _predict_rf_batch(params["feat"], params["bins"],
+                                    leaf, params["tree_mask"],
+                                    edges, X, depth=depth,
+                                    n_bins=edges.shape[-1] + 1)
         if task == "classification" and num_classes <= 2:
             return out[..., 0]
         return _shape_scores(out, num_classes, task)
@@ -1098,27 +1473,72 @@ class GBTFamilyBase(_TreeFamilyBase):
         # are the same program
         task = self._gbt_task(num_classes)
         n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
+        n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
-        # no depth grouping here: boosting rounds are a sequential scan,
-        # and a second scan chain for shallow configs costs more than the
-        # wasted deep levels (their active-mask already stops splitting)
-        depth = int(np.max(np.asarray(grid["maxDepth"])))
-        return _fit_gbt_batch(
-            X, y, weights, grid["maxDepth"],
-            _g(grid, "minInstancesPerNode", 0.0), _g(grid, "minInfoGain", 0.0),
-            _g(grid, "maxIter", 20.0), _g(grid, "stepSize", 0.1),
-            _g(grid, "lambda", self.lam_default),
-            _g(grid, "minChildWeight", self.mcw_default),
-            depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
-            task=task, n_rounds=n_rounds, sweep=sweep)
+        def one_call(g, w, depth, slots=0):
+            return _fit_gbt_batch(
+                X, y, w, g["maxDepth"],
+                _g(g, "minInstancesPerNode", 0.0), _g(g, "minInfoGain", 0.0),
+                _g(g, "maxIter", 20.0), _g(g, "stepSize", 0.1),
+                _g(g, "lambda", self.lam_default),
+                _g(g, "minChildWeight", self.mcw_default),
+                depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
+                task=task, n_rounds=n_rounds, sweep=sweep, n_slots=slots)
+
+        md = np.asarray(grid["maxDepth"], dtype=np.float64).reshape(-1)
+        d_max = int(md.max())
+        if d_max <= _MAX_HEAP_DEPTH:
+            # no depth grouping: boosting rounds are a sequential scan, and
+            # a second scan chain for shallow configs costs more than the
+            # wasted deep levels (their active-mask already stops splitting)
+            return one_call(grid, weights, d_max)
+        # deep grid: shallow configs share ONE heap scan at their own max
+        # depth; each deep depth runs a slot-chain scan; everything stitches
+        # into the chain layout (exact for heaps). The shared chain width
+        # must hold the deepest heap bucket's leaf layer
+        deep_mask = md > _MAX_HEAP_DEPTH
+        if (~deep_mask).any():
+            n_slots = max(n_slots, 2 ** int(md[~deep_mask].max()))
+        B = md.shape[0]
+        stitched = None
+        parts = []
+        if (~deep_mask).any():
+            idx = np.nonzero(~deep_mask)[0]
+            sub = {k: v[idx] for k, v in grid.items()}
+            d_sh = int(md[idx].max())
+            p = _heap_to_chain(one_call(sub, weights[idx], d_sh), d_sh,
+                               d_max, n_slots, N_BINS, leaf_axis=-1)
+            parts.append((idx, p))
+        for u in sorted({int(v) for v in md[deep_mask]}):
+            idx = np.nonzero(md == u)[0]
+            sub = {k: v[idx] for k, v in grid.items()}
+            p = _pad_chain_depth(one_call(sub, weights[idx], u, n_slots),
+                                 u, d_max, N_BINS, leaf_axis=-1)
+            parts.append((idx, p))
+        for idx, p in parts:
+            if stitched is None:
+                stitched = {k: (v if k == "edges"
+                                else jnp.zeros((B,) + v.shape[1:], v.dtype))
+                            for k, v in p.items()}
+            for k, v in p.items():
+                if k != "edges":
+                    stitched[k] = stitched[k].at[jnp.asarray(idx)].set(v)
+        return stitched
 
     def predict_batch(self, params, X, num_classes):
-        depth = _depth_of(params["leaf"].shape[-1])
         edges = self._edges_of(params)
-        margins = _predict_gbt_batch(
-            params["feat"], params["bins"], params["leaf"], params["f0"],
-            params["eta"], params["tree_mask"], edges, X, depth=depth,
-            n_bins=edges.shape[-1] + 1)                          # (B, C, n)
+        if "base_lv" in params:
+            margins = _predict_gbt_chain_batch(
+                params["feat_lv"], params["bins_lv"], params["base_lv"],
+                params["leaf"], params["f0"], params["eta"],
+                params["tree_mask"], edges, X,
+                n_bins=edges.shape[-1] + 1)                      # (B, C, n)
+        else:
+            depth = _depth_of(params["leaf"].shape[-1])
+            margins = _predict_gbt_batch(
+                params["feat"], params["bins"], params["leaf"], params["f0"],
+                params["eta"], params["tree_mask"], edges, X, depth=depth,
+                n_bins=edges.shape[-1] + 1)                      # (B, C, n)
         task = self._gbt_task(num_classes)
         if task == "regression":
             return margins[:, 0, :]

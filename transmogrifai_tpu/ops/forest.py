@@ -339,6 +339,371 @@ def forest_leaf_sums(codes: jnp.ndarray, feat_heap: jnp.ndarray,
     return out[:T]
 
 
+# ---------------------------------------------------------------------------
+# Slot-chain ("leaf budget") trees: arbitrary depth at bounded width
+#
+# A complete heap doubles its level width every level (2^l nodes), which caps
+# the practical depth at ~7: the descent's per-level lane width T_pad·2^l and
+# the final (R, T_pad·2^depth) leaf one-hot outgrow VMEM, and the grower's
+# histograms outgrow HBM. The reference's default grids include maxDepth 12
+# (DefaultSelectorParams.scala:37), so deep trees get a second representation:
+# per-level SLOT tables of static width W (the leaf budget — every split adds
+# exactly one net slot, so a W-slot chain holds any tree with ≤ W leaves,
+# grown level-wise with the best-gain splits kept, the XGBoost 'lossguide' /
+# LightGBM num_leaves design point). Routing is
+#
+#     slot' = base[slot] + go,   go = codes[:, feat[slot]] > bin[slot]
+#
+# where a split slot's base points at its child pair, a finished leaf's base
+# carries it forward unchanged (sentinel bin ⇒ go 0), and the slot after the
+# last level IS the leaf id in [0, W). Every per-level operand is ≤ T_pad·W
+# lanes regardless of depth, so depth 12 runs in the same VMEM envelope as a
+# depth-5 heap. Shallow complete heaps embed exactly (base = 2·slot), letting
+# mixed-depth grids share one predict program.
+# ---------------------------------------------------------------------------
+
+_BLK_R_CHAIN = 64     # rows per VMEM block (deep levels are lane-wide)
+_T_CHAIN = 32         # trees per chain kernel call (lane budget)
+_MAX_SLOTS = 256      # bin codes AND slot ids ride bf16 lanes: exact ≤ 256
+
+
+def _chain_widths(depth: int, W: int):
+    """Ragged per-level slot widths: level l holds ≤ min(2^l, W) live slots
+    (a level can at most double the previous one's count, capped at W)."""
+    return [min(2 ** level, W) for level in range(depth)]
+
+
+def _chain_w_eff(Wl: int) -> int:
+    """Kernel lane width per level: floored at 4 so T_pad·W_eff stays a
+    128-multiple (T_pad is a multiple of 32)."""
+    return max(4, Wl)
+
+
+def _check_slots(W: int) -> None:
+    if W > _MAX_SLOTS:
+        raise ValueError(
+            f"n_slots={W} > {_MAX_SLOTS}: slot ids are accumulated in "
+            f"bfloat16 lanes, exact only up to 256")
+
+
+def _chain_tables(feat_lv, bin_lv, base_lv, depth, W, n_bins, T_pad):
+    """j-major ragged per-level tables, concatenated flat: level l occupies
+    T_pad·_chain_w_eff(W_l) lanes (lane = slot·T_pad + t). Sentinel bins fill
+    padded slots/trees; padded bases are 0 (no rows ever sit there)."""
+    T = feat_lv.shape[0]
+    f_rows, b_rows, a_rows = [], [], []
+    for level, Wl in enumerate(_chain_widths(depth, W)):
+        We = _chain_w_eff(Wl)
+        f = jnp.pad(feat_lv[:, level, :Wl],
+                    ((0, T_pad - T), (0, We - Wl)))
+        b = jnp.pad(bin_lv[:, level, :Wl],
+                    ((0, T_pad - T), (0, We - Wl)), constant_values=n_bins)
+        a = jnp.pad(base_lv[:, level, :Wl],
+                    ((0, T_pad - T), (0, We - Wl)))
+        f_rows.append(f.T.reshape(-1))
+        b_rows.append(b.T.reshape(-1))
+        a_rows.append(a.T.reshape(-1))
+    return (jnp.concatenate(f_rows)[None, :].astype(jnp.int32),
+            jnp.concatenate(b_rows)[None, :].astype(jnp.int32),
+            jnp.concatenate(a_rows)[None, :].astype(jnp.int32))
+
+
+def _descend_chain(codes_f, f_ref, b_ref, a_ref, *, depth, W, T_pad, d_pad):
+    """In-kernel: (R, d_pad) f32 codes → (R, T_pad) int32 leaf slots.
+
+    Same matmul skeleton as `_descend`, plus the base-pointer gather: the
+    next slot is Σ_j oh[j]·(base[j] + go[j]) — one fused group-sum matmul
+    (base values < 256 are exact in the bf16 operand, accumulated f32)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = codes_f.shape[0]
+    codes_bf = codes_f.astype(jnp.bfloat16)
+    slot = jnp.zeros((R, T_pad), jnp.int32)
+    off = 0
+    for level, Wl in enumerate(_chain_widths(depth, W)):
+        We = _chain_w_eff(Wl)
+        w = T_pad * We
+        f_row = f_ref[0:1, off:off + w]                       # (1, w)
+        b_row = b_ref[0:1, off:off + w]
+        a_row = a_ref[0:1, off:off + w]
+        off += w
+        d_iota = jax.lax.broadcasted_iota(jnp.int32, (d_pad, w), 0)
+        sel = (d_iota == f_row).astype(jnp.bfloat16)          # (d_pad, w)
+        code_sel = jnp.dot(codes_bf, sel,
+                           preferred_element_type=jnp.float32)  # (R, w)
+        go_lane = (code_sel > b_row.astype(jnp.float32)
+                   ).astype(jnp.bfloat16)
+        slot_rep = pltpu.repeat(slot, We, axis=1)             # (R, w)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, w), 1)
+        oh = (slot_rep == lane // T_pad).astype(jnp.bfloat16)
+        val = (go_lane + a_row.astype(jnp.bfloat16)) * oh     # (R, w)
+        gl = jax.lax.broadcasted_iota(jnp.int32, (w, T_pad), 0) % T_pad
+        gt = jax.lax.broadcasted_iota(jnp.int32, (w, T_pad), 1)
+        G = (gl == gt).astype(jnp.bfloat16)                   # (w, T_pad)
+        nxt = jnp.dot(val, G, preferred_element_type=jnp.float32)
+        slot = nxt.astype(jnp.int32)
+    return slot
+
+
+def _leaf_onehot_chain(slot, *, W_out, T_pad):
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = slot.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, T_pad * W_out), 1)
+    slot_rep = pltpu.repeat(slot, W_out, axis=1)
+    return (slot_rep == lane // T_pad).astype(jnp.bfloat16)
+
+
+def _leaf_sums_chain_pallas(codes, f_lvls, b_lvls, a_lvls, aug, *, depth, W,
+                            W_out, n_bins, T_pad):
+    from jax.experimental import pallas as pl
+
+    n, d = codes.shape
+    k = aug.shape[1]
+    d_pad = _pad_to(d, 128)
+    k_pad = _pad_to(k, 8)
+    blk_r = _BLK_R_CHAIN
+    n_pad = _pad_to(n, blk_r)
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, n_pad - n), (0, d_pad - d)))
+    aug_p = jnp.pad(aug.astype(jnp.float32),
+                    ((0, n_pad - n), (0, k_pad - k)))
+
+    def kernel(codes_ref, f_ref, b_ref, a_ref, aug_ref, out_ref):
+        r = pl.program_id(0)
+        slot = _descend_chain(codes_ref[:].astype(jnp.float32), f_ref, b_ref,
+                              a_ref, depth=depth, W=W, T_pad=T_pad,
+                              d_pad=d_pad)
+        l_oh = _leaf_onehot_chain(slot, W_out=W_out, T_pad=T_pad)
+        part = jax.lax.dot_general(
+            aug_ref[:], l_oh.astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+        @pl.when(r == 0)
+        def _():
+            out_ref[:] = part
+
+        @pl.when(r > 0)
+        def _():
+            out_ref[:] += part
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((k_pad, T_pad * W_out), jnp.float32),
+        grid=(n_pad // blk_r,),
+        in_specs=[
+            pl.BlockSpec((blk_r, d_pad), lambda r: (r, 0)),
+            pl.BlockSpec(f_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(b_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(a_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec((blk_r, k_pad), lambda r: (r, 0)),
+        ],
+        out_specs=pl.BlockSpec((k_pad, T_pad * W_out), lambda r: (0, 0)),
+        interpret=_interpret(),
+    )(codes_p, f_lvls, b_lvls, a_lvls, aug_p)
+    # (k, slot·T_pad + t) -> (T_pad, W_out, k)
+    return out.reshape(k_pad, W_out, T_pad).transpose(2, 1, 0)[:, :, :k]
+
+
+def _predict_chain_pallas(codes, f_lvls, b_lvls, a_lvls, leaf_flat, *,
+                          depth, W, W_out, n_bins, T_pad):
+    from jax.experimental import pallas as pl
+
+    n, d = codes.shape
+    k = leaf_flat.shape[1]
+    d_pad = _pad_to(d, 128)
+    k_pad = _pad_to(k, 128)
+    blk_r = _BLK_R_CHAIN
+    n_pad = _pad_to(n, blk_r)
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, n_pad - n), (0, d_pad - d)))
+    leaf_p = jnp.pad(leaf_flat.astype(jnp.float32),
+                     ((0, 0), (0, k_pad - k)))
+
+    def kernel(codes_ref, f_ref, b_ref, a_ref, leaf_ref, out_ref):
+        slot = _descend_chain(codes_ref[:].astype(jnp.float32), f_ref, b_ref,
+                              a_ref, depth=depth, W=W, T_pad=T_pad,
+                              d_pad=d_pad)
+        l_oh = _leaf_onehot_chain(slot, W_out=W_out, T_pad=T_pad)
+        out_ref[:] = jnp.dot(l_oh.astype(jnp.float32), leaf_ref[:],
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, k_pad), jnp.float32),
+        grid=(n_pad // blk_r,),
+        in_specs=[
+            pl.BlockSpec((blk_r, d_pad), lambda r: (r, 0)),
+            pl.BlockSpec(f_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(b_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(a_lvls.shape, lambda r: (0, 0)),
+            pl.BlockSpec(leaf_flat.shape[:1] + (k_pad,), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk_r, k_pad), lambda r: (r, 0)),
+        interpret=_interpret(),
+    )(codes_p, f_lvls, b_lvls, a_lvls, leaf_p)
+    return out[:n, :k]
+
+
+def route_codes_chain_xla(codes: jnp.ndarray, feat_lv: jnp.ndarray,
+                          bin_lv: jnp.ndarray, base_lv: jnp.ndarray,
+                          n_bins: int) -> jnp.ndarray:
+    """(n, T) leaf-slot assignments for slot-chain trees, plain XLA."""
+    n, d = codes.shape
+    T, depth, W = feat_lv.shape
+    codes_bf = codes.astype(jnp.bfloat16)
+    slot = jnp.zeros((n, T), jnp.int32)
+    for level, Wl in enumerate(_chain_widths(depth, W)):
+        f_l = feat_lv[:, level, :Wl]                         # (T, Wl)
+        b_l = bin_lv[:, level, :Wl]
+        a_l = base_lv[:, level, :Wl]
+        sel = (f_l.reshape(-1)[None, :]
+               == jnp.arange(d, dtype=jnp.int32)[:, None]
+               ).astype(jnp.bfloat16)                        # (d, T·Wl)
+        code_sel = (codes_bf @ sel).reshape(n, T, Wl)
+        go_all = code_sel > b_l[None].astype(jnp.bfloat16)
+        s_oh = slot[:, :, None] == jnp.arange(Wl, dtype=jnp.int32)
+        go = jnp.any(go_all & s_oh, axis=2)
+        base = jnp.sum(jnp.where(s_oh, a_l[None], 0), axis=2)
+        slot = base + go.astype(jnp.int32)
+    return slot
+
+
+def _chain_xla_rowblocks(codes, fn, blk: int = 16384):
+    """Run ``fn(codes_block)`` over row blocks via lax.map — the XLA chain
+    fallback's per-level (n, T·W) transients would otherwise be O(n) HBM."""
+    n = codes.shape[0]
+    if n <= blk:
+        return fn(codes), n
+    n_pad = -(-n // blk) * blk
+    codes_p = jnp.pad(codes, ((0, n_pad - n), (0, 0)),
+                      constant_values=-1)    # code -1: routes left everywhere
+    blocks = codes_p.reshape(n_pad // blk, blk, -1)
+    return jax.lax.map(fn, blocks), n
+
+
+def _leaf_sums_chain_xla(codes, feat_lv, bin_lv, base_lv, aug, *, n_bins):
+    n = codes.shape[0]
+    T, depth, W = feat_lv.shape
+    W_out = min(2 ** depth, W)
+    aug_f = aug.astype(jnp.float32)
+    blk = 16384
+    if n <= blk:
+        node = route_codes_chain_xla(codes, feat_lv, bin_lv, base_lv, n_bins)
+        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
+        l_oh = (comb[:, :, None]
+                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
+                ).astype(jnp.float32).reshape(n, T * W_out)
+        out = jnp.einsum("na,nk->ak", l_oh, aug_f,
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+        return out.reshape(T, W_out, -1)
+    n_pad = -(-n // blk) * blk
+    codes_p = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+    aug_p = jnp.pad(aug_f, ((0, n_pad - n), (0, 0)))  # zero rows: no-op
+
+    def one(args):
+        c, a = args
+        node = route_codes_chain_xla(c, feat_lv, bin_lv, base_lv, n_bins)
+        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
+        l_oh = (comb[:, :, None]
+                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
+                ).astype(jnp.float32).reshape(blk, T * W_out)
+        return jnp.einsum("na,nk->ak", l_oh, a,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+
+    parts = jax.lax.map(one, (codes_p.reshape(-1, blk, codes.shape[1]),
+                              aug_p.reshape(-1, blk, aug.shape[1])))
+    return parts.sum(0).reshape(T, W_out, -1)
+
+
+def _predict_chain_xla(codes, feat_lv, bin_lv, base_lv, leaf, *, n_bins):
+    T, depth, W = feat_lv.shape
+    W_out, k = leaf.shape[1], leaf.shape[2]
+    leaf_2d = leaf.reshape(T * W_out, k).astype(jnp.float32)
+
+    def one(c):
+        nb = c.shape[0]
+        node = route_codes_chain_xla(c, feat_lv, bin_lv, base_lv, n_bins)
+        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
+        l_oh = (comb[:, :, None]
+                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
+                ).astype(jnp.float32).reshape(nb, T * W_out)
+        return jnp.einsum("na,ak->nk", l_oh, leaf_2d,
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
+
+    out, n = _chain_xla_rowblocks(codes, one)
+    if out.ndim == 3:
+        out = out.reshape(-1, out.shape[-1])[:n]
+    return out
+
+
+def forest_leaf_sums_chain(codes: jnp.ndarray, feat_lv: jnp.ndarray,
+                           bin_lv: jnp.ndarray, base_lv: jnp.ndarray,
+                           aug: jnp.ndarray, *, n_bins: int) -> jnp.ndarray:
+    """Exact leaf statistics for slot-chain trees in one fused pass.
+
+    feat_lv/bin_lv/base_lv: (T, depth, W) per-level slot tables (level l uses
+    the first min(2^l, W) slots); aug: (n, k) f32 per-row stats. Returns
+    (T, W_out, k) with W_out = min(2^depth, W).
+    """
+    _check_bins(n_bins)
+    T, depth, W = feat_lv.shape
+    _check_slots(W)
+    W_out = min(2 ** depth, W)
+    if not _use_pallas():
+        return _leaf_sums_chain_xla(codes, feat_lv, bin_lv, base_lv, aug,
+                                    n_bins=n_bins)
+    parts = []
+    for lo in range(0, T, _T_CHAIN):
+        hi = min(lo + _T_CHAIN, T)
+        T_pad = _T_CHAIN
+        f_lvls, b_lvls, a_lvls = _chain_tables(
+            feat_lv[lo:hi], bin_lv[lo:hi], base_lv[lo:hi], depth, W, n_bins,
+            T_pad)
+        out = _leaf_sums_chain_pallas(
+            codes, f_lvls, b_lvls, a_lvls, aug, depth=depth, W=W,
+            W_out=W_out, n_bins=n_bins, T_pad=T_pad)
+        parts.append(out[:hi - lo])
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+
+def forest_predict_chain(codes: jnp.ndarray, feat_lv: jnp.ndarray,
+                         bin_lv: jnp.ndarray, base_lv: jnp.ndarray,
+                         leaf: jnp.ndarray, *, n_bins: int) -> jnp.ndarray:
+    """Σ_t leaf[t, slot(row, t), :] for slot-chain trees in one fused pass.
+
+    leaf: (T, W_out, k) f32 leaf values. Returns (n, k) f32.
+    """
+    _check_bins(n_bins)
+    T, depth, W = feat_lv.shape
+    _check_slots(W)
+    W_out, k = leaf.shape[1], leaf.shape[2]
+    if not _use_pallas():
+        return _predict_chain_xla(codes, feat_lv, bin_lv, base_lv, leaf,
+                                  n_bins=n_bins)
+    out = None
+    for lo in range(0, T, _T_CHAIN):
+        hi = min(lo + _T_CHAIN, T)
+        T_pad = _T_CHAIN
+        f_lvls, b_lvls, a_lvls = _chain_tables(
+            feat_lv[lo:hi], bin_lv[lo:hi], base_lv[lo:hi], depth, W, n_bins,
+            T_pad)
+        leaf_flat = (jnp.pad(leaf[lo:hi].astype(jnp.float32),
+                             ((0, T_pad - (hi - lo)), (0, 0), (0, 0)))
+                     .transpose(1, 0, 2).reshape(T_pad * W_out, k))
+        part = _predict_chain_pallas(
+            codes, f_lvls, b_lvls, a_lvls, leaf_flat, depth=depth, W=W,
+            W_out=W_out, n_bins=n_bins, T_pad=T_pad)
+        out = part if out is None else out + part
+    return out
+
+
 def forest_predict(codes: jnp.ndarray, feat_heap: jnp.ndarray,
                    bin_heap: jnp.ndarray, leaf: jnp.ndarray, *,
                    depth: int, n_bins: int) -> jnp.ndarray:
