@@ -1,0 +1,62 @@
+"""Per-family timing of the DEFAULT-grid sweep (validate() per family, warm).
+
+Usage: python docs/experiments/_profile_default.py [rows] [feat]
+Prints per-family fit/predict/metric wall-clock so the fixed-cost attack
+(VERDICT r3 #1) aims at the right target.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ.setdefault("BENCH_ROWS", "1000000")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees   # noqa: F401
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else int(os.environ["BENCH_ROWS"])
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    folds = 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    fams = ("OpLogisticRegression", "OpRandomForestClassifier",
+            "OpGBTClassifier", "OpLinearSVC")
+    for f in fams:
+        fam = MODEL_REGISTRY[f]
+        grid = fam.default_grid("binary")
+        models = [(fam, grid)]
+
+        def sweep():
+            cv = OpCrossValidation(num_folds=folds, seed=0)
+            best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+            for r in best.results:
+                np.asarray(r.fold_metrics)
+            return best
+
+        sweep()
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sweep()
+            times.append(time.perf_counter() - t0)
+        dt = float(np.median(times))
+        B = folds * len(grid)
+        print(f"{f}: {len(grid)} cfgs, {B} fits, {dt:.3f}s "
+              f"({B/dt:.1f} fits/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Phase timing for one family's default-grid sweep: fit / leaf / predict /
+metric, isolated (warm). Usage:
+    python docs/experiments/_profile_phases.py [family] [rows]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def t(fn, reps=3):
+    fn()  # warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees   # noqa: F401
+    from transmogrifai_tpu.utils.padding import bucket_for
+
+    fam_name = sys.argv[1] if len(sys.argv) > 1 else "OpRandomForestClassifier"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000
+    d = 64
+    folds = 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    n_pad = bucket_for(n)
+    Xd = jnp.asarray(np.pad(X, ((0, n_pad - n), (0, 0))))
+    yd = jnp.asarray(np.pad(y, (0, n_pad - n)))
+
+    fam = MODEL_REGISTRY[fam_name]
+    grid = fam.default_grid("binary")
+    G = len(grid)
+    garr = fam.grid_to_arrays(grid)
+    rngm = np.random.RandomState(1)
+    fold_ids = rngm.randint(0, folds, size=n_pad).astype(np.uint8)
+    f_iota = jnp.arange(folds, dtype=jnp.uint8)[:, None]
+    ids_d = jnp.asarray(fold_ids)
+    train_w = (ids_d[None, :] != f_iota).astype(jnp.float32)
+    W = jnp.repeat(train_w, G, axis=0)
+    tiled = {k: jnp.tile(v, folds) for k, v in garr.items()}
+
+    def force(tree):
+        # scalar-forcing: device-side reduction + a 4-byte transfer, so the
+        # timing excludes tunnel bulk transfer (block_until_ready is a no-op
+        # over the tunnel; bulk np.asarray would time the link, not the TPU)
+        import jax.numpy as jnp_
+        leaves = [a for a in jax.tree_util.tree_leaves(tree)
+                  if hasattr(a, "dtype")]
+        s = sum(jnp_.sum(jnp_.abs(a.astype(jnp_.float32))) for a in leaves)
+        return float(np.asarray(s))
+
+    params = fam.sweep_fit_batch(Xd, yd, W, tiled, 2)
+    force(params)
+    dt_fit = t(lambda: force(fam.sweep_fit_batch(Xd, yd, W, tiled, 2)))
+    print(f"{fam_name}: sweep_fit_batch {dt_fit:.3f}s", flush=True)
+
+    nf = 131072
+    Xf = Xd[:nf]
+    dt_pred = t(lambda: force(fam.predict_batch(
+        fam.slice_params(params, 0, G), Xf, 2)))
+    print(f"{fam_name}: predict_batch 1fold/{nf} rows {dt_pred:.3f}s "
+          f"(x{folds} folds = {dt_pred*folds:.3f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,133 @@ def test_gbt_sweep_leaf_clamp_keeps_small_parents():
     assert leaf[0, 1] != 0.0
     assert leaf[0, 2] == 0.0       # H=0.5 under parent 1000.5: noise, zeroed
     assert leaf[0, 3] != 0.0
+
+
+# -- round-4 VERDICT items: serve fusion, LOCO vectorization, mesh honesty ---
+
+def _tiny_binary_table(n=96, seed=3):
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import OPVector, RealNN
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return FeatureTable({
+        "label": Column(RealNN, y),
+        "vec": Column(OPVector, X),
+    }, n), X, y
+
+
+def _fit_selected_model(models=None):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.selector.model_selector import ModelSelector
+    tbl, X, y = _tiny_binary_table()
+    label = FeatureBuilder.RealNN("label").extract(lambda r: r["label"]) \
+        .as_response()
+    vec_f = FeatureBuilder.OPVector("vec").extract(lambda r: r["vec"]) \
+        .as_predictor()
+    sel = ModelSelector("binary", models=models, splitter=None)
+    model = sel.set_input(label, vec_f).fit(tbl)
+    return model, tbl
+
+
+@pytest.mark.parametrize("models", [
+    [("OpLogisticRegression", [{"regParam": 0.01, "elasticNetParam": 0.0}])],
+    [("OpGBTClassifier", [{"maxDepth": 3, "minInstancesPerNode": 1,
+                           "minInfoGain": 0.0, "maxIter": 5,
+                           "stepSize": 0.3}])],
+])
+def test_selected_model_device_columnar_matches_transform(models):
+    """The fused Prediction emission (device_columnar) must equal the plain
+    transform_column matrix exactly (VERDICT r3 missing #4)."""
+    import jax.numpy as jnp
+    model, tbl = _fit_selected_model(models)
+    assert model.device_fusable
+    plain = np.asarray(model.transform_column(tbl).values)
+    X = jnp.asarray(np.asarray(tbl["vec"].values, np.float32))
+    vals, mask = model.device_columnar({model.device_inputs()[0]: (X, None)})
+    assert mask is None
+    np.testing.assert_allclose(np.asarray(vals), plain, rtol=1e-6, atol=1e-6)
+
+
+def test_compiled_score_includes_model_stage():
+    """compiled_score_function fuses the SelectedModel: no tail host stages
+    remain for a numeric pipeline, and the output column keeps the
+    Prediction type + keys metadata."""
+    from transmogrifai_tpu.local.scoring import compiled_score_function
+    from transmogrifai_tpu.types import Prediction
+    model, tbl = _fit_selected_model()
+    out_f = model.get_output()
+
+    class _WrapModel:
+        stages = [model]
+        result_features = [out_f]
+
+        def score(self, table):  # pragma: no cover - fallback path
+            raise AssertionError("fusion should have engaged")
+
+    fn = compiled_score_function(_WrapModel())
+    scored = fn(tbl)
+    col = scored[out_f.name]
+    assert col.feature_type is Prediction
+    keys = col.metadata.get("keys")
+    assert keys and keys[0] == "prediction"
+    plain = np.asarray(model.transform_column(tbl).values)
+    np.testing.assert_allclose(np.asarray(col.values), plain,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_loco_topk_maps_lazy_and_correct():
+    """Vectorized LOCO assembly: lazy TopKMaps match an eagerly-built
+    per-row dict construction (VERDICT r3 weak #4)."""
+    from transmogrifai_tpu.insights.record_insights import (
+        RecordInsightsLOCO, TopKMaps)
+    model, tbl = _fit_selected_model()
+    vec_feature = model.input_features[-1]
+    loco = RecordInsightsLOCO(model, top_k=3)
+    loco.set_input(vec_feature)
+    col = loco.transform_column(tbl)
+    assert isinstance(col.values, TopKMaps)
+    n = len(col.values)
+    dense = np.asarray(col.values)
+    assert dense is np.asarray(col.values)  # cached materialization
+    for i in (0, n // 2, n - 1):
+        d = col.values[i]
+        assert isinstance(d, dict) and len(d) <= 3
+        assert d == dense[i]
+        # descending |contribution| insertion order
+        mags = [abs(v) for v in d.values()]
+        assert mags == sorted(mags, reverse=True)
+
+
+def test_mesh_fold_sliced_eval_cap_applies():
+    """Under a mesh, fold-sliced scoring (and so max_eval_rows) now applies:
+    mesh sweep == single-device sweep metrics (VERDICT r3 weak #2)."""
+    import jax
+    from jax.sharding import Mesh
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n, d = 2048, 8
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    fam = MODEL_REGISTRY["OpLogisticRegression"]
+    models = [(fam, [{"regParam": 0.01, "elasticNetParam": 0.0},
+                     {"regParam": 0.1, "elasticNetParam": 0.5}])]
+
+    cv0 = OpCrossValidation(num_folds=3, seed=0, max_eval_rows=256)
+    best0 = cv0.validate(models, jnp.asarray(X), jnp.asarray(y), "binary",
+                         "AuROC", True, 2)
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    with Mesh(devs, ("data", "model")) as mesh:
+        cv1 = OpCrossValidation(num_folds=3, seed=0, max_eval_rows=256,
+                                mesh=mesh)
+        best1 = cv1.validate(models, jnp.asarray(X), jnp.asarray(y), "binary",
+                             "AuROC", True, 2)
+    np.testing.assert_allclose(best0.results[0].fold_metrics,
+                               best1.results[0].fold_metrics,
+                               rtol=1e-5, atol=1e-5)
+    assert best0.hyper == best1.hyper

@@ -14,6 +14,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -83,12 +84,16 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         self.splitter = splitter if splitter is not None else DataSplitter()
         self.evaluator = evaluator
         self.models = self._resolve_models(models)
+        self.mesh = None
 
     def set_mesh(self, mesh) -> "ModelSelector":
         """Shard the sweep over a ('data', 'model') mesh: rows over 'data',
         the config batch over 'model' (SURVEY §2.10 P1/P2; the reference's
-        8-thread Future pool becomes mesh axes)."""
+        8-thread Future pool becomes mesh axes). Also shards the winner
+        refit, and the fitted SelectedModel keeps scoring row-sharded (the
+        train/holdout evaluations ride it)."""
         self.validator.mesh = mesh
+        self.mesh = mesh
         return self
 
     def _resolve_models(self, models):
@@ -283,12 +288,21 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         family = MODEL_REGISTRY[best.family_name]
         garr = family.grid_to_arrays([best.hyper])
         n_fit = len(y)
-        n_pad = bucket_for(n_fit)
+        n_data = self.mesh.shape["data"] if self.mesh is not None else 1
+        n_pad = bucket_for(n_fit, multiple_of=n_data)
         Xf, yf = Xd, yd
         if n_pad != n_fit:
             Xf = jnp.pad(Xd, ((0, n_pad - n_fit), (0, 0)))
             yf = jnp.pad(yd, (0, n_pad - n_fit))
         W = jnp.zeros((1, n_pad), jnp.float32).at[:, :n_fit].set(1.0)
+        if self.mesh is not None:
+            # the winner refit is a full-data fit — shard its rows over
+            # 'data' like the sweep (round-3 left it unsharded: the most
+            # expensive single fit of the train path ran on one chip)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            Xf = jax.device_put(Xf, NamedSharding(self.mesh, P("data", None)))
+            yf = jax.device_put(yf, NamedSharding(self.mesh, P("data")))
+            W = jax.device_put(W, NamedSharding(self.mesh, P(None, "data")))
         params_b = family.fit_batch(Xf, yf, W, garr, num_classes)
         fitted = FittedParams(
             family=family.name, params=family.select_params(params_b, 0),
@@ -309,6 +323,7 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         )
         model = SelectedModel(fitted=fitted, summary=summary,
                               label_mapping=prep.label_mapping)
+        model.mesh = self.mesh
         model = self._finalize_model(model)
 
         # train/holdout evaluation (reference :168-188)
@@ -354,6 +369,10 @@ class SelectedModel(AllowLabelAsInput, Transformer):
         self.summary = summary
         self.label_mapping = label_mapping
         self.summary_metadata: Dict[str, Any] = {}
+        #: wiring attr (never serialized): when set, columnar scoring shards
+        #: its rows over the mesh 'data' axis — the selector's train/holdout
+        #: evaluations and any mesh-resident serve path ride it
+        self.mesh = None
 
     def _unmap_prediction(self, pred: np.ndarray) -> np.ndarray:
         """Map dense class indices back to the original labels dropped/remapped
@@ -364,13 +383,63 @@ class SelectedModel(AllowLabelAsInput, Transformer):
         return np.vectorize(lambda v: inverse.get(int(v), int(v)),
                             otypes=[np.float32])(pred)
 
+    @property
+    def device_fusable(self) -> bool:
+        """True when the winning family has a jit-traceable predict — the
+        Prediction emission then compiles INTO the fused serve program
+        (local/scoring.compiled_score_function; reference analog: the one
+        serve pass of FitStagesUtil.scala:96-119)."""
+        from ...models.api import ModelFamily
+        family = MODEL_REGISTRY[self.fitted.family]
+        return type(family).predict_parts is not ModelFamily.predict_parts
+
+    def device_inputs(self):
+        """Only the feature vector is read at serve time (the label input
+        feeds training, not the fitted model)."""
+        return [self.input_features[-1].name]
+
+    def device_columnar(self, env):
+        """Pure-jax dual of ``transform_column``: the (n, k) Prediction
+        matrix in ``prediction_column``'s key order."""
+        X, _ = env[self.device_inputs()[0]]
+        family = MODEL_REGISTRY[self.fitted.family]
+        parts = family.predict_parts(self.fitted, X)
+        pred = parts["prediction"].reshape(-1)
+        if self.label_mapping:
+            # DataCutter label de-index (see _unmap_prediction), as a dense
+            # lookup table: unmapped dense indices pass through unchanged
+            inverse = {dense: orig for orig, dense in
+                       self.label_mapping.items()}
+            size = max(inverse) + 2
+            inv = np.arange(size, dtype=np.float32)
+            for dense, orig in inverse.items():
+                inv[dense] = orig
+            idx = jnp.clip(pred.astype(jnp.int32), 0, size - 1)
+            pred = jnp.take(jnp.asarray(inv), idx)
+        cols = [pred]
+        for name in (Prediction.RawPredictionName,
+                     Prediction.ProbabilityName):
+            if name in parts:
+                arr = parts[name]
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                cols.extend(arr[:, i] for i in range(arr.shape[1]))
+        return jnp.stack(cols, axis=1), None
+
     def transform_column(self, table: FeatureTable) -> Column:
         _, vec_f = self.input_features
         X = jnp.asarray(table[vec_f.name].values, dtype=jnp.float32)
         n = X.shape[0]
-        n_pad = bucket_for(n)
+        # getattr: models loaded from disk predate the wiring attr (mesh is
+        # never serialized; the loading context re-attaches it if sharding)
+        mesh = getattr(self, "mesh", None)
+        n_data = mesh.shape["data"] if mesh is not None else 1
+        n_pad = bucket_for(n, multiple_of=n_data)
         if n_pad != n:  # bucket rows so the predict program is reused
             X = jnp.pad(X, ((0, n_pad - n), (0, 0)))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            X = jax.device_put(X, NamedSharding(mesh, P("data", None)))
         family = MODEL_REGISTRY[self.fitted.family]
         parts = family.predict_one(self.fitted, X)
         if n_pad != n:

@@ -170,8 +170,8 @@ class OpValidator:
         ``val_masks`` overrides the fold construction with explicit (F, n)
         boolean validation masks — used by the workflow-level CV path, which
         must evaluate one externally-prepared fold at a time. ``fold_sliced``
-        forces the per-fold row-gather scoring path on/off (default: on
-        whenever rows are not mesh-sharded)."""
+        forces the per-fold row-gather scoring path on/off (default: on —
+        under a mesh the gathered fold tensors are re-sharded over 'data')."""
         if val_masks is None:
             val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
@@ -207,13 +207,14 @@ class OpValidator:
         val_m = ids_d[None, :] == f_iota                          # (F, n)
         # fold-sliced scoring: every (fold, config) pair only needs ITS
         # fold's validation rows, so predict + metric run on the gathered
-        # per-fold partitions (~n/F rows each) instead of all n rows and a
-        # mask — an F x cut on the heavy tree predicts. The mesh path keeps
-        # full-row scoring (rows are sharded; a host-built gather would
-        # break the sharding layout).
+        # per-fold partitions (~n/F rows each, capped at max_eval_rows)
+        # instead of all n rows and a mask — an F x cut on the heavy tree
+        # predicts. Under a mesh the gathered fold tensors are re-placed
+        # with their row axis sharded over 'data' (round-3 forced full-row
+        # masked scoring here, silently dropping the eval-row cap — the
+        # mesh sweep then did MORE per-chip predict work than one chip).
         if fold_sliced is None:
-            fold_sliced = self.mesh is None
-        fold_sliced = fold_sliced and self.mesh is None
+            fold_sliced = True
         # the fold gather is built lazily, on the first family that uses it
         # (fold_sliced_predict, default on: with the max_eval_rows cap the
         # gathered rows beat full-row masked scoring even for single-matmul
@@ -227,7 +228,7 @@ class OpValidator:
                 nf = int(counts.max()) if F > 0 else 0
                 if cap is not None and nf > cap:
                     nf = cap
-                nf_b = bucket_for(max(nf, 1))
+                nf_b = bucket_for(max(nf, 1), multiple_of=n_data)
                 fidx = np.zeros((F, nf_b), np.int32)
                 fvalid = np.zeros((F, nf_b), bool)
                 for f in range(F):
@@ -243,10 +244,24 @@ class OpValidator:
                     fidx[f, :len(rows)] = rows
                     fvalid[f, :len(rows)] = True
                 fidx_d = jnp.asarray(fidx.reshape(-1))
-                _fold_cache["Xf"] = X[fidx_d].reshape(
-                    (F, nf_b) + X.shape[1:])
-                _fold_cache["yf"] = y[fidx_d].reshape(F, nf_b)
-                _fold_cache["valid"] = jnp.asarray(fvalid)
+                Xf = X[fidx_d].reshape((F, nf_b) + X.shape[1:])
+                yf = y[fidx_d].reshape(F, nf_b)
+                fvalid_d = jnp.asarray(fvalid)
+                if self.mesh is not None:
+                    # fold row axis sharded over 'data' so the per-fold
+                    # predicts + metrics stay row-parallel across chips
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+                    Xf = jax.device_put(Xf, NamedSharding(
+                        self.mesh,
+                        P(None, "data", *([None] * (X.ndim - 1)))))
+                    yf = jax.device_put(yf, NamedSharding(
+                        self.mesh, P(None, "data")))
+                    fvalid_d = jax.device_put(fvalid_d, NamedSharding(
+                        self.mesh, P(None, "data")))
+                _fold_cache["Xf"] = Xf
+                _fold_cache["yf"] = yf
+                _fold_cache["valid"] = fvalid_d
             return (_fold_cache["Xf"], _fold_cache["yf"],
                     _fold_cache["valid"])
         # pin binned-vs-exact AuROC/AuPR to the PRE-slice row count so

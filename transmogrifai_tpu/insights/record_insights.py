@@ -21,6 +21,81 @@ from ..types import OPVector, TextMap
 from ..vector_metadata import VectorMetadata
 
 
+class TopKMaps:
+    """Lazy array of per-row ``{column name: contribution}`` dicts.
+
+    The top-k assembly is fully vectorized (argsort + take_along_axis on the
+    whole batch); Python dicts materialize only on element ACCESS. At the
+    1M-row scale the batched device scoring already handles, building a dict
+    per row eagerly was the dominant serve-path cost (reference analog
+    RecordInsightsLOCO.scala:61-97 builds per-row maps inside a Spark UDF —
+    a row-at-a-time design this columnar layout replaces).
+
+    names: group/column name vocabulary; idx: (n, k) int indices into it,
+    -1 = unused slot; vals: (n, k) contribution values, slot order =
+    descending |contribution| (dict insertion order preserves it).
+    """
+
+    def __init__(self, names: Sequence[str], idx: np.ndarray,
+                 vals: np.ndarray):
+        self._names = list(names)
+        self._idx = idx
+        self._vals = vals
+        self._dense: Optional[np.ndarray] = None
+        self.ndim = 1
+        self.dtype = np.dtype(object)
+
+    @property
+    def shape(self):
+        return (self._idx.shape[0],)
+
+    def __len__(self) -> int:
+        return int(self._idx.shape[0])
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            return {self._names[j]: float(v)
+                    for j, v in zip(self._idx[i], self._vals[i]) if j >= 0}
+        return TopKMaps(self._names, self._idx[i], self._vals[i])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None, copy=None):
+        # cached: consumers like np.asarray(col.values)[i] inside per-row
+        # loops must not re-materialize the whole column per row
+        if self._dense is None:
+            out = np.empty(len(self), dtype=object)
+            for i in range(len(self)):
+                out[i] = self[i]
+            self._dense = out
+        return self._dense
+
+    def tolist(self) -> List[Dict[str, float]]:
+        return list(self)
+
+    def any_mask(self) -> np.ndarray:
+        """(n,) bool: row has at least one nonzero contribution."""
+        return (self._idx >= 0).any(axis=1)
+
+
+def _topk_maps_column(names: Sequence[str], contrib_rows: np.ndarray,
+                      k: int) -> Column:
+    """contrib_rows: (n, g) per-row contributions → TextMap column of lazy
+    top-k dicts (vectorized: one argsort over the batch, no per-row loop)."""
+    k = min(k, contrib_rows.shape[1])
+    order = np.argsort(-np.abs(contrib_rows), axis=1)[:, :k]    # (n, k)
+    vals = np.take_along_axis(contrib_rows, order, axis=1)      # (n, k)
+    # filter on the RAW contribution (a tiny-but-nonzero slot stays in the
+    # map, rounding to 0.0 in its value — the eager path's semantics), THEN
+    # round for display
+    idx = np.where(vals != 0.0, order, -1).astype(np.int32)
+    vals = np.round(vals.astype(np.float64), 6)
+    maps = TopKMaps(names, idx, vals)
+    return Column(TextMap, maps, maps.any_mask())
+
+
 def _score_of(parts: Dict[str, np.ndarray]) -> np.ndarray:
     """Scalar score per row from prediction parts: P(class 1) for binary,
     max-class probability for multiclass, raw prediction for regression
@@ -115,16 +190,7 @@ class RecordInsightsLOCO(AllowLabelAsInput, Transformer):
         # positive → slot pushed score up
 
         names = [name for name, _ in groups]
-        out = np.empty(n, dtype=object)
-        k = min(self.top_k, g)
-        order = np.argsort(-np.abs(diffs), axis=0)[:k]   # (k, n)
-        for i in range(n):
-            top = {}
-            for v in order[:, i]:
-                if diffs[v, i] != 0.0:
-                    top[names[v]] = round(float(diffs[v, i]), 6)
-            out[i] = top
-        return Column(TextMap, out, np.array([bool(o) for o in out]))
+        return _topk_maps_column(names, diffs.T, self.top_k)
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
         one = FeatureTable(
@@ -174,16 +240,7 @@ class RecordInsightsCorr(AllowLabelAsInput, Transformer):
         std[std == 0] = 1.0
         contrib = ((X - mean) / std) * corr[None, :]    # (n, d)
 
-        out = np.empty(n, dtype=object)
-        k = min(self.top_k, d)
-        order = np.argsort(-np.abs(contrib), axis=1)[:, :k]
-        for i in range(n):
-            top = {}
-            for j in order[i]:
-                if contrib[i, j] != 0.0:
-                    top[names[j]] = round(float(contrib[i, j]), 6)
-            out[i] = top
-        return Column(TextMap, out, np.array([bool(o) for o in out]))
+        return _topk_maps_column(names, contrib, self.top_k)
 
     def transform_row(self, row: Dict[str, Any]) -> Any:
         raise ValueError(

@@ -67,8 +67,11 @@ def compiled_score_function(model):
     stages = list(model.stages)
     # dataflow partition (not list-suffix): fuse every device-capable stage
     # unless it reads a column produced by a host stage that itself depends
-    # on a fused output (that host stage must run AFTER the fused program)
-    fused_set = {id(s) for s in stages if hasattr(s, "device_columnar")}
+    # on a fused output (that host stage must run AFTER the fused program).
+    # ``device_fusable`` lets a stage opt out dynamically (e.g. a
+    # SelectedModel whose winning family has no traceable predict).
+    fused_set = {id(s) for s in stages if hasattr(s, "device_columnar")
+                 and getattr(s, "device_fusable", True)}
 
     def _inputs(s):
         return (s.device_inputs() if hasattr(s, "device_inputs")
@@ -144,8 +147,9 @@ def compiled_score_function(model):
             for s in fused:
                 probe = s.transform(probe)
                 nm = s.get_output().name
-                meta_cache[nm] = {
-                    k2: v for k2, v in probe[nm].metadata.items()}
+                meta_cache[nm] = (
+                    probe[nm].feature_type,
+                    {k2: v for k2, v in probe[nm].metadata.items()})
         n = tbl.num_rows
         n_pad = bucket_for(n)
         vals_list, mask_list = [], []
@@ -171,9 +175,8 @@ def compiled_score_function(model):
             msk_np = None if msk is None else np.asarray(msk)[:n]
             if msk_np is not None and msk_np.all():
                 msk_np = None
-            new_cols[nm] = Column(
-                OPVectorType, arr[:n], msk_np,
-                dict(meta_cache.get(nm, {})))
+            ftype, md = meta_cache.get(nm, (OPVectorType, {}))
+            new_cols[nm] = Column(ftype, arr[:n], msk_np, dict(md))
         tbl = FeatureTable(new_cols, n, key=tbl.key)
         for s in tail_host:
             tbl = s.transform(tbl)

@@ -74,6 +74,17 @@ class ModelFamily(abc.ABC):
     def predict_one(self, fitted: FittedParams, X: jnp.ndarray) -> Dict[str, np.ndarray]:
         """Single-model prediction parts: {'prediction', 'probability'?, 'rawPrediction'?}."""
 
+    def predict_parts(self, fitted: FittedParams,
+                      X: jnp.ndarray) -> Optional[Dict[str, jnp.ndarray]]:
+        """jit-traceable dual of ``predict_one``: identical parts as jnp
+        arrays (the fitted params close over the trace as constants), so the
+        winning model's Prediction emission can compile INTO the one fused
+        serve program (local/scoring.compiled_score_function — reference
+        analog FitStagesUtil.scala:96-119 folds every stage in one pass).
+        None = this family's predict is host-only and the serve-path fusion
+        must leave the model stage outside the compiled program."""
+        return None
+
     def feature_importances(self, fitted: "FittedParams") -> Optional[np.ndarray]:
         """Per-input-dimension contribution scores for ModelInsights
         (|coefficients| for linear families, split frequencies for trees);

@@ -132,10 +132,14 @@ class GeneralizedLinearRegressionFamily(ModelFamily):
             + params["bias"][:, None]
         return _glm_mean(margin, params["family"][:, None])
 
-    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
-        margin = X @ fitted.params["coef"] + fitted.params["bias"]
+    def predict_parts(self, fitted: FittedParams, X):
+        margin = X @ jnp.asarray(fitted.params["coef"]) + fitted.params["bias"]
         pred = _glm_mean(margin, jnp.asarray(fitted.params["family"]))
-        return {"prediction": np.asarray(pred)}
+        return {"prediction": pred}
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, X).items()}
 
 
 register_family(GeneralizedLinearRegressionFamily())

@@ -275,19 +275,23 @@ class LogisticRegressionFamily(ModelFamily):
             + params["b"][:, None, :]
         return jax.nn.softmax(logits, axis=-1)
 
-    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+    def predict_parts(self, fitted: FittedParams, X):
         if fitted.num_classes <= 2:
-            margin = X @ fitted.params["coef"] + fitted.params["bias"]
+            margin = X @ jnp.asarray(fitted.params["coef"]) \
+                + fitted.params["bias"]
             p1 = jax.nn.sigmoid(margin)
             prob = jnp.stack([1 - p1, p1], axis=1)
             raw = jnp.stack([-margin, margin], axis=1)
         else:
-            raw = X @ fitted.params["W"] + fitted.params["b"]
+            raw = X @ jnp.asarray(fitted.params["W"]) \
+                + jnp.asarray(fitted.params["b"])
             prob = jax.nn.softmax(raw, axis=-1)
         pred = prob.argmax(axis=1).astype(jnp.float32)
-        return {"prediction": np.asarray(pred),
-                "probability": np.asarray(prob),
-                "rawPrediction": np.asarray(raw)}
+        return {"prediction": pred, "probability": prob, "rawPrediction": raw}
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, X).items()}
 
 
 @partial(jax.jit, static_argnames=("num_classes", "iters"))
@@ -416,9 +420,13 @@ class LinearRegressionFamily(ModelFamily):
         return jnp.einsum("bd,nd->bn", params["coef"], X, precision=_PREC) \
             + params["bias"][:, None]
 
+    def predict_parts(self, fitted: FittedParams, X):
+        pred = X @ jnp.asarray(fitted.params["coef"]) + fitted.params["bias"]
+        return {"prediction": pred}
+
     def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
-        pred = X @ fitted.params["coef"] + fitted.params["bias"]
-        return {"prediction": np.asarray(pred)}
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, X).items()}
 
 
 # ---------------------------------------------------------------------------
@@ -501,11 +509,15 @@ class LinearSVCFamily(ModelFamily):
             + params["bias"][:, None]
         return jax.nn.sigmoid(margins)
 
-    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
-        margin = X @ fitted.params["coef"] + fitted.params["bias"]
+    def predict_parts(self, fitted: FittedParams, X):
+        margin = X @ jnp.asarray(fitted.params["coef"]) + fitted.params["bias"]
         pred = (margin > 0).astype(jnp.float32)
         raw = jnp.stack([-margin, margin], axis=1)
-        return {"prediction": np.asarray(pred), "rawPrediction": np.asarray(raw)}
+        return {"prediction": pred, "rawPrediction": raw}
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, X).items()}
 
 
 # ---------------------------------------------------------------------------
@@ -552,13 +564,17 @@ class NaiveBayesFamily(ModelFamily):
             return jax.nn.softmax(logits, axis=-1)[:, :, 1]
         return jax.nn.softmax(logits, axis=-1)
 
-    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+    def predict_parts(self, fitted: FittedParams, X):
         Xp = jnp.maximum(X, 0.0)
-        raw = Xp @ fitted.params["log_prob"].T + fitted.params["log_prior"]
+        raw = Xp @ jnp.asarray(fitted.params["log_prob"]).T \
+            + jnp.asarray(fitted.params["log_prior"])
         prob = jax.nn.softmax(raw, axis=-1)
         pred = prob.argmax(axis=1).astype(jnp.float32)
-        return {"prediction": np.asarray(pred), "probability": np.asarray(prob),
-                "rawPrediction": np.asarray(raw)}
+        return {"prediction": pred, "probability": prob, "rawPrediction": raw}
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, X).items()}
 
 
 register_family(LogisticRegressionFamily())

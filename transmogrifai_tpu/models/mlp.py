@@ -148,13 +148,17 @@ class MultilayerPerceptronFamily(ModelFamily):
              "num_classes": batched["num_classes"]},
             is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray, int)))
 
-    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+    def predict_parts(self, fitted: FittedParams, X):
         p = fitted.params
-        logits = _forward(p["params"], jnp.asarray(X), p["masks"])
+        logits = _forward(p["params"], X, p["masks"])
         prob = jax.nn.softmax(logits, axis=-1)
         pred = prob.argmax(axis=1).astype(jnp.float32)
-        return {"prediction": np.asarray(pred), "probability": np.asarray(prob),
-                "rawPrediction": np.asarray(logits)}
+        return {"prediction": pred, "probability": prob,
+                "rawPrediction": logits}
+
+    def predict_one(self, fitted: FittedParams, X) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, jnp.asarray(X)).items()}
 
 
 register_family(MultilayerPerceptronFamily())

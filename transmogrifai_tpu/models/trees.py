@@ -1283,6 +1283,21 @@ def _embed_depth(params, d_small: int, d_max: int, n_bins: int,
     return out
 
 
+def _stitch_parts(B: int, parts):
+    """Scatter per-bucket param dicts (config-subset axis 0) back into a
+    (B, ...) batch; 'edges' is shared across buckets and passes through."""
+    stitched = None
+    for idx, p in parts:
+        if stitched is None:
+            stitched = {k: (v if k == "edges"
+                            else jnp.zeros((B,) + v.shape[1:], v.dtype))
+                        for k, v in p.items()}
+        for k, v in p.items():
+            if k != "edges":
+                stitched[k] = stitched[k].at[jnp.asarray(idx)].set(v)
+    return stitched
+
+
 def _fit_depth_grouped(grid, weights, fit_group, n_bins: int,
                        leaf_axis: int, fit_group_deep=None, n_slots: int = 0):
     """Partition the config batch by maxDepth and fit each bucket with its
@@ -1308,7 +1323,7 @@ def _fit_depth_grouped(grid, weights, fit_group, n_bins: int,
                          default=0)
         n_slots = max(n_slots, 2 ** d_heap_max)
     B = md.shape[0]
-    stitched = None
+    parts = []
     for u in uniq:
         idx = np.nonzero(md == u)[0]
         sub = {k: v[idx] for k, v in grid.items()}
@@ -1322,14 +1337,8 @@ def _fit_depth_grouped(grid, weights, fit_group, n_bins: int,
         else:
             p = _embed_depth(fit_group(sub, weights[idx], u), u, d_max,
                              n_bins, leaf_axis)
-        if stitched is None:
-            stitched = {k: (v if k == "edges"
-                            else jnp.zeros((B,) + v.shape[1:], v.dtype))
-                        for k, v in p.items()}
-        for k, v in p.items():
-            if k != "edges":
-                stitched[k] = stitched[k].at[jnp.asarray(idx)].set(v)
-    return stitched
+        parts.append((idx, p))
+    return _stitch_parts(B, parts)
 
 
 class DecisionTreeFamilyBase(_TreeFamilyBase):
@@ -1356,8 +1365,7 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
 
         return _fit_depth_grouped(
             grid, weights, fit_group, N_BINS, leaf_axis=-2,
-            fit_group_deep=lambda g, w, d, s: fit_group(g, w, d, s),
-            n_slots=n_slots)
+            fit_group_deep=fit_group, n_slots=n_slots)
 
     def predict_batch(self, params, X, num_classes):
         edges = self._edges_of(params)
@@ -1380,11 +1388,14 @@ class DecisionTreeFamilyBase(_TreeFamilyBase):
             return out[..., 0]
         return _shape_scores(out, num_classes, task)
 
-    def predict_one(self, fitted: FittedParams, X):
+    def predict_parts(self, fitted: FittedParams, X):
         params = {k: jnp.asarray(v)[None] for k, v in fitted.params.items()}
-        out = np.asarray(self.predict_batch(
-            params, jnp.asarray(X), fitted.num_classes))[0]
-        return _parts(out, fitted.num_classes, self._task(fitted.num_classes))
+        out = self.predict_batch(params, X, fitted.num_classes)[0]
+        return _parts_j(out, fitted.num_classes, self._task(fitted.num_classes))
+
+    def predict_one(self, fitted: FittedParams, X):
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, jnp.asarray(X)).items()}
 
 
 class RandomForestFamilyBase(_TreeFamilyBase):
@@ -1417,8 +1428,7 @@ class RandomForestFamilyBase(_TreeFamilyBase):
 
         return _fit_depth_grouped(
             grid, weights, fit_group, N_BINS, leaf_axis=-2,
-            fit_group_deep=lambda g, w, d, s: fit_group(g, w, d, s),
-            n_slots=n_slots)
+            fit_group_deep=fit_group, n_slots=n_slots)
 
     def predict_batch(self, params, X, num_classes):
         edges = self._edges_of(params)
@@ -1442,11 +1452,14 @@ class RandomForestFamilyBase(_TreeFamilyBase):
             return out[..., 0]
         return _shape_scores(out, num_classes, task)
 
-    def predict_one(self, fitted: FittedParams, X):
+    def predict_parts(self, fitted: FittedParams, X):
         params = {k: jnp.asarray(v)[None] for k, v in fitted.params.items()}
-        out = np.asarray(self.predict_batch(
-            params, jnp.asarray(X), fitted.num_classes))[0]
-        return _parts(out, fitted.num_classes, self._task(fitted.num_classes))
+        out = self.predict_batch(params, X, fitted.num_classes)[0]
+        return _parts_j(out, fitted.num_classes, self._task(fitted.num_classes))
+
+    def predict_one(self, fitted: FittedParams, X):
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, jnp.asarray(X)).items()}
 
 
 class GBTFamilyBase(_TreeFamilyBase):
@@ -1500,7 +1513,6 @@ class GBTFamilyBase(_TreeFamilyBase):
         if (~deep_mask).any():
             n_slots = max(n_slots, 2 ** int(md[~deep_mask].max()))
         B = md.shape[0]
-        stitched = None
         parts = []
         if (~deep_mask).any():
             idx = np.nonzero(~deep_mask)[0]
@@ -1515,15 +1527,7 @@ class GBTFamilyBase(_TreeFamilyBase):
             p = _pad_chain_depth(one_call(sub, weights[idx], u, n_slots),
                                  u, d_max, N_BINS, leaf_axis=-1)
             parts.append((idx, p))
-        for idx, p in parts:
-            if stitched is None:
-                stitched = {k: (v if k == "edges"
-                                else jnp.zeros((B,) + v.shape[1:], v.dtype))
-                            for k, v in p.items()}
-            for k, v in p.items():
-                if k != "edges":
-                    stitched[k] = stitched[k].at[jnp.asarray(idx)].set(v)
-        return stitched
+        return _stitch_parts(B, parts)
 
     def predict_batch(self, params, X, num_classes):
         edges = self._edges_of(params)
@@ -1546,21 +1550,24 @@ class GBTFamilyBase(_TreeFamilyBase):
             return jax.nn.sigmoid(margins[:, 0, :])
         return jax.nn.softmax(jnp.swapaxes(margins, 1, 2), axis=-1)
 
-    def predict_one(self, fitted: FittedParams, X):
+    def predict_parts(self, fitted: FittedParams, X):
         params = {k: jnp.asarray(v)[None] for k, v in fitted.params.items()}
         task = self._gbt_task(fitted.num_classes)
-        out = np.asarray(self.predict_batch(
-            params, jnp.asarray(X), fitted.num_classes))[0]
+        out = self.predict_batch(params, X, fitted.num_classes)[0]
         if task == "regression":
             return {"prediction": out}
         if task == "binary":
-            prob = np.stack([1 - out, out], axis=1)
-            pred = (out > 0.5).astype(np.float32)
+            prob = jnp.stack([1 - out, out], axis=1)
+            pred = (out > 0.5).astype(jnp.float32)
             return {"prediction": pred, "probability": prob,
-                    "rawPrediction": np.log(np.clip(prob, 1e-12, None))}
-        pred = out.argmax(axis=1).astype(np.float32)
+                    "rawPrediction": jnp.log(jnp.maximum(prob, 1e-12))}
+        pred = out.argmax(axis=1).astype(jnp.float32)
         return {"prediction": pred, "probability": out,
-                "rawPrediction": np.log(np.clip(out, 1e-12, None))}
+                "rawPrediction": jnp.log(jnp.maximum(out, 1e-12))}
+
+    def predict_one(self, fitted: FittedParams, X):
+        return {k: np.asarray(v)
+                for k, v in self.predict_parts(fitted, jnp.asarray(X)).items()}
 
 
 # -- shared output shaping ---------------------------------------------------
@@ -1579,13 +1586,14 @@ def _shape_scores(out, num_classes, task):
     return out[..., :num_classes]
 
 
-def _parts(out, num_classes, task):
+def _parts_j(out, num_classes, task):
+    """Prediction parts from family-convention scores, jit-traceable."""
     if task == "regression":
         return {"prediction": out}
-    prob = np.stack([1 - out, out], axis=1) if out.ndim == 1 else out
-    pred = prob.argmax(axis=1).astype(np.float32)
+    prob = jnp.stack([1 - out, out], axis=1) if out.ndim == 1 else out
+    pred = prob.argmax(axis=1).astype(jnp.float32)
     return {"prediction": pred, "probability": prob,
-            "rawPrediction": np.log(np.clip(prob, 1e-12, None))}
+            "rawPrediction": jnp.log(jnp.maximum(prob, 1e-12))}
 
 
 # -- concrete registered families --------------------------------------------

@@ -585,37 +585,38 @@ def _chain_xla_rowblocks(codes, fn, blk: int = 16384):
     return jax.lax.map(fn, blocks), n
 
 
+def _chain_leaf_onehot_xla(c, feat_lv, bin_lv, base_lv, W_out, n_bins):
+    """Route a row block down the chain tables and expand the (rows, T·W_out)
+    leaf-slot one-hot — the shared front half of the XLA leaf-sums/predict
+    fallbacks."""
+    T = feat_lv.shape[0]
+    node = route_codes_chain_xla(c, feat_lv, bin_lv, base_lv, n_bins)
+    comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
+    return (comb[:, :, None]
+            == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
+            ).astype(jnp.float32).reshape(c.shape[0], T * W_out)
+
+
 def _leaf_sums_chain_xla(codes, feat_lv, bin_lv, base_lv, aug, *, n_bins):
     n = codes.shape[0]
     T, depth, W = feat_lv.shape
     W_out = min(2 ** depth, W)
     aug_f = aug.astype(jnp.float32)
     blk = 16384
-    if n <= blk:
-        node = route_codes_chain_xla(codes, feat_lv, bin_lv, base_lv, n_bins)
-        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
-        l_oh = (comb[:, :, None]
-                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
-                ).astype(jnp.float32).reshape(n, T * W_out)
-        out = jnp.einsum("na,nk->ak", l_oh, aug_f,
-                         preferred_element_type=jnp.float32,
-                         precision=jax.lax.Precision.HIGHEST)
-        return out.reshape(T, W_out, -1)
-    n_pad = -(-n // blk) * blk
-    codes_p = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
-    aug_p = jnp.pad(aug_f, ((0, n_pad - n), (0, 0)))  # zero rows: no-op
 
     def one(args):
         c, a = args
-        node = route_codes_chain_xla(c, feat_lv, bin_lv, base_lv, n_bins)
-        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
-        l_oh = (comb[:, :, None]
-                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
-                ).astype(jnp.float32).reshape(blk, T * W_out)
+        l_oh = _chain_leaf_onehot_xla(c, feat_lv, bin_lv, base_lv, W_out,
+                                      n_bins)
         return jnp.einsum("na,nk->ak", l_oh, a,
                           preferred_element_type=jnp.float32,
                           precision=jax.lax.Precision.HIGHEST)
 
+    if n <= blk:
+        return one((codes, aug_f)).reshape(T, W_out, -1)
+    n_pad = -(-n // blk) * blk
+    codes_p = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+    aug_p = jnp.pad(aug_f, ((0, n_pad - n), (0, 0)))  # zero rows: no-op
     parts = jax.lax.map(one, (codes_p.reshape(-1, blk, codes.shape[1]),
                               aug_p.reshape(-1, blk, aug.shape[1])))
     return parts.sum(0).reshape(T, W_out, -1)
@@ -627,12 +628,8 @@ def _predict_chain_xla(codes, feat_lv, bin_lv, base_lv, leaf, *, n_bins):
     leaf_2d = leaf.reshape(T * W_out, k).astype(jnp.float32)
 
     def one(c):
-        nb = c.shape[0]
-        node = route_codes_chain_xla(c, feat_lv, bin_lv, base_lv, n_bins)
-        comb = node + (jnp.arange(T, dtype=jnp.int32) * W_out)[None, :]
-        l_oh = (comb[:, :, None]
-                == jnp.arange(T * W_out, dtype=jnp.int32).reshape(1, T, W_out)
-                ).astype(jnp.float32).reshape(nb, T * W_out)
+        l_oh = _chain_leaf_onehot_xla(c, feat_lv, bin_lv, base_lv, W_out,
+                                      n_bins)
         return jnp.einsum("na,ak->nk", l_oh, leaf_2d,
                           preferred_element_type=jnp.float32,
                           precision=jax.lax.Precision.HIGHEST)
