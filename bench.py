@@ -14,17 +14,22 @@ masked metrics. The metric is (configurations × folds) / wall-clock of the
 full validate() call, including host-side split construction.
 
 Modes (BENCH_MODE env):
-- ``dense`` (default): a RandomParamBuilder-scale sweep — 108 configs
-  across the 4 families × 3 folds = 324 fits. This is the throughput
-  number: AutoML sweeps at this density are what the 8-thread reference
-  pool grinds through in minutes.
-- ``default``: the exact stock default grids (33 configs, 99 fits) —
-  smaller sweep, fixed costs dominate; recorded in docs/benchmarks.md.
+- ``both`` (default): runs ``default`` then ``dense`` and prints one JSON
+  line per mode (dense LAST — the headline line). Driver-verifies the
+  out-of-the-box number alongside the dense throughput number (round-3
+  VERDICT asked for both).
+- ``dense``: a RandomParamBuilder-scale sweep — 108 configs across the 4
+  families × 3 folds = 324 fits. This is the throughput number: AutoML
+  sweeps at this density are what the 8-thread reference pool grinds
+  through in minutes.
+- ``default``: the exact stock default grids (45 configs incl. the
+  depth-12 trees, 135 fits) — the path every
+  ``BinaryClassificationModelSelector()`` user gets; fixed costs dominate.
 - ``linear``: round-1's logistic-only sweep (compatibility).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is value / 100 (the BASELINE.json north-star target; the
-reference publishes no wall-clock numbers of its own).
+Each line: {"metric", "value", "unit", "vs_baseline"}. vs_baseline is
+value / 100 (the BASELINE.json north-star target; the reference publishes
+no wall-clock numbers of its own).
 """
 import json
 import os
@@ -36,7 +41,7 @@ import numpy as np
 def _models(mode, registry):
     if mode not in ("dense", "default", "linear"):
         raise SystemExit(f"unknown BENCH_MODE {mode!r}: "
-                         "use dense | default | linear")
+                         "use both | dense | default | linear")
     if mode == "linear":
         grid = [{"regParam": r, "elasticNetParam": e}
                 for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
@@ -66,29 +71,13 @@ def _models(mode, registry):
             (registry["OpLinearSVC"], svc)]
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
+    import jax  # noqa: F401
     from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
     from transmogrifai_tpu.models.api import MODEL_REGISTRY
-    import transmogrifai_tpu.models.linear  # noqa: F401
-    import transmogrifai_tpu.models.trees   # noqa: F401
-
-    platform = jax.devices()[0].platform
-    mode = os.environ.get("BENCH_MODE", "dense")
-    n = int(os.environ.get(
-        "BENCH_ROWS", 1_000_000 if platform == "tpu" else 20_000))
-    d = int(os.environ.get("BENCH_FEATURES", 64))
-    folds = 3
 
     models = _models(mode, MODEL_REGISTRY)
     B = folds * sum(len(g) for _, g in models)
-
-    rng = np.random.RandomState(0)
-    X = rng.randn(n, d).astype(np.float32)
-    w_true = rng.randn(d).astype(np.float32)
-    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
-    Xd, yd = jnp.asarray(X), jnp.asarray(y)
 
     def sweep():
         cv = OpCrossValidation(num_folds=folds, seed=0)
@@ -101,7 +90,6 @@ def main():
         return best
 
     sweep()                                  # compile warmup
-    reps = int(os.environ.get("BENCH_REPS", 5))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -119,7 +107,34 @@ def main():
         "value": round(fits_per_sec, 2),
         "unit": "fits/sec",
         "vs_baseline": round(fits_per_sec / 100.0, 3),
-    }))
+    }), flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees   # noqa: F401
+
+    platform = jax.devices()[0].platform
+    mode = os.environ.get("BENCH_MODE", "both")
+    n = int(os.environ.get(
+        "BENCH_ROWS", 1_000_000 if platform == "tpu" else 20_000))
+    d = int(os.environ.get("BENCH_FEATURES", 64))
+    folds = 3
+    reps = int(os.environ.get("BENCH_REPS", 5))
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    # "both": default (out-of-the-box grids) first, dense LAST so the final
+    # line remains the headline throughput number
+    modes = ("default", "dense") if mode == "both" else (mode,)
+    for m in modes:
+        _run_mode(m, Xd, yd, n, d, platform, folds, reps)
 
 
 if __name__ == "__main__":

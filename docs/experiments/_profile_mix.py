@@ -1,0 +1,57 @@
+"""Mixed-depth overhead probe: RF/GBT sweep fit with depth subsets."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.trees   # noqa: F401
+    from transmogrifai_tpu.utils.padding import bucket_for
+
+    n, d, folds = 1_000_000, 64, 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d).astype(np.float32) + rng.randn(n) > 0
+         ).astype(np.float32)
+    n_pad = bucket_for(n)
+    Xd = jnp.asarray(np.pad(X, ((0, n_pad - n), (0, 0))))
+    yd = jnp.asarray(np.pad(y, (0, n_pad - n)))
+
+    def force(tree):
+        leaves = [a for a in jax.tree_util.tree_leaves(tree)
+                  if hasattr(a, "dtype")]
+        return float(np.asarray(sum(
+            jnp.sum(jnp.abs(a.astype(jnp.float32))) for a in leaves)))
+
+    fam_name = sys.argv[1] if len(sys.argv) > 1 else "OpRandomForestClassifier"
+    fam = MODEL_REGISTRY[fam_name]
+    for depths in ((3, 6), (6, 12), (3, 6, 12)):
+        grid = [g for g in fam.default_grid("binary")
+                if g["maxDepth"] in depths]
+        G = len(grid)
+        garr = fam.grid_to_arrays(grid)
+        ids = np.random.RandomState(1).randint(0, folds, n_pad
+                                               ).astype(np.uint8)
+        f_iota = jnp.arange(folds, dtype=jnp.uint8)[:, None]
+        W = jnp.repeat((jnp.asarray(ids)[None, :] != f_iota
+                        ).astype(jnp.float32), G, axis=0)
+        tiled = {k: jnp.tile(v, folds) for k, v in garr.items()}
+        force(fam.sweep_fit_batch(Xd, yd, W, tiled, 2))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            force(fam.sweep_fit_batch(Xd, yd, W, tiled, 2))
+            ts.append(time.perf_counter() - t0)
+        print(f"{fam_name} depths={depths}: {G} cfgs "
+              f"{float(np.median(ts)):.3f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 """Sweep fidelity experiment (VERDICT r2 #4): default (sampled) vs exact
 sweep on 1M x 64 — winner agreement, Spearman rank corr, holdout delta."""
-import json, time
+import json, os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
 import numpy as np
 import jax.numpy as jnp
 from scipy import stats as sps
@@ -24,11 +25,11 @@ lr = [{"regParam": r, "elasticNetParam": e}
 svc = [{"regParam": float(r)} for r in np.logspace(-4, 0, 20)]
 rf = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": mg,
        "numTrees": 50, "subsamplingRate": 1.0}
-      for dd in (3, 6) for mi in (5, 10, 50, 100)
+      for dd in (3, 6, 12) for mi in (5, 10, 50, 100)
       for mg in (0.001, 0.01, 0.1)]
 gbt = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": mg,
         "maxIter": 20, "stepSize": ss}
-       for dd in (3, 6) for mi in (10, 100)
+       for dd in (3, 6, 12) for mi in (10, 100)
        for mg in (0.001, 0.01, 0.1) for ss in (0.1, 0.3)]
 models = [(MODEL_REGISTRY["OpLogisticRegression"], lr),
           (MODEL_REGISTRY["OpRandomForestClassifier"], rf),
@@ -36,9 +37,9 @@ models = [(MODEL_REGISTRY["OpLogisticRegression"], lr),
           (MODEL_REGISTRY["OpLinearSVC"], svc)]
 
 def run(exact):
+    kw = ({"max_eval_rows": None} if exact else {})
     cv = OpCrossValidation(num_folds=folds, seed=0,
-                           max_eval_rows=None if exact else 131072,
-                           exact_sweep_fits=exact)
+                           exact_sweep_fits=exact, **kw)
     t0 = time.perf_counter()
     best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
     dt = time.perf_counter() - t0

@@ -1,6 +1,7 @@
 """Fidelity experiment #2: wide AutoML-style table (600k x 543 = 64 numeric +
 479 sparse one-hot-style binaries), generated on device."""
-import json, time
+import json, os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
 import numpy as np
 import jax, jax.numpy as jnp
 from scipy import stats as sps

@@ -84,3 +84,97 @@ def test_record_insights_corr(trained):
     ric = RecordInsightsCorr(selected, top_k=3).set_input(checked)
     out = ric.transform_column(scored)
     assert isinstance(out.values[0], dict) and len(out.values[0]) <= 3
+
+
+@pytest.fixture(scope="module")
+def trained_deep():
+    """Full-correlation checker + categorical + balancer: the round-4
+    insights additions (redundancy pairs, PMI tables, splitter summary)."""
+    from transmogrifai_tpu.impl.tuning.splitters import DataBalancer
+
+    rng = np.random.RandomState(11)
+    n = 480
+    a = rng.randn(n)
+    y = ((a + 0.4 * rng.randn(n)) > 0.7).astype(float)  # imbalanced
+    df = pd.DataFrame({
+        "y": y, "a": a, "twin": 2.0 * a + 1.0,          # |corr| == 1.0 pair
+        "other": rng.randn(n),
+        "cat": np.where(a > 0, "hi", "lo"),
+    })
+    yf = FeatureBuilder.RealNN("y").extract_field().as_response()
+    fs = [FeatureBuilder.Real(c).extract_field().as_predictor()
+          for c in ("a", "twin", "other")]
+    fs.append(FeatureBuilder.PickList("cat").extract_field().as_predictor())
+    from transmogrifai_tpu.impl.feature.transmogrifier import transmogrify
+    vec = transmogrify(fs)
+    checked = vec.sanity_check(yf, min_variance=1e-9, max_correlation=1.1,
+                               max_cramers_v=1.1, correlations="full")
+    pred = (BinaryClassificationModelSelector
+            .with_train_validation_split(
+                seed=7, splitter=DataBalancer(seed=3),
+                models=[("OpLogisticRegression", None)])
+            .set_input(yf, checked).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    return ModelInsights.extract(wf.train())
+
+
+def test_insights_redundancy_pmi_splitter(trained_deep):
+    mi = trained_deep
+    # redundancy: the a/twin pair at |corr| ~ 1.0
+    pairs = {(p["feature1"].split("_")[0], p["feature2"].split("_")[0])
+             for p in mi.cross_feature_redundancy}
+    assert any({"a", "twin"} == set(p) for p in pairs), \
+        mi.cross_feature_redundancy
+    top = mi.cross_feature_redundancy[0]
+    assert abs(top["correlation"]) > 0.99
+    # PMI tables recorded per categorical group
+    assert mi.categorical_pmi, "no PMI tables surfaced"
+    for group, tbl in mi.categorical_pmi.items():
+        arr = np.asarray(tbl, dtype=np.float64)
+        assert arr.ndim == 2 and arr.shape[1] >= 2, (group, arr.shape)
+    # splitter/balancer summary present and rendered (the balancer saw a
+    # 0.26 minority fraction -- above its threshold, so balanced=False is
+    # the recorded DECISION; presence of the counts is the contract)
+    assert "balanced" in mi.splitter_summary
+    assert mi.splitter_summary["positiveCount"] > 0
+    txt = mi.pretty_print()
+    assert "Splitter:" in txt and "Redundant column pairs" in txt
+    js = mi.to_json()
+    assert js["crossFeatureRedundancy"] and js["splitterSummary"]
+
+
+def test_insights_golden_file(trained_deep):
+    """Structural golden: the insights JSON keeps its schema — every
+    recorded key path present with the right shape/type (float values are
+    environment-sensitive, so the golden pins structure + stable fields)."""
+    import json
+    import os
+    js = trained_deep.to_json()
+    golden_path = os.path.join(os.path.dirname(__file__), "golden",
+                               "model_insights_schema.json")
+    with open(golden_path) as fh:
+        golden = json.load(fh)
+
+    def check(g, v, path="$"):
+        if isinstance(g, dict) and "__type__" in g:
+            t = g["__type__"]
+            if t == "number":
+                assert isinstance(v, (int, float)), (path, v)
+            elif t == "string":
+                assert isinstance(v, str), (path, v)
+            elif t == "list":
+                assert isinstance(v, list), (path, v)
+                if "min_len" in g:
+                    assert len(v) >= g["min_len"], (path, len(v))
+                if "item" in g and v:
+                    check(g["item"], v[0], path + "[0]")
+            return
+        if isinstance(g, dict):
+            assert isinstance(v, dict), (path, type(v))
+            for k, gv in g.items():
+                assert k in v, (path, k, sorted(v))
+                check(gv, v[k], f"{path}.{k}")
+            return
+        assert v == g, (path, g, v)
+
+    check(golden, js)

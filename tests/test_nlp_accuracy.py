@@ -239,3 +239,191 @@ def test_porter_stemmer_collapses_inflections():
         ["the", "cat", "were", "run", "quick"]
     t2 = TextTokenizer()
     assert t2.transform_fn("cats running") == ["cats", "running"]
+
+
+# -- round-4 tranche: 24 new languages (script narrowing + profiles) ---------
+
+LANG_FIXTURES_R4 = [
+    ("ca", "el gat és a la casa i no vol sortir amb nosaltres aquest vespre"),
+    ("hr", "pas je u kući i ne želi izaći s nama ovo je dobar dan za sve"),
+    ("sr", "пас је у кући и не жели да изађе са нама ово је добар дан"),
+    ("bg", "кучето е в къщата и не иска да излезе с нас това е добър ден"),
+    ("sk", "pes je v dome a nechce ísť s nami von to je dobrý deň pre nás"),
+    ("sl", "pes je v hiši in noče iti z nami ven to je dober dan za vse"),
+    ("lt", "šuo yra namuose ir jis nenori eiti su mumis tai yra gera diena"),
+    ("lv", "suns ir mājā un viņš nevēlas iet ar mums tas ir laba diena"),
+    ("et", "koer on majas ja ta ei taha meiega välja minna see on hea päev"),
+    ("ms", "anjing itu ada di dalam rumah dan dia tidak akan keluar dengan kami"),
+    ("tl", "ang aso ay nasa bahay at hindi ito lalabas para sa atin ngayon"),
+    ("sw", "mbwa yuko katika nyumba na hataki kwenda nje na sisi leo ni siku"),
+    ("af", "die hond is in die huis en hy wil nie met ons uitgaan nie"),
+    ("el", "ο σκύλος είναι στο σπίτι και δεν θέλει να βγει μαζί μας"),
+    ("ar", "الكلب في المنزل ولا يريد الخروج معنا هذا يوم جيد للجميع"),
+    ("fa", "سگ در خانه است و نمی‌خواهد با ما بیرون بیاید این یک روز خوب است"),
+    ("he", "הכלב נמצא בבית והוא לא רוצה לצאת איתנו זה יום טוב לכולם"),
+    ("hi", "कुत्ता घर में है और वह हमारे साथ बाहर नहीं जाना चाहता यह अच्छा दिन है"),
+    ("bn", "কুকুরটি বাড়িতে আছে এবং সে আমাদের সাথে বাইরে যেতে চায় না"),
+    ("ta", "நாய் வீட்டில் உள்ளது அது எங்களுடன் வெளியே செல்ல விரும்பவில்லை"),
+    ("th", "สุนัขอยู่ในบ้านและไม่อยากออกไปกับเราวันนี้เป็นวันที่ดี"),
+    ("ja", "犬は家にいて、私たちと一緒に外に出たくないです。今日はいい日です"),
+    ("ko", "개는 집에 있고 우리와 함께 나가고 싶어하지 않습니다 오늘은 좋은 날입니다"),
+    ("zh", "狗在房子里，它不想和我们一起出去。今天是美好的一天"),
+]
+
+
+def test_lang_detector_round4_languages():
+    det = LangDetector()
+    correct = 0
+    wrong = []
+    for want, text in LANG_FIXTURES_R4:
+        scores = det.transform_fn(text)
+        got = max(scores, key=scores.get) if scores else None
+        correct += (got == want)
+        if got != want:
+            wrong.append((want, got))
+    # script-unique languages must be exact; Latin/Cyrillic profiles may
+    # confuse at most 3 close pairs (hr/sr latin, ms/id, sk/cs)
+    assert correct >= len(LANG_FIXTURES_R4) - 3, \
+        f"{correct}/{len(LANG_FIXTURES_R4)}: {wrong}"
+
+
+def test_script_unique_languages_exact():
+    det = LangDetector()
+    for want, text in LANG_FIXTURES_R4:
+        if want in ("el", "he", "hi", "bn", "ta", "th", "ja", "ko", "zh",
+                    "ar", "fa"):
+            scores = det.transform_fn(text)
+            assert scores and max(scores, key=scores.get) == want, \
+                (want, scores)
+
+
+# -- round-4: container-aware MIME -------------------------------------------
+
+def _real_zip(*entries) -> bytes:
+    """A genuine zip built by zipfile (STORED) — the sniffer must parse
+    actual local-file headers, not substring-match raw bytes."""
+    import io
+    import zipfile
+    bio = io.BytesIO()
+    with zipfile.ZipFile(bio, "w", zipfile.ZIP_STORED) as z:
+        for name, data in entries:
+            z.writestr(name, data)
+    return bio.getvalue()
+
+
+_OOXML_CT = "<?xml version='1.0'?><Types></Types>"
+
+MIME_FIXTURES_R4 = [
+    (_real_zip(("[Content_Types].xml", _OOXML_CT),
+               ("word/document.xml", "<w:document/>")),
+     "application/vnd.openxmlformats-officedocument"
+     ".wordprocessingml.document"),
+    (_real_zip(("[Content_Types].xml", _OOXML_CT),
+               ("xl/workbook.xml", "<workbook/>")),
+     "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet"),
+    (_real_zip(("[Content_Types].xml", _OOXML_CT),
+               ("ppt/presentation.xml", "<p:presentation/>")),
+     "application/vnd.openxmlformats-officedocument"
+     ".presentationml.presentation"),
+    (_real_zip(("mimetype", "application/vnd.oasis.opendocument.text"),
+               ("content.xml", "<office/>")),
+     "application/vnd.oasis.opendocument.text"),
+    (_real_zip(("mimetype", "application/epub+zip"),
+               ("META-INF/container.xml", "<container/>")),
+     "application/epub+zip"),
+    (_real_zip(("META-INF/MANIFEST.MF", "Manifest-Version: 1.0"),
+               ("com/example/Main.class", "\xca\xfe")),
+     "application/java-archive"),
+    (_real_zip(("random.txt", "hello")), "application/zip"),
+    # the review repro: a path CONTAINING 'word/' must stay a plain zip
+    (_real_zip(("crossword/puzzle.txt", "hello")), "application/zip"),
+    (b"\x00" * 257 + b"ustar" + b"\x00" * 200, "application/x-tar"),
+]
+
+
+def test_mime_round4_containers():
+    det = MimeTypeDetector()
+    for raw, want in MIME_FIXTURES_R4:
+        got = det.transform_fn(base64.b64encode(raw).decode())
+        assert got == want, (want, got)
+
+
+def test_mime_gzip_tar_nesting():
+    import gzip as _gzip
+    inner_tar = b"\x00" * 257 + b"ustar" + b"\x00" * 250
+    gz = _gzip.compress(inner_tar)
+    det = MimeTypeDetector()
+    assert det.transform_fn(base64.b64encode(gz).decode()) == \
+        "application/x-gtar"
+    plain_gz = _gzip.compress(b"hello world, not a tar at all")
+    assert det.transform_fn(base64.b64encode(plain_gz).decode()) == \
+        "application/gzip"
+
+
+# -- round-4: 32 new phone regions -------------------------------------------
+
+PHONE_FIXTURES_R4 = [
+    ("AT", "+43 1 5344050", True), ("BE", "02 552 82 11", True),
+    ("PT", "+351 912 345 678", True), ("DK", "32 12 34 56", True),
+    ("NO", "+47 21 03 05 00", True), ("FI", "041 2345678", True),
+    ("PL", "+48 512 345 678", True), ("CZ", "601 123 456", True),
+    ("SK", "0901 123 456", True), ("HU", "06 1 234 5678", True),
+    ("RO", "0721 234 567", True), ("BG", "088 123 4567", True),
+    ("GR", "+30 21 0123 4567", True), ("IE", "085 123 4567", True),
+    ("IL", "052-123-4567", True), ("AE", "050 123 4567", True),
+    ("SA", "05 0123 4567", True), ("TH", "081 234 5678", True),
+    ("MY", "012-345 6789", True), ("PH", "0917 123 4567", True),
+    ("VN", "091 234 56 78", True), ("ID", "0812 3456 789", True),
+    ("PK", "0301 2345678", True), ("EG", "0100 123 4567", True),
+    ("NG", "0803 123 4567", True), ("KE", "0712 123456", True),
+    ("CL", "+56 9 6123 4567", True), ("CO", "+57 321 1234567", True),
+    ("PE", "987 654 321", True), ("UA", "050 123 4567", True),
+    ("HK", "+852 2123 4567", True), ("TW", "0912 345 678", True),
+    # invalids: too short / too long for the region
+    ("PT", "91234", False), ("PL", "51234567890123", False),
+    ("HK", "212345", False),
+]
+
+
+def test_phone_round4_regions():
+    for region, number, want in PHONE_FIXTURES_R4:
+        got = parse_phone(number, default_region=region)
+        assert got is not None, (region, number)
+        assert got[1] is want, (region, number, got)
+
+
+def test_phone_round4_e164_normalization():
+    # trunk prefixes strip into E.164 (incl. Hungary's two-digit '06')
+    assert parse_phone("06 1 234 5678", "HU")[0] == "+3612345678"
+    assert parse_phone("0901 123 456", "SK")[0] == "+421901123456"
+    assert parse_phone("032 12 34 56", "BE")[0] == "+3232123456"
+
+
+# -- round-4: French/German/Spanish stemmers ---------------------------------
+
+def test_language_stemmers_collapse_inflections():
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        french_stem, german_stem, spanish_stem)
+    # inflected forms of one lemma must collide to one stem
+    fr_groups = [("nations", "nation"), ("heureuses", "heureux"),
+                 ("abandonnées", "abandonnée")]
+    for a, b in fr_groups:
+        assert french_stem(a) == french_stem(b), (a, b)
+    de_groups = [("häuser", "häusern"), ("kindern", "kinder"),
+                 ("zeitungen", "zeitung")]
+    for a, b in de_groups:
+        assert german_stem(a) == german_stem(b), (a, b)
+    es_groups = [("niños", "niño"), ("trabajadores", "trabajador"),
+                 ("nacionales", "nacional")]
+    for a, b in es_groups:
+        assert spanish_stem(a) == spanish_stem(b), (a, b)
+
+
+def test_tokenizer_language_stemming():
+    from transmogrifai_tpu.impl.feature.vectorizers import TextTokenizer
+    tk = TextTokenizer(stemming=True, language="es")
+    toks = tk.transform_fn("los niños trabajadores")
+    assert "niño" in toks and "trabajador" in toks, toks
+    # unknown language: pass-through
+    tk2 = TextTokenizer(stemming=True, language="xx")
+    assert tk2.transform_fn("running dogs") == ["running", "dogs"]

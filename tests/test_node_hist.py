@@ -1,0 +1,41 @@
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+@pytest.mark.parametrize("T,Wl,stride", [(5, 1, 1), (54, 7, 1), (54, 64, 1),
+                                         (130, 16, 2), (20, 32, 2)])
+def test_node_hist_matches_acat(use_pallas, T, Wl, stride, monkeypatch):
+    import jax
+    monkeypatch.setenv("TG_TREE_PALLAS", "1" if use_pallas else "0")
+    if use_pallas:
+        # force the pallas kernel (interpret mode off-TPU) even below the
+        # production lane threshold — CI must execute the kernel's index
+        # maps and lane math, not only the XLA fallback
+        import transmogrifai_tpu.ops.tree_hist as th
+        monkeypatch.setattr(th, "_NODE_HIST_PALLAS_MIN_B", 0)
+    jax.clear_caches()
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops.tree_hist import (
+        hist_matmul, node_hist_matmul, _make)
+    _make.cache_clear()
+
+    rng = np.random.RandomState(0)
+    S, d, nb, k = 512, 9, 8, 3
+    codes = rng.randint(0, nb, size=(S, d)).astype(np.int32)
+    node = (rng.randint(0, max(stride * Wl, 1), size=(S, T))
+            .astype(np.int32))
+    sw = [rng.randn(S, T).astype(np.float32) for _ in range(k)]
+
+    out = np.asarray(node_hist_matmul(
+        jnp.asarray(codes), jnp.asarray(node),
+        [jnp.asarray(s) for s in sw], Wl, nb, stride=stride))
+
+    # reference: explicit masked A_cat through the plain hist contraction
+    j = stride * np.arange(Wl, dtype=np.int32)[None, :, None]
+    n_oh = (node[:, None, :] == j).astype(np.float32)
+    A = np.concatenate([n_oh * s[:, None, :] for s in sw],
+                       axis=1).reshape(S, k * Wl * T)
+    ref = np.asarray(hist_matmul(jnp.asarray(codes), jnp.asarray(A), nb))
+    assert out.shape == ref.shape == (k * Wl * T, d * nb)
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)

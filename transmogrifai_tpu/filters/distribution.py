@@ -62,6 +62,12 @@ class FeatureDistribution:
     summary: Summary = field(default_factory=Summary)
     is_numeric: bool = True
     sketch: Optional[StreamingHistogram] = None
+    #: mesh path: (V_d, M_d, col_index, shift) — row-sharded device column
+    #: data for exact CDF-diff binning (``RawFeatureFilter`` batch-fills all
+    #: device-backed dists in ONE program; replaces the host SPDT sketch
+    #: when a mesh is attached). ``shift``: f64 center subtracted before the
+    #: f32 cast (keeps epoch-millis-scale values exact within f32).
+    device_data: Optional[Any] = None
 
     @property
     def full_name(self) -> str:
@@ -138,26 +144,47 @@ def text_distribution(name: str, tokens_per_row: Sequence[Optional[Sequence[str]
         summary=Summary(0.0, float(text_bins), card, card), is_numeric=False)
 
 
-def fill_numeric_bins(train: FeatureDistribution,
+def numeric_bin_edges(train: FeatureDistribution,
                       score: Optional[FeatureDistribution],
-                      max_bins: int) -> None:
-    """Bin both sketches over boundaries derived from the TRAIN summary
-    (reference: score distributions are binned against train Summary bins)."""
+                      max_bins: int) -> Optional[np.ndarray]:
+    """Shared bin boundaries from the train/score summaries (reference:
+    score distributions are binned against train Summary bins), or None when
+    the feature has no finite range."""
     lo = train.summary.min
     hi = train.summary.max
     if score is not None and score.summary.count:
         lo, hi = min(lo, score.summary.min), max(hi, score.summary.max)
     if not np.isfinite(lo) or not np.isfinite(hi):
-        return
+        return None
     if hi <= lo:
         hi = lo + 1.0
     edges = np.linspace(lo, hi, max_bins + 1)
     # open-ended first/last bins via sentinels beyond the observed range
-    finite_edges = np.concatenate([[lo - 1.0], edges[1:-1], [hi + 1.0]])
+    return np.concatenate([[lo - 1.0], edges[1:-1], [hi + 1.0]])
+
+
+def fill_numeric_bins(train: FeatureDistribution,
+                      score: Optional[FeatureDistribution],
+                      max_bins: int) -> None:
+    """Bin both sketches over shared boundaries. Device-backed dists
+    (``device_data``) are normally batch-filled by the RawFeatureFilter in
+    one program before this runs; this per-feature path is the fallback."""
+    finite_edges = numeric_bin_edges(train, score, max_bins)
+    if finite_edges is None:
+        return
     for dist in (train, score):
-        if dist is None or dist.sketch is None:
+        if dist is None:
             continue
-        dist.distribution = dist.sketch.density(finite_edges)
+        if dist.device_data is not None:
+            import jax.numpy as jnp
+            V_d, M_d, j, shift = dist.device_data
+            le = ((V_d[:, j, None]
+                   <= jnp.asarray((finite_edges - shift).astype(np.float32)
+                                  )[None, :]) & M_d[:, j, None])
+            cs = np.asarray(le.astype(jnp.float32).sum(axis=0))
+            dist.distribution = np.diff(cs)
+        elif dist.sketch is not None:
+            dist.distribution = dist.sketch.density(finite_edges)
 
 
 def column_distributions(name: str, col: Column, max_bins: int, text_bins: int,

@@ -95,20 +95,153 @@ class RawFeatureFilter:
         self.correlation_type = correlation_type
         self.protected_features = set(protected_features)
         self.text_bins = text_bins
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> "RawFeatureFilter":
+        """Shard the numeric distribution stats over a mesh's 'data' axis.
+
+        RFF is the FIRST full pass over raw data (reference monoid reduce
+        over RDD partitions, RawFeatureFilter.scala:135-196) — without this
+        it is a single-host serial bottleneck before any sharded work
+        starts. Numeric columns batch into one row-sharded device pass
+        (count/min/max/sum + exact CDF-diff histograms); string/map columns
+        remain host work by design (SURVEY §2.9 host boundary)."""
+        self.mesh = mesh
+        return self
 
     # -- distribution computation (reference computeFeatureStats:135-196) ----
     def _distributions(self, table: FeatureTable, features: Sequence[Feature],
                        ) -> Dict[str, List[FeatureDistribution]]:
         out: Dict[str, List[FeatureDistribution]] = {}
+        numeric: List[Feature] = []
         for f in features:
             if f.is_response:
                 continue
             col = table.get(f.name)
             if col is None:
                 continue
+            if self.mesh is not None and col.kind in (
+                    "real", "binary", "integral", "date"):
+                numeric.append(f)
+                continue
             out[f.name] = column_distributions(
                 f.name, col, self.bins, self.text_bins)
+        if numeric:
+            out.update(self._device_numeric_distributions(table, numeric))
         return out
+
+    def _device_numeric_distributions(
+            self, table: FeatureTable, feats: Sequence[Feature],
+            ) -> Dict[str, List[FeatureDistribution]]:
+        """All numeric columns in ONE row-sharded device stats pass: per-
+        column count/nulls/min/max/sum, with the binned distributions
+        batch-filled later (``_batch_fill_device_bins`` — one program for
+        every feature, one sync). Columns are f64-centered on host before
+        the f32 cast so epoch-millis-scale values keep full precision in
+        the shifted frame. Counting is EXACT (CDF diff) — a tighter
+        estimator than the host SPDT sketch's interpolated density, so a
+        metric sitting within the sketch's approximation error of a
+        threshold can decide differently with a mesh attached; fill rates
+        and summaries are bit-matched."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .distribution import Summary
+
+        n = table.num_rows
+        n_data = self.mesh.shape["data"]
+        n_pad = -(-max(n, 1) // n_data) * n_data
+        V = np.zeros((n_pad, len(feats)), np.float32)
+        M = np.zeros((n_pad, len(feats)), bool)
+        shifts = np.zeros(len(feats), np.float64)
+        for j, f in enumerate(feats):
+            col = table[f.name]
+            vals = np.asarray(col.values, np.float64)
+            valid = col.valid_mask()
+            if valid.any():
+                shifts[j] = float(np.median(vals[valid]))
+            V[:n, j] = (vals - shifts[j]).astype(np.float32)
+            M[:n, j] = valid
+        sh = NamedSharding(self.mesh, P("data", None))
+        V_d = jax.device_put(jnp.asarray(V), sh)
+        M_d = jax.device_put(jnp.asarray(M), sh)
+        self._stats_input_sharding = str(V_d.sharding.spec)
+
+        @jax.jit
+        def stats(v, m):
+            cnt = m.astype(jnp.int32).sum(axis=0)       # exact past 2^24
+            vs = jnp.where(m, v, 0.0)
+            return (cnt,
+                    jnp.where(m, v, jnp.inf).min(axis=0),
+                    jnp.where(m, v, -jnp.inf).max(axis=0),
+                    vs.sum(axis=0))
+
+        cnt, mn, mx, sm = (np.asarray(a) for a in stats(V_d, M_d))
+
+        out: Dict[str, List[FeatureDistribution]] = {}
+        for j, f in enumerate(feats):
+            c = float(cnt[j])
+            out[f.name] = [FeatureDistribution(
+                name=f.name, count=float(n), nulls=float(n) - c,
+                summary=Summary(
+                    float(mn[j]) + shifts[j] if c else np.inf,
+                    float(mx[j]) + shifts[j] if c else -np.inf,
+                    float(sm[j]) + shifts[j] * c, c),
+                is_numeric=True, device_data=(V_d, M_d, j, shifts[j]))]
+        return out
+
+    @staticmethod
+    def _batch_fill_device_bins(train_dists, score_dists, max_bins: int,
+                                ) -> None:
+        """Fill every device-backed dist's binned distribution in ONE
+        program per table (a lax.map over columns) + one sync each — the
+        per-feature path would cost two link round-trips per feature."""
+        from .distribution import numeric_bin_edges
+
+        groups: Dict[int, List[Tuple[Any, np.ndarray]]] = {}
+        handles: Dict[int, Tuple[Any, Any]] = {}
+        for name, dlist in train_dists.items():
+            for d in dlist:
+                if d.device_data is None:
+                    continue
+                sd = None
+                if score_dists is not None:
+                    sd = next((s for s in score_dists.get(name, [])
+                               if s.key == d.key), None)
+                edges = numeric_bin_edges(d, sd, max_bins)
+                for dist in (d, sd):
+                    if dist is None or dist.device_data is None:
+                        continue
+                    V_d, M_d, j, shift = dist.device_data
+                    if edges is None:
+                        dist.device_data = None
+                        continue
+                    groups.setdefault(id(V_d), []).append(
+                        (dist, (edges - shift).astype(np.float32)))
+                    handles[id(V_d)] = (V_d, M_d)
+        if not groups:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def batched_cdf(v, m, cols, edges):
+            def one(args):
+                vj, mj, ej = args
+                le = (vj[:, None] <= ej[None, :]) & mj[:, None]
+                return le.astype(jnp.float32).sum(axis=0)
+            return jax.lax.map(
+                one, (v[:, cols].T, m[:, cols].T, edges))
+
+        for gid, pairs in groups.items():
+            V_d, M_d = handles[gid]
+            cols = jnp.asarray([p[0].device_data[2] for p in pairs],
+                               dtype=jnp.int32)
+            edges = jnp.asarray(np.stack([p[1] for p in pairs]))
+            cdfs = np.asarray(batched_cdf(V_d, M_d, cols, edges))
+            for (dist, _), cs in zip(pairs, cdfs):
+                dist.distribution = np.diff(cs)
+                dist.device_data = None
 
     def _null_label_correlations(self, table: FeatureTable,
                                  features: Sequence[Feature],
@@ -156,10 +289,20 @@ class RawFeatureFilter:
         if not cols:
             return {}
         X = jnp.asarray(np.stack(cols, axis=1))
+        yd = jnp.asarray(y)
+        # correlations are not pad-invariant, so shard only when the row
+        # count divides the 'data' axis evenly (always true for the padded
+        # stats pass; here rows come straight from the reader)
+        if (self.mesh is not None
+                and X.shape[0] % self.mesh.shape["data"] == 0):
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            X = jax.device_put(X, NamedSharding(self.mesh, P("data", None)))
+            yd = jax.device_put(yd, NamedSharding(self.mesh, P("data")))
         corr_fn = (spearman_correlation
                    if self.correlation_type == "spearman"
                    else pearson_correlation)
-        corrs = np.asarray(corr_fn(X, jnp.asarray(y)))
+        corrs = np.asarray(corr_fn(X, yd))
         return {n: float(c) for n, c in zip(names, corrs)}
 
     # -- main entry (reference generateFilteredRaw) --------------------------
@@ -178,6 +321,9 @@ class RawFeatureFilter:
                           if f.is_response and f.name in table), None)
         null_corr = self._null_label_correlations(
             table, raw_features, label_col, train_dists)
+        # mesh path: bin every device-backed distribution in one batched
+        # program per table before the per-feature metric loop
+        self._batch_fill_device_bins(train_dists, score_dists, self.bins)
 
         metrics: List[FeatureMetrics] = []
         excluded_features: List[str] = []

@@ -172,7 +172,79 @@ _STOPWORD_PROFILES: Dict[str, frozenset] = {
  sudah""".split()),
     "vi": frozenset("""và của là có không được trong cho một người này các
  với những để tôi bạn anh chị em chúng họ hoặc đã sẽ đang""".split()),
+    # -- round-4 tranche: toward Optimaize's ~70 (next 24) ------------------
+    "ca": frozenset("""el la els les un una i és en per que amb no es seu
+ seva aquest aquesta però més com del dels al als ho ja també""".split()),
+    "hr": frozenset("""i u na je se da za s od su ne to kao ali o po iz koji
+ biti bio ona mi vi oni kada ako ili sa što ovo ova taj""".split()),
+    "sr": frozenset("""и у на је се да за с од су не то као али о по из
+ који бити био она ми ви они када ако или са што ово ова тај""".split()),
+    "bg": frozenset("""и в на е се да за с от са не то като но о по из
+ който съм бил тя ние вие те кога ако или със що това този""".split()),
+    "sk": frozenset("""a v na je sa že to s z do aj o k ale ako po za by bol
+ sú ten táto jeho jej my vy oni keď pre pri alebo""".split()),
+    "sl": frozenset("""in v na je se da za s z od so ne to kot ali o po iz
+ ki biti bil ona mi vi oni ko če s čim to ta tudi""".split()),
+    "lt": frozenset("""ir į yra kad su iš bet tai kaip o po už nuo per dėl
+ prie buvo būti jis ji mes jūs jie kai jei arba šis ši""".split()),
+    "lv": frozenset("""un ir ka ar no bet tas kā o pēc uz par pie bija būt
+ viņš viņa mēs jūs viņi kad ja vai šis šī arī tikai""".split()),
+    "et": frozenset("""ja on et ei see ta oli aga mis kui nii ka nagu oma
+ selle olema tema meie teie nad siis või ning veel juba""".split()),
+    "ms": frozenset("""yang dan di dengan untuk dari pada ini itu ialah
+ tidak akan ke dalam juga boleh ada saya awak dia kami mereka atau
+ telah""".split()),
+    "tl": frozenset("""ang ng sa na at ay mga ito hindi para kung siya ako
+ ikaw kami sila may ba rin lang naman pero o dahil""".split()),
+    "sw": frozenset("""na ya wa kwa ni za katika la hii hiyo si kama lakini
+ au yake wake mimi wewe yeye sisi nyinyi wao kuwa sana""".split()),
+    "af": frozenset("""die en van in is dat op te met vir nie sy wees er aan
+ ook as by nog na dan uit hierdie om maar hy ons julle hulle""".split()),
+    "el": frozenset("""και το η ο να του της με που είναι για από δεν στο
+ στη τον την τα οι ένα μια αυτό αλλά ή αν θα""".split()),
+    "fa": frozenset("""و در به از که این را با است برای آن یک خود تا بر او
+ ما شما آنها اگر یا هم نیز باید بود""".split()),
+    "ar": frozenset("""في من على أن إلى عن مع هذا هذه التي الذي كان لا ما هو
+ هي نحن أنتم هم إذا أو لم قد كل بعد""".split()),
+    "he": frozenset("""של את על אל עם זה זאת אשר היה לא מה הוא היא אנחנו
+ אתם הם אם או גם כל אחרי אבל יש כי""".split()),
+    "hi": frozenset("""और का की के में है कि यह वह से पर को नहीं एक हम तुम
+ वे अगर या भी सब बाद था थी""".split()),
+    "bn": frozenset("""এবং ও এর যে মধ্যে হয় এই সে থেকে উপর কে না এক আমরা
+ তুমি তারা যদি বা আরও সব পরে ছিল""".split()),
+    "th": frozenset("""และ ของ ที่ ใน เป็น ไม่ ได้ ให้ มี ว่า จะ กับ แต่
+ หรือ เขา เรา คุณ พวก ถ้า ก็ ทุก หลัง""".split()),
+    "ja": frozenset("""の に は を た が で て と し れ さ ある いる も
+ する から な こと として""".split()),
+    "ko": frozenset("""이 그 저 것 수 들 및 에서 의 를 을 은 는 가 와 과
+ 하다 있다 없다 그리고 하지만""".split()),
+    "zh": frozenset("""的 一 是 在 不 了 有 和 人 这 中 大 为 上 个 国 我
+ 以 要 他 时 来 用 们""".split()),
+    "ta": frozenset("""மற்றும் இந்த அந்த என்று ஒரு இல்லை உள்ள அது இது நான்
+ நீ அவர் நாம் அவர்கள் என அல்லது எல்லா பின்""".split()),
 }
+
+#: decisive Unicode script ranges: when ≥60% of a text's letters fall in
+#: one of these blocks, the language set narrows to the block's candidates
+#: (the Optimaize n-gram analog for languages without whitespace or with
+#: unique scripts); within multi-language scripts the stopword profiles
+#: disambiguate
+_SCRIPT_LANGS = [
+    ((0x3040, 0x30FF), ("ja",)),            # Hiragana + Katakana
+    ((0xAC00, 0xD7AF), ("ko",)),            # Hangul syllables
+    ((0x0E00, 0x0E7F), ("th",)),            # Thai
+    ((0x0590, 0x05FF), ("he",)),            # Hebrew
+    ((0x0900, 0x097F), ("hi",)),            # Devanagari
+    ((0x0980, 0x09FF), ("bn",)),            # Bengali
+    ((0x0B80, 0x0BFF), ("ta",)),            # Tamil
+    ((0x0370, 0x03FF), ("el",)),            # Greek
+    ((0x0600, 0x06FF), ("ar", "fa")),       # Arabic script: ar vs fa
+    ((0x4E00, 0x9FFF), ("zh", "ja")),       # CJK ideographs: zh vs ja
+    ((0x0400, 0x04FF), ("ru", "uk", "bg", "sr")),  # Cyrillic
+]
+
+#: Persian-specific letters absent from Arabic (پ چ ژ گ ک ی)
+_FA_CHARS = frozenset("پچژگکی")
 
 
 class OpStopWordsRemover(UnaryTransformer):
@@ -623,28 +695,65 @@ class OpLDAModel(_VectorModelBase):
 
 class LangDetector(UnaryTransformer):
     """Text → RealMap of language scores (reference LangDetector.scala wraps
-    Optimaize; here: stopword-profile hit rates over a 20-language table —
-    see _STOPWORD_PROFILES for the list, tests/test_nlp_accuracy.py for the
-    per-language fixture floors)."""
+    Optimaize, ~70 languages; here: Unicode-script narrowing + stopword-
+    profile hit rates over a **44-language** table — see _STOPWORD_PROFILES
+    / _SCRIPT_LANGS, tests/test_nlp_accuracy.py for per-language floors).
+
+    Script-unique languages (ja/ko/th/he/hi/bn/ta/el and Arabic-script
+    ar/fa) are decided by character blocks — the whitespace tokenizer
+    cannot segment them; multi-language scripts (Cyrillic, Latin) fall
+    through to per-language stopword profiles restricted to that script."""
 
     def __init__(self, uid=None):
         def fn(v):
             if not v:
                 return None
-            toks = tokenize_text(v)
-            if not toks:
-                return None
-            scores = {}
-            for lang, words in _STOPWORD_PROFILES.items():
-                hits = sum(1 for t in toks if t in words)
-                if hits:
-                    scores[lang] = hits / len(toks)
-            total = sum(scores.values())
-            if not total:
-                return None
-            return {k: v_ / total for k, v_ in scores.items()}
+            s = str(v)
+            letters = [c for c in s if c.isalpha()]
+            if letters:
+                n_l = len(letters)
+                in_range = {}
+                for (lo, hi), langs in _SCRIPT_LANGS:
+                    c = sum(1 for ch in letters if lo <= ord(ch) <= hi)
+                    if c:
+                        in_range[(lo, hi)] = (c, langs)
+                kana = in_range.get((0x3040, 0x30FF), (0, ()))[0]
+                cjk = in_range.get((0x4E00, 0x9FFF), (0, ()))[0]
+                if kana and (kana + cjk) >= 0.5 * n_l:
+                    return {"ja": 1.0}
+                if cjk >= 0.5 * n_l:
+                    return {"zh": 1.0}
+                for (lo, hi), (c, langs) in in_range.items():
+                    if c < 0.5 * n_l or (lo, hi) in (
+                            (0x3040, 0x30FF), (0x4E00, 0x9FFF)):
+                        continue
+                    if langs == ("ar", "fa"):
+                        return {"fa" if any(ch in _FA_CHARS for ch in s)
+                                else "ar": 1.0}
+                    if len(langs) == 1:
+                        return {langs[0]: 1.0}
+                    # multi-language script (Cyrillic): restrict profiles
+                    return self._profile_scores(s, langs)
+            return self._profile_scores(s, None)
         super().__init__("langDetect", transform_fn=fn, output_type=RealMap,
                          input_type=Text, uid=uid)
+
+    @staticmethod
+    def _profile_scores(s, restrict):
+        toks = tokenize_text(s)
+        if not toks:
+            return None
+        scores = {}
+        for lang, words in _STOPWORD_PROFILES.items():
+            if restrict is not None and lang not in restrict:
+                continue
+            hits = sum(1 for t in toks if t in words)
+            if hits:
+                scores[lang] = hits / len(toks)
+        total = sum(scores.values())
+        if not total:
+            return None
+        return {k: v_ / total for k, v_ in scores.items()}
 
 
 _NER_TITLES = frozenset({"mr", "mrs", "ms", "dr", "prof", "sir"})
@@ -766,20 +875,116 @@ _MAGIC = [
 ]
 
 
+#: zip entry-name cues → container-specific MIME (reference Tika opens the
+#: zip and reads [Content_Types].xml / the ODF mimetype entry; a docx IS a
+#: zip — round 3 sniffed it as application/zip). The first local-file
+#: header's name sits at byte 30, and OOXML/ODF/epub/jar writers put the
+#: identifying entry first (ODF and epub REQUIRE it first).
+_ZIP_CONTAINERS = [
+    (b"word/", "application/vnd.openxmlformats-officedocument"
+               ".wordprocessingml.document"),
+    (b"xl/", "application/vnd.openxmlformats-officedocument"
+             ".spreadsheetml.sheet"),
+    (b"ppt/", "application/vnd.openxmlformats-officedocument"
+              ".presentationml.presentation"),
+    (b"mimetypeapplication/vnd.oasis.opendocument.text",
+     "application/vnd.oasis.opendocument.text"),
+    (b"mimetypeapplication/vnd.oasis.opendocument.spreadsheet",
+     "application/vnd.oasis.opendocument.spreadsheet"),
+    (b"mimetypeapplication/vnd.oasis.opendocument.presentation",
+     "application/vnd.oasis.opendocument.presentation"),
+    (b"mimetypeapplication/epub+zip", "application/epub+zip"),
+    (b"META-INF/MANIFEST.MF", "application/java-archive"),
+]
+
+#: how much base64 we decode for container inspection: 4096 chars → 3072
+#: bytes, enough for the tar ustar magic at offset 257 and the zip central
+#: cues ([Content_Types].xml appears within the first entries for OOXML)
+_MIME_PEEK_B64 = 4096
+
+
+def _zip_entry_names(buf: bytes, limit: int = 16):
+    """Entry names from zip local-file headers within the peek window.
+    Anchored parsing (not substring search over compressed bytes — deflate
+    data or an unrelated path like 'crossword/x.txt' must not look like an
+    OOXML part)."""
+    names = []
+    off = 0
+    while len(names) < limit and off + 30 <= len(buf):
+        if buf[off:off + 4] != b"PK\x03\x04":
+            break
+        n_len = int.from_bytes(buf[off + 26:off + 28], "little")
+        e_len = int.from_bytes(buf[off + 28:off + 30], "little")
+        c_size = int.from_bytes(buf[off + 18:off + 22], "little")
+        names.append(buf[off + 30:off + 30 + n_len])
+        nxt = off + 30 + n_len + e_len + c_size
+        if nxt <= off:
+            break
+        off = nxt
+    return names
+
+
+def _sniff_zip(buf: bytes) -> str:
+    """Inside-zip container detection (Tika's container recursion analog):
+    decisions key on parsed ENTRY NAMES (and the ODF/epub mimetype entry's
+    stored content, which immediately follows its header)."""
+    names = _zip_entry_names(buf)
+    if names and names[0] == b"mimetype":
+        # ODF/epub require the uncompressed mimetype entry first; its
+        # content starts right after the 30-byte header + name
+        for cue, mime in _ZIP_CONTAINERS:
+            if cue.startswith(b"mimetype") and cue[8:] in buf[:300]:
+                return mime
+    for nm in names:
+        if nm.startswith(b"word/"):
+            return _ZIP_CONTAINERS[0][1]
+        if nm.startswith(b"xl/"):
+            return _ZIP_CONTAINERS[1][1]
+        if nm.startswith(b"ppt/"):
+            return _ZIP_CONTAINERS[2][1]
+        if nm == b"META-INF/MANIFEST.MF":
+            return _ZIP_CONTAINERS[7][1]
+    return "application/zip"
+
+
+def _sniff_gzip(buf: bytes) -> str:
+    """Peek inside gzip (Tika reports the compressed stream's type for
+    .tar.gz); failures fall back to plain gzip."""
+    try:
+        import zlib
+        inner = zlib.decompressobj(47).decompress(buf, 1024)
+        if len(inner) > 262 and inner[257:262] == b"ustar":
+            return "application/x-gtar"
+    except Exception:
+        pass
+    return "application/gzip"
+
+
 class MimeTypeDetector(UnaryTransformer):
-    """Base64 → Text MIME type by magic bytes (reference
-    MimeTypeDetector.scala wraps Apache Tika)."""
+    """Base64 → Text MIME type by magic bytes, with container inspection:
+    zip-based formats (docx/xlsx/pptx/odt/ods/odp/epub/jar) resolve to
+    their specific type via entry-name cues, gzip peeks for an inner tar,
+    and plain tar is detected by the ustar magic at offset 257 (reference
+    MimeTypeDetector.scala wraps Apache Tika, which recurses containers)."""
 
     def __init__(self, uid=None):
         def fn(v):
             if not v:
                 return None
             try:
-                head = _b64.b64decode(str(v)[:64] + "==", validate=False)[:24]
+                buf = _b64.b64decode(str(v)[:_MIME_PEEK_B64] + "==",
+                                     validate=False)
             except Exception:
                 return None
+            head = buf[:24]
+            if len(buf) > 262 and buf[257:262] == b"ustar":
+                return "application/x-tar"
             for magic, off, mime in _MAGIC:
                 if head[off:off + len(magic)] == magic:
+                    if mime == "application/zip":
+                        return _sniff_zip(buf)
+                    if mime == "application/gzip":
+                        return _sniff_gzip(buf)
                     return mime
             if all(32 <= b < 127 or b in (9, 10, 13) for b in head[:16]):
                 return "text/plain"
@@ -805,6 +1010,25 @@ _PHONE_REGIONS = {
     "KR": ("82", (8, 9, 10), "0"), "RU": ("7", 10, "8"),
     "ZA": ("27", 9, "0"), "AR": ("54", 10, "0"),
     "SG": ("65", 8, ""), "NZ": ("64", (8, 9), "0"),
+    # -- round-4 tranche (libphonenumber national-significant-number
+    # lengths; trunk prefix where the national dialing format carries one)
+    "AT": ("43", (8, 9, 10, 11, 12, 13), "0"),
+    "BE": ("32", (8, 9), "0"), "PT": ("351", 9, ""),
+    "DK": ("45", 8, ""), "NO": ("47", 8, ""),
+    "FI": ("358", (6, 7, 8, 9, 10, 11), "0"),
+    "PL": ("48", 9, ""), "CZ": ("420", 9, ""),
+    "SK": ("421", 9, "0"), "HU": ("36", (8, 9), "06"),
+    "RO": ("40", 9, "0"), "BG": ("359", (8, 9), "0"),
+    "GR": ("30", 10, ""), "IE": ("353", (7, 8, 9), "0"),
+    "IL": ("972", (8, 9), "0"), "AE": ("971", (8, 9), "0"),
+    "SA": ("966", (8, 9), "0"), "TH": ("66", (8, 9), "0"),
+    "MY": ("60", (8, 9, 10), "0"), "PH": ("63", 10, "0"),
+    "VN": ("84", (9, 10), "0"), "ID": ("62", (9, 10, 11, 12), "0"),
+    "PK": ("92", 10, "0"), "EG": ("20", (8, 9, 10), "0"),
+    "NG": ("234", (7, 8, 10), "0"), "KE": ("254", 9, "0"),
+    "CL": ("56", 9, ""), "CO": ("57", 10, ""),
+    "PE": ("51", (8, 9), "0"), "UA": ("380", 9, "0"),
+    "HK": ("852", 8, ""), "TW": ("886", (8, 9), "0"),
 }
 
 

@@ -424,22 +424,87 @@ def porter_stem(w: str) -> str:
     return w
 
 
+def _strip_suffixes(w: str, suffixes, min_stem: int = 3) -> str:
+    """Longest-match suffix strip with a minimum stem length — the shared
+    skeleton of the light per-language stemmers below."""
+    for suf, rep in suffixes:
+        if w.endswith(suf) and len(w) - len(suf) >= min_stem:
+            return w[: len(w) - len(suf)] + rep
+    return w
+
+
+#: light Snowball-style suffix strippers (reference: Lucene ships full
+#: per-language Snowball analyzers, LuceneTextAnalyzer.scala:203; as with
+#: porter_stem the goal is stable feature collisions for inflected forms,
+#: not linguistic fidelity). Ordered longest-first so the longest suffix
+#: wins.
+_FR_SUFFIXES = [
+    ("issements", ""), ("issement", ""), ("atrices", "ateur"),
+    ("ateurs", "ateur"), ("ations", "ation"), ("logies", "logie"),
+    ("ements", ""), ("amment", ""), ("emment", ""), ("ances", "ance"),
+    ("ables", "able"), ("istes", "iste"), ("euses", "eux"),
+    ("ments", "ment"), ("ation", "ation"), ("ance", "ance"),
+    ("able", "able"), ("iste", "iste"), ("euse", "eux"), ("ités", "ité"),
+    ("ement", ""), ("ives", "if"), ("ive", "if"), ("eaux", "eau"),
+    ("aux", "al"), ("ité", "ité"), ("er", ""), ("es", ""), ("s", ""),
+    ("e", ""),
+]
+_DE_SUFFIXES = [
+    ("ungen", "ung"), ("heiten", "heit"), ("keiten", "keit"),
+    ("lichen", "lich"), ("ischen", "isch"), ("erinnen", "er"),
+    ("ern", ""), ("ung", "ung"), ("heit", "heit"),
+    ("keit", "keit"), ("lich", "lich"), ("isch", "isch"), ("en", ""),
+    ("er", ""), ("es", ""), ("em", ""), ("e", ""), ("s", ""), ("n", ""),
+]
+_ES_SUFFIXES = [
+    ("amientos", ""), ("imientos", ""), ("aciones", "ación"),
+    ("amiento", ""), ("imiento", ""), ("adoras", "ador"),
+    ("adores", "ador"), ("ancias", "ancia"), ("idades", "idad"),
+    ("encias", "encia"), ("amente", ""), ("mente", ""), ("ación", "ación"),
+    ("adora", "ador"), ("ancia", "ancia"), ("encia", "encia"),
+    ("idad", "idad"), ("istas", "ista"), ("ista", "ista"),
+    ("ables", "able"), ("ibles", "ible"), ("able", "able"),
+    ("ible", "ible"), ("osos", "oso"), ("osas", "oso"), ("osa", "oso"),
+    ("oso", "oso"), ("es", ""), ("as", "a"), ("os", "o"), ("s", ""),
+]
+
+
+def french_stem(w: str) -> str:
+    return _strip_suffixes(w, _FR_SUFFIXES) if len(w) > 4 else w
+
+
+def german_stem(w: str) -> str:
+    return _strip_suffixes(w, _DE_SUFFIXES, min_stem=4) if len(w) > 4 else w
+
+
+def spanish_stem(w: str) -> str:
+    return _strip_suffixes(w, _ES_SUFFIXES) if len(w) > 4 else w
+
+
+#: language → stemmer for TextTokenizer(stemming=True, language=...)
+STEMMERS = {"en": porter_stem, "fr": french_stem, "de": german_stem,
+            "es": spanish_stem}
+
+
 class TextTokenizer(UnaryTransformer):
     """Text → TextList (reference TextTokenizer.scala:196). ``stemming``
-    applies the English Porter-style stemmer to every token (reference
-    Lucene analyzers stem per-language; non-English text passes through
-    mostly untouched since the rules key on English suffixes)."""
+    applies the ``language``'s stemmer to every token (reference Lucene
+    analyzers stem per-language, LuceneTextAnalyzer.scala:203; en/fr/de/es
+    here — other languages pass through untouched)."""
 
     def __init__(self, min_token_length: int = TransmogrifierDefaults.MinTokenLength,
-                 stemming: bool = False, uid=None):
+                 stemming: bool = False, language: str = "en", uid=None):
+        stem = STEMMERS.get(language, lambda t: t)
+
         def fn(v):
             toks = tokenize_text(v, min_token_length)
-            return [porter_stem(t) for t in toks] if stemming else toks
+            return [stem(t) for t in toks] if stemming else toks
         super().__init__(
             "tokenize", transform_fn=fn,
             output_type=TextList, input_type=Text, uid=uid)
         self.min_token_length = min_token_length
         self.stemming = stemming
+        self.language = language
 
 
 def _hash_token(tok: str, num_hashes: int) -> int:

@@ -57,6 +57,19 @@ class BestEstimator:
     results: List[ValidationResult] = field(default_factory=list)
 
 
+@dataclass
+class PendingValidation:
+    """A queued-but-unsynced validate(): every family's device programs are
+    dispatched; ``resolve()`` materializes the metrics and picks the winner.
+    Lets workflow-level CV queue ALL folds' programs back-to-back before a
+    single host sync (the reference's analog: concurrent fold Futures,
+    OpValidator.applyDAG :228-256)."""
+    _finish: Any
+
+    def resolve(self) -> BestEstimator:
+        return self._finish()
+
+
 @functools.lru_cache(maxsize=None)
 def _metric_fn(problem: str, metric: str, batched_y: bool = False,
                binned: "Optional[bool]" = None):
@@ -113,7 +126,7 @@ class OpValidator:
     parallel axes are mesh axes and XLA inserts the psum collectives."""
 
     def __init__(self, seed: int = 42, stratify: bool = False, mesh=None,
-                 max_eval_rows: "Optional[int]" = 131072,
+                 max_eval_rows: "Optional[int]" = 65536,
                  exact_sweep_fits: bool = False):
         self.seed = seed
         self.stratify = stratify
@@ -163,7 +176,7 @@ class OpValidator:
                  metric_name: str, larger_better: bool, num_classes: int,
                  val_masks: Optional[np.ndarray] = None,
                  fold_sliced: Optional[bool] = None,
-                 ) -> BestEstimator:
+                 resolve: bool = True):
         """Run the full |families| × |grid| × |folds| sweep. Each family is one
         vmapped fit_batch + predict_batch + batched-metric program.
 
@@ -236,7 +249,7 @@ class OpValidator:
                     if cap is not None and len(rows) > cap:
                         # deterministic strided subsample: validation METRIC
                         # estimates use <= cap rows per fold (std of AuROC at
-                        # 131k rows ~1e-3 — far below fold-to-fold variance);
+                        # 65k rows ~2e-3 — far below fold-to-fold variance);
                         # the winner's holdout/train evaluations and refit
                         # always use full data
                         rows = rows[np.linspace(0, len(rows) - 1, cap)
@@ -282,7 +295,6 @@ class OpValidator:
 
         results: List[ValidationResult] = []
         pending: List[Any] = []
-        best: Optional[BestEstimator] = None
         for family, grid in models:
             G = len(grid)
             garr = family.grid_to_arrays(grid)                   # each (G,)
@@ -344,23 +356,30 @@ class OpValidator:
             # on the device back-to-back, then ONE sync reads all metrics
             # (a per-family sync costs a link round-trip each)
             pending.append((family.name, list(grid), m, B_true, G))
-        for fam_name, grid_l, m, B_true, G in pending:
-            fold_metrics = np.asarray(m[:B_true]).reshape(F, G)
-            mean_metrics = fold_metrics.mean(axis=0)
-            results.append(ValidationResult(
-                family=fam_name, grid=grid_l, metric_name=metric_name,
-                fold_metrics=fold_metrics, mean_metrics=mean_metrics))
-            g_best = int(np.argmax(mean_metrics) if larger_better
-                         else np.argmin(mean_metrics))
-            value = float(mean_metrics[g_best])
-            better = best is None or (
-                (value > best.metric_value) if larger_better
-                else (value < best.metric_value))
-            if better:
-                best = BestEstimator(fam_name, dict(grid_l[g_best]), value)
-        assert best is not None, "no models to validate"
-        best.results = results
-        return best
+
+        def finish() -> BestEstimator:
+            best: Optional[BestEstimator] = None
+            for fam_name, grid_l, m, B_true, G in pending:
+                fold_metrics = np.asarray(m[:B_true]).reshape(F, G)
+                mean_metrics = fold_metrics.mean(axis=0)
+                results.append(ValidationResult(
+                    family=fam_name, grid=grid_l, metric_name=metric_name,
+                    fold_metrics=fold_metrics, mean_metrics=mean_metrics))
+                g_best = int(np.argmax(mean_metrics) if larger_better
+                             else np.argmin(mean_metrics))
+                value = float(mean_metrics[g_best])
+                better = best is None or (
+                    (value > best.metric_value) if larger_better
+                    else (value < best.metric_value))
+                if better:
+                    best = BestEstimator(fam_name, dict(grid_l[g_best]), value)
+            assert best is not None, "no models to validate"
+            best.results = results
+            return best
+
+        if resolve:
+            return finish()
+        return PendingValidation(finish)
 
 
 class OpCrossValidation(OpValidator):

@@ -70,6 +70,24 @@ class ModelInsights:
     blacklisted_features: List[str]
     raw_feature_filter_results: Optional[Dict[str, Any]]
     version_info: Dict[str, str]
+    #: cross-feature redundancy: column pairs whose |corr| exceeds the
+    #: redundancy threshold, from the SanityChecker's full (d, d) matrix
+    #: (``correlations="full"``; reference SanityChecker.scala:634-638
+    #: computes the same matrix — empty under the label-only default)
+    cross_feature_redundancy: List[Dict[str, Any]] = field(
+        default_factory=list)
+    #: per categorical group: the (feature value × label) pointwise mutual
+    #: information table (reference OpStatistics.contingencyStats PMI)
+    categorical_pmi: Dict[str, List[List[float]]] = field(
+        default_factory=dict)
+    #: DataSplitter/DataBalancer/DataCutter decisions recorded at fit time
+    #: (reference ModelSelectorSummary splitter metadata)
+    splitter_summary: Dict[str, Any] = field(default_factory=dict)
+
+    #: |correlation| above which a kept column pair is reported redundant
+    REDUNDANCY_THRESHOLD = 0.9
+    #: cap on reported redundancy pairs (sorted by |corr| descending)
+    REDUNDANCY_TOP_K = 50
 
     # -- extraction (reference extractFromStages :436) -----------------------
     @staticmethod
@@ -110,6 +128,18 @@ class ModelInsights:
                     "grid": r.grid,
                 })
         rff = getattr(model, "rff_results", None)
+        redundancy: List[Dict[str, Any]] = []
+        pmi: Dict[str, Any] = {}
+        splitter_summary: Dict[str, Any] = {}
+        if checker is not None:
+            s = checker.summary
+            redundancy = ModelInsights._redundancy_pairs(s)
+            pmi = dict(s.get("pointwiseMutualInfo", {}) or {})
+        if selected is not None:
+            splitter_summary = dict(
+                getattr(selected.summary, "splitter_summary", {}) or {})
+            if sel_json is not None:
+                sel_json["splitterSummary"] = splitter_summary
         return ModelInsights(
             label=label,
             features=features,
@@ -118,7 +148,38 @@ class ModelInsights:
             blacklisted_features=[f.name for f in model.blacklisted_features],
             raw_feature_filter_results=rff.to_json() if rff is not None else None,
             version_info=version_info(),
+            cross_feature_redundancy=redundancy,
+            categorical_pmi=pmi,
+            splitter_summary=splitter_summary,
         )
+
+    @staticmethod
+    def _redundancy_pairs(summary) -> List[Dict[str, Any]]:
+        """Kept-column pairs with |corr| ≥ REDUNDANCY_THRESHOLD from the
+        checker's full feature-feature matrix (None under the label-only
+        correlation default)."""
+        fc = summary.get("featureCorrelations")
+        if fc is None:
+            return []
+        names: List[str] = list(summary.get("names", []))
+        C = np.asarray(fc, dtype=np.float64)
+        if C.ndim != 2 or C.shape[0] != C.shape[1]:
+            return []
+        thr = ModelInsights.REDUNDANCY_THRESHOLD
+        iu, ju = np.triu_indices(C.shape[0], k=1)
+        with np.errstate(invalid="ignore"):
+            vals = C[iu, ju]
+        hit = np.nonzero(np.abs(np.nan_to_num(vals)) >= thr)[0]
+        order = hit[np.argsort(-np.abs(vals[hit]))]
+        out = []
+        for k in order[:ModelInsights.REDUNDANCY_TOP_K]:
+            i, j = int(iu[k]), int(ju[k])
+            out.append({
+                "feature1": names[i] if i < len(names) else f"c{i}",
+                "feature2": names[j] if j < len(names) else f"c{j}",
+                "correlation": round(float(vals[k]), 6),
+            })
+        return out
 
     @staticmethod
     def _label_summary(model, selected) -> LabelSummary:
@@ -235,6 +296,9 @@ class ModelInsights:
             "blacklistedFeatures": self.blacklisted_features,
             "rawFeatureFilterResults": enc(self.raw_feature_filter_results),
             "versionInfo": self.version_info,
+            "crossFeatureRedundancy": enc(self.cross_feature_redundancy),
+            "categoricalPointwiseMutualInfo": enc(self.categorical_pmi),
+            "splitterSummary": enc(self.splitter_summary),
         }
 
     def to_json_string(self) -> str:
@@ -275,6 +339,14 @@ class ModelInsights:
         lines.append(format_table(["contribution", "correlation", "feature"],
                                   table_rows,
                                   title="Top feature contributions"))
+        if self.splitter_summary:
+            lines.append(f"Splitter: {self.splitter_summary}")
+        if self.cross_feature_redundancy:
+            lines.append("Redundant column pairs (|corr| >= "
+                         f"{self.REDUNDANCY_THRESHOLD}):")
+            for p in self.cross_feature_redundancy[:10]:
+                lines.append(f"  {p['feature1']} ~ {p['feature2']}: "
+                             f"{p['correlation']:+.4f}")
         if self.blacklisted_features:
             lines.append(f"Blacklisted raw features: {self.blacklisted_features}")
         return "\n".join(lines)

@@ -56,7 +56,7 @@ from ..ops.forest import (
     forest_leaf_sums, forest_leaf_sums_chain, forest_predict,
     forest_predict_chain,
 )
-from ..ops.tree_hist import hist_matmul
+from ..ops.tree_hist import hist_matmul, node_hist_matmul
 from .api import FittedParams, ModelFamily, register_family
 
 N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
@@ -67,10 +67,13 @@ N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 #: (exact refit pass), sweep-time leaf values use the sample.
 _HIST_SAMPLE = 65536
 
-#: sweep-time sample cap: CV candidates grow from half the refit sample —
-#: split thresholds are order statistics and the CV ranking is robust to
-#: the extra estimator noise; the refit winner regrows at _HIST_SAMPLE
-_SWEEP_HIST_SAMPLE = 32768
+#: sweep-time sample cap: CV candidates grow from a quarter of the refit
+#: sample — split thresholds are order statistics and the CV ranking is
+#: robust to the extra estimator noise (measured: docs/benchmarks.md "Sweep
+#: fidelity", re-run for this value); the refit winner regrows at
+#: _HIST_SAMPLE. Round 3 used 32768; halving it halves every growth
+#: histogram's rows for the depth-12 default grids
+_SWEEP_HIST_SAMPLE = 16384
 
 #: config-chunk sizing: batch configurations together until the deepest
 #: level's (sample rows x configs x trees x nodes) transient reaches this
@@ -281,7 +284,6 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     thr_heap = jnp.full((Tb, H), jnp.inf, jnp.float32)
     bin_heap = jnp.full((Tb, H), n_bins, jnp.int32)
     node = jnp.zeros((S, Tb), jnp.int32)
-    sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
     hist_prev = None
     # depth 0: one root leaf per tree, stats are the plain column sums
     leaf_stats = jnp.stack(
@@ -301,19 +303,14 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         # histogram matmul FLOPs and the A_cat HBM traffic at every level.
         if level == 0:
             # root: node == 0 everywhere, the one-hot is all-ones
-            A_cat = jnp.concatenate(sw_bf, axis=1)                   # (S, kTb)
-            hist = hist_matmul(codes_s, A_cat, n_bins)
+            hist = node_hist_matmul(codes_s, node, sw_list, 1, n_bins)
             hist = hist.reshape(k, Tb, d, n_bins).transpose(1, 2, 3, 0)
         else:
             h = m // 2
-            # left-child one-hot, j-major: (S, h, Tb) vs node (S, 1, Tb)
-            j2 = (2 * jnp.arange(h, dtype=jnp.int32))[None, :, None]
-            n_oh_l = (node[:, None, :] == j2).astype(jnp.bfloat16
-                                                     ).reshape(S, h * Tb)
-            A_cat = jnp.concatenate(
-                [n_oh_l.reshape(S, h, Tb) * sw_bf[k_i][:, None, :]
-                 for k_i in range(k)], axis=1).reshape(S, k * h * Tb)
-            hist_l = hist_matmul(codes_s, A_cat, n_bins)
+            # left children only (heap slot 2j), fused in VMEM
+            # (node_hist_matmul stride=2); right = parent − left below
+            hist_l = node_hist_matmul(codes_s, node, sw_list, h, n_bins,
+                                      stride=2)
             hist_l = hist_l.reshape(k, h * Tb, d, n_bins
                                     ).transpose(1, 2, 3, 0)          # (h·Tb,…)
             hist_r = hist_prev - hist_l
@@ -409,7 +406,6 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     k = len(sw_list)
     W = n_slots
     codes_f = codes_s.astype(jnp.bfloat16)
-    sw_bf = [s.astype(jnp.bfloat16) for s in sw_list]
     feat_lv = jnp.zeros((Tb, depth, W), jnp.int32)
     thr_lv = jnp.full((Tb, depth, W), jnp.inf, jnp.float32)
     bin_lv = jnp.full((Tb, depth, W), n_bins, jnp.int32)
@@ -421,13 +417,11 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         Wl = widths[level]
         Wn = widths[level + 1] if level + 1 < depth else min(2 ** depth, W)
         M = Wl * Tb
-        # slot one-hot, j-major lanes (lane = j·Tb + t) like _grow_forest
-        j_all = jnp.arange(Wl, dtype=jnp.int32)[None, :, None]
-        n_oh = (node[:, None, :] == j_all).astype(jnp.bfloat16)  # (S, Wl, Tb)
-        A_cat = jnp.concatenate(
-            [n_oh * sw_bf[ki][:, None, :] for ki in range(k)],
-            axis=1).reshape(S, k * M)
-        hist = hist_matmul(codes_s, A_cat, n_bins)
+        # fused node-histogram: the (slot one-hot × stat) operand expands
+        # tile-by-tile in VMEM (ops/tree_hist.node_hist_matmul) — the
+        # (S, k·Wl·Tb) A_cat it replaces was gigabytes of HBM traffic per
+        # level at sweep widths
+        hist = node_hist_matmul(codes_s, node, sw_list, Wl, n_bins)
         hist = hist.reshape(k, M, d, n_bins).transpose(1, 2, 3, 0)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                       # (M, k) node totals
@@ -483,6 +477,8 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         go_lane = (code_sel > bb_eff.astype(jnp.bfloat16)
                    ).astype(jnp.bfloat16)
         val_lane = go_lane + base_2d.reshape(M).astype(jnp.bfloat16)[None, :]
+        j_all = jnp.arange(Wl, dtype=jnp.int32)[None, :, None]
+        n_oh = (node[:, None, :] == j_all).astype(jnp.bfloat16)   # (S, Wl, Tb)
         nxt = (val_lane.reshape(S, Wl, Tb) * n_oh).sum(axis=1)    # (S, Tb)
         node = jnp.round(nxt.astype(jnp.float32)).astype(jnp.int32)
         n_live = n_live + n_split

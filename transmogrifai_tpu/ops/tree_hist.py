@@ -209,6 +209,184 @@ def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
     return _make(n_bins, exact)(codes, A)
 
 
+# ---------------------------------------------------------------------------
+# Fused node-histogram: hist over (stat, slot, tree) lanes WITHOUT ever
+# materializing the (S, k·Wl·T) masked-stat operand in HBM
+# ---------------------------------------------------------------------------
+
+#: node-hist kernel minimum total lanes — smaller calls take the XLA path
+_NODE_HIST_PALLAS_MIN_B = 32768
+
+
+def _t_pad128(T: int) -> int:
+    """Tree-lane padding the node-hist kernel accepts: 32, 64, or a multiple
+    of 128 (so a 128-lane output block covers whole trees × whole slots)."""
+    if T <= 32:
+        return 32
+    if T <= 64:
+        return 64
+    return _pad_to(T, 128)
+
+
+def _node_hist_xla(codes, node, sws, Wl_eff, n_bins, stride, k, exact=False):
+    """Reference semantics: materialize the masked-stat operand and reuse the
+    plain hist contraction. node: (S, T_pad) int32 (pad -1); sws:
+    (k, S, T_pad) stat-stacked. Returns (k·Wl_eff·T_pad, d·nb)."""
+    S, T_pad = node.shape
+    j = stride * jnp.arange(Wl_eff, dtype=jnp.int32)[None, :, None]
+    n_oh = (node[:, None, :] == j).astype(sws.dtype)      # (S, Wl_eff, T_pad)
+    A = jnp.concatenate(
+        [n_oh * sws[ki][:, None, :] for ki in range(k)],
+        axis=1).reshape(S, k * Wl_eff * T_pad)
+    return _hist_xla(codes, A, n_bins, exact)
+
+
+def _node_hist_pallas(codes, node, sws, Wl_eff, n_bins, stride, k,
+                      exact=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, d = codes.shape
+    T_pad = node.shape[1]
+    assert T_pad in (32, 64) or T_pad % 128 == 0, T_pad
+    lanes_per_k = Wl_eff * T_pad
+    assert lanes_per_k % 128 == 0, (Wl_eff, T_pad)
+    B = k * lanes_per_k
+    rep = max(1, 128 // T_pad)            # j's covered by one 128-lane block
+    blocks_per_k = lanes_per_k // 128
+    t_blocks = max(1, T_pad // 128)       # node col-blocks per j (T_pad>=128)
+
+    d_mult = 128 // math.gcd(n_bins, 128)
+    d_pad = _pad_to(d, d_mult)
+    if d_pad > 128:
+        d_pad = _pad_to(d_pad, 128)
+        blk_d = 128
+    else:
+        blk_d = d_pad
+    out_lanes = n_bins * blk_d
+    blk_s = _BLK_S
+    while blk_s > 256 and blk_s * out_lanes * 2 > (4 << 20):
+        blk_s //= 2
+    s_pad = _pad_to(S, blk_s)
+
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, s_pad - S), (0, d_pad - d)),
+                      constant_values=n_bins)
+    node_p = jnp.pad(node, ((0, s_pad - S), (0, 0)), constant_values=-1)
+    sws_p = jnp.pad(sws.astype(jnp.float32),
+                    ((0, 0), (0, s_pad - S), (0, 0)))    # (k, S, T_pad)
+
+    n_blk = min(T_pad, 128)
+
+    def kernel(codes_ref, node_ref, sws_ref, out_ref):
+        b = pl.program_id(0)
+        s = pl.program_id(2)
+        # bin one-hot tile, bin-major (see module docstring)
+        c_rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)
+        b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, out_lanes), 1)
+                  // blk_d)
+        oh = (c_rep == b_iota).astype(jnp.bfloat16)
+        # masked-stat tile (blk_s, 128) built in VMEM: lane i covers slot
+        # j = j0 + i // T_pad (rep j's per block when T_pad < 128) of tree
+        # t = t0 + i % T_pad, stat k fixed per block
+        if rep > 1:
+            nd = pltpu.repeat(node_ref[:], rep, axis=1)       # (blk_s, 128)
+            sw = pltpu.repeat(sws_ref[0], rep, axis=1)
+        else:
+            nd = node_ref[:]
+            sw = sws_ref[0]
+        jb = b % blocks_per_k
+        j0 = (jb // t_blocks) * rep if T_pad >= 128 else jb * rep
+        lane = jax.lax.broadcasted_iota(jnp.int32, (blk_s, 128), 1)
+        j_row = j0 + lane // n_blk if rep > 1 else j0
+        A = jnp.where(nd == stride * j_row, sw, 0.0)
+        part = jnp.dot(A.T.astype(jnp.bfloat16), oh,
+                       preferred_element_type=jnp.float32)
+
+        @pl.when(s == 0)
+        def _():
+            out_ref[:] = part
+
+        @pl.when(s > 0)
+        def _():
+            out_ref[:] += part
+
+    def node_cols(bb, f, s):
+        # T_pad >= 128: pick the t-block this lane block covers; else whole
+        return (s, (bb % blocks_per_k) % t_blocks if T_pad >= 128 else 0)
+
+    def sws_cols(bb, f, s):
+        ki = bb // blocks_per_k
+        if T_pad >= 128:
+            return (ki, s, (bb % blocks_per_k) % t_blocks)
+        return (ki, s, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, d_pad * n_bins), jnp.float32),
+        grid=(B // 128, d_pad // blk_d, s_pad // blk_s),
+        in_specs=[
+            pl.BlockSpec((blk_s, blk_d), lambda bb, f, s: (s, f)),
+            pl.BlockSpec((blk_s, n_blk), node_cols),
+            pl.BlockSpec((1, blk_s, n_blk), sws_cols),
+        ],
+        out_specs=pl.BlockSpec((128, out_lanes), lambda bb, f, s: (bb, f)),
+        interpret=_interpret(),
+    )(codes_p, node_p, sws_p)
+
+    nbd = d_pad // blk_d
+    out = (out.reshape(B, nbd, n_bins, blk_d)
+           .transpose(0, 1, 3, 2)
+           .reshape(B, d_pad * n_bins))
+    return out[:, :d * n_bins]
+
+
+def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
+                     sw_list, Wl: int, n_bins: int,
+                     stride: int = 1) -> jnp.ndarray:
+    """hist[(k, j, t), f·nb + b] = Σ_s sw_k[s,t] · 1[node[s,t] == stride·j]
+    · 1[codes[s,f] == b] — the tree-growth histogram with the slot one-hot ×
+    stat product expanded tile-by-tile in VMEM. The A_cat materialization
+    this replaces was the growers' dominant HBM traffic: (S, k·Wl·T) f32 is
+    gigabytes per level at RF sweep widths (models/trees.py round-3 built it
+    with jnp.concatenate before every hist call).
+
+    codes: (S, d) int32 bin codes; node: (S, T) int32 current slot per tree
+    (values < 0 never match); sw_list: k arrays (S, T) of per-tree stats;
+    ``stride``: slot-id multiplier (2 = heap left-children, 1 = chain slots).
+    Returns (k·Wl·T, d·n_bins) f32, lane = (k·Wl + j)·T + t — identical
+    layout to ``hist_matmul(codes, A_cat, n_bins)`` with A_cat built k-major
+    then j-major.
+    """
+    S, d = codes.shape
+    T = node.shape[1]
+    k = len(sw_list)
+    T_pad = _t_pad128(T)
+    rep = max(1, 128 // T_pad)
+    Wl_eff = max(Wl, rep)
+    if Wl_eff * T_pad % 128:
+        Wl_eff = -(-Wl_eff // rep) * rep
+    node_p = (jnp.pad(node, ((0, 0), (0, T_pad - T)), constant_values=-1)
+              if T_pad != T else node)
+    sws = jnp.stack(
+        [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
+         if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
+    # pallas pays a fixed per-call cost (grid setup + per-block one-hot
+    # re-expansion); below this lane count the XLA A_cat contraction is
+    # faster despite its HBM materialization (measured: GBT's 64·54-lane
+    # scan steps regressed ~10% under the kernel while RF's 64·512-lane
+    # levels gained)
+    if _use_pallas() and k * Wl_eff * T_pad >= _NODE_HIST_PALLAS_MIN_B:
+        out = _node_hist_pallas(codes, node_p, sws, Wl_eff, n_bins,
+                                stride, k)
+    else:
+        out = _node_hist_xla(codes, node_p, sws, Wl_eff, n_bins, stride, k)
+    if Wl_eff != Wl or T_pad != T:
+        out = (out.reshape(k, Wl_eff, T_pad, d * n_bins)[:, :Wl, :T]
+               .reshape(k * Wl * T, d * n_bins))
+    return out
+
+
 # Routing no longer lives here: the per-level decision-bit contraction
 # (route_matmul) was replaced by the feature-select matmul inside
 # models/trees.py _grow_tree (1/n_bins-th the FLOPs) and by the fused

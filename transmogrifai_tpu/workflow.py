@@ -196,6 +196,10 @@ class OpWorkflow(_WorkflowCore):
         blacklisted: Tuple[Feature, ...] = ()
         rff_results = None
         if self._raw_feature_filter is not None:
+            if (getattr(self, "_mesh", None) is not None
+                    and hasattr(self._raw_feature_filter, "set_mesh")):
+                # RFF is the first full pass over raw data — shard it too
+                self._raw_feature_filter.set_mesh(self._mesh)
             table, blacklist, rff_results = self._raw_feature_filter.filter_raw(
                 table, self.raw_features)
             if blacklist:
