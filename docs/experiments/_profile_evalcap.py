@@ -1,0 +1,48 @@
+"""Isolate sweep predict/metric cost: validate() wall at different
+max_eval_rows (1k ~= fit-only + fixed; default cap adds predict+metric)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+    import transmogrifai_tpu.models.linear  # noqa: F401
+    import transmogrifai_tpu.models.trees   # noqa: F401
+
+    n, d, folds = 1_000_000, 64, 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d).astype(np.float32) + rng.randn(n) > 0
+         ).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    for fam_name in ("OpRandomForestClassifier", "OpGBTClassifier"):
+        fam = MODEL_REGISTRY[fam_name]
+        models = [(fam, fam.default_grid("binary"))]
+        for cap in (1024, 65536):
+            def sweep():
+                cv = OpCrossValidation(num_folds=folds, seed=0,
+                                       max_eval_rows=cap)
+                best = cv.validate(models, Xd, yd, "binary", "AuROC",
+                                   True, 2)
+                for r in best.results:
+                    np.asarray(r.fold_metrics)
+            sweep()
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                sweep()
+                ts.append(time.perf_counter() - t0)
+            print(f"{fam_name} cap={cap}: {float(np.median(ts)):.3f}s",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Workflow-level CV vs plain CV wall ratio on the Titanic pipeline
+(round-3: 1.75x; round-4 target ~1.2x via the deferred fold sync)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    from transmogrifai_tpu.examples.titanic import build_workflow
+
+    def run(workflow_cv):
+        wf, survived, prediction = build_workflow(seed=42)
+        if workflow_cv:
+            wf = wf.with_workflow_cv()
+        t0 = time.perf_counter()
+        wf.train()
+        return time.perf_counter() - t0
+
+    # warm both paths' compiles, then measure
+    run(False), run(True)
+    plain = min(run(False) for _ in range(3))
+    wfcv = min(run(True) for _ in range(3))
+    print(f"plain CV: {plain:.2f}s  workflow-CV: {wfcv:.2f}s  "
+          f"ratio x{wfcv / plain:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,12 @@ _CFG_CHUNK_ELEMS = 1 << 30
 #: trees per fused-descent call (ops/forest.py pallas cap)
 _PREDICT_TREE_CHUNK = 128
 
+#: per-level histogram element budget (f32): bounds the (Tb·nodes, d,
+#: n_bins, k) split-search pipeline — XLA keeps ~3-6 of these alive
+#: through the cumsum/gain chain, so ~1 GB per tensor keeps peak HBM well
+#: inside a 16 GB chip even with that multiplier
+_LEVEL_HIST_ELEMS = 1 << 28
+
 
 # ---------------------------------------------------------------------------
 # Binning
@@ -445,10 +451,18 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         cand_2d = cand.reshape(Wl, Tb) & live
         # leaf-budget cap: each split adds one net slot, so at most
         # q = W_next − n_live splits may run this level; keep the q best
-        # by gain (rank via double argsort, −inf keys sort last)
+        # by gain. Rank by COUNTING dominating slots — a (Wl, Wl, Tb)
+        # comparison reduction — instead of a double argsort: XLA's sort
+        # costs ~ms per call at these shapes while the count is one
+        # elementwise pass; ties break by slot index ascending, identical
+        # to a stable descending argsort
         key = jnp.where(cand_2d, bgain.reshape(Wl, Tb), -jnp.inf)
-        order = jnp.argsort(-key, axis=0)
-        rank = jnp.argsort(order, axis=0)                        # (Wl, Tb)
+        k_i = key[:, None, :]                                    # (Wl,1,Tb)
+        k_j = key[None, :, :]                                    # (1,Wl,Tb)
+        j_lt_i = (jnp.arange(Wl)[None, :, None]
+                  < jnp.arange(Wl)[:, None, None])
+        dominates = (k_j > k_i) | ((k_j == k_i) & j_lt_i)
+        rank = dominates.sum(axis=1).astype(jnp.int32)           # (Wl, Tb)
         q = jnp.maximum(Wn - n_live, 0)[None, :]
         kept = cand_2d & (rank < q)
         n_split = kept.sum(axis=0).astype(jnp.int32)             # (Tb,)
@@ -688,6 +702,15 @@ def _fit_rf_batch(X, y, weights, max_depth, min_inst, min_gain, num_trees,
               else 2 ** (depth - 1))
     cb = max(1, min(B, _CFG_CHUNK_ELEMS
                     // (S * n_trees * max(lane_w, 2 * (k + 1)))))
+    # ...AND the per-level histogram/gain pipeline, whose (Tb·nodes, d,
+    # n_bins, k) f32 tensors scale with the FEATURE count, not the sample:
+    # at small S the first bound lets whole wide grids through, and a
+    # 600-column text-hashed vector at depth 12 then asks for >25 GB of
+    # HBM (seen on the Titanic pipeline; XLA holds several of these
+    # alive across the cumsum/gain chain)
+    nodes_w = min(2 ** depth, n_slots) if deep else 2 ** (depth - 1)
+    cb = max(1, min(cb, _LEVEL_HIST_ELEMS
+                    // (n_trees * nodes_w * d * n_bins * k)))
 
     def one_chunk(w_c, md, mi, mg, ss, seed):
         """Grow a chunk of cb configs — cb·n_trees trees — in one
@@ -1484,7 +1507,7 @@ class GBTFamilyBase(_TreeFamilyBase):
         n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
         n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
-        def one_call(g, w, depth, slots=0):
+        def one_raw(g, w, depth, slots=0):
             return _fit_gbt_batch(
                 X, y, w, g["maxDepth"],
                 _g(g, "minInstancesPerNode", 0.0), _g(g, "minInfoGain", 0.0),
@@ -1494,6 +1517,33 @@ class GBTFamilyBase(_TreeFamilyBase):
                 depth=depth, n_bins=N_BINS, num_classes=max(num_classes, 2),
                 task=task, n_rounds=n_rounds, sweep=sweep, n_slots=slots)
 
+        def one_call(g, w, depth, slots=0):
+            # config chunking under the SAME per-level histogram budget as
+            # RF (_LEVEL_HIST_ELEMS): the (Tb·nodes, d, n_bins, k) split
+            # pipeline scales with the feature count, and GBT's boosting
+            # scan otherwise runs every config at once — a 600-column
+            # text-hashed vector at depth 12 would ask XLA for tens of GB
+            B_g = w.shape[0]
+            C_g = max(num_classes, 2) if task == "multiclass" else 1
+            nodes_w = (min(2 ** depth, slots) if slots
+                       else 2 ** max(depth - 1, 0))
+            per_cfg = C_g * nodes_w * X.shape[1] * N_BINS * 3
+            cb = int(max(1, min(B_g, _LEVEL_HIST_ELEMS // max(per_cfg, 1))))
+            if cb >= B_g:
+                return one_raw(g, w, depth, slots)
+            n_ch = -(-B_g // cb)
+            parts = []
+            for c in range(n_ch):
+                # wrap the tail chunk so every chunk shares one compile
+                idx = np.arange(c * cb, (c + 1) * cb) % B_g
+                sub = {k2: v[jnp.asarray(idx)] for k2, v in g.items()}
+                p = one_raw(sub, w[jnp.asarray(idx)], depth, slots)
+                count = min((c + 1) * cb, B_g) - c * cb
+                parts.append((idx[:count],
+                              {k2: (v if k2 == "edges" else v[:count])
+                               for k2, v in p.items()}))
+            return _stitch_parts(B_g, parts)
+
         md = np.asarray(grid["maxDepth"], dtype=np.float64).reshape(-1)
         d_max = int(md.max())
         if d_max <= _MAX_HEAP_DEPTH:
@@ -1501,29 +1551,20 @@ class GBTFamilyBase(_TreeFamilyBase):
             # a second scan chain for shallow configs costs more than the
             # wasted deep levels (their active-mask already stops splitting)
             return one_call(grid, weights, d_max)
-        # deep grid: shallow configs share ONE heap scan at their own max
-        # depth; each deep depth runs a slot-chain scan; everything stitches
-        # into the chain layout (exact for heaps). The shared chain width
-        # must hold the deepest heap bucket's leaf layer
-        deep_mask = md > _MAX_HEAP_DEPTH
-        if (~deep_mask).any():
-            n_slots = max(n_slots, 2 ** int(md[~deep_mask].max()))
-        B = md.shape[0]
-        parts = []
-        if (~deep_mask).any():
-            idx = np.nonzero(~deep_mask)[0]
-            sub = {k: v[idx] for k, v in grid.items()}
-            d_sh = int(md[idx].max())
-            p = _heap_to_chain(one_call(sub, weights[idx], d_sh), d_sh,
-                               d_max, n_slots, N_BINS, leaf_axis=-1)
-            parts.append((idx, p))
-        for u in sorted({int(v) for v in md[deep_mask]}):
-            idx = np.nonzero(md == u)[0]
-            sub = {k: v[idx] for k, v in grid.items()}
-            p = _pad_chain_depth(one_call(sub, weights[idx], u, n_slots),
-                                 u, d_max, N_BINS, leaf_axis=-1)
-            parts.append((idx, p))
-        return _stitch_parts(B, parts)
+        # deep grid: ONE slot-chain scan for ALL configs at the deepest
+        # depth. Boosting is step-count-bound (each of rounds x levels
+        # sequential steps carries ~ms of small-op overhead at GBT's narrow
+        # lane widths), so a merged 240-step scan beats a 120-step heap
+        # scan PLUS a 240-step chain scan even though shallow configs ride
+        # along through the deep levels (their max_depth mask stops
+        # splitting; the budget keeps those levels narrow). Shallow
+        # configs' trees still fit within the budget exactly when
+        # 2^depth <= n_slots (chain == heap, test_capped_grower_matches_
+        # heap_when_uncapped).
+        shallow = md[md <= _MAX_HEAP_DEPTH]
+        if shallow.size:  # budget must hold a shallow config's full tree
+            n_slots = max(n_slots, 2 ** int(shallow.max()))
+        return one_call(grid, weights, d_max, n_slots)
 
     def predict_batch(self, params, X, num_classes):
         edges = self._edges_of(params)

@@ -214,8 +214,15 @@ def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
 # materializing the (S, k·Wl·T) masked-stat operand in HBM
 # ---------------------------------------------------------------------------
 
-#: node-hist kernel minimum total lanes — smaller calls take the XLA path
-_NODE_HIST_PALLAS_MIN_B = 32768
+#: node-hist kernel lane threshold. MEASURED (v5e, S=16384, d=64, nb=32,
+#: amortized over 24 in-program calls): RF chain shape (T=300, Wl=64, k=2)
+#: pallas 29.4ms vs XLA 24.8ms/call; GBT shape (T=54) 8.2 vs 7.8 — XLA's
+#: pipelined A_cat contraction wins at every sweep shape this framework
+#: produces (the kernel also pays T→128-multiple lane padding, +28% at
+#: T=300). Effectively disabled by default; kept CI-tested (interpret
+#: mode, tests/test_node_hist.py) for larger-S regimes and as the
+#: measurement record.
+_NODE_HIST_PALLAS_MIN_B = 1 << 62
 
 
 def _t_pad128(T: int) -> int:
@@ -371,11 +378,9 @@ def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
     sws = jnp.stack(
         [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
          if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
-    # pallas pays a fixed per-call cost (grid setup + per-block one-hot
-    # re-expansion); below this lane count the XLA A_cat contraction is
-    # faster despite its HBM materialization (measured: GBT's 64·54-lane
-    # scan steps regressed ~10% under the kernel while RF's 64·512-lane
-    # levels gained)
+    # dispatch per the measurement record on _NODE_HIST_PALLAS_MIN_B (XLA
+    # wins at every sweep shape measured; the kernel stays for larger-S
+    # regimes and is CI-exercised with the threshold monkeypatched to 0)
     if _use_pallas() and k * Wl_eff * T_pad >= _NODE_HIST_PALLAS_MIN_B:
         out = _node_hist_pallas(codes, node_p, sws, Wl_eff, n_bins,
                                 stride, k)
