@@ -1,0 +1,69 @@
+"""Scaling probes for sweep-mode tree fits: how fit time scales with
+numTrees (RF), maxIter (GBT), and depth mix. Run on the real TPU."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax                               # noqa: E402
+import jax.numpy as jnp                  # noqa: E402
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY  # noqa: E402
+import transmogrifai_tpu.models.trees   # noqa: F401,E402
+
+
+def timeit(fn, reps=3):
+    fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = fn()
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+            else a, r)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    platform = jax.devices()[0].platform
+    n = 1_000_000 if platform == "tpu" else 20_000
+    d = 64
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    F = 3
+    rs = np.random.RandomState(1)
+    fold_ids = rs.randint(0, F, size=n).astype(np.uint8)
+    ids_d = jnp.asarray(fold_ids)
+    f_iota = jnp.arange(F, dtype=jnp.uint8)[:, None]
+    train_w = (ids_d[None, :] != f_iota).astype(jnp.float32)
+
+    def fit_time(fam, grid):
+        G = len(grid)
+        garr = fam.grid_to_arrays(grid)
+        W = jnp.repeat(train_w, G, axis=0)
+        tiled = {k: jnp.tile(v, F) for k, v in garr.items()}
+        return timeit(lambda: fam.sweep_fit_batch(Xd, yd, W, tiled, 2))
+
+    rf = MODEL_REGISTRY["OpRandomForestClassifier"]
+    base = rf.default_grid("binary")
+    for nt in (50, 16):
+        g = [dict(c, numTrees=nt) for c in base]
+        print(f"RF numTrees={nt:3d}: fit={fit_time(rf, g):.3f}s", flush=True)
+
+    gbt = MODEL_REGISTRY["OpGBTClassifier"]
+    gbase = gbt.default_grid("binary")
+    for mi in (10,):
+        g = [dict(c, maxIter=mi) for c in gbase]
+        print(f"GBT maxIter={mi:3d}: fit={fit_time(gbt, g):.3f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

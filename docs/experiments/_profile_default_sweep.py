@@ -1,0 +1,68 @@
+"""Per-family timing of the stock default-grid sweep (BENCH_MODE=default).
+
+Times each family's full validate() contribution separately (fit + predict +
+metric, host-synced) to locate where the 135-fit sweep's wall-clock goes.
+Run on the real TPU: python docs/experiments/_profile_default_sweep.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax                               # noqa: E402
+import jax.numpy as jnp                  # noqa: E402
+
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation  # noqa: E402
+from transmogrifai_tpu.models.api import MODEL_REGISTRY  # noqa: E402
+import transmogrifai_tpu.models.linear  # noqa: F401,E402
+import transmogrifai_tpu.models.trees   # noqa: F401,E402
+
+
+def main():
+    platform = jax.devices()[0].platform
+    n = 1_000_000 if platform == "tpu" else 20_000
+    d = 64
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    fams = ("OpLogisticRegression", "OpRandomForestClassifier",
+            "OpGBTClassifier", "OpLinearSVC")
+    models_all = [(MODEL_REGISTRY[f], MODEL_REGISTRY[f].default_grid("binary"))
+                  for f in fams]
+
+    for fam, grid in models_all:
+        cv = OpCrossValidation(num_folds=3, seed=0)
+        # warmup/compile
+        cv.validate([(fam, grid)], Xd, yd, "binary", "AuROC", True, 2)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cv.validate([(fam, grid)], Xd, yd, "binary", "AuROC", True, 2)
+            times.append(time.perf_counter() - t0)
+        dt = float(np.median(times))
+        fits = 3 * len(grid)
+        print(f"{fam.name:30s} configs={len(grid):3d} fits={fits:4d} "
+              f"median={dt:7.3f}s  fits/sec={fits/dt:7.1f}")
+
+    # full 4-family sweep for reference
+    cv = OpCrossValidation(num_folds=3, seed=0)
+    cv.validate(models_all, Xd, yd, "binary", "AuROC", True, 2)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cv.validate(models_all, Xd, yd, "binary", "AuROC", True, 2)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    fits = 3 * sum(len(g) for _, g in models_all)
+    print(f"{'ALL 4 FAMILIES':30s} configs={sum(len(g) for _, g in models_all):3d} "
+          f"fits={fits:4d} median={dt:7.3f}s  fits/sec={fits/dt:7.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Decompose the RF default-grid sweep into fit / predict / metric time,
+and per-depth-bucket fit time. Run on the real TPU."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax                               # noqa: E402
+import jax.numpy as jnp                  # noqa: E402
+
+from transmogrifai_tpu.models.api import MODEL_REGISTRY  # noqa: E402
+import transmogrifai_tpu.models.linear  # noqa: F401,E402
+import transmogrifai_tpu.models.trees   # noqa: F401,E402
+
+
+def timeit(fn, reps=3):
+    fn()  # warmup/compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = fn()
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready() if hasattr(a, "block_until_ready")
+            else a, r)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    platform = jax.devices()[0].platform
+    n = 1_000_000 if platform == "tpu" else 20_000
+    d = 64
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+    y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    for fname in ("OpRandomForestClassifier", "OpGBTClassifier"):
+        fam = MODEL_REGISTRY[fname]
+        grid = fam.default_grid("binary")
+        F, G = 3, len(grid)
+        # emulate validate()'s tiling: 3 folds x G configs
+        rs = np.random.RandomState(1)
+        fold_ids = rs.randint(0, F, size=n).astype(np.uint8)
+        ids_d = jnp.asarray(fold_ids)
+        f_iota = jnp.arange(F, dtype=jnp.uint8)[:, None]
+        train_w = (ids_d[None, :] != f_iota).astype(jnp.float32)
+        garr = fam.grid_to_arrays(grid)
+        W = jnp.repeat(train_w, G, axis=0)
+        tiled = {k: jnp.tile(v, F) for k, v in garr.items()}
+
+        t_fit = timeit(lambda: fam.sweep_fit_batch(Xd, yd, W, tiled, 2))
+        params = fam.sweep_fit_batch(Xd, yd, W, tiled, 2)
+
+        nf = 65536
+        Xf = Xd[:nf]
+        t_pred = timeit(lambda: fam.predict_batch(
+            fam.slice_params(params, 0, G), Xf, 2), reps=3)
+        print(f"{fname}: all-depth fit({F*G} cfg)={t_fit:.3f}s  "
+              f"predict({G} cfg x {nf} rows)={t_pred:.3f}s x{F} folds "
+              f"= {t_pred*F:.3f}s")
+
+        # per-depth fit buckets
+        for dep in (3, 6, 12):
+            sub = [g for g in grid if g["maxDepth"] == dep]
+            Gs = len(sub)
+            ga = fam.grid_to_arrays(sub)
+            Ws = jnp.repeat(train_w, Gs, axis=0)
+            ts = {k: jnp.tile(v, F) for k, v in ga.items()}
+            t_d = timeit(lambda: fam.sweep_fit_batch(Xd, yd, Ws, ts, 2))
+            ps = fam.sweep_fit_batch(Xd, yd, Ws, ts, 2)
+            t_p = timeit(lambda: fam.predict_batch(
+                fam.slice_params(ps, 0, Gs), Xf, 2))
+            print(f"  depth={dep:2d}: fit({F*Gs} cfg)={t_d:.3f}s  "
+                  f"predict({Gs} cfg)={t_p:.3f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -239,3 +239,46 @@ def test_default_grids_include_depth12():
         fam = MODEL_REGISTRY[name]
         depths = sorted({g["maxDepth"] for g in fam.default_grid("binary")})
         assert depths == [3, 6, 12], (name, depths)
+
+
+# ---------------------------------------------------------------------------
+# Sibling-subtraction chain grower == full-histogram chain grower
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode,W,depth", [
+    ("counts", 8, 5), ("counts", 16, 7), ("gh", 8, 6),
+])
+def test_chain_sibling_subtraction_parity(monkeypatch, mode, W, depth):
+    """The Tb-gated sibling-subtraction path (fresh even-slot histograms +
+    odd-slot reconstruction) must grow the same trees as the full
+    per-level histogram path — CI only reaches the gate-off branch
+    naturally (sweep batches on real TPU are the Tb >= 128 regime), so
+    force both branches and compare all five outputs."""
+    rng = np.random.RandomState(11)
+    S, d, Tb, n_bins = 512, 6, 12, 16
+    codes = jnp.asarray(rng.randint(0, n_bins, size=(S, d), dtype=np.int32))
+    edges = jnp.asarray(
+        np.sort(rng.randn(d, n_bins - 1).astype(np.float32), axis=1))
+    k = 2 if mode == "counts" else 3
+    # well-separated stats so split choices don't sit on numeric ties
+    sw_list = [jnp.asarray(rng.rand(S, Tb).astype(np.float32) + 0.1)
+               for _ in range(k)]
+    fmasks = jnp.ones((Tb, d), bool)
+    cfg = {"max_depth": jnp.full((Tb,), float(depth), jnp.float32),
+           "min_instances": jnp.full((Tb,), 1.0, jnp.float32),
+           "min_info_gain": jnp.full((Tb,), 1e-4, jnp.float32),
+           "lam": jnp.full((Tb,), 1e-6, jnp.float32),
+           "min_child_weight": jnp.zeros((Tb,), jnp.float32)}
+
+    def grow():
+        return T._grow_forest_capped(
+            codes, edges, sw_list, fmasks, cfg,
+            depth=depth, n_bins=n_bins, mode=mode, n_slots=W)
+
+    monkeypatch.setattr(T, "_CHAIN_SIBLING_MIN_TB", 1 << 30)
+    base = [np.asarray(a) for a in grow()]
+    monkeypatch.setattr(T, "_CHAIN_SIBLING_MIN_TB", 1)
+    sib = [np.asarray(a) for a in grow()]
+    names = ("feat_lv", "thr_lv", "bin_lv", "base_lv", "node_s")
+    for nm, a, b in zip(names, base, sib):
+        np.testing.assert_array_equal(a, b, err_msg=nm)

@@ -224,7 +224,7 @@ _STOPWORD_PROFILES: Dict[str, frozenset] = {
  நீ அவர் நாம் அவர்கள் என அல்லது எல்லா பின்""".split()),
 }
 
-#: decisive Unicode script ranges: when ≥60% of a text's letters fall in
+#: decisive Unicode script ranges: when ≥50% of a text's letters fall in
 #: one of these blocks, the language set narrows to the block's candidates
 #: (the Optimaize n-gram analog for languages without whitespace or with
 #: unique scripts); within multi-language scripts the stopword profiles
@@ -924,6 +924,33 @@ def _zip_entry_names(buf: bytes, limit: int = 16):
     return names
 
 
+def _zip_stored_content(buf: bytes, target: bytes, limit: int = 16) -> bytes:
+    """Content bytes of a STORED (method 0) local-file entry named
+    ``target`` within the peek window; b"" when absent, compressed, or
+    truncated. Anchored header walk like :func:`_zip_entry_names`."""
+    off = 0
+    seen = 0
+    while seen < limit and off + 30 <= len(buf):
+        if buf[off:off + 4] != b"PK\x03\x04":
+            break
+        method = int.from_bytes(buf[off + 8:off + 10], "little")
+        n_len = int.from_bytes(buf[off + 26:off + 28], "little")
+        e_len = int.from_bytes(buf[off + 28:off + 30], "little")
+        c_size = int.from_bytes(buf[off + 18:off + 22], "little")
+        name = buf[off + 30:off + 30 + n_len]
+        data_off = off + 30 + n_len + e_len
+        if name == target:
+            if method != 0:
+                return b""
+            return buf[data_off:data_off + c_size]
+        nxt = data_off + c_size
+        if nxt <= off:
+            break
+        off = nxt
+        seen += 1
+    return b""
+
+
 def _sniff_zip(buf: bytes) -> str:
     """Inside-zip container detection (Tika's container recursion analog):
     decisions key on parsed ENTRY NAMES (and the ODF/epub mimetype entry's
@@ -944,6 +971,24 @@ def _sniff_zip(buf: bytes) -> str:
             return _ZIP_CONTAINERS[2][1]
         if nm == b"META-INF/MANIFEST.MF":
             return _ZIP_CONTAINERS[7][1]
+    if any(nm == b"[Content_Types].xml" for nm in names):
+        # OOXML whose word/-xl/-ppt/ parts fall outside the peek window
+        # (nonstandard entry order, or a large [Content_Types].xml pushing
+        # them past 3 KB): the flavor lives in [Content_Types].xml's
+        # MAIN-part declaration, so when that entry is STORED parse its
+        # content (never the surrounding deflate bytes — the
+        # _zip_entry_names invariant); else report Tika's generic OOXML
+        # type rather than degrading to application/zip
+        ct = _zip_stored_content(buf, b"[Content_Types].xml")
+        for cue, mime in (
+                (b"wordprocessingml.document.main+xml",
+                 _ZIP_CONTAINERS[0][1]),
+                (b"spreadsheetml.sheet.main+xml", _ZIP_CONTAINERS[1][1]),
+                (b"presentationml.presentation.main+xml",
+                 _ZIP_CONTAINERS[2][1])):
+            if cue in ct:
+                return mime
+        return "application/x-tika-ooxml"
     return "application/zip"
 
 

@@ -84,6 +84,11 @@ _CFG_CHUNK_ELEMS = 1 << 30
 #: trees per fused-descent call (ops/forest.py pallas cap)
 _PREDICT_TREE_CHUNK = 128
 
+#: chain-grower sibling subtraction pays off only for wide tree batches
+#: (see the measurement note in _grow_forest_capped); below this width the
+#: per-level reconstruction overhead exceeds the saved contraction
+_CHAIN_SIBLING_MIN_TB = 128
+
 #: per-level histogram element budget (f32): bounds the (Tb·nodes, d,
 #: n_bins, k) split-search pipeline — XLA keeps ~3-6 of these alive
 #: through the cumsum/gain chain, so ~1 GB per tensor keeps peak HBM well
@@ -419,16 +424,58 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     node = jnp.zeros((S, Tb), jnp.int32)          # slot at current level
     n_live = jnp.ones((Tb,), jnp.int32)
     widths = _chain_widths(depth, W)
+    # sibling subtraction, chain edition (the heap grower's LightGBM trick
+    # adapted to slot-chain trees): per-tree row weights are constant
+    # across levels, so a freshly-computed histogram is only needed for
+    # EVEN slots (node_hist_matmul stride=2 — halves the dominant
+    # contraction). Odd slots reconstruct from the previous level: a right
+    # child (its parent was kept, child base even) is parent − left
+    # sibling; a carried slot landing on an odd position keeps its old
+    # histogram verbatim. The (j_src, is_rchild) odd-slot inverse mapping
+    # is built from the level's kept/carried/base tables; dead slots
+    # (≥ n_live) may carry garbage but are masked out of every split
+    # decision (`live`) and are never sourced by kept/carried.
+    # MEASURED (v5e, S=16384, d=64, nb=32, W=64): wins only when the tree
+    # batch is wide enough for the halved contraction to stay MXU-bound —
+    # RF sweep chunks (Tb=500) −8%, GBT's Tb=54 boosting scan +17% (its
+    # narrow per-step ops are latency-bound; the reconstruction's extra
+    # gathers/stacks cost more than the saved FLOPs), hence the width gate.
+    sibling = Tb >= _CHAIN_SIBLING_MIN_TB
+    hist5_prev = None                 # (Wl_prev, Tb, d, nb, k) f32
+    odd_map_prev = None               # (j_src (Wh_o, Tb), is_rchild)
     for level in range(depth):
         Wl = widths[level]
         Wn = widths[level + 1] if level + 1 < depth else min(2 ** depth, W)
         M = Wl * Tb
-        # fused node-histogram: the (slot one-hot × stat) operand expands
-        # tile-by-tile in VMEM (ops/tree_hist.node_hist_matmul) — the
-        # (S, k·Wl·Tb) A_cat it replaces was gigabytes of HBM traffic per
-        # level at sweep widths
-        hist = node_hist_matmul(codes_s, node, sw_list, Wl, n_bins)
-        hist = hist.reshape(k, M, d, n_bins).transpose(1, 2, 3, 0)
+        # node-histogram contraction (ops/tree_hist.node_hist_matmul).
+        # A pallas kernel that expands the (slot one-hot × stat) operand
+        # tile-by-tile in VMEM exists, but XLA's pipelined contraction won
+        # at every measured sweep shape, so the active path materializes
+        # the (S, k·(Wl/2)·Tb) operand (see _NODE_HIST_PALLAS_MIN_B)
+        if level == 0 or Wl % 2 or not sibling:
+            hist = node_hist_matmul(codes_s, node, sw_list, Wl, n_bins)
+            hist5 = hist.reshape(k, Wl, Tb, d, n_bins
+                                 ).transpose(1, 2, 3, 4, 0)
+        else:
+            Wh = Wl // 2
+            he = node_hist_matmul(codes_s, node, sw_list, Wh, n_bins,
+                                  stride=2)
+            he5 = he.reshape(k, Wh, Tb, d, n_bins
+                             ).transpose(1, 2, 3, 4, 0)   # slot 2j'
+            j_src, is_rch = odd_map_prev
+            prev_flat = hist5_prev.reshape(
+                hist5_prev.shape[0], Tb, d * n_bins * k)
+            src = jnp.take_along_axis(
+                prev_flat.transpose(1, 0, 2),             # (Tb, Wl_prev, ·)
+                j_src.T[:, :, None].astype(jnp.int32), axis=1
+            ).transpose(1, 0, 2).reshape(Wh, Tb, d, n_bins, k)
+            odd5 = src - jnp.where(
+                is_rch[:, :, None, None, None], he5,
+                jnp.zeros_like(he5))
+            hist5 = jnp.stack([he5, odd5], axis=1).reshape(
+                Wl, Tb, d, n_bins, k)
+        hist5_prev = hist5
+        hist = hist5.reshape(M, d, n_bins, k)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                       # (M, k) node totals
         SL = cum[:, :, :-1, :]
@@ -474,6 +521,22 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         base_2d = jnp.where(
             kept, 2 * rank,
             jnp.where(carried, 2 * n_split[None, :] + c_rank, 0))
+        if sibling and level + 1 < depth and widths[level + 1] % 2 == 0:
+            # odd-slot inverse map for the next level's sibling
+            # subtraction: odd slot i sources prev slot j where either j
+            # was kept and its right child landed at i (base+1 == i), or
+            # j carried onto i (base == i). Targets are unique, so the
+            # one-hot · j sum IS the inverse permutation.
+            wh_n = widths[level + 1] // 2
+            i_odd = (1 + 2 * jnp.arange(wh_n, dtype=jnp.int32)
+                     )[None, :, None]                       # (1, wh_n, 1)
+            oh_r = (jnp.where(kept, base_2d + 1, -1)[:, None, :]
+                    == i_odd)                               # (Wl, wh_n, Tb)
+            oh_c = (jnp.where(carried, base_2d, -1)[:, None, :]
+                    == i_odd)
+            j_idx = jnp.arange(Wl, dtype=jnp.int32)[:, None, None]
+            odd_map_prev = (((oh_r | oh_c) * j_idx).sum(axis=0),
+                            oh_r.any(axis=0))               # (wh_n, Tb) ×2
         kept_f = kept.reshape(M)
         bf_eff = jnp.where(kept_f, bf, 0)
         bb_eff = jnp.where(kept_f, bb, n_bins)
