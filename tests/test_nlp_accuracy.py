@@ -427,3 +427,197 @@ def test_tokenizer_language_stemming():
     # unknown language: pass-through
     tk2 = TextTokenizer(stemming=True, language="xx")
     assert tk2.transform_fn("running dogs") == ["running", "dogs"]
+
+
+# -- round-5: numbering-plan patterns + number type + region resolution ------
+
+PHONE_STRICT_FIXTURES = [
+    # (region, number, lenient_valid, strict_valid)
+    ("US", "650-253-0000", True, True),
+    ("US", "650-123-4567", True, False),   # exchange starting 1: not NANP
+    ("US", "150-253-0000", True, False),   # area code starting 1: not NANP
+    ("GB", "07911 123456", True, True),    # mobile
+    ("GB", "020 7946 0958", True, True),   # London fixed
+    ("GB", "09911 123456", True, False),   # 9x: premium, not in plan table
+    ("FR", "06 12 34 56 78", True, True),
+    ("FR", "08 12 34 56 78", True, False),
+    ("AU", "0412 345 678", True, True),
+    ("AU", "0912 345 678", True, False),
+    ("RU", "8 912 345 67 89", True, True),
+    ("RU", "8 012 345 67 89", True, False),
+    ("SG", "9123 4567", True, True),
+    ("SG", "1123 4567", True, False),
+]
+
+
+def test_phone_strict_patterns():
+    for region, number, lenient, strict in PHONE_STRICT_FIXTURES:
+        rl = parse_phone(number, region)
+        rs = parse_phone(number, region, strict=True)
+        assert rl is not None and rl[1] is lenient, (region, number, rl)
+        assert rs is not None and rs[1] is strict, (region, number, rs)
+    # explicit-cc numbers get pattern-checked under strict too
+    assert parse_phone("+44 7911 123456", "US", strict=True)[1] is True
+    assert parse_phone("+1 650 123 4567", "US", strict=True)[1] is False
+
+
+PHONE_TYPE_FIXTURES = [
+    ("GB", "07911 123456", "mobile"),
+    ("GB", "020 7946 0958", "fixed_line"),
+    ("FR", "06 12 34 56 78", "mobile"),
+    ("FR", "01 42 68 53 00", "fixed_line"),
+    ("DE", "0151 12345678", "mobile"),
+    ("AU", "0412 345 678", "mobile"),
+    ("AU", "02 9374 4000", "fixed_line"),
+    ("JP", "090 1234 5678", "mobile"),
+    ("CN", "138 1234 5678", "mobile"),
+    ("CN", "010 1234 5678", "fixed_line"),
+    ("RU", "8 912 345 67 89", "mobile"),
+    ("BR", "11 91234 5678", "mobile"),
+    ("BR", "11 3123 4567", "fixed_line"),
+    ("US", "650 253 0000", "fixed_line_or_mobile"),
+    ("SG", "9123 4567", "mobile"),
+    ("HK", "2123 4567", "fixed_line"),
+    ("IT", "347 123 4567", "mobile"),
+    ("ES", "612 34 56 78", "mobile"),
+    ("IN", "98765 43210", "mobile"),
+    ("ZA", "082 123 4567", "mobile"),
+]
+
+
+def test_phone_number_type():
+    from transmogrifai_tpu.impl.feature.text import phone_number_type
+    correct = 0
+    for region, number, want in PHONE_TYPE_FIXTURES:
+        got = phone_number_type(number, region)
+        if got == want:
+            correct += 1
+    # floor: the simplified plan tables must classify >= 18/20; exact
+    # libphonenumber metadata would be 20/20
+    assert correct >= len(PHONE_TYPE_FIXTURES) - 2, correct
+    # explicit country code routes through the right region's table
+    assert phone_number_type("+44 7911 123456") == "mobile"
+    assert phone_number_type("+65 6123 4567") == "fixed_line"
+
+
+def test_phone_region_name_resolution():
+    from transmogrifai_tpu.impl.feature.text import (IsValidPhoneNumber,
+                                                     ParsePhoneNumber)
+    p = ParsePhoneNumber()
+    # free-text country names resolve by Jaccard bigram similarity
+    # (reference validCountryCode :285-305)
+    assert p.transform_fn("020 7946 0958", "United Kingdom") == "+442079460958"
+    assert p.transform_fn("06 12 34 56 78", "FRANCE") == "+33612345678"
+    assert p.transform_fn("650 253 0000", "United States") == "+16502530000"
+    # region codes pass straight through; unknown text falls to default
+    assert p.transform_fn("650 253 0000", "US") == "+16502530000"
+    v = IsValidPhoneNumber()
+    assert v.transform_fn("020 7946 0958", "GB") is True
+    assert v.transform_fn("1", "GB") is False
+    assert v.transform_fn(None, "GB") is None
+
+
+# -- round-5: 21 new languages + close-pair cues ------------------------------
+
+LANG_FIXTURES_R5 = [
+    # close pairs the round-4 stopword profiles confused on short text
+    ("sv", "och det är inte så bra efter allt som hände här"),
+    ("no", "og det er ikke så bra etter alt som skjedde her"),
+    ("da", "og det er ikke så godt efter alt hvad der skete her"),
+    ("cs", "a když byl ten člověk doma, že to bylo dobré při práci"),
+    ("sk", "a keď bol ten človek doma, že to bolo dobré pri práci"),
+    ("ms", "saya boleh pergi ke sana kerana awak ialah kawan saya"),
+    ("id", "saya bisa pergi ke sana karena kamu adalah teman saya"),
+    ("pt", "uma casa não é mais do que um lugar para estar"),
+    ("gl", "unha casa non é máis do que un lugar para estar"),
+    # new Latin/Cyrillic profiles
+    ("is", "og það er ekki svo gott eftir allt sem gerðist hér"),
+    ("ga", "agus tá sé go maith nuair a bhí mé ar an mbóthar seo"),
+    ("cy", "mae hi yn dda iawn pan oedd y bobl yn y dref gyda ni"),
+    ("eu", "eta hau ez da hain ona baina izan behar du egin"),
+    ("sq", "dhe kjo nuk është shumë mirë por ai ishte këtu kur erdhi"),
+    ("mk", "и тоа не е многу добро но тој беше тука кога дојде со нив"),
+    ("be", "і гэта не вельмі добра але ён быў тут калі прыйшоў да нас"),
+]
+
+LANG_SCRIPT_EXACT_R5 = [
+    ("hy", "սա շատ լավ օր է մեզ համար"),
+    ("ka", "ეს ძალიან კარგი დღეა ჩვენთვის"),
+    ("ml", "ഇത് ഞങ്ങൾക്ക് വളരെ നല്ല ദിവസമാണ്"),
+    ("te", "ఇది మాకు చాలా మంచి రోజు"),
+    ("kn", "ಇದು ನಮಗೆ ತುಂಬಾ ಒಳ್ಳೆಯ ದಿನ"),
+    ("gu", "આ અમારા માટે ખૂબ સરસ દિવસ છે"),
+    ("pa", "ਇਹ ਸਾਡੇ ਲਈ ਬਹੁਤ ਵਧੀਆ ਦਿਨ ਹੈ"),
+    ("si", "මෙය අපට ඉතා හොඳ දවසකි"),
+    ("my", "ဒီနေ့ဟာ ကျွန်တော်တို့အတွက် အလွန်ကောင်းတဲ့နေ့ပါ"),
+    ("km", "នេះជាថ្ងៃល្អណាស់សម្រាប់ពួកយើង"),
+    ("lo", "ມື້ນີ້ເປັນມື້ທີ່ດີຫຼາຍສຳລັບພວກເຮົາ"),
+    ("am", "ይህ ለእኛ በጣም ጥሩ ቀን ነው"),
+    ("ur", "یہ ہمارے لیے بہت اچھا دن ہے"),
+]
+
+
+def test_lang_round5_close_pairs_and_new_profiles():
+    d = LangDetector()
+    correct = 0
+    for want, text in LANG_FIXTURES_R5:
+        sc = d.transform_fn(text)
+        if sc and max(sc, key=sc.get) == want:
+            correct += 1
+    # floor: the weighted cue profiles must get >= 15/16 of the
+    # close-pair/new-profile fixtures (sv/no/da, cs/sk, ms/id, pt/gl were
+    # coin flips on round-4's unweighted stopword hit rates)
+    assert correct >= len(LANG_FIXTURES_R5) - 1, correct
+
+
+def test_lang_round5_script_exact():
+    d = LangDetector()
+    for want, text in LANG_SCRIPT_EXACT_R5:
+        sc = d.transform_fn(text)
+        assert sc is not None and max(sc, key=sc.get) == want, (want, sc)
+
+
+def test_round5_stemmers_collapse_inflections():
+    from transmogrifai_tpu.impl.feature.vectorizers import (
+        STEMMERS, dutch_stem, italian_stem, portuguese_stem, russian_stem)
+    # the point is stable feature collisions: inflected forms of one lemma
+    # must map to one stem (reference: Lucene per-language Snowball,
+    # LuceneTextAnalyzer.scala:203)
+    groups = [
+        (italian_stem, ["informazione", "informazioni"]),
+        (italian_stem, ["lavorato", "lavorare", "lavorati"]),
+        (italian_stem, ["famoso", "famosi", "famosa"]),
+        (portuguese_stem, ["informação", "informações"]),
+        (portuguese_stem, ["famoso", "famosos", "famosa"]),
+        (portuguese_stem, ["trabalhar", "trabalhamento"]),
+        (dutch_stem, ["mogelijkheid", "mogelijkheden"]),
+        (dutch_stem, ["werking", "werkingen"]),
+        (russian_stem, ["книга", "книги", "книгами"]),
+        (russian_stem, ["работать", "работал", "работает"]),
+        (russian_stem, ["хороший", "хорошего"]),
+    ]
+    for fn, words in groups:
+        stems = {fn(w) for w in words}
+        assert len(stems) == 1, (words, stems)
+    for lang in ("it", "pt", "nl", "ru"):
+        assert lang in STEMMERS
+    # TextTokenizer integration
+    from transmogrifai_tpu.impl.feature.vectorizers import TextTokenizer
+    t = TextTokenizer(stemming=True, language="ru")
+    assert t.transform_fn("работать работал") == ["работ", "работ"]
+
+
+def test_round5_review_regressions():
+    # shared ä/ö letters must not outvote a zero-evidence language
+    d = LangDetector()
+    for want, t in [("fi", "tämä on erittäin hyvä päivä meille"),
+                    ("et", "see on meile väga hea päev")]:
+        sc = d.transform_fn(t)
+        assert max(sc, key=sc.get) == want, (want, sc)
+    # unknown default_region keeps the US-rules fallback
+    assert parse_phone("650 253 0000", "ZZ") == ("+16502530000", True)
+    # free text sharing only incidental bigrams falls to the default region
+    from transmogrifai_tpu.impl.feature.text import _resolve_region
+    assert _resolve_region("Unknown", "US") == "US"
+    assert _resolve_region("Europe", "US") == "US"
+    assert _resolve_region("United Kingdom", "US") == "GB"

@@ -25,7 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...stages.base import Estimator, Transformer, UnaryTransformer
+from ...stages.base import (BinaryTransformer, Estimator, Transformer,
+                            UnaryTransformer)
 from ...table import Column, FeatureTable
 from ...types import (
     Base64, Binary, Email, Integral, MultiPickListMap, OPVector, Phone,
@@ -222,6 +223,51 @@ _STOPWORD_PROFILES: Dict[str, frozenset] = {
  以 要 他 时 来 用 们""".split()),
     "ta": frozenset("""மற்றும் இந்த அந்த என்று ஒரு இல்லை உள்ள அது இது நான்
  நீ அவர் நாம் அவர்கள் என அல்லது எல்லா பின்""".split()),
+    # -- round-5 tranche: toward/past Optimaize's ~70 (see _SCRIPT_LANGS
+    # for the 12 script-exact additions) -----------------------------------
+    "is": frozenset("""og að er ekki það sem hann hún við þið þeir en um
+ frá til með fyrir var ég þú hvað eða líka núna alltaf""".split()),
+    "ga": frozenset("""agus an na is tá ní sé sí mé tú muid sibh siad ar
+ le do ag go bhí seo sin ach nó gach nuair mar""".split()),
+    "cy": frozenset("""y yr mae yn a ac i o gan am ar ei eu ni chi nhw
+ oedd bod hwn hon ond neu gyda wedi fel dim""".split()),
+    "eu": frozenset("""eta da ez du bat hau hori zen dira nik zuk guk
+ zuek haiek edo ere baina izan dute dago egin behar""".split()),
+    "gl": frozenset("""e o a os as un unha de do da en non que para con
+ se máis pero como ou ao polo pola é son ten""".split()),
+    "sq": frozenset("""dhe në një është nuk të për me nga se si por ose
+ ai ajo ne ju ata kjo ky ishte janë kur çdo""".split()),
+    "mk": frozenset("""и во на е се да за со од не тоа како но по кој
+ беше таа ние вие тие ако или што ова овој сите""".split()),
+    "be": frozenset("""і ў не на я што ён з як гэта па але яны мы яна у
+ за тое ж вы так яго яе да быў для пры пра або калі""".split()),
+    "ur": frozenset("""اور کا کی کے میں ہے کہ یہ وہ سے پر کو نہیں ایک ہم
+ تم اگر یا بھی سب بعد تھا تھی""".split()),
+}
+
+#: decisive token/character CUES for closely-related language pairs where
+#: shared stopwords drown the signal on short text (the reference's
+#: Optimaize n-gram profiles are robust here; these weighted cues are the
+#: hand-built analog). Token cues count 3x a stopword hit; each decisive
+#: character counts 2x (capped) — sv/no/da, cs/sk, ms/id, pt/gl, fi/et.
+_CUE_TOKENS: Dict[str, frozenset] = {
+    "sv": frozenset("och är inte jag vad ingen mycket".split()),
+    "no": frozenset("etter av hva noen ut".split()),
+    "da": frozenset("af efter hvad nogen gennem".split()),
+    "cs": frozenset("že když byl nebo při".split()),
+    "sk": frozenset("keď bol alebo pri sú".split()),
+    "ms": frozenset("boleh awak ialah kerana".split()),
+    "id": frozenset("bisa kamu adalah karena sudah".split()),
+    "pt": frozenset("uma não mais pelo pela são está".split()),
+    "gl": frozenset("unha non máis polo pola ten".split()),
+    "hr": frozenset("što tko uvijek lijepo tjedan".split()),
+    "sl": frozenset("če tudi kot kdo vedno".split()),
+}
+
+_CUE_CHARS: Dict[str, str] = {
+    "sv": "äö", "no": "æø", "da": "æø", "de": "ß",
+    "cs": "řěů", "sk": "ľĺŕô", "is": "þð", "ro": "țș",
+    "pt": "ãõ", "hu": "őű", "et": "õ", "tr": "ğı",
 }
 
 #: decisive Unicode script ranges: when ≥50% of a text's letters fall in
@@ -238,10 +284,27 @@ _SCRIPT_LANGS = [
     ((0x0980, 0x09FF), ("bn",)),            # Bengali
     ((0x0B80, 0x0BFF), ("ta",)),            # Tamil
     ((0x0370, 0x03FF), ("el",)),            # Greek
-    ((0x0600, 0x06FF), ("ar", "fa")),       # Arabic script: ar vs fa
+    ((0x0600, 0x06FF), ("ar", "fa", "ur")),  # Arabic script: ar/fa/ur
     ((0x4E00, 0x9FFF), ("zh", "ja")),       # CJK ideographs: zh vs ja
-    ((0x0400, 0x04FF), ("ru", "uk", "bg", "sr")),  # Cyrillic
+    ((0x0400, 0x04FF), ("ru", "uk", "bg", "sr", "mk", "be")),  # Cyrillic
+    # -- round-5: script-exact languages (Optimaize covers these via
+    # profiles; a unique block is strictly stronger evidence) -------------
+    ((0x0530, 0x058F), ("hy",)),            # Armenian
+    ((0x10A0, 0x10FF), ("ka",)),            # Georgian
+    ((0x0D00, 0x0D7F), ("ml",)),            # Malayalam
+    ((0x0C00, 0x0C7F), ("te",)),            # Telugu
+    ((0x0C80, 0x0CFF), ("kn",)),            # Kannada
+    ((0x0A80, 0x0AFF), ("gu",)),            # Gujarati
+    ((0x0A00, 0x0A7F), ("pa",)),            # Gurmukhi (Punjabi)
+    ((0x0D80, 0x0DFF), ("si",)),            # Sinhala
+    ((0x1000, 0x109F), ("my",)),            # Myanmar (Burmese)
+    ((0x1780, 0x17FF), ("km",)),            # Khmer
+    ((0x0E80, 0x0EFF), ("lo",)),            # Lao
+    ((0x1200, 0x137F), ("am",)),            # Ethiopic (Amharic)
 ]
+
+#: Urdu-specific letters absent from Arabic and Persian (ٹ ڈ ڑ ں ے ھ)
+_UR_CHARS = frozenset("ٹڈڑںےھ")
 
 #: Persian-specific letters absent from Arabic (پ چ ژ گ ک ی)
 _FA_CHARS = frozenset("پچژگکی")
@@ -695,9 +758,10 @@ class OpLDAModel(_VectorModelBase):
 
 class LangDetector(UnaryTransformer):
     """Text → RealMap of language scores (reference LangDetector.scala wraps
-    Optimaize, ~70 languages; here: Unicode-script narrowing + stopword-
-    profile hit rates over a **44-language** table — see _STOPWORD_PROFILES
-    / _SCRIPT_LANGS, tests/test_nlp_accuracy.py for per-language floors).
+    Optimaize, ~70 languages; here: Unicode-script narrowing + weighted
+    stopword/cue-profile hit rates over a **65-language** table — see
+    _STOPWORD_PROFILES / _CUE_TOKENS / _SCRIPT_LANGS,
+    tests/test_nlp_accuracy.py for per-language floors).
 
     Script-unique languages (ja/ko/th/he/hi/bn/ta/el and Arabic-script
     ar/fa) are decided by character blocks — the whitespace tokenizer
@@ -727,7 +791,9 @@ class LangDetector(UnaryTransformer):
                     if c < 0.5 * n_l or (lo, hi) in (
                             (0x3040, 0x30FF), (0x4E00, 0x9FFF)):
                         continue
-                    if langs == ("ar", "fa"):
+                    if langs == ("ar", "fa", "ur"):
+                        if any(ch in _UR_CHARS for ch in s):
+                            return {"ur": 1.0}
                         return {"fa" if any(ch in _FA_CHARS for ch in s)
                                 else "ar": 1.0}
                     if len(langs) == 1:
@@ -748,7 +814,17 @@ class LangDetector(UnaryTransformer):
             if restrict is not None and lang not in restrict:
                 continue
             hits = sum(1 for t in toks if t in words)
+            # weighted cues split closely-related pairs (see _CUE_TOKENS);
+            # gated on >=1 base stopword hit so letters SHARED across
+            # languages (sv/fi/et/de all write ä/ö) cannot rank a language
+            # with zero profile evidence above the true one
             if hits:
+                cues = _CUE_TOKENS.get(lang)
+                if cues:
+                    hits += 3 * sum(1 for t in toks if t in cues)
+                cue_ch = _CUE_CHARS.get(lang)
+                if cue_ch:
+                    hits += 2 * min(sum(s.count(c) for c in cue_ch), 3)
                 scores[lang] = hits / len(toks)
         total = sum(scores.values())
         if not total:
@@ -992,6 +1068,65 @@ def _sniff_zip(buf: bytes) -> str:
     return "application/zip"
 
 
+#: OLE2 main-stream names → concrete legacy-Office MIME type (Tika's POIFS
+#: container detection analog; names live in the compound-file directory)
+_OLE2_STREAMS = (
+    ("WordDocument", "application/msword"),
+    ("Workbook", "application/vnd.ms-excel"),
+    ("Book", "application/vnd.ms-excel"),
+    ("PowerPoint Document", "application/vnd.ms-powerpoint"),
+    ("VisioDocument", "application/vnd.visio"),
+)
+
+#: how much extra base64 we are willing to decode to reach the OLE2
+#: directory sector (the header points at it; legacy Office files keep it
+#: in the first few sectors, but it is rarely inside the 3 KB peek)
+_OLE2_MAX_BYTES = 256 << 10
+
+
+def _sniff_ole2(full_b64: str, head: bytes) -> str:
+    """Legacy doc/xls/ppt via the compound-file (CFBF/OLE2) directory:
+    parse the header's sector size + first-directory-sector pointer, decode
+    just enough of the base64 payload to reach that sector, and classify by
+    the well-known main-stream names (reference MimeTypeDetector.scala:134
+    delegates to Tika's POIFS inspection). Unknown or out-of-reach
+    directories keep Tika's x-tika-msoffice catch-all."""
+    try:
+        if len(head) < 80:
+            return "application/x-tika-msoffice"
+        sect_shift = int.from_bytes(head[30:32], "little")
+        if not 7 <= sect_shift <= 12:
+            return "application/x-tika-msoffice"
+        ssz = 1 << sect_shift
+        dir_sect = int.from_bytes(head[48:52], "little", signed=True)
+        if dir_sect < 0:
+            return "application/x-tika-msoffice"
+        # sector n starts at (n + 1) << sect_shift (header = sector -1)
+        dir_off = (dir_sect + 1) << sect_shift
+        want = dir_off + ssz
+        if want > _OLE2_MAX_BYTES:
+            return "application/x-tika-msoffice"
+        n_chars = -(-want // 3) * 4 + 4
+        buf = _b64.b64decode(full_b64[:n_chars] + "==", validate=False)
+        if len(buf) < dir_off + 128:
+            return "application/x-tika-msoffice"
+        names = []
+        for off in range(dir_off, min(dir_off + ssz, len(buf) - 127), 128):
+            n_len = int.from_bytes(buf[off + 64:off + 66], "little")
+            if not 2 <= n_len <= 64:
+                continue
+            try:
+                names.append(buf[off:off + n_len - 2].decode("utf-16-le"))
+            except Exception:
+                continue
+        for stream, mime in _OLE2_STREAMS:
+            if stream in names:
+                return mime
+    except Exception:
+        pass
+    return "application/x-tika-msoffice"
+
+
 def _sniff_gzip(buf: bytes) -> str:
     """Peek inside gzip (Tika reports the compressed stream's type for
     .tar.gz); failures fall back to plain gzip."""
@@ -1008,9 +1143,11 @@ def _sniff_gzip(buf: bytes) -> str:
 class MimeTypeDetector(UnaryTransformer):
     """Base64 → Text MIME type by magic bytes, with container inspection:
     zip-based formats (docx/xlsx/pptx/odt/ods/odp/epub/jar) resolve to
-    their specific type via entry-name cues, gzip peeks for an inner tar,
-    and plain tar is detected by the ustar magic at offset 257 (reference
-    MimeTypeDetector.scala wraps Apache Tika, which recurses containers)."""
+    their specific type via entry-name cues, legacy OLE2 (doc/xls/ppt/vsd)
+    via the compound-file directory's main-stream names, gzip peeks for an
+    inner tar, and plain tar is detected by the ustar magic at offset 257
+    (reference MimeTypeDetector.scala wraps Apache Tika, which recurses
+    containers)."""
 
     def __init__(self, uid=None):
         def fn(v):
@@ -1030,6 +1167,8 @@ class MimeTypeDetector(UnaryTransformer):
                         return _sniff_zip(buf)
                     if mime == "application/gzip":
                         return _sniff_gzip(buf)
+                    if mime == "application/x-tika-msoffice":
+                        return _sniff_ole2(str(v), buf[:512])
                     return mime
             if all(32 <= b < 127 or b in (9, 10, 13) for b in head[:16]):
                 return "text/plain"
@@ -1077,9 +1216,86 @@ _PHONE_REGIONS = {
 }
 
 
-def parse_phone(v: Optional[str], default_region: str = "US"
-                ) -> Optional[Tuple[str, bool]]:
-    """→ (E.164-ish normalized, is_valid) (reference PhoneNumberParser)."""
+#: per-region national-significant-number PATTERNS (libphonenumber
+#: isValidNumber analog for the top-traffic regions; the length table above
+#: is the isPossibleNumber analog for all 54). Each entry: leading-digit /
+#: area-code regexes for fixed-line and mobile numbers, anchored over the
+#: NSN after trunk stripping. NANP regions share one fixed-or-mobile plan.
+#: Reference: PhoneNumberParser.scala delegates both tiers to
+#: libphonenumber's per-region metadata (:259-314).
+_NANP = r"[2-9]\d{2}[2-9]\d{6}"
+_PHONE_PATTERNS: Dict[str, Dict[str, str]] = {
+    "US": {"fixed_line_or_mobile": _NANP},
+    "CA": {"fixed_line_or_mobile": _NANP},
+    "GB": {"mobile": r"7[1-57-9]\d{8}", "fixed_line": r"[12]\d{8,9}|3\d{9}"},
+    "FR": {"mobile": r"[67]\d{8}", "fixed_line": r"[1-59]\d{8}"},
+    "DE": {"mobile": r"1[5-7]\d{8,9}", "fixed_line": r"[2-9]\d{7,10}"},
+    "IN": {"mobile": r"[6-9]\d{9}", "fixed_line": r"[2-5]\d{9}"},
+    "AU": {"mobile": r"4\d{8}", "fixed_line": r"[2378]\d{8}"},
+    "JP": {"mobile": r"[789]0\d{8}", "fixed_line": r"[1-9]\d{7,8}"},
+    "BR": {"mobile": r"\d{2}9\d{8}", "fixed_line": r"\d{2}[2-5]\d{7}"},
+    "MX": {"fixed_line_or_mobile": r"[2-9]\d{9}"},
+    "IT": {"mobile": r"3\d{8,9}", "fixed_line": r"0\d{8,9}"},
+    "ES": {"mobile": r"[67]\d{8}", "fixed_line": r"[89]\d{8}"},
+    "NL": {"mobile": r"6\d{8}", "fixed_line": r"[1-578]\d{8}"},
+    "SE": {"mobile": r"7[02369]\d{7}", "fixed_line": r"[1-68]\d{6,8}"},
+    "CH": {"mobile": r"7[5-9]\d{7}", "fixed_line": r"[2-6]\d{8}"},
+    "CN": {"mobile": r"1[3-9]\d{9}", "fixed_line": r"[2-9]\d{8,9}"},
+    "KR": {"mobile": r"1[0-9]\d{7,8}",
+           "fixed_line": r"2\d{7,8}|[3-6]\d{8}"},
+    "RU": {"mobile": r"9\d{9}", "fixed_line": r"[348]\d{9}"},
+    "ZA": {"mobile": r"[67]\d{8}|8[1-4]\d{7}", "fixed_line": r"[1-5]\d{8}"},
+    "SG": {"mobile": r"[89]\d{7}", "fixed_line": r"[36]\d{7}"},
+    "HK": {"mobile": r"[569]\d{7}", "fixed_line": r"[23]\d{7}"},
+    "PL": {"mobile": r"(?:4[5-9]|5[0137]|6[069]|7[2389]|88)\d{7}",
+           "fixed_line": r"[1-3]\d{8}"},
+}
+
+
+def _match_pattern(region: str, nsn: str) -> Optional[str]:
+    """NSN → number type ('mobile' / 'fixed_line' /
+    'fixed_line_or_mobile') per the region's pattern table; None when the
+    region has no table or nothing matches."""
+    pats = _PHONE_PATTERNS.get(region)
+    if not pats:
+        return None
+    for typ, pat in pats.items():
+        if re.fullmatch(pat, nsn):
+            return typ
+    return None
+
+
+def _split_nsn(digits: str, region: str,
+               spec: Optional[Tuple] = None) -> Optional[str]:
+    """Digits (national or cc-prefixed) → the national significant number
+    for ``region``, or None when the shape matches neither. ``spec``
+    overrides the region lookup (parse_phone passes its already-resolved
+    spec so unknown regions keep the documented US-rules fallback)."""
+    spec = spec if spec is not None else _PHONE_REGIONS.get(region)
+    if spec is None:
+        return None
+    cc, ln, trunk = spec
+    lens = (ln,) if isinstance(ln, int) else tuple(ln)
+    if trunk and digits.startswith(trunk) \
+            and len(digits) - len(trunk) in lens:
+        return digits[len(trunk):]
+    if len(digits) in lens:
+        return digits
+    if digits.startswith(cc) and len(digits) - len(cc) in lens:
+        return digits[len(cc):]
+    return None
+
+
+def parse_phone(v: Optional[str], default_region: str = "US",
+                strict: bool = False) -> Optional[Tuple[str, bool]]:
+    """→ (E.164-ish normalized, is_valid) (reference PhoneNumberParser).
+
+    Two validation tiers mirroring libphonenumber: the default checks
+    country code + national-number LENGTH (isPossibleNumber analog, all 54
+    regions); ``strict=True`` additionally requires the leading-digit /
+    area-code pattern of the region's numbering plan when the region is in
+    ``_PHONE_PATTERNS`` (isValidNumber analog, 22 regions — regions without
+    a pattern table keep length semantics)."""
     if not v:
         return None
     digits = re.sub(r"[^\d+]", "", str(v))
@@ -1087,51 +1303,201 @@ def parse_phone(v: Optional[str], default_region: str = "US"
     digits = digits.lstrip("+")
     if not digits:
         return None
-    cc, ln, trunk = _PHONE_REGIONS.get(default_region.upper(),
-                                       ("1", 10, ""))
+    region = default_region.upper()
+    cc, ln, trunk = _PHONE_REGIONS.get(region, ("1", 10, ""))
     lens = (ln,) if isinstance(ln, int) else tuple(ln)
     if explicit_cc:
-        for region, (rcc, rln, _tr) in _PHONE_REGIONS.items():
+        for rg, (rcc, rln, _tr) in _PHONE_REGIONS.items():
             rlens = (rln,) if isinstance(rln, int) else tuple(rln)
             if digits.startswith(rcc) and len(digits) - len(rcc) in rlens:
+                if strict and _PHONE_PATTERNS.get(rg) is not None \
+                        and _match_pattern(rg, digits[len(rcc):]) is None:
+                    continue
                 return ("+" + digits, True)
         return ("+" + digits, False)
     # national format with the region's trunk prefix: strip it for E.164
-    if trunk and digits.startswith(trunk) \
-            and len(digits) - len(trunk) in lens:
-        return ("+" + cc + digits[len(trunk):], True)
-    if len(digits) in lens:
-        return ("+" + cc + digits, True)
-    if digits.startswith(cc) and len(digits) - len(cc) in lens:
-        return ("+" + digits, True)
+    nsn = _split_nsn(digits, region, spec=(cc, ln, trunk))
+    if nsn is not None:
+        ok = (not strict or _PHONE_PATTERNS.get(region) is None
+              or _match_pattern(region, nsn) is not None)
+        if ok:
+            return ("+" + cc + nsn, True)
     return ("+" + digits, False)
+
+
+def phone_number_type(v: Optional[str], default_region: str = "US"
+                      ) -> Optional[str]:
+    """Phone → 'mobile' | 'fixed_line' | 'fixed_line_or_mobile' | None
+    (libphonenumber PhoneNumberUtil.getNumberType analog for the regions
+    with pattern metadata; None = invalid, unknown type, or no table)."""
+    if not v:
+        return None
+    digits = re.sub(r"[^\d+]", "", str(v))
+    explicit_cc = digits.startswith("+")
+    digits = digits.lstrip("+")
+    if not digits:
+        return None
+    if explicit_cc:
+        for rg, (rcc, rln, _tr) in _PHONE_REGIONS.items():
+            rlens = (rln,) if isinstance(rln, int) else tuple(rln)
+            if digits.startswith(rcc) and len(digits) - len(rcc) in rlens:
+                t = _match_pattern(rg, digits[len(rcc):])
+                if t is not None:
+                    return t
+        return None
+    region = default_region.upper()
+    nsn = _split_nsn(digits, region)
+    return _match_pattern(region, nsn) if nsn is not None else None
 
 
 class PhoneNumberParser(UnaryTransformer):
     """Phone → Phone normalized, invalid → missing (reference
-    PhoneNumberParser.scala)."""
+    PhoneNumberParser.scala). ``strict`` requires the region's numbering-
+    plan pattern (libphonenumber isValidNumber tier) on top of the length
+    check (isPossibleNumber tier)."""
 
-    def __init__(self, default_region: str = "US", uid=None):
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid=None):
         def fn(v):
-            r = parse_phone(v, default_region)
+            r = parse_phone(v, default_region, strict=strict)
             return r[0] if r is not None and r[1] else None
         super().__init__("parsePhone", transform_fn=fn, output_type=Phone,
                          input_type=Phone, uid=uid)
         self.default_region = default_region
+        self.strict = strict
 
 
 class IsValidPhoneDefaultCountry(UnaryTransformer):
     """Phone → Binary validity (reference isValidPhoneDefaultCountry)."""
 
-    def __init__(self, default_region: str = "US", uid=None):
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid=None):
         def fn(v):
             if v is None:
                 return None
-            r = parse_phone(v, default_region)
+            r = parse_phone(v, default_region, strict=strict)
             return bool(r is not None and r[1])
         super().__init__("isValidPhone", transform_fn=fn, output_type=Binary,
                          input_type=Phone, uid=uid)
         self.default_region = default_region
+        self.strict = strict
+
+
+def _bigrams(s: str) -> set:
+    s = s.strip().upper()
+    return {s[i:i + 2] for i in range(len(s) - 1)} if len(s) > 1 else {s}
+
+
+def _name_bigrams(table: Dict[str, str]):
+    return [(code.upper(), [_bigrams(n) for n in str(names).split(",")])
+            for code, names in table.items()]
+
+
+#: minimum Jaccard similarity for a free-text country-name match — below
+#: it, unrelated text shares only incidental bigrams ('Europe' vs 'PERU')
+#: and must fall back to the default region
+_REGION_SIM_FLOOR = 0.34
+
+
+def _resolve_region(region_text: Optional[str], default_region: str,
+                    name_bigrams=None) -> str:
+    """Free-text region → region code (reference
+    PhoneNumberParser.validCountryCode :285-305): exact region-code match
+    first, then Jaccard bigram similarity against country NAMES (so
+    'United States' or 'USA,United States of America' both resolve to US).
+    Unlike the reference's unconditional maxBy, matches below
+    ``_REGION_SIM_FLOOR`` fall back to the default region — arbitrary text
+    must not resolve to whichever country shares one bigram."""
+    if not region_text:
+        return default_region
+    rc = str(region_text).strip().upper()
+    if rc in _PHONE_REGIONS:
+        return rc
+    rc_bi = _bigrams(rc)
+    best, best_sim = None, 0.0
+    for code, name_sets in (name_bigrams
+                            if name_bigrams is not None
+                            else _DEFAULT_NAME_BIGRAMS):
+        for nb in name_sets:
+            inter = len(rc_bi & nb)
+            union = len(rc_bi | nb)
+            sim = inter / union if union else 0.0
+            if sim > best_sim:
+                best, best_sim = code, sim
+    return best if best is not None and best_sim >= _REGION_SIM_FLOOR \
+        else default_region
+
+
+#: country-name table for free-text region resolution (reference
+#: DefaultCountryCodes, PhoneNumberParser.scala:325+ — NANP-heavy there;
+#: here one name per supported region)
+_DEFAULT_COUNTRY_NAMES: Dict[str, str] = {
+    "US": "USA, UNITED STATES OF AMERICA", "CA": "CANADA",
+    "GB": "UNITED KINGDOM, GREAT BRITAIN", "FR": "FRANCE",
+    "DE": "GERMANY, DEUTSCHLAND", "IN": "INDIA", "AU": "AUSTRALIA",
+    "JP": "JAPAN", "BR": "BRAZIL, BRASIL", "MX": "MEXICO", "IT": "ITALY",
+    "ES": "SPAIN, ESPANA", "NL": "NETHERLANDS, HOLLAND", "SE": "SWEDEN",
+    "CH": "SWITZERLAND", "CN": "CHINA", "KR": "SOUTH KOREA, KOREA",
+    "RU": "RUSSIA, RUSSIAN FEDERATION", "ZA": "SOUTH AFRICA",
+    "AR": "ARGENTINA", "SG": "SINGAPORE", "NZ": "NEW ZEALAND",
+    "AT": "AUSTRIA", "BE": "BELGIUM", "PT": "PORTUGAL", "DK": "DENMARK",
+    "NO": "NORWAY", "FI": "FINLAND", "PL": "POLAND",
+    "CZ": "CZECH REPUBLIC, CZECHIA", "SK": "SLOVAKIA", "HU": "HUNGARY",
+    "RO": "ROMANIA", "BG": "BULGARIA", "GR": "GREECE", "IE": "IRELAND",
+    "IL": "ISRAEL", "AE": "UNITED ARAB EMIRATES, UAE",
+    "SA": "SAUDI ARABIA", "TH": "THAILAND", "MY": "MALAYSIA",
+    "PH": "PHILIPPINES", "VN": "VIETNAM", "ID": "INDONESIA",
+    "PK": "PAKISTAN", "EG": "EGYPT", "NG": "NIGERIA", "KE": "KENYA",
+    "CL": "CHILE", "CO": "COLOMBIA", "PE": "PERU", "UA": "UKRAINE",
+    "HK": "HONG KONG", "TW": "TAIWAN",
+}
+
+_DEFAULT_NAME_BIGRAMS = _name_bigrams(_DEFAULT_COUNTRY_NAMES)
+
+
+class ParsePhoneNumber(BinaryTransformer):
+    """(Phone, Text region) → Phone normalized (reference
+    ParsePhoneNumber.scala:143): the second input names the region per row
+    — a region code or a free-text country name resolved by Jaccard bigram
+    similarity. International (+-prefixed) numbers ignore the region."""
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 codes_and_countries: Optional[Dict[str, str]] = None,
+                 uid=None):
+        name_bi = (_name_bigrams(codes_and_countries)
+                   if codes_and_countries else None)
+
+        def fn(v, region_text):
+            rc = _resolve_region(region_text, default_region, name_bi)
+            r = parse_phone(v, rc, strict=strict)
+            return r[0] if r is not None and r[1] else None
+        super().__init__("parsePhoneCC", transform_fn=fn, output_type=Phone,
+                         input_types=(Phone, Text), uid=uid)
+        self.default_region = default_region
+        self.strict = strict
+
+
+class IsValidPhoneNumber(BinaryTransformer):
+    """(Phone, Text region) → Binary validity (reference
+    IsValidPhoneNumber.scala:198)."""
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 codes_and_countries: Optional[Dict[str, str]] = None,
+                 uid=None):
+        name_bi = (_name_bigrams(codes_and_countries)
+                   if codes_and_countries else None)
+
+        def fn(v, region_text):
+            if v is None:
+                return None
+            rc = _resolve_region(region_text, default_region, name_bi)
+            r = parse_phone(v, rc, strict=strict)
+            return bool(r is not None and r[1])
+        super().__init__("isValidPhoneCC", transform_fn=fn,
+                         output_type=Binary, input_types=(Phone, Text),
+                         uid=uid)
+        self.default_region = default_region
+        self.strict = strict
 
 
 _EMAIL_RE = re.compile(

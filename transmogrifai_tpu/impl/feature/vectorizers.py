@@ -469,6 +469,62 @@ _ES_SUFFIXES = [
 ]
 
 
+_IT_SUFFIXES = [
+    ("azioni", "azione"), ("amenti", ""), ("imenti", ""),
+    ("amento", ""), ("imento", ""), ("azione", "azione"),
+    ("atrici", "atore"), ("atrice", "atore"), ("atori", "atore"),
+    ("atore", "atore"), ("abili", "abile"), ("ibili", "ibile"),
+    ("abile", "abile"), ("ibile", "ibile"), ("mente", ""),
+    ("ista", "ista"), ("isti", "ista"), ("iste", "ista"),
+    ("anza", "anza"), ("anze", "anza"), ("ità", "ità"),
+    ("osi", "oso"), ("ose", "oso"), ("osa", "oso"), ("oso", "oso"),
+    ("are", ""), ("ere", ""), ("ire", ""), ("ato", ""), ("ata", ""),
+    ("ati", ""), ("ate", ""), ("i", ""), ("e", ""), ("a", ""), ("o", ""),
+]
+_PT_SUFFIXES = [
+    ("amentos", ""), ("imentos", ""), ("adoras", "ador"),
+    ("adores", "ador"), ("amento", ""), ("imento", ""),
+    ("ações", "ação"), ("idades", "idade"), ("amente", ""),
+    ("mente", ""), ("adora", "ador"), ("ação", "ação"),
+    ("antes", "ante"), ("ância", "ância"), ("idade", "idade"),
+    ("ismos", "ismo"), ("istas", "ista"), ("ismo", "ismo"),
+    ("ista", "ista"), ("osos", "oso"), ("osas", "oso"), ("osa", "oso"),
+    ("oso", "oso"), ("ivas", "ivo"), ("ivos", "ivo"), ("iva", "ivo"),
+    ("ivo", "ivo"), ("ões", "ão"), ("ar", ""), ("er", ""), ("ir", ""),
+    ("es", ""), ("as", "a"), ("os", "o"), ("s", ""),
+]
+_NL_SUFFIXES = [
+    ("heden", "heid"), ("elijke", "elijk"), ("elijk", "elijk"),
+    ("ingen", "ing"), ("aren", "aar"), ("eren", ""), ("ende", ""),
+    ("tjes", ""), ("ing", "ing"), ("aar", "aar"), ("end", ""),
+    ("ster", ""), ("je", ""), ("en", ""), ("er", ""), ("es", ""),
+    ("s", ""), ("e", ""),
+]
+#: Russian: strip reflexive particle first, then the longest
+#: verb/adjective/noun ending (RSLP-style ordering, Cyrillic)
+_RU_REFLEXIVE = ("ся", "сь")
+_RU_SUFFIXES = [
+    ("ировать", ""), ("ованный", ""), ("ейший", ""),
+    ("ениями", "ение"), ("ениях", "ение"),
+    ("ениям", "ение"), ("ением", "ение"), ("ости", "ость"),
+    ("остью", "ость"), ("ение", "ение"), ("ения", "ение"),
+    ("ении", "ение"), ("ством", "ство"), ("ство", "ство"),
+    ("ывать", ""), ("ивать", ""), ("овать", ""), ("аться", ""),
+    ("иться", ""), ("ешься", ""), ("ется", ""), ("ители", "итель"),
+    ("итель", "итель"), ("ами", ""), ("ями", ""), ("ого", ""),
+    ("его", ""), ("ому", ""), ("ему", ""), ("ыми", ""), ("ими", ""),
+    ("ая", ""), ("яя", ""), ("ой", ""), ("ый", ""), ("ий", ""),
+    ("ем", ""), ("им", ""), ("ом", ""), ("ах", ""), ("ях", ""),
+    ("ует", ""), ("ешь", ""), ("ете", ""), ("ает", ""), ("яет", ""),
+    ("ить", ""), ("ать", ""),
+    ("ять", ""), ("еть", ""), ("ал", ""), ("ил", ""), ("ыл", ""),
+    ("ла", ""), ("ло", ""), ("ли", ""), ("ов", ""), ("ев", ""),
+    ("ей", ""), ("ам", ""), ("ям", ""), ("ы", ""), ("и", ""),
+    ("а", ""), ("я", ""), ("о", ""), ("е", ""), ("у", ""), ("ю", ""),
+    ("ь", ""),
+]
+
+
 def french_stem(w: str) -> str:
     return _strip_suffixes(w, _FR_SUFFIXES) if len(w) > 4 else w
 
@@ -481,9 +537,32 @@ def spanish_stem(w: str) -> str:
     return _strip_suffixes(w, _ES_SUFFIXES) if len(w) > 4 else w
 
 
+def italian_stem(w: str) -> str:
+    return _strip_suffixes(w, _IT_SUFFIXES) if len(w) > 4 else w
+
+
+def portuguese_stem(w: str) -> str:
+    return _strip_suffixes(w, _PT_SUFFIXES) if len(w) > 4 else w
+
+
+def dutch_stem(w: str) -> str:
+    return _strip_suffixes(w, _NL_SUFFIXES, min_stem=4) if len(w) > 4 else w
+
+
+def russian_stem(w: str) -> str:
+    if len(w) <= 4:
+        return w
+    for r in _RU_REFLEXIVE:
+        if w.endswith(r) and len(w) - len(r) >= 3:
+            w = w[: len(w) - len(r)]
+            break
+    return _strip_suffixes(w, _RU_SUFFIXES)
+
+
 #: language → stemmer for TextTokenizer(stemming=True, language=...)
 STEMMERS = {"en": porter_stem, "fr": french_stem, "de": german_stem,
-            "es": spanish_stem}
+            "es": spanish_stem, "it": italian_stem, "pt": portuguese_stem,
+            "nl": dutch_stem, "ru": russian_stem}
 
 
 class TextTokenizer(UnaryTransformer):

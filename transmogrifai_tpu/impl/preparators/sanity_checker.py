@@ -23,7 +23,8 @@ from ...ops.stats import (
     col_stats, contingency_stats, contingency_table, pearson_correlation,
     pearson_correlation_matrix, spearman_correlation,
 )
-from ...stages.base import AllowLabelAsInput, Estimator, Transformer
+from ...stages.base import (AllowLabelAsInput, Estimator, PendingFit,
+                            Transformer)
 from ...table import Column, FeatureTable
 from ...types import OPVector, RealNN
 from ...vector_metadata import VectorColumnMetadata, VectorMetadata
@@ -160,6 +161,15 @@ class SanityChecker(AllowLabelAsInput, Estimator):
 
     # -- fit ------------------------------------------------------------------
     def fit(self, table: FeatureTable) -> Transformer:
+        return self.fit_queued(table).finish_now()
+
+    def fit_queued(self, table: FeatureTable) -> PendingFit:
+        """Queued-fit protocol (stages/base.py): dispatch every device stat
+        program (col stats, label correlation, optional full matrix,
+        contingency counts) and defer the single host transfer + column
+        decisions to finish — workflow-level CV queues all F folds' checker
+        fits before one sync (reference OpValidator.applyDAG :228-256 runs
+        fold DAG copies on concurrent Futures)."""
         label_f, vec_f = self.input_features
         y = np.asarray(table[label_f.name].values, dtype=np.float32).reshape(-1)
         col = table[vec_f.name]
@@ -195,7 +205,8 @@ class SanityChecker(AllowLabelAsInput, Estimator):
             corr = spearman_correlation(Xd, yd, row_mask)
         else:
             corr = pearson_correlation(Xd, yd, row_mask)
-        feature_corr: Optional[np.ndarray] = None
+        dev: Dict[str, Any] = dict(stats._asdict())
+        dev["corr"] = corr
         if getattr(self, "correlations", "label") == "full":
             # (d, d) feature-feature matrix on device (one MXU matmul);
             # Spearman mode ranks the columns first, matching the label path
@@ -204,21 +215,17 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                 import jax as _jax
                 from ...ops.stats import _rank
                 Xc = _jax.vmap(_rank, in_axes=1, out_axes=1)(Xd)
-            feature_corr = np.asarray(pearson_correlation_matrix(Xc,
-                                                                 row_mask))
-        stats = {k: np.asarray(v) for k, v in stats._asdict().items()}
-        corr = np.asarray(corr)
+            dev["feature_corr"] = pearson_correlation_matrix(Xc, row_mask)
 
-        # categorical association stats per feature group (reference :420-516)
-        cramers_by_col = np.full(d, np.nan)
-        rule_conf_by_col = np.full(d, np.nan)
-        support_by_col = np.full(d, np.nan)
-        group_cramers: Dict[str, float] = {}
-        group_mi: Dict[str, float] = {}
-        group_pmi: Dict[str, List[List[float]]] = {}
+        # categorical association stats per feature group (reference
+        # :420-516): dispatch the one contingency matmul for every
+        # indicator column now; the per-group association stats run on the
+        # tiny (m, L) numpy tables at finish time
+        groups: List[Any] = []
         if vm is not None:
             labels = np.unique(ys)
-            is_binary_like = len(labels) <= 20 and np.allclose(labels, labels.astype(int))
+            is_binary_like = (len(labels) <= 20
+                              and np.allclose(labels, labels.astype(int)))
             if is_binary_like:
                 # yd is the (possibly mesh-padded) device label vector; pad
                 # rows are excluded via row_mask in the contingency matmul
@@ -229,109 +236,121 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                           if all(vm.columns[i].indicator_value is not None
                                  for i in idxs)]
                 if groups:
-                    # ONE matmul for every indicator column's contingency
-                    # counts + ONE host sync; per-group association stats
-                    # then run on tiny (m, L) numpy tables — per-group
-                    # device calls would pay a link round-trip (and a
-                    # recompile per distinct group size) each
                     all_idx = np.concatenate(
                         [np.asarray(idxs) for _, idxs in groups])
-                    counts = np.asarray(contingency_table(
+                    dev["counts"] = contingency_table(
                         Xd[:, jnp.asarray(all_idx)], label_idx, num_labels,
-                        row_mask))
-                    off = 0
-                    for group, idxs in groups:
-                        m = len(idxs)
-                        cs = _contingency_stats_np(counts[off:off + m])
-                        off += m
-                        group_cramers[group] = cs["cramers_v"]
-                        group_mi[group] = cs["mutual_info"]
-                        group_pmi[group] = [
-                            [round(float(x), 6) for x in r]
-                            for r in cs["pointwise_mutual_info"]]
-                        for j, i_col in enumerate(idxs):
-                            cramers_by_col[i_col] = cs["cramers_v"]
-                            rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
-                            support_by_col[i_col] = cs["support"][j]
+                        row_mask)
+        n_sample = int(len(ys))
+        sharding_note = getattr(self, "_stats_input_sharding", None)
 
-        # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
-        reasons: Dict[int, List[str]] = {}
+        def finish(host: Dict[str, np.ndarray]) -> Transformer:
+            stats = {k: host[k]
+                     for k in ("count", "mean", "variance", "min", "max")}
+            corr = host["corr"]
+            feature_corr = host.get("feature_corr")
+            cramers_by_col = np.full(d, np.nan)
+            rule_conf_by_col = np.full(d, np.nan)
+            support_by_col = np.full(d, np.nan)
+            group_cramers: Dict[str, float] = {}
+            group_mi: Dict[str, float] = {}
+            group_pmi: Dict[str, List[List[float]]] = {}
+            if groups:
+                counts = host["counts"]
+                off = 0
+                for group, idxs in groups:
+                    m = len(idxs)
+                    cs = _contingency_stats_np(counts[off:off + m])
+                    off += m
+                    group_cramers[group] = cs["cramers_v"]
+                    group_mi[group] = cs["mutual_info"]
+                    group_pmi[group] = [
+                        [round(float(x), 6) for x in r]
+                        for r in cs["pointwise_mutual_info"]]
+                    for j, i_col in enumerate(idxs):
+                        cramers_by_col[i_col] = cs["cramers_v"]
+                        rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
+                        support_by_col[i_col] = cs["support"][j]
 
-        def flag(i: int, why: str):
-            reasons.setdefault(i, []).append(why)
+            # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
+            reasons: Dict[int, List[str]] = {}
 
-        for i in range(d):
-            if stats["variance"][i] < self.min_variance:
-                flag(i, f"variance {stats['variance'][i]:.3g} below min {self.min_variance}")
-            c = corr[i]
-            if not np.isnan(c):
-                if abs(c) > self.max_correlation:
-                    flag(i, f"label correlation {c:.3f} above max {self.max_correlation} (leakage)")
-                elif abs(c) < self.min_correlation:
-                    flag(i, f"label correlation {c:.3f} below min {self.min_correlation}")
-            if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
-                flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
-            if (not np.isnan(rule_conf_by_col[i])
-                    and rule_conf_by_col[i] >= self.max_rule_confidence
-                    and support_by_col[i] >= 0
-                    and support_by_col[i] * len(ys) >= self.min_required_rule_support):
-                flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
-                        f"at/above max {self.max_rule_confidence} (leakage)")
+            def flag(i: int, why: str):
+                reasons.setdefault(i, []).append(why)
 
-        # feature-group propagation (reference: if one indicator of a pivot
-        # group leaks, the whole group goes). protect_text_shared_hash
-        # exempts shared-hash text columns — a hash slot aggregates many
-        # tokens, so a sibling's leak says nothing about it (reference
-        # reasonsToRemove :821 + isTextSharedHash :840)
-        if self.remove_feature_group and vm is not None and reasons:
-            groups = vm.index_of_group()
-            leak = {i for i, why in reasons.items()
-                    if any("leakage" in w or "Cramér" in w for w in why)}
-            for group, idxs in groups.items():
-                if leak.intersection(idxs):
-                    for i in idxs:
-                        if i in reasons:
-                            continue
-                        if (self.protect_text_shared_hash
-                                and _is_text_shared_hash(vm.columns[i])):
-                            continue
-                        flag(i, f"sibling column in group '{group}' flagged for leakage")
+            for i in range(d):
+                if stats["variance"][i] < self.min_variance:
+                    flag(i, f"variance {stats['variance'][i]:.3g} below min {self.min_variance}")
+                c = corr[i]
+                if not np.isnan(c):
+                    if abs(c) > self.max_correlation:
+                        flag(i, f"label correlation {c:.3f} above max {self.max_correlation} (leakage)")
+                    elif abs(c) < self.min_correlation:
+                        flag(i, f"label correlation {c:.3f} below min {self.min_correlation}")
+                if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
+                    flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
+                if (not np.isnan(rule_conf_by_col[i])
+                        and rule_conf_by_col[i] >= self.max_rule_confidence
+                        and support_by_col[i] >= 0
+                        and support_by_col[i] * n_sample >= self.min_required_rule_support):
+                    flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
+                            f"at/above max {self.max_rule_confidence} (leakage)")
 
-        to_remove = sorted(reasons) if self.remove_bad_features else []
-        keep = [i for i in range(d) if i not in set(to_remove)]
-        if not keep:
-            raise ValueError(
-                "SanityChecker would remove ALL feature columns — loosen thresholds")
+            # feature-group propagation (reference: if one indicator of a pivot
+            # group leaks, the whole group goes). protect_text_shared_hash
+            # exempts shared-hash text columns — a hash slot aggregates many
+            # tokens, so a sibling's leak says nothing about it (reference
+            # reasonsToRemove :821 + isTextSharedHash :840)
+            if self.remove_feature_group and vm is not None and reasons:
+                all_groups = vm.index_of_group()
+                leak = {i for i, why in reasons.items()
+                        if any("leakage" in w or "Cramér" in w for w in why)}
+                for group, idxs in all_groups.items():
+                    if leak.intersection(idxs):
+                        for i in idxs:
+                            if i in reasons:
+                                continue
+                            if (self.protect_text_shared_hash
+                                    and _is_text_shared_hash(vm.columns[i])):
+                                continue
+                            flag(i, f"sibling column in group '{group}' flagged for leakage")
 
-        names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
-        summary = SanityCheckerSummary(
-            stats=ColumnStatistics(
-                names=names,
-                count=stats["count"].tolist(),
-                mean=stats["mean"].tolist(),
-                variance=stats["variance"].tolist(),
-                min=stats["min"].tolist(),
-                max=stats["max"].tolist()),
-            categorical=CategoricalGroupStats(
-                cramers_v={g: v for g, v in group_cramers.items()},
-                mutual_info=group_mi,
-                pointwise_mutual_info=group_pmi),
-            correlations_with_label=[None if np.isnan(c) else float(c)
-                                     for c in corr],
-            correlation_type=("spearman" if self.correlation_type_spearman
-                              else "pearson"),
-            dropped=[names[i] for i in to_remove],
-            reasons={names[i]: why for i, why in reasons.items()},
-            sample_size=int(len(ys)),
-            feature_correlations=feature_corr,
-        )
-        model = SanityCheckerModel(keep_indices=keep, summary=summary)
-        model.summary_metadata = summary.to_json()
-        # diagnostic: how the stats pass was placed (asserted by the
-        # multichip dryrun — 'data'-sharded under with_mesh)
-        model._stats_input_sharding = getattr(
-            self, "_stats_input_sharding", None)
-        return self._finalize_model(model)
+            to_remove = sorted(reasons) if self.remove_bad_features else []
+            keep = [i for i in range(d) if i not in set(to_remove)]
+            if not keep:
+                raise ValueError(
+                    "SanityChecker would remove ALL feature columns — loosen thresholds")
+
+            names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
+            summary = SanityCheckerSummary(
+                stats=ColumnStatistics(
+                    names=names,
+                    count=stats["count"].tolist(),
+                    mean=stats["mean"].tolist(),
+                    variance=stats["variance"].tolist(),
+                    min=stats["min"].tolist(),
+                    max=stats["max"].tolist()),
+                categorical=CategoricalGroupStats(
+                    cramers_v={g: v for g, v in group_cramers.items()},
+                    mutual_info=group_mi,
+                    pointwise_mutual_info=group_pmi),
+                correlations_with_label=[None if np.isnan(c) else float(c)
+                                         for c in corr],
+                correlation_type=("spearman" if self.correlation_type_spearman
+                                  else "pearson"),
+                dropped=[names[i] for i in to_remove],
+                reasons={names[i]: why for i, why in reasons.items()},
+                sample_size=n_sample,
+                feature_correlations=feature_corr,
+            )
+            model = SanityCheckerModel(keep_indices=keep, summary=summary)
+            model.summary_metadata = summary.to_json()
+            # diagnostic: how the stats pass was placed (asserted by the
+            # multichip dryrun — 'data'-sharded under with_mesh)
+            model._stats_input_sharding = sharding_note
+            return self._finalize_model(model)
+
+        return PendingFit(dev, finish)
 
 
 class SanityCheckerModel(AllowLabelAsInput, Transformer):

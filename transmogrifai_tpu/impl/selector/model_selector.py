@@ -182,26 +182,38 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         F = val_masks.shape[0]
         # pass 1: fit every fold's in-CV DAG copy and collect its feature
         # matrix (fold-specific SanityCheckers may keep different columns).
-        # Matrices park on HOST between passes — holding F device copies
-        # would multiply peak HBM by the fold count at 1M×543 scale
+        # Stage-by-stage across folds: each estimator's F fold fits are
+        # QUEUED via the fit_queued protocol and resolved with one fused
+        # host transfer (stages/base.materialize_pending) — the fold-serial
+        # host loop's F sync round-trips were the residual wall over plain
+        # CV (reference fits fold DAG copies on concurrent Futures,
+        # OpValidator.applyDAG :228-256). Matrices park on HOST between
+        # passes — holding F device copies would multiply peak HBM by the
+        # fold count at 1M×543 scale
+        from ...stages.base import materialize_pending
+        fold_train_rows = [np.nonzero(~val_masks[f])[0] for f in range(F)]
+        fold_tbls: List[Any] = [sub] * F
+        for layer in during_layers:
+            for stage, _ in layer:
+                if isinstance(stage, Estimator):
+                    # fit on each fold's train rows only; one transform of
+                    # the full table serves both train and val rows
+                    pend = [stage.fit_queued(
+                        fold_tbls[f].take(fold_train_rows[f]))
+                        for f in range(F)]
+                    stage_models = materialize_pending(pend)
+                else:
+                    stage_models = [stage] * F
+                for f in range(F):
+                    fold_tbls[f] = stage_models[f].transform(fold_tbls[f])
         fold_X: List[Optional[np.ndarray]] = []
         for f in range(F):
-            train_rows = np.nonzero(~val_masks[f])[0]
-            full_tbl = sub
-            for layer in during_layers:
-                for stage, _ in layer:
-                    if isinstance(stage, Estimator):
-                        # fit on the fold's train rows only; one transform of
-                        # the full table serves both train and val rows
-                        model = stage.fit(full_tbl.take(train_rows))
-                    else:
-                        model = stage
-                    full_tbl = model.transform(full_tbl)
-            if vec_f.name not in full_tbl.column_names:
+            if vec_f.name not in fold_tbls[f].column_names:
                 raise ValueError(
                     f"in-CV DAG did not produce feature '{vec_f.name}'")
-            fold_X.append(np.asarray(full_tbl[vec_f.name].values,
+            fold_X.append(np.asarray(fold_tbls[f][vec_f.name].values,
                                      dtype=np.float32))
+        del fold_tbls
         # pass 2: pad every fold's matrix to the widest fold with zero
         # columns (inert: dead-column standardization pins their linear
         # coefficients to 0, constant columns never win a tree split), so

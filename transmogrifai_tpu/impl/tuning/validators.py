@@ -187,7 +187,7 @@ class OpValidator:
     parallel axes are mesh axes and XLA inserts the psum collectives."""
 
     def __init__(self, seed: int = 42, stratify: bool = False, mesh=None,
-                 max_eval_rows: "Optional[int]" = 65536,
+                 max_eval_rows: "Optional[int]" = 32768,
                  exact_sweep_fits: bool = False):
         self.seed = seed
         self.stratify = stratify
@@ -196,8 +196,8 @@ class OpValidator:
         #: most this many of its fold's rows (deterministic strided
         #: subsample). Metric ESTIMATES only — refit, holdout and train
         #: evaluations always use full data. None = score every validation
-        #: row (exact reference parity); the default trades ~1e-4 of AuROC
-        #: estimator noise for an ~8x cut in sweep predict time at 1M+ rows.
+        #: row (exact reference parity); the default trades ~3e-3 of AuROC
+        #: estimator noise for a ~10x cut in sweep predict time at 1M+ rows.
         #: Measured fidelity of the default vs the exact setting:
         #: docs/benchmarks.md "Sweep fidelity".
         self.max_eval_rows = max_eval_rows

@@ -67,13 +67,22 @@ N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
 #: (exact refit pass), sweep-time leaf values use the sample.
 _HIST_SAMPLE = 65536
 
-#: sweep-time sample cap: CV candidates grow from a quarter of the refit
+#: sweep-time sample cap: CV candidates grow from a fraction of the refit
 #: sample — split thresholds are order statistics and the CV ranking is
 #: robust to the extra estimator noise (measured: docs/benchmarks.md "Sweep
 #: fidelity", re-run for this value); the refit winner regrows at
-#: _HIST_SAMPLE. Round 3 used 32768; halving it halves every growth
-#: histogram's rows for the depth-12 default grids
-_SWEEP_HIST_SAMPLE = 16384
+#: _HIST_SAMPLE. Round 3 used 32768, round 4 16384; each halving halves
+#: every growth histogram's rows for the depth-12 default grids
+_SWEEP_HIST_SAMPLE = 8192
+
+#: sweep-time ensemble caps: CV candidates RANK with this many RF trees /
+#: GBT boosting rounds — the metric is an ensemble-size-consistent estimate
+#: (every config gets the same cap), the winner refits at its full
+#: numTrees/maxIter through fit_batch(sweep=False). Same contract as the
+#: split-search sample above; fidelity-gated by docs/experiments/
+#: fidelity_1m64.py ("Sweep fidelity" in docs/benchmarks.md)
+_SWEEP_RF_TREES = 16
+_SWEEP_GBT_ROUNDS = 12
 
 #: config-chunk sizing: batch configurations together until the deepest
 #: level's (sample rows x configs x trees x nodes) transient reaches this
@@ -1497,6 +1506,11 @@ class RandomForestFamilyBase(_TreeFamilyBase):
         B = weights.shape[0]
         seeds = jnp.arange(B, dtype=jnp.float32) + 7.0
         grid = dict(grid, _seeds=seeds)
+        if sweep and n_trees > _SWEEP_RF_TREES:
+            # rank with a capped forest; the winner refits at full numTrees
+            n_trees = _SWEEP_RF_TREES
+            grid = dict(grid, numTrees=jnp.minimum(
+                jnp.asarray(_g(grid, "numTrees", 20.0)), float(n_trees)))
         n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
         def fit_group(g, w, depth, slots=0):
@@ -1568,6 +1582,12 @@ class GBTFamilyBase(_TreeFamilyBase):
         # are the same program
         task = self._gbt_task(num_classes)
         n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
+        if sweep and n_rounds > _SWEEP_GBT_ROUNDS:
+            # rank with truncated boosting; the winner refits at full
+            # maxIter (boosting rounds are the sweep's serial-step floor)
+            n_rounds = _SWEEP_GBT_ROUNDS
+            grid = dict(grid, maxIter=jnp.minimum(
+                jnp.asarray(_g(grid, "maxIter", 20.0)), float(n_rounds)))
         n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
         def one_raw(g, w, depth, slots=0):
@@ -1592,15 +1612,28 @@ class GBTFamilyBase(_TreeFamilyBase):
                        else 2 ** max(depth - 1, 0))
             per_cfg = C_g * nodes_w * X.shape[1] * N_BINS * 3
             cb = int(max(1, min(B_g, _LEVEL_HIST_ELEMS // max(per_cfg, 1))))
+            # ...AND bound the (S, k·Wl·T_pad) masked-stat operand of the
+            # level histogram itself: at the refit sample (65536 rows) a
+            # 200+-config exact grid otherwise asks XLA for a >10 GB
+            # concatenate per level, and the scheduler keeps ~3 pipeline
+            # stages of it alive (observed 24.5 GB on the fidelity
+            # experiment's exact arm)
+            S_est = min(X.shape[0],
+                        _SWEEP_HIST_SAMPLE if sweep else _HIST_SAMPLE)
+            lanes_max = max((1 << 29) // max(S_est, 1), 192)
+            cb = int(max(1, min(cb, lanes_max // (3 * nodes_w * C_g))))
             if cb >= B_g:
                 return one_raw(g, w, depth, slots)
             n_ch = -(-B_g // cb)
             parts = []
             for c in range(n_ch):
                 # wrap the tail chunk so every chunk shares one compile
+                # plain-numpy index: grid values may be host constants
+                # (the fused sweep program passes them that way), and
+                # numpy cannot be indexed by a traced jnp constant
                 idx = np.arange(c * cb, (c + 1) * cb) % B_g
-                sub = {k2: v[jnp.asarray(idx)] for k2, v in g.items()}
-                p = one_raw(sub, w[jnp.asarray(idx)], depth, slots)
+                sub = {k2: v[idx] for k2, v in g.items()}
+                p = one_raw(sub, w[idx], depth, slots)
                 count = min((c + 1) * cb, B_g) - c * cb
                 parts.append((idx[:count],
                               {k2: (v if k2 == "edges" else v[:count])

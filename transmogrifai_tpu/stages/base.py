@@ -154,6 +154,52 @@ class Transformer(OpPipelineStage):
             v.item() if isinstance(v, np.generic) else v)
 
 
+class PendingFit:
+    """A dispatched-but-unsynced estimator fit: the device stat programs are
+    queued, the host decision logic waits in ``finish``. Lets a caller
+    (workflow-level CV pass 1, model_selector.py) queue F folds' fits
+    back-to-back and pay ONE host transfer instead of F serial round-trips
+    (the reference's analog: concurrent fold Futures,
+    OpValidator.applyDAG :228-256)."""
+
+    def __init__(self, dev: Dict[str, Any], finish: Callable[[Dict[str, Any]],
+                                                             "Transformer"]):
+        self.dev = dev          # name -> device array, still materializing
+        self._finish = finish   # host dict (same keys, np arrays) -> model
+
+    def finish_now(self) -> "Transformer":
+        return self._finish({k: np.asarray(v) for k, v in self.dev.items()})
+
+
+def materialize_pending(pendings: "List[PendingFit]") -> "List[Transformer]":
+    """Resolve many queued fits with ONE host transfer per dtype: all
+    pending device leaves concatenate into flat vectors (grouped by dtype —
+    casting counts through f32 would round above 2^24), transfer once, and
+    split back. On tunneled backends a transfer costs ~70-130 ms of pure
+    link latency, so F·|leaves| separate np.asarray calls dominate the
+    actual stat kernels."""
+    import jax.numpy as jnp
+    leaves = []               # (pending_idx, key, shape, dtype)
+    by_dtype: Dict[Any, list] = {}
+    for pi, p in enumerate(pendings):
+        for k, v in p.dev.items():
+            v = jnp.asarray(v)
+            leaves.append((pi, k, v.shape, v.dtype))
+            by_dtype.setdefault(str(v.dtype), []).append(v.reshape(-1))
+    flat_host = {dt: np.asarray(jnp.concatenate(vs)) if len(vs) > 1
+                 else np.asarray(vs[0])
+                 for dt, vs in by_dtype.items()}
+    offs = {dt: 0 for dt in flat_host}
+    host_dicts: List[Dict[str, Any]] = [{} for _ in pendings]
+    for pi, k, shape, dtype in leaves:
+        dt = str(dtype)
+        size = int(np.prod(shape)) if shape else 1
+        host_dicts[pi][k] = flat_host[dt][offs[dt]:offs[dt] + size
+                                          ].reshape(shape)
+        offs[dt] += size
+    return [p._finish(h) for p, h in zip(pendings, host_dicts)]
+
+
 class Estimator(OpPipelineStage):
     """A stage that must be fit on data, producing a Transformer model
     (reference Unary/Binary/…Estimator fitFn pattern)."""
@@ -163,6 +209,14 @@ class Estimator(OpPipelineStage):
         """Fit on the table and return the fitted model transformer. The model
         MUST reuse this stage's uid and output feature so DAG wiring holds
         (reference: model uid == estimator uid)."""
+
+    def fit_queued(self, table: FeatureTable) -> PendingFit:
+        """Queued-fit protocol: dispatch the device stat programs and defer
+        the host sync + decision logic to ``PendingFit.finish``. The default
+        wraps plain ``fit`` (sync happens inside it); estimators whose fit
+        is transfer-latency-bound override this (SanityChecker)."""
+        model = self.fit(table)
+        return PendingFit({}, lambda _h: model)
 
     def _finalize_model(self, model: Transformer) -> Transformer:
         model.uid = self.uid
