@@ -110,6 +110,75 @@ def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
     }), flush=True)
 
 
+def _run_mesh_line():
+    """Virtual-8-device CPU mesh sweep fits/sec — a NUMBER for mesh-path
+    regressions (round-4 VERDICT weak #5: the dryrun's wall-ratio assert
+    alone left ~20% headroom before anything fired). Runs in a subprocess
+    because this process is bound to the TPU platform; shared-core virtual
+    devices measure the sharding machinery's overhead, not speedup."""
+    import subprocess
+    import sys
+    code = r"""
+import os, sys, time, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+from jax._src import xla_bridge as _xb
+for _n in list(_xb._backend_factories):
+    if _n != "cpu":
+        _xb._backend_factories.pop(_n, None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+sys.path.insert(0, %r)
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+from transmogrifai_tpu.parallel import MeshSpec, make_mesh
+import transmogrifai_tpu.models.linear  # noqa: F401
+rng = np.random.RandomState(0)
+n, d = 32768, 32
+X = rng.randn(n, d).astype(np.float32)
+y = (X @ rng.randn(d).astype(np.float32) > 0).astype(np.float32)
+Xd, yd = jnp.asarray(X), jnp.asarray(y)
+mesh = make_mesh(MeshSpec(data=4, model=2))
+grid = [{"regParam": r, "elasticNetParam": e}
+        for r in (0.01, 0.03, 0.1, 0.2) for e in (0.0, 0.5)]
+models = [(MODEL_REGISTRY["OpLogisticRegression"], grid)]
+cv = OpCrossValidation(num_folds=3, seed=0, mesh=mesh, max_eval_rows=4096)
+cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    best = cv.validate(models, Xd, yd, "binary", "AuROC", True, 2)
+    for r in best.results:
+        np.asarray(r.fold_metrics)
+    ts.append(time.perf_counter() - t0)
+fits = 3 * len(grid)
+print(json.dumps({"fits_per_sec": round(fits / min(ts), 2)}))
+""" % os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run([sys.executable, "-c", code], timeout=600,
+                             capture_output=True, text=True)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        fps = json.loads(line)["fits_per_sec"]
+    except Exception as e:  # mesh line must never sink the TPU lines
+        print(json.dumps({"metric": "mesh_sweep_error",
+                          "value": 0, "unit": "fits/sec",
+                          "vs_baseline": 0.0,
+                          "error": f"{type(e).__name__}"}), flush=True)
+        return
+    print(json.dumps({
+        "metric": "model_fold_fits_per_sec_lr_mesh8cpu_32768rows_32feat",
+        "value": fps,
+        "unit": "fits/sec",
+        # vs the recorded round-5 single-device-CPU wall of the same
+        # sweep shape (~84 fits/sec, docs/benchmarks.md "Mesh honesty"),
+        # NOT the TPU north-star
+        "vs_baseline": round(fps / 84.0, 3),
+    }), flush=True)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -130,10 +199,13 @@ def main():
     y = (X @ w_true + rng.randn(n) > 0).astype(np.float32)
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
 
-    # "both": default (out-of-the-box grids) first, dense LAST so the final
-    # line remains the headline throughput number
+    # "both": default (out-of-the-box grids) first, then the virtual-mesh
+    # regression line, dense LAST so the final line remains the headline
+    # throughput number
     modes = ("default", "dense") if mode == "both" else (mode,)
-    for m in modes:
+    for i, m in enumerate(modes):
+        if mode == "both" and i == len(modes) - 1:
+            _run_mesh_line()
         _run_mode(m, Xd, yd, n, d, platform, folds, reps)
 
 

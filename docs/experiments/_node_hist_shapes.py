@@ -1,0 +1,88 @@
+"""node_hist_matmul: pallas kernel vs XLA contraction at the REFIT-scale
+shapes (S=65536) the round-4 measurement did not cover (it measured sweep
+shapes only, where XLA won). Decides _NODE_HIST_PALLAS_MIN_B (VERDICT r4
+next #6)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from transmogrifai_tpu.ops import tree_hist as TH  # noqa: E402
+from docs.experiments.node_hist_pallas import (  # noqa: E402
+    _node_hist_pallas, pad_node_inputs)
+
+
+def bench(fn, reps=5, chain=20):
+    fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    # subtract the ~0.1s dispatch+transfer floor, divide by the chain
+    return max(float(np.median(ts)) - 0.1, 1e-6) / chain * 1e3
+
+
+def main():
+    rng = np.random.RandomState(0)
+    d, nb = 64, 32
+    # (label, S, T, Wl, k): production shapes
+    shapes = [
+        ("RF refit deep (1 cfg x 50 trees, W=256)", 65536, 50, 256, 2),
+        ("RF refit deep level6 (W=64)", 65536, 50, 64, 2),
+        ("GBT refit deep (1 cfg, W=256)", 65536, 1, 256, 3),
+        ("exact sweep GBT (42 cfg, W=64)", 65536, 42, 64, 3),
+        ("sweep RF chunk (500 trees, W=64, S=8k)", 8192, 500, 64, 2),
+    ]
+    for label, S, T, Wl, k in shapes:
+        codes = jnp.asarray(rng.randint(0, nb, size=(S, d), dtype=np.int32))
+        node = jnp.asarray(rng.randint(0, Wl, size=(S, T), dtype=np.int32))
+        sws = [jnp.asarray(rng.rand(S, T).astype(np.float32))
+               for _ in range(k)]
+
+        node_p, sws_p, Wl_eff, T_pad = pad_node_inputs(node, sws, Wl)
+        # chain CHAIN calls inside one jit (fold the result back into the
+        # stat operand) so device time is unambiguous even where
+        # block_until_ready is cheap-but-lying on queued work
+        CHAIN = 20
+
+        def chain_of(kernel):
+            def f(c, n, s):
+                acc = jnp.float32(0)
+                for _ in range(CHAIN):
+                    out = kernel(c, n, s + acc * 1e-20)
+                    acc = out[0, 0]
+                return acc
+            return jax.jit(f)
+
+        jit_xla = chain_of(lambda c, n, s: TH._node_hist_xla(
+            c, n, s, Wl, nb, 1, k))
+        jit_pal = chain_of(lambda c, n, s: _node_hist_pallas(
+            c, n, s, Wl_eff, nb, 1, k))
+
+        def run_xla():
+            return np.asarray(jit_xla(codes, node_p, sws_p))
+
+        def run_pallas():
+            return np.asarray(jit_pal(codes, node_p, sws_p))
+
+        t_x = bench(run_xla)
+        try:
+            t_p = bench(run_pallas)
+        except Exception as e:
+            t_p = float("nan")
+            print(f"  pallas failed: {type(e).__name__}: {str(e)[:120]}")
+        lanes = k * Wl * TH._t_pad128(T)
+        print(f"{label:42s} S={S:6d} lanes={lanes:7d}: "
+              f"XLA {t_x:8.2f} ms  pallas {t_p:8.2f} ms  "
+              f"{'PALLAS' if t_p < t_x else 'xla'} wins", flush=True)
+
+
+if __name__ == "__main__":
+    main()

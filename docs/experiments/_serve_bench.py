@@ -1,0 +1,81 @@
+"""Device-bound serve benchmark (VERDICT r4 weak #6): rows/sec through the
+fused serve program at micro-batch sizes from the RTT-bound 4096 to
+device-bound >= 65536, np.asarray-synced. 13-feature pipeline (12 numeric +
+1 categorical), LR winner — the same shape as the round-4 serve table."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+
+def build_model(n_train=20000, seed=0):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.feature import transmogrify
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.impl.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import PickList, Real, RealNN
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(seed)
+
+    def table(n, rs):
+        X = rs.randn(n, 12).astype(np.float32)
+        cats = rs.choice(["a", "b", "c", "d"], size=n)
+        y = (X[:, 0] - X[:, 1] + (cats == "a") + 0.3 * rs.randn(n)
+             > 0).astype(np.float32)
+        cols = {f"x{i}": Column.of_values(Real, X[:, i]) for i in range(12)}
+        cols["cat"] = Column.of_values(PickList, list(cats))
+        cols["label"] = Column.of_values(RealNN, y)
+        return FeatureTable(cols, n)
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(12)]
+    cat = FeatureBuilder.PickList("cat").extract_field().as_predictor()
+    vec = transmogrify(feats + [cat])
+    checked = SanityChecker().set_input(label, vec).get_output()
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None).set_input(label, checked).get_output()
+    wf = (OpWorkflow()
+          .set_input_table(table(n_train, rng))
+          .set_result_features(pred))
+    return wf.train(), pred, table
+
+
+def main():
+    from transmogrifai_tpu.local.scoring import compiled_score_function
+
+    model, pred, table = build_model()
+    score = compiled_score_function(model)
+    rng = np.random.RandomState(7)
+    results = []
+    for bs in (4096, 16384, 65536, 262144):
+        tbl = table(bs, rng)
+        out = score(tbl)                        # warm/compile this bucket
+        np.asarray(out[pred.name].values)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = score(tbl)
+            np.asarray(out[pred.name].values)   # full host materialization
+            times.append(time.perf_counter() - t0)
+        dt = float(np.median(times))
+        results.append((bs, bs / dt, dt))
+        print(f"batch={bs:7d}: {bs/dt:10.0f} rows/sec  ({dt*1e3:7.1f} ms)",
+              flush=True)
+    print("\nmarkdown row:")
+    for bs, rps, dt in results:
+        print(f"| {bs} | {rps/1e3:.1f}k rows/sec | {dt*1e3:.1f} ms |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""RETIRED pallas kernel: fused node-histogram (measurement record).
+
+The kernel expands the tree growers' (slot one-hot × stat) operand
+tile-by-tile in VMEM instead of materializing the (S, k·Wl·T_pad) A_cat in
+HBM. MEASURED on v5e (d=64, nb=32, median of 5 chained-20 reps,
+docs/experiments/_node_hist_shapes.py) — XLA's pipelined contraction wins
+at EVERY shape this framework produces, sweep and refit alike:
+
+| shape                                   | S     | lanes | XLA      | pallas   |
+|-----------------------------------------|-------|-------|----------|----------|
+| RF refit deep (1 cfg × 50 trees, W=256) | 65536 | 32768 | 20.0 ms  | 62.2 ms  |
+| RF refit deep level ≤6 (W=64)           | 65536 |  8192 |  4.8 ms  | 15.4 ms  |
+| GBT refit deep (1 cfg, W=256)           | 65536 | 24576 | 14.2 ms  | 55.4 ms  |
+| exact sweep GBT (42 cfg, W=64)          | 65536 | 12288 |  7.2 ms  | 23.4 ms  |
+| sweep RF chunk (500 trees, W=64)        |  8192 | 65536 |  8.4 ms  | 17.7 ms  |
+
+(round-4 sweep-shape measurements agreed: RF chain 29.4 vs 24.8 ms/call,
+GBT 8.2 vs 7.8.) The XLA contraction pipelines the A_cat expansion through
+HBM faster than this kernel re-expands the one-hot per 128-lane output
+block — the re-expansion multiplies one-hot compute by (lanes/128), which
+at production widths exceeds the HBM traffic it saves. Kept here (not
+imported by the package) as the measurement record; the production path is
+ops/tree_hist._node_hist_xla. The SMALL-operand pallas kernel
+(_hist_pallas, ≤1024 stat columns) remains active in production — that
+regime measured faster.
+
+To re-evaluate on future hardware: copy this kernel back next to
+_node_hist_xla and route node_hist_matmul through it above a lane
+threshold; parity test shape: tests/test_node_hist.py.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops.tree_hist import (_BLK_S, _interpret, _pad_to,
+                                             _t_pad128)
+
+
+def pad_node_inputs(node, sw_list, Wl):
+    """The lane-padding prologue node_hist_matmul applies before kernel
+    dispatch (shared by the parity test and the measurement script so the
+    recipe cannot drift from the production math): returns
+    (node_p, sws_stacked, Wl_eff, T_pad)."""
+    T = node.shape[1]
+    T_pad = _t_pad128(T)
+    rep = max(1, 128 // T_pad)
+    Wl_eff = max(Wl, rep)
+    if Wl_eff * T_pad % 128:
+        Wl_eff = -(-Wl_eff // rep) * rep
+    node_p = (jnp.pad(node, ((0, 0), (0, T_pad - T)), constant_values=-1)
+              if T_pad != T else node)
+    sws = jnp.stack(
+        [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
+         if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
+    return node_p, sws, Wl_eff, T_pad
+
+
+def _node_hist_pallas(codes, node, sws, Wl_eff, n_bins, stride, k,
+                      exact=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, d = codes.shape
+    T_pad = node.shape[1]
+    assert T_pad in (32, 64) or T_pad % 128 == 0, T_pad
+    lanes_per_k = Wl_eff * T_pad
+    assert lanes_per_k % 128 == 0, (Wl_eff, T_pad)
+    B = k * lanes_per_k
+    rep = max(1, 128 // T_pad)            # j's covered by one 128-lane block
+    blocks_per_k = lanes_per_k // 128
+    t_blocks = max(1, T_pad // 128)       # node col-blocks per j (T_pad>=128)
+
+    d_mult = 128 // math.gcd(n_bins, 128)
+    d_pad = _pad_to(d, d_mult)
+    if d_pad > 128:
+        d_pad = _pad_to(d_pad, 128)
+        blk_d = 128
+    else:
+        blk_d = d_pad
+    out_lanes = n_bins * blk_d
+    blk_s = _BLK_S
+    while blk_s > 256 and blk_s * out_lanes * 2 > (4 << 20):
+        blk_s //= 2
+    s_pad = _pad_to(S, blk_s)
+
+    codes_p = jnp.pad(codes.astype(jnp.int32),
+                      ((0, s_pad - S), (0, d_pad - d)),
+                      constant_values=n_bins)
+    node_p = jnp.pad(node, ((0, s_pad - S), (0, 0)), constant_values=-1)
+    sws_p = jnp.pad(sws.astype(jnp.float32),
+                    ((0, 0), (0, s_pad - S), (0, 0)))    # (k, S, T_pad)
+
+    n_blk = min(T_pad, 128)
+
+    def kernel(codes_ref, node_ref, sws_ref, out_ref):
+        b = pl.program_id(0)
+        s = pl.program_id(2)
+        # bin one-hot tile, bin-major (see module docstring)
+        c_rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)
+        b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, out_lanes), 1)
+                  // blk_d)
+        oh = (c_rep == b_iota).astype(jnp.bfloat16)
+        # masked-stat tile (blk_s, 128) built in VMEM: lane i covers slot
+        # j = j0 + i // T_pad (rep j's per block when T_pad < 128) of tree
+        # t = t0 + i % T_pad, stat k fixed per block
+        if rep > 1:
+            nd = pltpu.repeat(node_ref[:], rep, axis=1)       # (blk_s, 128)
+            sw = pltpu.repeat(sws_ref[0], rep, axis=1)
+        else:
+            nd = node_ref[:]
+            sw = sws_ref[0]
+        jb = b % blocks_per_k
+        j0 = (jb // t_blocks) * rep if T_pad >= 128 else jb * rep
+        lane = jax.lax.broadcasted_iota(jnp.int32, (blk_s, 128), 1)
+        j_row = j0 + lane // n_blk if rep > 1 else j0
+        A = jnp.where(nd == stride * j_row, sw, 0.0)
+        part = jnp.dot(A.T.astype(jnp.bfloat16), oh,
+                       preferred_element_type=jnp.float32)
+
+        @pl.when(s == 0)
+        def _():
+            out_ref[:] = part
+
+        @pl.when(s > 0)
+        def _():
+            out_ref[:] += part
+
+    def node_cols(bb, f, s):
+        # T_pad >= 128: pick the t-block this lane block covers; else whole
+        return (s, (bb % blocks_per_k) % t_blocks if T_pad >= 128 else 0)
+
+    def sws_cols(bb, f, s):
+        ki = bb // blocks_per_k
+        if T_pad >= 128:
+            return (ki, s, (bb % blocks_per_k) % t_blocks)
+        return (ki, s, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, d_pad * n_bins), jnp.float32),
+        grid=(B // 128, d_pad // blk_d, s_pad // blk_s),
+        in_specs=[
+            pl.BlockSpec((blk_s, blk_d), lambda bb, f, s: (s, f)),
+            pl.BlockSpec((blk_s, n_blk), node_cols),
+            pl.BlockSpec((1, blk_s, n_blk), sws_cols),
+        ],
+        out_specs=pl.BlockSpec((128, out_lanes), lambda bb, f, s: (bb, f)),
+        interpret=_interpret(),
+    )(codes_p, node_p, sws_p)
+
+    nbd = d_pad // blk_d
+    out = (out.reshape(B, nbd, n_bins, blk_d)
+           .transpose(0, 1, 3, 2)
+           .reshape(B, d_pad * n_bins))
+    return out[:, :d * n_bins]
+

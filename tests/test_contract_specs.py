@@ -649,6 +649,32 @@ class TestIsValidPhoneSpec(OpTransformerSpec):
             [True, False, None]
 
 
+class TestParsePhoneNumberSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import ParsePhoneNumber
+    stage_cls = ParsePhoneNumber
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.types import Text
+        stage = cls.stage_cls().set_input(_f("p", "Phone"), _f("rc", "Text"))
+        table = _tbl(p=(Phone, ["020 7946 0958", "650 253 0000", None]),
+                     rc=(Text, ["United Kingdom", "US", "GB"]))
+        return stage, table, ["+442079460958", "+16502530000", None]
+
+
+class TestIsValidPhoneNumberSpec(OpTransformerSpec):
+    from transmogrifai_tpu.impl.feature.text import IsValidPhoneNumber
+    stage_cls = IsValidPhoneNumber
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.types import Text
+        stage = cls.stage_cls().set_input(_f("p", "Phone"), _f("rc", "Text"))
+        table = _tbl(p=(Phone, ["020 7946 0958", "1", None]),
+                     rc=(Text, ["GB", "GB", "US"]))
+        return stage, table, [True, False, None]
+
+
 class TestLangDetectorSpec(OpTransformerSpec):
     from transmogrifai_tpu.impl.feature.text import LangDetector
     stage_cls = LangDetector

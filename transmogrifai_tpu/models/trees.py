@@ -456,11 +456,10 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         Wl = widths[level]
         Wn = widths[level + 1] if level + 1 < depth else min(2 ** depth, W)
         M = Wl * Tb
-        # node-histogram contraction (ops/tree_hist.node_hist_matmul).
-        # A pallas kernel that expands the (slot one-hot × stat) operand
-        # tile-by-tile in VMEM exists, but XLA's pipelined contraction won
-        # at every measured sweep shape, so the active path materializes
-        # the (S, k·(Wl/2)·Tb) operand (see _NODE_HIST_PALLAS_MIN_B)
+        # node-histogram contraction (ops/tree_hist.node_hist_matmul):
+        # XLA's pipelined A_cat contraction — a pallas kernel that expanded
+        # the operand in VMEM measured slower at every production shape and
+        # is retired to docs/experiments/node_hist_pallas.py
         if level == 0 or Wl % 2 or not sibling:
             hist = node_hist_matmul(codes_s, node, sw_list, Wl, n_bins)
             hist5 = hist.reshape(k, Wl, Tb, d, n_bins

@@ -214,15 +214,6 @@ def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
 # materializing the (S, k·Wl·T) masked-stat operand in HBM
 # ---------------------------------------------------------------------------
 
-#: node-hist kernel lane threshold. MEASURED (v5e, S=16384, d=64, nb=32,
-#: amortized over 24 in-program calls): RF chain shape (T=300, Wl=64, k=2)
-#: pallas 29.4ms vs XLA 24.8ms/call; GBT shape (T=54) 8.2 vs 7.8 — XLA's
-#: pipelined A_cat contraction wins at every sweep shape this framework
-#: produces (the kernel also pays T→128-multiple lane padding, +28% at
-#: T=300). Effectively disabled by default; kept CI-tested (interpret
-#: mode, tests/test_node_hist.py) for larger-S regimes and as the
-#: measurement record.
-_NODE_HIST_PALLAS_MIN_B = 1 << 62
 
 
 def _t_pad128(T: int) -> int:
@@ -247,105 +238,6 @@ def _node_hist_xla(codes, node, sws, Wl_eff, n_bins, stride, k, exact=False):
         axis=1).reshape(S, k * Wl_eff * T_pad)
     return _hist_xla(codes, A, n_bins, exact)
 
-
-def _node_hist_pallas(codes, node, sws, Wl_eff, n_bins, stride, k,
-                      exact=False):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    S, d = codes.shape
-    T_pad = node.shape[1]
-    assert T_pad in (32, 64) or T_pad % 128 == 0, T_pad
-    lanes_per_k = Wl_eff * T_pad
-    assert lanes_per_k % 128 == 0, (Wl_eff, T_pad)
-    B = k * lanes_per_k
-    rep = max(1, 128 // T_pad)            # j's covered by one 128-lane block
-    blocks_per_k = lanes_per_k // 128
-    t_blocks = max(1, T_pad // 128)       # node col-blocks per j (T_pad>=128)
-
-    d_mult = 128 // math.gcd(n_bins, 128)
-    d_pad = _pad_to(d, d_mult)
-    if d_pad > 128:
-        d_pad = _pad_to(d_pad, 128)
-        blk_d = 128
-    else:
-        blk_d = d_pad
-    out_lanes = n_bins * blk_d
-    blk_s = _BLK_S
-    while blk_s > 256 and blk_s * out_lanes * 2 > (4 << 20):
-        blk_s //= 2
-    s_pad = _pad_to(S, blk_s)
-
-    codes_p = jnp.pad(codes.astype(jnp.int32),
-                      ((0, s_pad - S), (0, d_pad - d)),
-                      constant_values=n_bins)
-    node_p = jnp.pad(node, ((0, s_pad - S), (0, 0)), constant_values=-1)
-    sws_p = jnp.pad(sws.astype(jnp.float32),
-                    ((0, 0), (0, s_pad - S), (0, 0)))    # (k, S, T_pad)
-
-    n_blk = min(T_pad, 128)
-
-    def kernel(codes_ref, node_ref, sws_ref, out_ref):
-        b = pl.program_id(0)
-        s = pl.program_id(2)
-        # bin one-hot tile, bin-major (see module docstring)
-        c_rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)
-        b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, out_lanes), 1)
-                  // blk_d)
-        oh = (c_rep == b_iota).astype(jnp.bfloat16)
-        # masked-stat tile (blk_s, 128) built in VMEM: lane i covers slot
-        # j = j0 + i // T_pad (rep j's per block when T_pad < 128) of tree
-        # t = t0 + i % T_pad, stat k fixed per block
-        if rep > 1:
-            nd = pltpu.repeat(node_ref[:], rep, axis=1)       # (blk_s, 128)
-            sw = pltpu.repeat(sws_ref[0], rep, axis=1)
-        else:
-            nd = node_ref[:]
-            sw = sws_ref[0]
-        jb = b % blocks_per_k
-        j0 = (jb // t_blocks) * rep if T_pad >= 128 else jb * rep
-        lane = jax.lax.broadcasted_iota(jnp.int32, (blk_s, 128), 1)
-        j_row = j0 + lane // n_blk if rep > 1 else j0
-        A = jnp.where(nd == stride * j_row, sw, 0.0)
-        part = jnp.dot(A.T.astype(jnp.bfloat16), oh,
-                       preferred_element_type=jnp.float32)
-
-        @pl.when(s == 0)
-        def _():
-            out_ref[:] = part
-
-        @pl.when(s > 0)
-        def _():
-            out_ref[:] += part
-
-    def node_cols(bb, f, s):
-        # T_pad >= 128: pick the t-block this lane block covers; else whole
-        return (s, (bb % blocks_per_k) % t_blocks if T_pad >= 128 else 0)
-
-    def sws_cols(bb, f, s):
-        ki = bb // blocks_per_k
-        if T_pad >= 128:
-            return (ki, s, (bb % blocks_per_k) % t_blocks)
-        return (ki, s, 0)
-
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((B, d_pad * n_bins), jnp.float32),
-        grid=(B // 128, d_pad // blk_d, s_pad // blk_s),
-        in_specs=[
-            pl.BlockSpec((blk_s, blk_d), lambda bb, f, s: (s, f)),
-            pl.BlockSpec((blk_s, n_blk), node_cols),
-            pl.BlockSpec((1, blk_s, n_blk), sws_cols),
-        ],
-        out_specs=pl.BlockSpec((128, out_lanes), lambda bb, f, s: (bb, f)),
-        interpret=_interpret(),
-    )(codes_p, node_p, sws_p)
-
-    nbd = d_pad // blk_d
-    out = (out.reshape(B, nbd, n_bins, blk_d)
-           .transpose(0, 1, 3, 2)
-           .reshape(B, d_pad * n_bins))
-    return out[:, :d * n_bins]
 
 
 def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
@@ -378,14 +270,11 @@ def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
     sws = jnp.stack(
         [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
          if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
-    # dispatch per the measurement record on _NODE_HIST_PALLAS_MIN_B (XLA
-    # wins at every sweep shape measured; the kernel stays for larger-S
-    # regimes and is CI-exercised with the threshold monkeypatched to 0)
-    if _use_pallas() and k * Wl_eff * T_pad >= _NODE_HIST_PALLAS_MIN_B:
-        out = _node_hist_pallas(codes, node_p, sws, Wl_eff, n_bins,
-                                stride, k)
-    else:
-        out = _node_hist_xla(codes, node_p, sws, Wl_eff, n_bins, stride, k)
+    # always the XLA contraction: a pallas kernel that expanded the
+    # one-hot per output block measured SLOWER at every production shape,
+    # sweep and refit alike — retired to docs/experiments/node_hist_pallas.py
+    # with the measurement table (_node_hist_shapes.py)
+    out = _node_hist_xla(codes, node_p, sws, Wl_eff, n_bins, stride, k)
     if Wl_eff != Wl or T_pad != T:
         out = (out.reshape(k, Wl_eff, T_pad, d * n_bins)[:, :Wl, :T]
                .reshape(k * Wl * T, d * n_bins))
