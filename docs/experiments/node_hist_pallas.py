@@ -38,10 +38,12 @@ from transmogrifai_tpu.ops.tree_hist import (_BLK_S, _interpret, _pad_to,
 
 
 def pad_node_inputs(node, sw_list, Wl):
-    """The lane-padding prologue node_hist_matmul applies before kernel
-    dispatch (shared by the parity test and the measurement script so the
-    recipe cannot drift from the production math): returns
-    (node_p, sws_stacked, Wl_eff, T_pad)."""
+    """The lane-padding prologue this kernel requires (32/64/128-multiple
+    tree lanes, 128-divisible Wl_eff·T_pad). Production node_hist_matmul
+    keeps an inline copy of the same math — measured FASTER with the
+    padding even on the always-XLA path (see its comment), so the recipe
+    exists in both places; this helper is shared by the parity test and
+    the measurement script. Returns (node_p, sws_stacked, Wl_eff, T_pad)."""
     T = node.shape[1]
     T_pad = _t_pad128(T)
     rep = max(1, 128 // T_pad)

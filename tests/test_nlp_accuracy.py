@@ -621,3 +621,85 @@ def test_round5_review_regressions():
     assert _resolve_region("Unknown", "US") == "US"
     assert _resolve_region("Europe", "US") == "US"
     assert _resolve_region("United Kingdom", "US") == "GB"
+
+
+# -- round-5b: 99 new phone regions (toward libphonenumber's ~240) -----------
+
+PHONE_FIXTURES_R5 = [
+    # NANP territories share cc 1
+    ("DO", "809-555-1234", True), ("JM", "876-555-1234", True),
+    ("PR", "787 555 1234", True), ("TT", "868 555 1234", True),
+    # Europe
+    ("IS", "581 2345", True), ("MT", "2122 1234", True),
+    ("CY", "2212 3456", True), ("HR", "01 2345 678", True),
+    ("RS", "011 123 4567", True), ("SI", "01 234 5678", True),
+    ("AL", "04 123 4567", True), ("LV", "2123 4567", True),
+    ("BY", "8 29 123 45 67", True), ("MD", "022 123 45", True),
+    # Caucasus / Central Asia
+    ("GE", "032 212 3456", True), ("AM", "010 12345", True),
+    ("KZ", "8 701 123 4567", True), ("UZ", "90 123 45 67", True),
+    # South / Southeast Asia
+    ("BD", "01712 345678", True), ("LK", "011 234 5678", True),
+    ("NP", "01-4123456", True), ("MM", "09 212 3456", True),
+    ("KH", "012 345 678", True), ("LA", "020 2123 4567", True),
+    ("MO", "2812 3456", True),
+    # Middle East / Africa
+    ("JO", "06 123 4567", True), ("KW", "2222 1234", True),
+    ("QA", "4412 3456", True), ("IR", "021 1234 5678", True),
+    ("MA", "0612 345 678", True), ("TN", "71 123 456", True),
+    ("GH", "024 123 4567", True), ("TZ", "0712 345 678", True),
+    ("ET", "091 123 4567", True), ("SN", "77 123 45 67", True),
+    ("RW", "078 123 4567", True), ("MU", "5123 4567", True),
+    # Latin America / Pacific
+    ("EC", "02 234 5678", True), ("UY", "2123 4567", True),
+    ("PY", "021 123 456", True), ("BO", "2 212 3456", True),
+    ("VE", "0212 123 4567", True), ("CR", "2222 1234", True),
+    ("GT", "2212 3456", True), ("CU", "07 123 4567", True),
+    ("FJ", "321 2345", True),
+    # invalid shapes
+    ("IS", "12", False), ("MT", "123", False), ("KW", "12345678901", False),
+]
+
+
+def test_phone_round5_regions():
+    for region, number, want in PHONE_FIXTURES_R5:
+        got = parse_phone(number, default_region=region)
+        assert got is not None, (region, number)
+        assert got[1] is want, (region, number, got)
+    # explicit country codes resolve against the widened table
+    assert parse_phone("+354 581 2345", "US")[1] is True
+    assert parse_phone("+880 1712 345678", "US")[1] is True
+    assert parse_phone("+598 2123 4567", "US")[1] is True
+    # region count floor: the length table must keep growing, not shrink
+    from transmogrifai_tpu.impl.feature.text import _PHONE_REGIONS
+    assert len(_PHONE_REGIONS) >= 150
+
+
+LANG_FIXTURES_R5B = [
+    ("mt", "il-ktieb huwa fuq il-mejda u dan mhux tajjeb għal kulħadd"),
+    ("so", "waxaa jira dad badan oo ku nool halkan iyo meelo kale"),
+    ("ht", "mwen gen anpil moun nan kay la ak tout fanmi nou yo"),
+    ("br", "an den a zo bet er gêr hag eus ar vro-se e oa"),
+    ("yi", "דער מענטש איז אין דער הויז מיט די קינדער און זיי זענען דאָ"),
+    ("he", "האיש נמצא בבית עם הילדים והם היו שם כל היום"),
+    ("mr", "तो घरात आहे आणि आम्ही सगळे तिथे होतो पण ते आले नाहीत"),
+    ("ne", "ऊ घरमा छ र हामी सबै त्यहाँ थियौं तर उनीहरू आएनन्"),
+    ("hi", "वह घर में है और हम सब वहाँ थे पर वे नहीं आए"),
+]
+
+
+def test_lang_round5b_past_optimaize():
+    """72 languages total (Optimaize ships ~70): in-script splits for
+    Hebrew (he/yi) and Devanagari (hi/mr/ne) plus mt/so/ht/br profiles.
+    Short in-script text without profile evidence falls back to the
+    block's dominant language rather than None."""
+    d = LangDetector()
+    correct = 0
+    for want, t in LANG_FIXTURES_R5B:
+        sc = d.transform_fn(t)
+        if sc and max(sc, key=sc.get) == want:
+            correct += 1
+    assert correct >= len(LANG_FIXTURES_R5B) - 1, correct
+    # fallback: Devanagari digits-and-letters-only short text still → hi
+    sc = d.transform_fn("नमस्ते")
+    assert sc and max(sc, key=sc.get) == "hi"

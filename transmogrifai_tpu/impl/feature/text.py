@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import base64 as _b64
 import re
+import unicodedata
 from collections import Counter
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -127,6 +128,17 @@ won't would wouldn't you your yours yourself yourselves
 #: profiles; ~20 languages here, each pinned by tests/test_nlp_accuracy.py
 #: fixtures). Accented/diacritic forms included where the tokenizer keeps
 #: them (it lowercases but preserves letters).
+def _strip_marks(text: str) -> str:
+    """Remove combining marks (Mn + Mc) after NFD decomposition. The
+    word-regex tokenizer treats Hebrew niqqud / Yiddish pointing (Mn) and
+    Brahmic vowel signs (Mc — Devanagari matras etc.) as non-word
+    characters and SPLITS words on them ('דאָס' → 'דא', 'ס'; 'हामी' →
+    'ह', 'म'), so both the detector's token stream and the stopword
+    profiles must be mark-stripped for profile hits to ever match."""
+    return "".join(c for c in unicodedata.normalize("NFD", text)
+                   if unicodedata.category(c) not in ("Mn", "Mc"))
+
+
 _STOPWORD_PROFILES: Dict[str, frozenset] = {
     "en": ENGLISH_STOP_WORDS,
     "fr": frozenset("""le la les un une des et est dans pour que qui sur avec
@@ -243,6 +255,21 @@ _STOPWORD_PROFILES: Dict[str, frozenset] = {
  за тое ж вы так яго яе да быў для пры пра або калі""".split()),
     "ur": frozenset("""اور کا کی کے میں ہے کہ یہ وہ سے پر کو نہیں ایک ہم
  تم اگر یا بھی سب بعد تھا تھی""".split()),
+    # -- round-5b: past Optimaize's ~70 -------------------------------------
+    "mt": frozenset("""il u ta li ma hija huwa dan din għal minn fuq biex
+ kien mhux ukoll jew meta kif dawn qed se iktar""".split()),
+    "so": frozenset("""iyo ka ku oo waa in uu ay la ma aan ayaa waxaa kale
+ badan sidoo markii halkan aad buu soo noqon""".split()),
+    "ht": frozenset("""nan ak pou li yo ki sa se te gen moun tout pa mwen
+ ou nou yon sou men anpil kounye apre""".split()),
+    "br": frozenset("""hag ar an en e da eus ez oa bet ul ur med pe gant
+ evit war a-raok goude brezhoneg kement""".split()),
+    "yi": frozenset("""דער די דאָס איז און אין פֿון מיט אויף ער זי מיר איר
+ זיי אַ אַן נישט וואָס ווען אויך נאָך""".split()),
+    "mr": frozenset("""आणि आहे या तो ती ते मी तू आम्ही तुम्ही हा ही हे पण
+ किंवा मध्ये वर साठी होता होती आहेत""".split()),
+    "ne": frozenset("""र छ यो त्यो म तिमी हामी उनीहरू यी ती पनि वा मा लागि
+ थियो थिए गर्न भने छन् हुन्छ""".split()),
 }
 
 #: decisive token/character CUES for closely-related language pairs where
@@ -270,6 +297,14 @@ _CUE_CHARS: Dict[str, str] = {
     "pt": "ãõ", "hu": "őű", "et": "õ", "tr": "ğı",
 }
 
+# mark-strip every profile/cue word once at import: the detector compares
+# mark-stripped tokens (see _strip_marks — without this, pointed Yiddish /
+# matra-bearing Devanagari words could never match)
+_STOPWORD_PROFILES = {lang: frozenset(_strip_marks(w) for w in words)
+                      for lang, words in _STOPWORD_PROFILES.items()}
+_CUE_TOKENS = {lang: frozenset(_strip_marks(w) for w in words)
+               for lang, words in _CUE_TOKENS.items()}
+
 #: decisive Unicode script ranges: when ≥50% of a text's letters fall in
 #: one of these blocks, the language set narrows to the block's candidates
 #: (the Optimaize n-gram analog for languages without whitespace or with
@@ -279,8 +314,8 @@ _SCRIPT_LANGS = [
     ((0x3040, 0x30FF), ("ja",)),            # Hiragana + Katakana
     ((0xAC00, 0xD7AF), ("ko",)),            # Hangul syllables
     ((0x0E00, 0x0E7F), ("th",)),            # Thai
-    ((0x0590, 0x05FF), ("he",)),            # Hebrew
-    ((0x0900, 0x097F), ("hi",)),            # Devanagari
+    ((0x0590, 0x05FF), ("he", "yi")),       # Hebrew script: he vs yi
+    ((0x0900, 0x097F), ("hi", "mr", "ne")),  # Devanagari: hi/mr/ne
     ((0x0980, 0x09FF), ("bn",)),            # Bengali
     ((0x0B80, 0x0BFF), ("ta",)),            # Tamil
     ((0x0370, 0x03FF), ("el",)),            # Greek
@@ -759,7 +794,7 @@ class OpLDAModel(_VectorModelBase):
 class LangDetector(UnaryTransformer):
     """Text → RealMap of language scores (reference LangDetector.scala wraps
     Optimaize, ~70 languages; here: Unicode-script narrowing + weighted
-    stopword/cue-profile hit rates over a **65-language** table — see
+    stopword/cue-profile hit rates over a **72-language** table — see
     _STOPWORD_PROFILES / _CUE_TOKENS / _SCRIPT_LANGS,
     tests/test_nlp_accuracy.py for per-language floors).
 
@@ -798,15 +833,19 @@ class LangDetector(UnaryTransformer):
                                 else "ar": 1.0}
                     if len(langs) == 1:
                         return {langs[0]: 1.0}
-                    # multi-language script (Cyrillic): restrict profiles
-                    return self._profile_scores(s, langs)
+                    # multi-language script (Cyrillic, Hebrew he/yi,
+                    # Devanagari hi/mr/ne): restrict profiles to the
+                    # block; no profile evidence ⇒ the block's dominant
+                    # language (listed first)
+                    return (self._profile_scores(s, langs)
+                            or {langs[0]: 1.0})
             return self._profile_scores(s, None)
         super().__init__("langDetect", transform_fn=fn, output_type=RealMap,
                          input_type=Text, uid=uid)
 
     @staticmethod
     def _profile_scores(s, restrict):
-        toks = tokenize_text(s)
+        toks = tokenize_text(_strip_marks(s))
         if not toks:
             return None
         scores = {}
@@ -1213,6 +1252,60 @@ _PHONE_REGIONS = {
     "CL": ("56", 9, ""), "CO": ("57", 10, ""),
     "PE": ("51", (8, 9), "0"), "UA": ("380", 9, "0"),
     "HK": ("852", 8, ""), "TW": ("886", (8, 9), "0"),
+    # -- round-5 tranche: toward libphonenumber's ~240 regions.
+    # NANP territories (cc 1, 10-digit national numbers, no trunk) — the
+    # reference's DefaultCountryCodes is NANP-heavy
+    # (PhoneNumberParser.scala:325+)
+    "DO": ("1", 10, ""), "PR": ("1", 10, ""), "BS": ("1", 10, ""),
+    "BB": ("1", 10, ""), "JM": ("1", 10, ""), "TT": ("1", 10, ""),
+    "AI": ("1", 10, ""), "AG": ("1", 10, ""), "VG": ("1", 10, ""),
+    "VI": ("1", 10, ""), "KY": ("1", 10, ""), "BM": ("1", 10, ""),
+    "GD": ("1", 10, ""), "TC": ("1", 10, ""), "MS": ("1", 10, ""),
+    "LC": ("1", 10, ""), "DM": ("1", 10, ""), "VC": ("1", 10, ""),
+    "KN": ("1", 10, ""), "GU": ("1", 10, ""),
+    # Europe
+    "IS": ("354", 7, ""), "LU": ("352", (6, 8, 9), ""),
+    "MT": ("356", 8, ""), "CY": ("357", 8, ""), "EE": ("372", (7, 8), ""),
+    "HR": ("385", (8, 9), "0"), "SI": ("386", 8, "0"),
+    "RS": ("381", (8, 9), "0"), "BA": ("387", 8, "0"),
+    "MK": ("389", 8, "0"), "AL": ("355", 9, "0"),
+    "LT": ("370", 8, "8"), "LV": ("371", 8, ""),
+    "MD": ("373", 8, "0"), "BY": ("375", 9, "8"),
+    "ME": ("382", 8, "0"), "MC": ("377", (8, 9), ""),
+    "LI": ("423", 7, ""), "AD": ("376", 6, ""),
+    # Caucasus / Central Asia
+    "GE": ("995", 9, "0"), "AM": ("374", 8, "0"),
+    "AZ": ("994", 9, "0"), "KZ": ("7", 10, "8"),
+    "UZ": ("998", 9, ""), "KG": ("996", 9, "0"), "TJ": ("992", 9, ""),
+    "TM": ("993", 8, "8"), "MN": ("976", 8, ""),
+    # South / Southeast Asia
+    "BD": ("880", (8, 9, 10), "0"), "LK": ("94", 9, "0"),
+    "NP": ("977", (8, 9, 10), "0"), "MM": ("95", (7, 8, 9, 10), "0"),
+    "KH": ("855", (8, 9), "0"), "LA": ("856", (8, 9, 10), "0"),
+    "BN": ("673", 7, ""), "MO": ("853", 8, ""),
+    # Middle East / North Africa
+    "JO": ("962", (8, 9), "0"), "LB": ("961", (7, 8), "0"),
+    "KW": ("965", 8, ""), "QA": ("974", 8, ""), "BH": ("973", 8, ""),
+    "OM": ("968", 8, ""), "IQ": ("964", 10, "0"),
+    "IR": ("98", 10, "0"), "SY": ("963", (8, 9), "0"),
+    "YE": ("967", (7, 8, 9), "0"),
+    "MA": ("212", 9, "0"), "DZ": ("213", (8, 9), "0"),
+    "TN": ("216", 8, ""), "LY": ("218", (8, 9), "0"),
+    # Sub-Saharan Africa
+    "GH": ("233", 9, "0"), "TZ": ("255", 9, "0"), "UG": ("256", 9, "0"),
+    "ZM": ("260", 9, "0"), "ZW": ("263", 9, "0"),
+    "ET": ("251", 9, "0"), "SN": ("221", 9, ""), "CI": ("225", 10, ""),
+    "CM": ("237", 9, ""), "RW": ("250", 9, "0"), "MW": ("265", (7, 9), "0"),
+    "MZ": ("258", (8, 9), ""), "BW": ("267", (7, 8), ""),
+    "NA": ("264", (8, 9), "0"), "MU": ("230", (7, 8), ""),
+    # Latin America
+    "EC": ("593", (8, 9), "0"), "UY": ("598", 8, "0"),
+    "PY": ("595", (8, 9), "0"), "BO": ("591", 8, "0"),
+    "VE": ("58", 10, "0"), "CR": ("506", 8, ""), "PA": ("507", (7, 8), ""),
+    "GT": ("502", 8, ""), "HN": ("504", 8, ""), "SV": ("503", 8, ""),
+    "NI": ("505", 8, ""), "CU": ("53", 8, "0"),
+    # Pacific
+    "FJ": ("679", 7, ""), "PG": ("675", (7, 8), ""),
 }
 
 
@@ -1225,8 +1318,13 @@ _PHONE_REGIONS = {
 #: libphonenumber's per-region metadata (:259-314).
 _NANP = r"[2-9]\d{2}[2-9]\d{6}"
 _PHONE_PATTERNS: Dict[str, Dict[str, str]] = {
-    "US": {"fixed_line_or_mobile": _NANP},
-    "CA": {"fixed_line_or_mobile": _NANP},
+    # every NANP region shares one numbering plan (area code [2-9]XX +
+    # exchange [2-9]XX) — without these entries a strict "+1" lookup would
+    # fall through to a pattern-less territory and accept any 10 digits
+    **{rg: {"fixed_line_or_mobile": _NANP}
+       for rg in ("US", "CA", "DO", "PR", "BS", "BB", "JM", "TT", "AI",
+                  "AG", "VG", "VI", "KY", "BM", "GD", "TC", "MS", "LC",
+                  "DM", "VC", "KN", "GU")},
     "GB": {"mobile": r"7[1-57-9]\d{8}", "fixed_line": r"[12]\d{8,9}|3\d{9}"},
     "FR": {"mobile": r"[67]\d{8}", "fixed_line": r"[1-59]\d{8}"},
     "DE": {"mobile": r"1[5-7]\d{8,9}", "fixed_line": r"[2-9]\d{7,10}"},

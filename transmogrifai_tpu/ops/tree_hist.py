@@ -244,11 +244,11 @@ def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
                      sw_list, Wl: int, n_bins: int,
                      stride: int = 1) -> jnp.ndarray:
     """hist[(k, j, t), f·nb + b] = Σ_s sw_k[s,t] · 1[node[s,t] == stride·j]
-    · 1[codes[s,f] == b] — the tree-growth histogram with the slot one-hot ×
-    stat product expanded tile-by-tile in VMEM. The A_cat materialization
-    this replaces was the growers' dominant HBM traffic: (S, k·Wl·T) f32 is
-    gigabytes per level at RF sweep widths (models/trees.py round-3 built it
-    with jnp.concatenate before every hist call).
+    · 1[codes[s,f] == b] — the tree-growth histogram as one XLA contraction
+    over the masked-stat operand (the (S, k·Wl·T) A_cat is materialized;
+    a pallas kernel that expanded it tile-by-tile in VMEM measured SLOWER
+    at every production shape, sweep and refit alike — retired with its
+    measurement table to docs/experiments/node_hist_pallas.py).
 
     codes: (S, d) int32 bin codes; node: (S, T) int32 current slot per tree
     (values < 0 never match); sw_list: k arrays (S, T) of per-tree stats;
@@ -260,6 +260,12 @@ def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
     S, d = codes.shape
     T = node.shape[1]
     k = len(sw_list)
+    # lane padding to 32/64/128-multiple tree lanes is KEPT on purpose: it
+    # predates the retired pallas kernel's constraints but MEASURES faster
+    # on v5e — removing it dropped the default-grid sweep from ~108 to
+    # ~88 fits/sec (the A_cat expansion + contraction tile better on
+    # 128-aligned minor dims than on T=54-ragged ones, logical-FLOP
+    # savings notwithstanding)
     T_pad = _t_pad128(T)
     rep = max(1, 128 // T_pad)
     Wl_eff = max(Wl, rep)
@@ -270,10 +276,6 @@ def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
     sws = jnp.stack(
         [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
          if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
-    # always the XLA contraction: a pallas kernel that expanded the
-    # one-hot per output block measured SLOWER at every production shape,
-    # sweep and refit alike — retired to docs/experiments/node_hist_pallas.py
-    # with the measurement table (_node_hist_shapes.py)
     out = _node_hist_xla(codes, node_p, sws, Wl_eff, n_bins, stride, k)
     if Wl_eff != Wl or T_pad != T:
         out = (out.reshape(k, Wl_eff, T_pad, d * n_bins)[:, :Wl, :T]
