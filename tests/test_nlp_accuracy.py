@@ -703,3 +703,69 @@ def test_lang_round5b_past_optimaize():
     # fallback: Devanagari digits-and-letters-only short text still → hi
     sc = d.transform_fn("नमस्ते")
     assert sc and max(sc, key=sc.get) == "hi"
+
+
+def test_round5b_stemmer_tranche():
+    """17 light per-language stemmers (reference: Lucene's ~30 Snowball
+    analyzers, LuceneTextAnalyzer.scala:203) — inflected forms of one
+    lemma must collide to one stem."""
+    from transmogrifai_tpu.impl.feature.vectorizers import STEMMERS
+    groups = {
+        "sv": [["bilarna", "bilar", "bilen"], ["friheten", "friheter"]],
+        "no": [["bilene", "biler", "bilen"]],
+        "da": [["bilerne", "biler", "bilen"]],
+        "fi": [["talossa", "talosta", "talolla"]],
+        "hu": [["házban", "házból", "házak"]],
+        "tr": [["evlerde", "evlerden", "evler"],
+               ["kitaplar", "kitaplardan"]],
+        "pl": [["domach", "domami", "domu"],
+               ["możliwościach", "możliwość"]],
+        "ro": [["casele", "caselor"], ["lucrările", "lucrări"]],
+        "cs": [["městech", "města", "město"],
+               ["možnostech", "možnosti"]],
+    }
+    for lang, sets in groups.items():
+        fn = STEMMERS[lang]
+        for words in sets:
+            stems = {fn(w) for w in words}
+            assert len(stems) == 1, (lang, words, stems)
+    assert len(STEMMERS) >= 17
+
+
+def test_ner_round5_no_case_regimes():
+    """Lowercase prose and ALL-CAPS headlines carry no case signal — the
+    round-4 VERDICT lists both as losses vs OpenNLP; the given-name
+    lexicon + gazetteer now recover them (novel names still lose)."""
+    ner = NameEntityRecognizer()
+    r = ner.transform_fn("yesterday john smith met sarah jones downtown")
+    assert {"john smith", "sarah jones"} <= set(r.get("Person", []))
+    r = ner.transform_fn("JOHN SMITH FLIES TO PARIS AFTER ACME CORP DEAL")
+    assert "JOHN SMITH" in r.get("Person", [])
+    assert "PARIS" in r.get("Location", [])
+    assert "ACME CORP" in r.get("Organization", [])
+    # a lowercase name-like verb context must NOT create a Person
+    r = ner.transform_fn("mark said the meeting was fine")
+    assert not r or "Person" not in r
+    # mixed-case path unchanged
+    r = ner.transform_fn("Dr. John Smith went to the store")
+    assert "John Smith" in r.get("Person", [])
+
+
+def test_round5b_review_regressions():
+    from transmogrifai_tpu.impl.feature.text import _CUE_TOKENS
+    # Latin diacritics must survive mark-stripping: the close-pair cues
+    # are distinct or they decide nothing
+    assert not (_CUE_TOKENS["gl"] & _CUE_TOKENS["pt"])
+    assert not (_CUE_TOKENS["cs"] & _CUE_TOKENS["sk"])
+    d = LangDetector()
+    # unprofiled Cyrillic languages return None, not a confident 'ru'
+    assert d.transform_fn("монгол хэл дээр бичигдсэн текст байна") is None
+    # normally-cased prose: lowercase case evidence BEATS the name lexicon
+    ner = NameEntityRecognizer()
+    for t in ("The grace period expired and they will mark twenty years",
+              "An amber alert was issued after the frank discussion"):
+        r = ner.transform_fn(t)
+        assert not r or "Person" not in r, (t, r)
+    # Romanian 'copiilor' reaches the longer suffix now
+    from transmogrifai_tpu.impl.feature.vectorizers import romanian_stem
+    assert romanian_stem("copiilor") == romanian_stem("copii")

@@ -559,10 +559,136 @@ def russian_stem(w: str) -> str:
     return _strip_suffixes(w, _RU_SUFFIXES)
 
 
+#: Scandinavian: sv/no/da share the -en/-et/-er/-ene noun machinery
+_SV_SUFFIXES = [
+    ("heterna", "het"), ("heten", "het"), ("heter", "het"),
+    ("arna", ""), ("erna", ""), ("orna", ""), ("ande", ""), ("ende", ""),
+    ("aste", ""), ("arne", ""), ("aren", ""), ("ades", ""), ("are", ""),
+    ("ade", ""), ("at", ""), ("ad", ""), ("en", ""), ("ar", ""),
+    ("er", ""), ("or", ""), ("et", ""), ("a", ""), ("e", ""), ("s", ""),
+]
+_NO_DA_SUFFIXES = [
+    ("hetene", "het"), ("heten", "het"), ("heter", "het"),
+    ("erne", ""), ("ende", ""), ("ene", ""), ("ane", ""), ("else", ""),
+    ("ere", ""), ("est", ""), ("et", ""), ("en", ""), ("er", ""),
+    ("ar", ""), ("te", ""), ("e", ""), ("s", ""),
+]
+#: Finnish: strip possessives then the most common case endings (a real
+#: Snowball Finnish is far deeper; goal is stable collisions)
+_FI_SUFFIXES = [
+    ("issaan", ""), ("issään", ""), ("llaan", ""), ("llään", ""),
+    ("ssaan", ""), ("ssään", ""), ("iensa", ""), ("iensä", ""),
+    ("isiin", ""), ("ista", ""), ("istä", ""), ("ille", ""),
+    ("illa", ""), ("illä", ""), ("issa", ""), ("issä", ""),
+    ("lla", ""), ("llä", ""), ("ssa", ""), ("ssä", ""), ("sta", ""),
+    ("stä", ""), ("lle", ""), ("lta", ""), ("ltä", ""), ("ksi", ""),
+    ("tta", ""), ("ttä", ""), ("ien", ""), ("in", ""), ("it", ""),
+    ("et", ""), ("at", ""), ("ät", ""), ("na", ""), ("nä", ""),
+    ("a", ""), ("ä", ""), ("n", ""), ("t", ""),
+]
+#: Hungarian: case endings + plural
+_HU_SUFFIXES = [
+    ("jainak", ""), ("einek", ""), ("oknak", ""), ("eknek", ""),
+    ("ságok", "ság"), ("ségek", "ség"), ("ság", "ság"), ("ség", "ség"),
+    ("okat", ""), ("eket", ""), ("akat", ""), ("ban", ""), ("ben", ""),
+    ("nak", ""), ("nek", ""), ("val", ""), ("vel", ""), ("ból", ""),
+    ("ből", ""), ("hoz", ""), ("hez", ""), ("ról", ""), ("ről", ""),
+    ("ok", ""), ("ek", ""), ("ak", ""), ("ot", ""), ("et", ""),
+    ("at", ""), ("on", ""), ("en", ""), ("án", ""), ("én", ""),
+    ("t", ""), ("k", ""),
+]
+#: Turkish: agglutinative chain simplified to the outermost layers
+_TR_SUFFIXES = [
+    ("larından", ""), ("lerinden", ""), ("larında", ""), ("lerinde", ""),
+    ("larini", ""), ("lerini", ""), ("larına", ""), ("lerine", ""),
+    ("ların", ""), ("lerin", ""), ("ları", ""), ("leri", ""),
+    ("lardan", ""), ("lerden", ""), ("larda", ""), ("lerde", ""),
+    ("lara", ""), ("lere", ""), ("lar", ""), ("ler", ""),
+    ("ında", ""), ("inde", ""), ("undan", ""), ("ünden", ""),
+    ("dan", ""), ("den", ""), ("tan", ""), ("ten", ""),
+    ("da", ""), ("de", ""), ("ta", ""), ("te", ""),
+    ("ın", ""), ("in", ""), ("un", ""), ("ün", ""),
+    ("ı", ""), ("i", ""), ("u", ""), ("ü", ""), ("a", ""), ("e", ""),
+]
+#: Polish: declension + common verb endings
+_PL_SUFFIXES = [
+    ("owaniach", ""), ("owania", ""), ("owanie", ""), ("ościach", "ość"),
+    ("ościami", "ość"), ("ości", "ość"), ("ość", "ość"),
+    ("owych", "owy"), ("owymi", "owy"), ("owej", "owy"), ("owego", "owy"),
+    ("owy", "owy"), ("owa", "owy"), ("owe", "owy"),
+    ("ach", ""), ("ami", ""), ("iem", ""), ("em", ""), ("om", ""),
+    ("ów", ""), ("ej", ""), ("ego", ""), ("emu", ""), ("ymi", ""),
+    ("ych", ""), ("ą", ""), ("ę", ""), ("y", ""), ("i", ""), ("e", ""),
+    ("a", ""), ("o", ""), ("u", ""),
+]
+#: Romanian: articles + plural/case
+_RO_SUFFIXES = [
+    ("iilor", ""), ("ilor", ""), ("ului", ""), ("elor", ""),
+    ("ările", "are"), ("area", "are"), ("erea", "ere"), ("irea", "ire"),
+    ("ări", "are"), ("uri", ""), ("ele", ""), ("ea", ""), ("ul", ""),
+    ("ii", ""), ("le", ""), ("lui", ""), ("ă", ""), ("a", ""),
+    ("e", ""), ("i", ""), ("u", ""),
+]
+#: Czech: declension
+_CS_SUFFIXES = [
+    ("ováním", "ování"), ("ování", "ování"), ("ostech", "ost"),
+    ("ostem", "ost"), ("ostí", "ost"), ("osti", "ost"), ("ost", "ost"),
+    ("ého", ""), ("ému", ""), ("ými", ""), ("ých", ""), ("ami", ""),
+    ("emi", ""), ("ech", ""), ("ích", ""), ("ům", ""), ("em", ""),
+    ("ou", ""), ("y", ""), ("i", ""), ("e", ""), ("é", ""),
+    ("á", ""), ("í", ""), ("ý", ""), ("a", ""), ("o", ""), ("u", ""),
+]
+
+
+def swedish_stem(w: str) -> str:
+    return _strip_suffixes(w, _SV_SUFFIXES) if len(w) > 4 else w
+
+
+def norwegian_stem(w: str) -> str:
+    return _strip_suffixes(w, _NO_DA_SUFFIXES) if len(w) > 4 else w
+
+
+def danish_stem(w: str) -> str:
+    return _strip_suffixes(w, _NO_DA_SUFFIXES) if len(w) > 4 else w
+
+
+def finnish_stem(w: str) -> str:
+    return _strip_suffixes(w, _FI_SUFFIXES) if len(w) > 5 else w
+
+
+def hungarian_stem(w: str) -> str:
+    return _strip_suffixes(w, _HU_SUFFIXES) if len(w) > 4 else w
+
+
+def turkish_stem(w: str) -> str:
+    if len(w) <= 4:
+        return w
+    # peel at most two agglutinated layers
+    w1 = _strip_suffixes(w, _TR_SUFFIXES)
+    return _strip_suffixes(w1, _TR_SUFFIXES) if len(w1) > 5 else w1
+
+
+def polish_stem(w: str) -> str:
+    return _strip_suffixes(w, _PL_SUFFIXES) if len(w) > 3 else w
+
+
+def romanian_stem(w: str) -> str:
+    return _strip_suffixes(w, _RO_SUFFIXES) if len(w) > 4 else w
+
+
+def czech_stem(w: str) -> str:
+    return _strip_suffixes(w, _CS_SUFFIXES) if len(w) > 4 else w
+
+
 #: language → stemmer for TextTokenizer(stemming=True, language=...)
+#: (reference: Lucene ships ~30 per-language Snowball analyzers,
+#: LuceneTextAnalyzer.scala:203 — 17 light analogs here)
 STEMMERS = {"en": porter_stem, "fr": french_stem, "de": german_stem,
             "es": spanish_stem, "it": italian_stem, "pt": portuguese_stem,
-            "nl": dutch_stem, "ru": russian_stem}
+            "nl": dutch_stem, "ru": russian_stem,
+            "sv": swedish_stem, "no": norwegian_stem, "da": danish_stem,
+            "fi": finnish_stem, "hu": hungarian_stem, "tr": turkish_stem,
+            "pl": polish_stem, "ro": romanian_stem, "cs": czech_stem}
 
 
 class TextTokenizer(UnaryTransformer):
