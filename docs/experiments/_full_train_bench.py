@@ -1,0 +1,66 @@
+"""Full AutoML train wall (transmogrify → SanityChecker → 4-family default
+CV sweep) at 1M rows × 14 raw features — the round-1..4 'Full AutoML train'
+benchmark re-measured with the round-5 fused sweep."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build(n, seed=0):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.feature import transmogrify
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.impl.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import PickList, Real, RealNN
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 12).astype(np.float32)
+    c1 = rng.choice(["a", "b", "c", "d", "e"], size=n)
+    c2 = rng.choice([f"k{i}" for i in range(40)], size=n)
+    y = (X[:, 0] - X[:, 1] + (c1 == "a") + 0.3 * rng.randn(n)
+         > 0).astype(np.float32)
+    cols = {f"x{i}": Column.of_values(Real, X[:, i]) for i in range(12)}
+    cols["c1"] = Column.of_values(PickList, list(c1))
+    cols["c2"] = Column.of_values(PickList, list(c2))
+    cols["label"] = Column.of_values(RealNN, y)
+    tbl = FeatureTable(cols, n)
+
+    label = FeatureBuilder.RealNN("label").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(12)]
+    feats += [FeatureBuilder.PickList("c1").extract_field().as_predictor(),
+              FeatureBuilder.PickList("c2").extract_field().as_predictor()]
+    vec = transmogrify(feats)
+    checked = SanityChecker().set_input(label, vec).get_output()
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        splitter=None).set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_table(tbl).set_result_features(pred)), pred
+
+
+def main():
+    import jax
+    n = 1_000_000 if jax.devices()[0].platform == "tpu" else 20_000
+    wf, pred = build(n)
+    t0 = time.perf_counter()
+    model = wf.train()
+    cold = time.perf_counter() - t0
+    print(f"cold train ({n} rows): {cold:.1f}s", flush=True)
+    ts = []
+    for _ in range(2):
+        wf2, _ = build(n)
+        t0 = time.perf_counter()
+        wf2.train()
+        ts.append(time.perf_counter() - t0)
+    print(f"warm train: {min(ts):.1f}s (reps: "
+          f"{', '.join(f'{t:.1f}' for t in ts)})")
+
+
+if __name__ == "__main__":
+    main()
