@@ -10,7 +10,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def build(n, seed=0):
+def build(n, seed=0, table_cache={}):
     from transmogrifai_tpu.features import FeatureBuilder
     from transmogrifai_tpu.impl.feature import transmogrify
     from transmogrifai_tpu.impl.preparators import SanityChecker
@@ -20,17 +20,22 @@ def build(n, seed=0):
     from transmogrifai_tpu.types import PickList, Real, RealNN
     from transmogrifai_tpu.workflow import OpWorkflow
 
-    rng = np.random.RandomState(seed)
-    X = rng.randn(n, 12).astype(np.float32)
-    c1 = rng.choice(["a", "b", "c", "d", "e"], size=n)
-    c2 = rng.choice([f"k{i}" for i in range(40)], size=n)
-    y = (X[:, 0] - X[:, 1] + (c1 == "a") + 0.3 * rng.randn(n)
-         > 0).astype(np.float32)
-    cols = {f"x{i}": Column.of_values(Real, X[:, i]) for i in range(12)}
-    cols["c1"] = Column.of_values(PickList, list(c1))
-    cols["c2"] = Column.of_values(PickList, list(c2))
-    cols["label"] = Column.of_values(RealNN, y)
-    tbl = FeatureTable(cols, n)
+    # dataset built once and reused across reps (multi-second host work at
+    # 1M rows; only the workflow graph is rebuilt per rep)
+    if (n, seed) not in table_cache:
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, 12).astype(np.float32)
+        c1 = rng.choice(["a", "b", "c", "d", "e"], size=n)
+        c2 = rng.choice([f"k{i}" for i in range(40)], size=n)
+        y = (X[:, 0] - X[:, 1] + (c1 == "a") + 0.3 * rng.randn(n)
+             > 0).astype(np.float32)
+        cols = {f"x{i}": Column.of_values(Real, X[:, i])
+                for i in range(12)}
+        cols["c1"] = Column.of_values(PickList, list(c1))
+        cols["c2"] = Column.of_values(PickList, list(c2))
+        cols["label"] = Column.of_values(RealNN, y)
+        table_cache[(n, seed)] = FeatureTable(cols, n)
+    tbl = table_cache[(n, seed)]
 
     label = FeatureBuilder.RealNN("label").extract_field().as_response()
     feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
