@@ -1,4 +1,11 @@
-"""Locate the tree-count-independent cost in RF sweep fits: depth-12-only
+"""METHODOLOGY WARNING (round-5 finding): this probe times with
+per-array block_until_ready, which costs ~90 ms of tunnel latency PER
+ARRAY and fabricated a ~0.65 s "fixed cost" — see
+docs/benchmarks.md measurement caveats for the honest recipe
+(single np.asarray sync, or chained-iteration jits). Numbers from
+this script are exploration history, not the record.
+
+Locate the tree-count-independent cost in RF sweep fits: depth-12-only
 grid, numTrees in {50, 8}, and sample size {16384, 4096}."""
 import os
 import sys

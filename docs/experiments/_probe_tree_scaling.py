@@ -1,4 +1,11 @@
-"""Scaling probes for sweep-mode tree fits: how fit time scales with
+"""METHODOLOGY WARNING (round-5 finding): this probe times with
+per-array block_until_ready, which costs ~90 ms of tunnel latency PER
+ARRAY and fabricated a ~0.65 s "fixed cost" — see
+docs/benchmarks.md measurement caveats for the honest recipe
+(single np.asarray sync, or chained-iteration jits). Numbers from
+this script are exploration history, not the record.
+
+Scaling probes for sweep-mode tree fits: how fit time scales with
 numTrees (RF), maxIter (GBT), and depth mix. Run on the real TPU."""
 import os
 import sys

@@ -1,4 +1,11 @@
-"""Decompose the RF default-grid sweep into fit / predict / metric time,
+"""METHODOLOGY WARNING (round-5 finding): this probe times with
+per-array block_until_ready, which costs ~90 ms of tunnel latency PER
+ARRAY and fabricated a ~0.65 s "fixed cost" — see
+docs/benchmarks.md measurement caveats for the honest recipe
+(single np.asarray sync, or chained-iteration jits). Numbers from
+this script are exploration history, not the record.
+
+Decompose the RF default-grid sweep into fit / predict / metric time,
 and per-depth-bucket fit time. Run on the real TPU."""
 import os
 import sys
