@@ -168,7 +168,10 @@ class PendingFit:
         self._finish = finish   # host dict (same keys, np arrays) -> model
 
     def finish_now(self) -> "Transformer":
-        return self._finish({k: np.asarray(v) for k, v in self.dev.items()})
+        # even a single fit resolves through the fused per-dtype transfer:
+        # a plain np.asarray per leaf costs a ~100 ms tunnel round-trip
+        # EACH (7 leaves for a SanityChecker fit)
+        return materialize_pending([self])[0]
 
 
 def materialize_pending(pendings: "List[PendingFit]") -> "List[Transformer]":
@@ -183,6 +186,13 @@ def materialize_pending(pendings: "List[PendingFit]") -> "List[Transformer]":
     by_dtype: Dict[Any, list] = {}
     for pi, p in enumerate(pendings):
         for k, v in p.dev.items():
+            if isinstance(v, np.ndarray):
+                # host leaves keep their exact dtype (jnp.asarray would
+                # silently narrow f64/i64 under the default x64-off
+                # config — the rounding hazard this function's per-dtype
+                # grouping exists to avoid)
+                leaves.append((pi, k, None, None))
+                continue
             v = jnp.asarray(v)
             leaves.append((pi, k, v.shape, v.dtype))
             by_dtype.setdefault(str(v.dtype), []).append(v.reshape(-1))
@@ -192,6 +202,9 @@ def materialize_pending(pendings: "List[PendingFit]") -> "List[Transformer]":
     offs = {dt: 0 for dt in flat_host}
     host_dicts: List[Dict[str, Any]] = [{} for _ in pendings]
     for pi, k, shape, dtype in leaves:
+        if shape is None:          # host leaf, passed through untouched
+            host_dicts[pi][k] = pendings[pi].dev[k]
+            continue
         dt = str(dtype)
         size = int(np.prod(shape)) if shape else 1
         host_dicts[pi][k] = flat_host[dt][offs[dt]:offs[dt] + size
