@@ -42,3 +42,30 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(42)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_injection_leak(request):
+    """Fault-injection sites must be inert outside chaos tests: an armed
+    site leaking out of a ``chaos``-marked test (or in via a stray
+    TG_FAULTS env without TG_CHAOS) would poison unrelated tests' — and
+    production paths' — behavior silently."""
+    import os as _os
+
+    from transmogrifai_tpu.robustness import faults
+
+    is_chaos = (request.node.get_closest_marker("chaos") is not None
+                or bool(_os.environ.get(faults.CHAOS_ENV)))
+    if not is_chaos:
+        assert not faults.active_sites(), (
+            "fault-injection sites are armed outside a chaos test: "
+            f"{faults.active_sites()}")
+    yield
+    if not is_chaos:
+        assert not faults.active_sites(), (
+            "a test leaked armed fault-injection sites: "
+            f"{faults.active_sites()}")
+    else:
+        # belt and braces: a chaos test that failed before its injected()
+        # context exited must not poison the rest of the session
+        faults.clear()

@@ -34,9 +34,13 @@ def test_documented_dials_match_code():
     from transmogrifai_tpu.models import trees as T
 
     dials = _doc_dials()
-    sig = inspect.signature(OpValidator.__init__)
-    assert int(dials["max_eval_rows default"]) == \
-        sig.parameters["max_eval_rows"].default
+    # the signature default is a sentinel resolved in __init__ (it picks
+    # 32768 or the round-4 value under TG_SWEEP_FIDELITY); assert the
+    # RESOLVED default the doc documents
+    assert inspect.signature(OpValidator.__init__).parameters[
+        "max_eval_rows"].default == OpValidator._EVAL_ROWS_DEFAULT
+    os.environ.pop("TG_SWEEP_FIDELITY", None)
+    assert int(dials["max_eval_rows default"]) == OpValidator().max_eval_rows
     assert int(dials["_SWEEP_HIST_SAMPLE"]) == T._SWEEP_HIST_SAMPLE
     assert int(dials["_SWEEP_RF_TREES"]) == T._SWEEP_RF_TREES
     assert int(dials["_SWEEP_GBT_ROUNDS"]) == T._SWEEP_GBT_ROUNDS

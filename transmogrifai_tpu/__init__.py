@@ -29,6 +29,8 @@ _LAZY = {
     "transmogrify": ".impl.feature.transmogrifier",
     "DataReaders": ".readers.readers",
     "Evaluators": ".evaluators.factory",
+    "RetryPolicy": ".robustness.policy",
+    "FaultReport": ".robustness.policy",
 }
 
 

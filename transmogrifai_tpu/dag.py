@@ -72,6 +72,7 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                           profiler: Optional[Any] = None,
                           checkpoint: Optional[Any] = None,
                           preloaded: Optional[Dict[str, Any]] = None,
+                          retry_policy: Optional[Any] = None,
                           ) -> Tuple[FeatureTable, Dict[str, Any]]:
     """Fit estimators layer-by-layer, transforming as we go (reference
     FitStagesUtil.fitAndTransformDAG / fitAndTransformLayer).
@@ -81,8 +82,16 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
     crash-resumable training (the analog of the reference's persist-every-K
     resilience, OpWorkflowModel.scala:449-455).
 
+    ``retry_policy`` (a ``robustness.RetryPolicy``, wired by
+    ``OpWorkflow.with_fault_policy``) re-runs a stage fit that fails with a
+    TRANSIENT error — device-transfer hiccups on tunneled backends — the
+    analog of the reference's ``spark.task.maxFailures``. Fatal errors
+    (shape/trace bugs) are never retried: the fit is deterministic, so
+    re-running the same program on the same inputs cannot change them.
+
     Returns (transformed table, {estimator uid → fitted model}).
     """
+    from .robustness import faults
     prof = profiler or _NULL_PROFILER
     pre = preloaded or {}
     fitted: Dict[str, Any] = {}
@@ -96,8 +105,15 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                     model.input_features = stage.input_features
                     model._output_feature = stage.get_output()
                 else:
-                    with prof.track(stage, "fit", li):
-                        model = stage.fit(table)
+                    def _fit(stage=stage, li=li):
+                        faults.inject("dag.stage_fit", key=stage.uid)
+                        with prof.track(stage, "fit", li):
+                            return stage.fit(table)
+                    if retry_policy is not None:
+                        model = retry_policy.execute(
+                            _fit, site=f"dag.stage_fit[{stage.uid}]")
+                    else:
+                        model = _fit()
                     if checkpoint is not None:
                         checkpoint(model)
                 fitted[stage.uid] = model

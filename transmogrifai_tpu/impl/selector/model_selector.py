@@ -19,12 +19,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.api import MODEL_REGISTRY, FittedParams, ModelFamily
+from ...robustness import faults
+from ...robustness.guards import (
+    AllCandidatesFailedError, params_finite, quarantine_non_finite,
+)
+from ...robustness.policy import FaultLog, FaultReport
 from ...stages.base import AllowLabelAsInput, Estimator, Transformer
 from ...table import Column, FeatureTable
 from ...types import OPVector, Prediction, RealNN
 from ..tuning.splitters import DataSplitter, PreparedData, Splitter
 from ..tuning.validators import BestEstimator, OpCrossValidation, OpValidator
 from ...utils.padding import bucket_for
+
+#: refit-fallback depth: how many ranked candidates may be tried when the
+#: winner's full-data refit diverges before the train aborts aggregated
+_MAX_REFIT_ATTEMPTS = 3
 
 
 @dataclass
@@ -46,6 +55,10 @@ class ModelSelectorSummary:
     #: explainable from the summary alone (the reference always scores every
     #: validation row, OpValidator.scala:270-312).
     validation_eval_row_cap: Optional[int] = None
+    #: candidates excluded from selection (non-finite CV metrics, fits that
+    #: threw, non-finite refit params), with their failure reasons — the
+    #: sweep continued without them (docs/robustness.md)
+    quarantined: List[Dict[str, Any]] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -61,6 +74,7 @@ class ModelSelectorSummary:
             "holdoutEvaluation": self.holdout_evaluation,
             "splitterSummary": self.splitter_summary,
             "validationEvalRowCap": self.validation_eval_row_cap,
+            "quarantinedCandidates": [dict(r) for r in self.quarantined],
         }
 
 
@@ -245,23 +259,34 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         fold_results = [r.resolve() if hasattr(r, "resolve") else r
                         for r in fold_results]
 
-        # average fold winners per (family, grid point)
+        # average fold winners per (family, grid point); a candidate with a
+        # non-finite metric in ANY fold has a non-finite mean and is
+        # quarantined from the merged selection (guards; the per-fold
+        # validates already recorded the fold-level reports)
         best: Optional[BestEstimator] = None
         merged: List[Any] = []
+        quarantined: List[Dict[str, Any]] = []
         for i, (family, grid) in enumerate(self.models):
             folds = np.stack([fr.results[i].fold_metrics[0]
                               for fr in fold_results])      # (F, G)
-            mean = folds.mean(axis=0)
             r = fold_results[0].results[i]
+            mean, masked, records = quarantine_non_finite(
+                family.name, list(grid), folds, metric_name, larger_better)
+            quarantined.extend(records)
             r.fold_metrics, r.mean_metrics = folds, mean
             merged.append(r)
-            g_best = int(np.argmax(mean) if larger_better else np.argmin(mean))
+            if not np.isfinite(mean).any():
+                continue
+            g_best = int(np.argmax(masked) if larger_better
+                         else np.argmin(masked))
             value = float(mean[g_best])
             if best is None or ((value > best.metric_value) if larger_better
                                 else (value < best.metric_value)):
                 best = BestEstimator(family.name, dict(grid[g_best]), value)
-        assert best is not None
+        if best is None:
+            raise AllCandidatesFailedError(quarantined)
         best.results = merged
+        best.quarantined = quarantined
         self._preset_best = best
         return best
 
@@ -308,8 +333,6 @@ class ModelSelector(AllowLabelAsInput, Estimator):
 
         # refit winner on full prepared train (reference :158-159); rows
         # bucket-padded with zero weights for compile reuse
-        family = MODEL_REGISTRY[best.family_name]
-        garr = family.grid_to_arrays([best.hyper])
         n_fit = len(y)
         n_data = self.mesh.shape["data"] if self.mesh is not None else 1
         n_pad = bucket_for(n_fit, multiple_of=n_data)
@@ -321,28 +344,63 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         if self.mesh is not None:
             # the winner refit is a full-data fit — shard its rows over
             # 'data' like the sweep (round-3 left it unsharded: the most
-            # expensive single fit of the train path ran on one chip)
+            # expensive single fit of the train path ran on one chip);
+            # placements retry transient link errors (robustness/policy.py)
             from jax.sharding import NamedSharding, PartitionSpec as P
-            Xf = jax.device_put(Xf, NamedSharding(self.mesh, P("data", None)))
-            yf = jax.device_put(yf, NamedSharding(self.mesh, P("data")))
-            W = jax.device_put(W, NamedSharding(self.mesh, P(None, "data")))
-        params_b = family.fit_batch(Xf, yf, W, garr, num_classes)
-        fitted = FittedParams(
-            family=family.name, params=family.select_params(params_b, 0),
-            hyper=dict(best.hyper), num_classes=num_classes)
+            from ...parallel.distributed import retrying_device_put
+            Xf = retrying_device_put(Xf, NamedSharding(self.mesh,
+                                                       P("data", None)))
+            yf = retrying_device_put(yf, NamedSharding(self.mesh, P("data")))
+            W = retrying_device_put(W, NamedSharding(self.mesh,
+                                                     P(None, "data")))
+        # refit with a non-finite guard and fallback: a winner that diverges
+        # on the full prepared train (the sweep fit at a sample/cap; the
+        # refit is the exact program) is quarantined and the next-ranked
+        # finite candidate refits instead. With no fault the first candidate
+        # IS the sweep winner, bit-identically.
+        fitted = None
+        best_used = (best.family_name, dict(best.hyper), best.metric_value)
+        refit_quarantine: List[Dict[str, Any]] = []
+        for fam_name, hyper, value in self._ranked_candidates(
+                best, larger_better)[:_MAX_REFIT_ATTEMPTS]:
+            family = MODEL_REGISTRY[fam_name]
+            try:
+                faults.inject("selector.refit", key=fam_name)
+                garr = family.grid_to_arrays([hyper])
+                params_b = family.fit_batch(Xf, yf, W, garr, num_classes)
+                sel_params = family.select_params(params_b, 0)
+                if not params_finite(sel_params,
+                                     getattr(family, "inf_ok_params", ())):
+                    raise ArithmeticError(
+                        "refit produced non-finite fitted params")
+                fitted = FittedParams(
+                    family=fam_name, params=sel_params,
+                    hyper=dict(hyper), num_classes=num_classes)
+                best_used = (fam_name, dict(hyper), value)
+                break
+            except Exception as e:
+                rec = {"family": fam_name, "hyper": dict(hyper),
+                       "reason": f"refit failed: {type(e).__name__}: {e}"}
+                refit_quarantine.append(rec)
+                FaultLog.record(FaultReport(site="selector.refit",
+                                            kind="quarantine", detail=rec))
+        if fitted is None:
+            raise AllCandidatesFailedError(
+                list(best.quarantined) + refit_quarantine)
 
         summary = ModelSelectorSummary(
             validation_type=type(self.validator).__name__,
             validation_metric=metric_name,
             problem=self.problem,
-            best_model_type=best.family_name,
-            best_hyper=dict(best.hyper),
-            best_metric_value=best.metric_value,
+            best_model_type=best_used[0],
+            best_hyper=best_used[1],
+            best_metric_value=best_used[2],
             larger_better=larger_better,
             validation_results=best.results,
             splitter_summary=dict(getattr(self.splitter, "summary", {}) or {}),
             validation_eval_row_cap=getattr(self.validator, "max_eval_rows",
                                             None),
+            quarantined=list(best.quarantined) + refit_quarantine,
         )
         model = SelectedModel(fitted=fitted, summary=summary,
                               label_mapping=prep.label_mapping)
@@ -362,6 +420,23 @@ class ModelSelector(AllowLabelAsInput, Estimator):
                 ev.evaluate_all(model.transform(test_tbl)))
         model.summary_metadata = summary.to_json()
         return model
+
+    def _ranked_candidates(self, best, larger_better: bool):
+        """Winner first, then every other finite-metric candidate ordered by
+        mean validation metric — the refit fallback order used when the
+        winner's full-data refit throws or yields non-finite params."""
+        ranked = [(best.family_name, dict(best.hyper), best.metric_value)]
+        pool = []
+        for r in best.results or []:
+            for g, hyper in enumerate(r.grid):
+                v = float(r.mean_metrics[g])
+                if not np.isfinite(v):
+                    continue
+                if (r.family == ranked[0][0] and dict(hyper) == ranked[0][1]):
+                    continue
+                pool.append((r.family, dict(hyper), v))
+        pool.sort(key=(lambda t: -t[2]) if larger_better else (lambda t: t[2]))
+        return ranked + pool
 
     def _default_evaluator(self):
         if self.evaluator is not None:

@@ -12,7 +12,10 @@ on device, shardable across chips over the 'model' mesh axis.
 from __future__ import annotations
 
 import functools
+import logging
+import os
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -21,7 +24,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
+from ...robustness import faults
+from ...robustness.guards import (
+    AllCandidatesFailedError, quarantine_non_finite,
+)
+from ...robustness.policy import FaultLog, FaultReport
+from ...utils.fidelity import ROUND4_MAX_EVAL_ROWS, round4_defaults
 from ...utils.padding import bucket_for
+
+logger = logging.getLogger(__name__)
 from ...ops.metrics import (
     aupr_masked, auroc_masked, binary_threshold_metrics_masked,
     log_loss_masked, multiclass_metrics_masked, regression_metrics_masked,
@@ -50,11 +61,15 @@ class ValidationResult:
 
 @dataclass
 class BestEstimator:
-    """Winner of validation (reference OpValidator.wrapBestEstimator :147)."""
+    """Winner of validation (reference OpValidator.wrapBestEstimator :147).
+    ``quarantined`` carries the records of candidates excluded from
+    selection (non-finite metrics or a fit that threw) — they surface in
+    ``ModelSelectorSummary`` with their failure reasons."""
     family_name: str
     hyper: Dict[str, Any]
     metric_value: float
     results: List[ValidationResult] = field(default_factory=list)
+    quarantined: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass
@@ -117,8 +132,26 @@ def _metric_fn(problem: str, metric: str, batched_y: bool = False,
 
 #: fused per-family sweep programs, keyed by (family, grid, fold/metric
 #: config) — reused across validate() calls so bench reps and repeated
-#: workflow fits pay one compile
-_FUSED_CACHE: Dict[Any, Any] = {}
+#: workflow fits pay one compile. LRU-bounded: each entry pins a jitted
+#: executable plus its tiled host grid constants, so a long-lived process
+#: fitting many distinct grids would otherwise grow compiled-program memory
+#: without bound (eviction just re-pays the pre-existing compile cost)
+_FUSED_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+_FUSED_CACHE_MAX = int(os.environ.get("TG_FUSED_CACHE_MAX", "32"))
+
+
+def _fused_cache_get(key):
+    prog = _FUSED_CACHE.get(key)
+    if prog is not None:
+        _FUSED_CACHE.move_to_end(key)
+    return prog
+
+
+def _fused_cache_put(key, prog) -> None:
+    _FUSED_CACHE[key] = prog
+    _FUSED_CACHE.move_to_end(key)
+    while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+        _FUSED_CACHE.popitem(last=False)
 
 
 def _make_fused_program(family, garr_np, G: int, F: int, problem: str,
@@ -186,9 +219,16 @@ class OpValidator:
     is its 8-thread Future pool (OpValidator.scala:318-333); here the
     parallel axes are mesh axes and XLA inserts the psum collectives."""
 
+    #: sentinel: "caller did not choose" — the constructor resolves it to
+    #: 32768 (round-5 default) or 65536 under TG_SWEEP_FIDELITY=round4
+    _EVAL_ROWS_DEFAULT = -1
+
     def __init__(self, seed: int = 42, stratify: bool = False, mesh=None,
-                 max_eval_rows: "Optional[int]" = 32768,
+                 max_eval_rows: "Optional[int]" = _EVAL_ROWS_DEFAULT,
                  exact_sweep_fits: bool = False):
+        if max_eval_rows == self._EVAL_ROWS_DEFAULT:
+            max_eval_rows = (ROUND4_MAX_EVAL_ROWS if round4_defaults()
+                             else 32768)
         self.seed = seed
         self.stratify = stratify
         self.mesh = mesh
@@ -359,8 +399,12 @@ class OpValidator:
                 self.mesh, P("data", *([None] * (X.ndim - 1)))))
             y = jax.device_put(y, row_sh)
 
-        pending: List[Any] = []
-        for family, grid in models:
+        def _dispatch(family, grid):
+            """One family's sweep branch → a pending (name, grid, metric
+            program output, B_true, G) entry. Runs under the quarantine
+            try/except below: a throw here (trace error, diverging fused
+            fit, injected fault) quarantines the family instead of
+            aborting the sweep."""
             G = len(grid)
             sliced_f = fold_sliced and getattr(family, "fold_sliced_predict",
                                                True)
@@ -371,7 +415,7 @@ class OpValidator:
                 key = (family, repr([sorted(g.items()) for g in grid]),
                        F, G, problem, metric_name, num_classes,
                        self.exact_sweep_fits, sliced_f, binned_f)
-                prog = _FUSED_CACHE.get(key)
+                prog = _fused_cache_get(key)
                 if prog is None:
                     garr_np = {k: np.asarray(v)
                                for k, v in family.grid_to_arrays(grid).items()}
@@ -379,14 +423,13 @@ class OpValidator:
                         family, garr_np, G, F, problem, metric_name,
                         num_classes, self.exact_sweep_fits, sliced_f,
                         binned_f)
-                    _FUSED_CACHE[key] = prog
+                    _fused_cache_put(key, prog)
                 if sliced_f:
                     Xf, yf, fvalid_d = _fold_data()
                     m = prog(X, y, ids_d, Xf, yf, fvalid_d)
                 else:
                     m = prog(X, y, ids_d)
-                pending.append((family.name, list(grid), m, F * G, G))
-                continue
+                return (family.name, list(grid), m, F * G, G)
             garr = family.grid_to_arrays(grid)                   # each (G,)
             # tile: config b = fold f * G + g
             W = jnp.repeat(train_w, G, axis=0)                   # (F*G, n)
@@ -444,43 +487,81 @@ class OpValidator:
             # defer host materialization: every family's full program queues
             # on the device back-to-back, then ONE sync reads all metrics
             # (a per-family sync costs a link round-trip each)
-            pending.append((family.name, list(grid), m, B_true, G))
+            return (family.name, list(grid), m, B_true, G)
+
+        # per-candidate quarantine at family granularity: a family's whole
+        # branch is one fused program, so a throw (trace error, diverging
+        # fit, injected fault) poisons all its configs — record the reason,
+        # keep a NaN placeholder, and let the sweep continue on the other
+        # families (the reference survives this via Spark task retries +
+        # lineage; only all-candidates-failed raises, aggregated, below)
+        pending: List[Any] = []
+        fit_failures: Dict[int, str] = {}
+        for fi, (family, grid) in enumerate(models):
+            try:
+                faults.inject("validator.family_fit", key=family.name)
+                pending.append(_dispatch(family, grid))
+            except Exception as e:
+                reason = f"fit raised {type(e).__name__}: {e}"
+                logger.warning("quarantining model family %s: %s",
+                               family.name, reason)
+                pending.append((family.name, list(grid), None,
+                                F * len(grid), len(grid)))
+                fit_failures[fi] = reason
 
         # fuse every family's metric vector into ONE device array so finish()
         # pays a single host transfer (measured ~70-130ms per warm transfer
         # over the tunneled backend — a per-family np.asarray was ~0.4s of
         # pure link latency on the 4-family default sweep)
-        all_m = (jnp.concatenate([p[2].reshape(-1) for p in pending])
-                 if len(pending) > 1 else None)
+        valid_m = [p[2] for p in pending if p[2] is not None]
+        all_m = (jnp.concatenate([m.reshape(-1) for m in valid_m])
+                 if len(valid_m) > 1 else None)
 
         def finish() -> BestEstimator:
+            from ...parallel.distributed import fetch_to_host
+
             # build the result list locally (not the closed-over `results`)
             # so resolving a PendingValidation twice cannot duplicate entries
             results: List[ValidationResult] = []
+            quarantined: List[Dict[str, Any]] = []
             best: Optional[BestEstimator] = None
-            m_host = np.asarray(all_m) if all_m is not None else None
+            m_host = fetch_to_host(all_m) if all_m is not None else None
             off = 0
-            for fam_name, grid_l, m, B_true, G in pending:
-                if m_host is not None:
+            for fi, (fam_name, grid_l, m, B_true, G) in enumerate(pending):
+                if m is None:  # the family's fit threw before dispatch
+                    fold_metrics = np.full((F, G), np.nan, dtype=np.float64)
+                elif m_host is not None:
                     m_fam = m_host[off:off + m.size]
                     off += m.size
+                    fold_metrics = m_fam[:B_true].reshape(F, G)
                 else:
-                    m_fam = np.asarray(m).reshape(-1)
-                fold_metrics = m_fam[:B_true].reshape(F, G)
-                mean_metrics = fold_metrics.mean(axis=0)
+                    m_fam = fetch_to_host(m).reshape(-1)
+                    fold_metrics = m_fam[:B_true].reshape(F, G)
+                fold_metrics = faults.poison("validator.fold_metrics",
+                                             fold_metrics, key=fam_name)
+                # non-finite guard: quarantine diverged configs instead of
+                # letting NaN elect itself (np.argmax ranks NaN as the max)
+                mean_metrics, masked_means, records = quarantine_non_finite(
+                    fam_name, grid_l, fold_metrics, metric_name,
+                    larger_better, reason=fit_failures.get(fi))
+                quarantined.extend(records)
                 results.append(ValidationResult(
                     family=fam_name, grid=grid_l, metric_name=metric_name,
                     fold_metrics=fold_metrics, mean_metrics=mean_metrics))
-                g_best = int(np.argmax(mean_metrics) if larger_better
-                             else np.argmin(mean_metrics))
+                if not np.isfinite(mean_metrics).any():
+                    continue  # whole family quarantined
+                g_best = int(np.argmax(masked_means) if larger_better
+                             else np.argmin(masked_means))
                 value = float(mean_metrics[g_best])
                 better = best is None or (
                     (value > best.metric_value) if larger_better
                     else (value < best.metric_value))
                 if better:
                     best = BestEstimator(fam_name, dict(grid_l[g_best]), value)
-            assert best is not None, "no models to validate"
+            if best is None:
+                raise AllCandidatesFailedError(quarantined)
             best.results = results
+            best.quarantined = quarantined
             return best
 
         if resolve:

@@ -39,6 +39,11 @@ class ModelFamily(abc.ABC):
     name: str = ""
     #: problem kinds: subset of {"binary", "multiclass", "regression"}
     supports: frozenset = frozenset()
+    #: fitted-param keys where ±inf is a STRUCTURAL sentinel, not divergence
+    #: (tree thresholds use +inf for "stopped node routes every row left");
+    #: the refit non-finite guard (robustness/guards.params_finite) checks
+    #: these keys for NaN only
+    inf_ok_params: tuple = ()
 
     @abc.abstractmethod
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:

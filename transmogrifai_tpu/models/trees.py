@@ -45,12 +45,18 @@ XGBoost's C++:
 """
 from __future__ import annotations
 
+import logging
+
 from functools import partial
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.fidelity import ROUND4_SWEEP_HIST_SAMPLE, round4_defaults
+
+logger = logging.getLogger(__name__)
 
 from ..ops.forest import (
     forest_leaf_sums, forest_leaf_sums_chain, forest_predict,
@@ -83,6 +89,47 @@ _SWEEP_HIST_SAMPLE = 8192
 #: fidelity_1m64.py ("Sweep fidelity" in docs/benchmarks.md)
 _SWEEP_RF_TREES = 16
 _SWEEP_GBT_ROUNDS = 12
+
+
+def _sweep_hist_sample() -> int:
+    """Sweep-time split-search sample rows; TG_SWEEP_FIDELITY=round4
+    restores the round-4 value (utils/fidelity.py)."""
+    return ROUND4_SWEEP_HIST_SAMPLE if round4_defaults() else _SWEEP_HIST_SAMPLE
+
+
+def _sweep_ensemble_cap(vals: np.ndarray, cap: int,
+                        param: str) -> Optional[np.ndarray]:
+    """Rank-consistent sweep-time ensemble capping.
+
+    All configs equal (the default grids): clamp uniformly to ``cap`` — the
+    CV estimate stays ensemble-size-consistent because every candidate gets
+    the same budget. Distinct values (a custom grid sweeping ensemble size):
+    a uniform clamp would fit every above-cap config byte-identically and
+    selection among them would silently degenerate to grid order, so the
+    sizes scale PROPORTIONALLY (max → cap, floor 1) instead, preserving the
+    grid's relative budgets; the warning flags that ranking across ensemble
+    sizes is then an approximation. Returns the capped per-config values, or
+    None when no cap applies (all values ≤ cap, or round-4 fidelity
+    defaults disable sweep caps)."""
+    if round4_defaults():
+        return None
+    vals = np.asarray(vals, dtype=np.float64)
+    vmax = float(vals.max())
+    if vmax <= cap:
+        return None
+    if np.unique(vals).size == 1:
+        return np.minimum(vals, float(cap))
+    scaled = np.maximum(1.0, np.round(vals * (cap / vmax)))
+    logger.warning(
+        "custom grid sweeps %s over distinct values %s above the sweep "
+        "ranking cap %d; candidates rank with proportionally scaled "
+        "ensembles %s (a uniform cap would make them byte-identical and "
+        "unrankable) and the winner refits at its full %s — an "
+        "approximation when ranking across ensemble sizes. Set "
+        "TG_SWEEP_FIDELITY=round4 to disable sweep ensemble caps.",
+        param, sorted(set(vals.tolist())), cap,
+        sorted(set(scaled.tolist())), param)
+    return scaled
 
 #: config-chunk sizing: batch configurations together until the deepest
 #: level's (sample rows x configs x trees x nodes) transient reaches this
@@ -636,7 +683,7 @@ def _prep_tree_inputs(X, y, n_bins, num_classes, task, full_bin=True,
     ``sweep`` halves the split-search sample (_SWEEP_HIST_SAMPLE)."""
     n = X.shape[0]
     samp = jnp.asarray(_sample_rows(
-        n, _SWEEP_HIST_SAMPLE if sweep else _HIST_SAMPLE))
+        n, _sweep_hist_sample() if sweep else _HIST_SAMPLE))
     Xs = X[samp]
     edges = _quantile_edges(Xs, n_bins)
     if full_bin:
@@ -1211,6 +1258,10 @@ def _g(grid, key, default):
 
 
 class _TreeFamilyBase(ModelFamily):
+    #: +inf thresholds are the "stopped node routes left" sentinel in both
+    #: the heap (thresh) and slot-chain (thresh_lv) layouts — legitimate
+    #: fitted state, exempted from the refit finite-params guard
+    inf_ok_params = ("thresh", "thresh_lv")
     #: config sweep runs under chunked lax.map (sequential per chip), so the
     #: batch axis cannot shard over the 'model' mesh axis; rows still shard.
     shardable = False
@@ -1501,15 +1552,20 @@ class RandomForestFamilyBase(_TreeFamilyBase):
 
     def fit_batch(self, X, y, weights, grid, num_classes, sweep=False):
         task = self._task(num_classes)
-        n_trees = int(np.max(np.asarray(_g(grid, "numTrees", 20.0))))
+        tree_vals = np.asarray(_g(grid, "numTrees", 20.0))
+        n_trees = int(tree_vals.max())
         B = weights.shape[0]
         seeds = jnp.arange(B, dtype=jnp.float32) + 7.0
         grid = dict(grid, _seeds=seeds)
-        if sweep and n_trees > _SWEEP_RF_TREES:
+        if sweep:
             # rank with a capped forest; the winner refits at full numTrees
-            n_trees = _SWEEP_RF_TREES
-            grid = dict(grid, numTrees=jnp.minimum(
-                jnp.asarray(_g(grid, "numTrees", 20.0)), float(n_trees)))
+            # (proportional per-config scaling when the grid sweeps
+            # numTrees itself — see _sweep_ensemble_cap)
+            capped = _sweep_ensemble_cap(tree_vals, _SWEEP_RF_TREES,
+                                         "numTrees")
+            if capped is not None:
+                n_trees = int(capped.max())
+                grid = dict(grid, numTrees=jnp.asarray(capped, jnp.float32))
         n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
         def fit_group(g, w, depth, slots=0):
@@ -1580,13 +1636,18 @@ class GBTFamilyBase(_TreeFamilyBase):
         # GBT trains entirely on the split-search sample: sweep and refit
         # are the same program
         task = self._gbt_task(num_classes)
-        n_rounds = int(np.max(np.asarray(_g(grid, "maxIter", 20.0))))
-        if sweep and n_rounds > _SWEEP_GBT_ROUNDS:
+        iter_vals = np.asarray(_g(grid, "maxIter", 20.0))
+        n_rounds = int(iter_vals.max())
+        if sweep:
             # rank with truncated boosting; the winner refits at full
-            # maxIter (boosting rounds are the sweep's serial-step floor)
-            n_rounds = _SWEEP_GBT_ROUNDS
-            grid = dict(grid, maxIter=jnp.minimum(
-                jnp.asarray(_g(grid, "maxIter", 20.0)), float(n_rounds)))
+            # maxIter (boosting rounds are the sweep's serial-step floor;
+            # proportional per-config scaling when the grid sweeps maxIter
+            # itself — see _sweep_ensemble_cap)
+            capped = _sweep_ensemble_cap(iter_vals, _SWEEP_GBT_ROUNDS,
+                                         "maxIter")
+            if capped is not None:
+                n_rounds = int(capped.max())
+                grid = dict(grid, maxIter=jnp.asarray(capped, jnp.float32))
         n_slots = _SWEEP_SLOTS if sweep else _REFIT_SLOTS
 
         def one_raw(g, w, depth, slots=0):
@@ -1618,7 +1679,7 @@ class GBTFamilyBase(_TreeFamilyBase):
             # stages of it alive (observed 24.5 GB on the fidelity
             # experiment's exact arm)
             S_est = min(X.shape[0],
-                        _SWEEP_HIST_SAMPLE if sweep else _HIST_SAMPLE)
+                        _sweep_hist_sample() if sweep else _HIST_SAMPLE)
             lanes_max = max((1 << 29) // max(S_est, 1), 192)
             cb = int(max(1, min(cb, lanes_max // (3 * nodes_w * C_g))))
             if cb >= B_g:

@@ -83,6 +83,43 @@ def initialize(coordinator_address: Optional[str] = None,
         raise
 
 
+def fetch_to_host(arr, policy=None, site: str = "distributed.to_host"):
+    """Device→host transfer guarded by a retry policy.
+
+    On tunneled backends the host link is the flakiest hop of the training
+    path (transient UNAVAILABLE / connection resets); a failed metric
+    transfer used to abort the whole sweep even though the device result was
+    intact and re-readable. Retries re-issue only the transfer — device
+    state is untouched. Deterministic fault site: ``distributed.to_host``."""
+    import numpy as np
+
+    from ..robustness import faults
+    from ..robustness.policy import RetryPolicy
+    policy = policy or RetryPolicy(base_delay=0.01)
+
+    def pull():
+        faults.inject(site)
+        return np.asarray(arr)
+
+    return policy.execute(pull, site=site)
+
+
+def retrying_device_put(x, sharding=None, policy=None,
+                        site: str = "distributed.device_put"):
+    """Host→device placement guarded by a retry policy (the dual of
+    :func:`fetch_to_host`). Fault site: ``distributed.device_put``."""
+    from ..robustness import faults
+    from ..robustness.policy import RetryPolicy
+    policy = policy or RetryPolicy(base_delay=0.01)
+
+    def put():
+        faults.inject(site)
+        return (jax.device_put(x, sharding) if sharding is not None
+                else jax.device_put(x))
+
+    return policy.execute(put, site=site)
+
+
 def is_primary() -> bool:
     """True on the process that should write models/metrics (the reference's
     driver role)."""

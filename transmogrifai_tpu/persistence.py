@@ -469,11 +469,18 @@ def save_stage_checkpoint(stage: OpPipelineStage, ckpt_dir: str) -> None:
 
 def load_stage_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
     """Load every stage checkpoint in ``ckpt_dir``, keyed by uid. Corrupt or
-    partially-written entries are skipped (they refit instead)."""
+    partially-written entries (a crash mid-``np.savez``, a truncated copy)
+    are skipped with a logged warning and a ``checkpoint_skipped``
+    FaultReport — the stage refits from data instead of the whole resume
+    crashing on state it can deterministically rebuild."""
+    import logging
+
+    from .robustness.policy import FaultLog, FaultReport
+    logger = logging.getLogger(__name__)
     out: Dict[str, OpPipelineStage] = {}
     if not os.path.isdir(ckpt_dir):
         return out
-    for fname in os.listdir(ckpt_dir):
+    for fname in sorted(os.listdir(ckpt_dir)):
         if not fname.endswith(".json"):
             continue
         uid = fname[:-5]
@@ -484,6 +491,13 @@ def load_stage_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
                          allow_pickle=False) as npz:
                 arrays = dict(npz)
             out[uid] = stage_from_json(desc, arrays)
-        except Exception:
+        except Exception as e:
+            logger.warning(
+                "skipping corrupt stage checkpoint %s in %s (%s: %s); the "
+                "stage will refit", uid, ckpt_dir, type(e).__name__, e)
+            FaultLog.record(FaultReport(
+                site="persistence.checkpoint", kind="checkpoint_skipped",
+                detail={"uid": uid, "dir": ckpt_dir,
+                        "error": f"{type(e).__name__}: {e}"}))
             continue
     return out

@@ -1,0 +1,27 @@
+"""Fault-isolated execution: retry policies, non-finite guards, and a
+deterministic fault-injection harness.
+
+The reference keeps long AutoML sweeps alive on flaky Spark executors via
+task retries and lineage recomputation (reference: spark.task.maxFailures,
+RDD lineage). The TPU rebuild replaced that substrate with jitted device
+programs, so resilience has to be rebuilt at the framework layer:
+
+* :mod:`.policy` — ``RetryPolicy`` (exponential backoff + deterministic
+  jitter, per-attempt deadline, transient-vs-fatal classification) plus the
+  ``FaultReport`` record and the train-scoped ``FaultLog``;
+* :mod:`.guards` — non-finite guards over candidate CV metrics and fitted
+  params, producing quarantine records instead of crashes;
+* :mod:`.faults` — env/config-driven deterministic fault injection (named
+  sites, fail-Nth-call, NaN poisoning) so every recovery path is testable
+  on CPU (``JAX_PLATFORMS=cpu``, ``TG_CHAOS=1``).
+
+See docs/robustness.md for the fault-policy contract, the injection-site
+table, and the ``summary()["faults"]`` schema.
+"""
+from . import faults  # noqa: F401
+from .guards import (  # noqa: F401
+    AllCandidatesFailedError, params_finite, quarantine_non_finite,
+)
+from .policy import (  # noqa: F401
+    FaultLog, FaultReport, RetryPolicy, is_transient_error,
+)

@@ -1,0 +1,181 @@
+"""Deterministic fault-injection harness.
+
+Every recovery path in the framework is exercised through *named sites*
+compiled into the production code (``inject``/``poison`` calls). A site is
+completely inert — one dict lookup on an empty dict — unless a
+:class:`FaultSpec` is armed for it, either programmatically
+(:func:`configure` / the :func:`injected` context manager, used by the
+``chaos``-marked tests) or via environment::
+
+    TG_CHAOS=1 TG_FAULTS='{"distributed.to_host": {"mode": "raise", "nth": 1, "count": 2}}'
+
+The env path is gated on ``TG_CHAOS`` so a leaked ``TG_FAULTS`` can never
+arm sites in a production process; ``tests/conftest.py`` additionally
+asserts no sites are active around every non-chaos test.
+
+Determinism: sites fire purely on call counters (fail the Nth..Nth+count-1
+matching calls) — no clocks, no randomness — so a chaos test replays the
+exact same fault sequence on every run.
+
+Injection sites (see docs/robustness.md for the full table):
+
+===========================  ====================================================
+site                         fires in
+===========================  ====================================================
+``validator.family_fit``     per model family, before its sweep branch dispatches
+``validator.fold_metrics``   per family, on the host (F, G) CV metric matrix
+                             (``nan`` mode poisons candidate metrics)
+``selector.refit``           before the winner's full-data refit
+``dag.stage_fit``            before each estimator fit in the DAG
+``distributed.to_host``      before each guarded device→host transfer
+``distributed.device_put``   before each guarded host→device placement
+===========================  ====================================================
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: chaos gate: the env-driven spec (TG_FAULTS) is honored only when this is
+#: set, so fault hooks can never arm themselves in a production process
+CHAOS_ENV = "TG_CHAOS"
+#: JSON dict {site: spec-dict} (see FaultSpec fields)
+SPEC_ENV = "TG_FAULTS"
+
+
+class TransientFaultError(RuntimeError):
+    """Injected error classified transient by RetryPolicy (a stand-in for
+    device-transfer hiccups: UNAVAILABLE / DEADLINE_EXCEEDED / link resets)."""
+
+
+class InjectedFaultError(RuntimeError):
+    """Injected error classified fatal (never retried)."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed site.
+
+    ``mode``: ``"raise"`` (throw from :func:`inject`) or ``"nan"`` (poison
+    the array passed to :func:`poison`). ``nth``/``count``: fire on matching
+    calls nth..nth+count-1 (1-based). ``key``: only fire when the call's
+    ``key`` matches (None = any). ``index``: nan mode — flat index to
+    poison; None poisons the whole array. ``transient``: raise mode — throw
+    :class:`TransientFaultError` (retryable) vs :class:`InjectedFaultError`.
+    """
+    site: str
+    mode: str = "raise"
+    nth: int = 1
+    count: int = 1
+    key: Optional[str] = None
+    index: Optional[int] = 0
+    transient: bool = True
+
+
+_LOCK = threading.Lock()
+_SPECS: Dict[str, FaultSpec] = {}
+_CALLS: Dict[str, int] = {}
+_ENV_LOADED = False
+
+
+def _load_env() -> None:
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    _ENV_LOADED = True
+    raw = os.environ.get(SPEC_ENV)
+    if not raw:
+        return
+    if not os.environ.get(CHAOS_ENV):
+        logger.warning(
+            "%s is set but %s is not: ignoring fault-injection spec (sites "
+            "stay inert outside chaos runs)", SPEC_ENV, CHAOS_ENV)
+        return
+    configure(json.loads(raw))
+
+
+def configure(specs: Dict[str, Dict[str, Any]]) -> None:
+    """Arm sites from {site: spec-dict}; resets all call counters."""
+    with _LOCK:
+        for site, kv in specs.items():
+            _SPECS[site] = FaultSpec(site=site, **kv)
+        _CALLS.clear()
+
+
+def clear() -> None:
+    """Disarm every site and reset counters."""
+    with _LOCK:
+        _SPECS.clear()
+        _CALLS.clear()
+
+
+def active_sites() -> List[str]:
+    """Names of currently-armed sites (empty in production)."""
+    _load_env()
+    return sorted(_SPECS)
+
+
+@contextlib.contextmanager
+def injected(specs: Dict[str, Dict[str, Any]]):
+    """Arm ``specs`` for the duration of the block, then disarm everything
+    (the chaos tests' entry point)."""
+    configure(specs)
+    try:
+        yield
+    finally:
+        clear()
+
+
+def _fires(site: str, key: Optional[str]) -> Optional[FaultSpec]:
+    spec = _SPECS.get(site)
+    if spec is None:
+        return None
+    if spec.key is not None and key != spec.key:
+        return None
+    with _LOCK:
+        n = _CALLS.get(site, 0) + 1
+        _CALLS[site] = n
+    if spec.nth <= n < spec.nth + spec.count:
+        return spec
+    return None
+
+
+def inject(site: str, key: Optional[str] = None) -> None:
+    """Raise the armed fault for ``site`` if its spec fires on this call.
+    Inert (one falsy dict check) when nothing is armed."""
+    if not _SPECS and _ENV_LOADED:
+        return
+    _load_env()
+    spec = _fires(site, key)
+    if spec is None or spec.mode != "raise":
+        return
+    exc = TransientFaultError if spec.transient else InjectedFaultError
+    raise exc(f"injected fault at site '{site}'"
+              + (f" (key={key})" if key else ""))
+
+
+def poison(site: str, arr: np.ndarray, key: Optional[str] = None) -> np.ndarray:
+    """Return ``arr`` with NaN poisoning applied if the armed ``nan`` spec
+    for ``site`` fires on this call; otherwise return ``arr`` untouched."""
+    if not _SPECS and _ENV_LOADED:
+        return arr
+    _load_env()
+    spec = _fires(site, key)
+    if spec is None or spec.mode != "nan":
+        return arr
+    out = np.array(arr, dtype=np.float64 if arr.dtype.kind != "f"
+                   else arr.dtype, copy=True)
+    if spec.index is None:
+        out[...] = np.nan
+    else:
+        out.reshape(-1)[spec.index] = np.nan
+    return out

@@ -1,0 +1,90 @@
+"""Non-finite guards: quarantine diverging candidates instead of crashing.
+
+A GLM candidate whose loss diverges, a tree whose leaf stats overflow, or a
+poisoned metric (faults.py) all surface as non-finite CV metrics or fitted
+params. The guards turn each into a quarantine record — the sweep continues
+on the remaining candidates — and only the all-candidates-failed case
+raises, with every reason aggregated.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .policy import FaultLog, FaultReport
+
+
+class AllCandidatesFailedError(RuntimeError):
+    """Every candidate of the sweep was quarantined; carries the aggregated
+    per-candidate reasons so one traceback explains the whole failure."""
+
+    def __init__(self, records: List[Dict[str, Any]]):
+        self.records = list(records)
+        lines = [f"  - {r.get('family')}[{r.get('gridIndex')}] "
+                 f"{r.get('hyper')}: {r.get('reason')}" for r in self.records]
+        super().__init__(
+            "all %d sweep candidate(s) were quarantined:\n%s"
+            % (len(self.records), "\n".join(lines)))
+
+
+def quarantine_non_finite(family: str, grid: List[Dict[str, Any]],
+                          fold_metrics: np.ndarray, metric_name: str,
+                          larger_better: bool,
+                          reason: Optional[str] = None,
+                          ) -> Tuple[np.ndarray, np.ndarray,
+                                     List[Dict[str, Any]]]:
+    """Validate one family's (F, G) CV metric matrix.
+
+    Returns ``(mean_metrics, masked_means, records)``: per-config means (NaN
+    preserved for reporting), the means with non-finite entries replaced by
+    the worst possible value (so argmax/argmin never elects a quarantined
+    config — plain np.argmax treats NaN as the maximum), and one quarantine
+    record per non-finite config. When every config is finite the masked
+    means equal the raw means bit-for-bit, keeping selection byte-identical
+    to the unguarded path."""
+    mean_metrics = fold_metrics.mean(axis=0)
+    finite = np.isfinite(mean_metrics)
+    records: List[Dict[str, Any]] = []
+    for g in np.nonzero(~finite)[0]:
+        rec = {
+            "family": family,
+            "gridIndex": int(g),
+            "hyper": dict(grid[g]) if g < len(grid) else {},
+            "metricName": metric_name,
+            "foldMetrics": [float(v) for v in fold_metrics[:, g]],
+            "reason": reason or ("non-finite validation metric "
+                                 f"({mean_metrics[g]!r})"),
+        }
+        records.append(rec)
+        FaultLog.record(FaultReport(site="validator.candidate",
+                                    kind="quarantine", detail=rec))
+    if finite.all():
+        return mean_metrics, mean_metrics, records
+    worst = -np.inf if larger_better else np.inf
+    return mean_metrics, np.where(finite, mean_metrics, worst), records
+
+
+def params_finite(params: Dict[str, Any], allow_inf: Sequence[str] = ()
+                  ) -> bool:
+    """True when every float leaf of a fitted param pytree is finite. Keys
+    in ``allow_inf`` (a family's ``inf_ok_params`` — e.g. tree thresholds,
+    where +inf is the "stopped node" sentinel) are checked for NaN only.
+    The reduction runs on device; only one scalar per leaf crosses the
+    link."""
+    import jax.numpy as jnp
+    for k, v in params.items():
+        if isinstance(v, dict):
+            if not params_finite(v, allow_inf):
+                return False
+            continue
+        try:
+            arr = jnp.asarray(v)
+        except (TypeError, ValueError):
+            continue
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            ok = (jnp.logical_not(jnp.any(jnp.isnan(arr)))
+                  if k in allow_inf else jnp.all(jnp.isfinite(arr)))
+            if not bool(ok):
+                return False
+    return True

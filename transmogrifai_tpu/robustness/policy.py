@@ -1,0 +1,178 @@
+"""Retry policies and fault accounting.
+
+The reference rides Spark's ``spark.task.maxFailures`` + lineage
+recomputation; here retries are explicit: :class:`RetryPolicy` re-runs a
+named operation on *transient* failures (device-transfer hiccups, link
+resets, injected :class:`~.faults.TransientFaultError`) with exponential
+backoff and deterministic jitter, and every recovery — retry, quarantine,
+skipped checkpoint — is recorded as a :class:`FaultReport` in the
+train-scoped :class:`FaultLog` that ``OpWorkflowModel.summary()["faults"]``
+surfaces.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .faults import InjectedFaultError, TransientFaultError
+
+#: substrings (lowercased) marking an error transient: the gRPC-style
+#: status codes surfaced by jax/PJRT transfer failures plus socket-level
+#: resets on tunneled backends
+TRANSIENT_PATTERNS = (
+    "unavailable", "deadline exceeded", "deadline_exceeded", "data_loss",
+    "connection reset", "connection refused", "broken pipe", "socket",
+    "temporarily", "transfer failed", "resource temporarily",
+)
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """Default transient-vs-fatal classification: explicit transient marker
+    types, OS-level I/O interruptions, and runtime errors whose message
+    carries a retryable transport status. Everything else — ValueError,
+    shape/trace errors, injected fatal faults — is fatal: retrying a
+    deterministic program on the same inputs cannot fix those."""
+    if isinstance(exc, InjectedFaultError):
+        return False
+    if isinstance(exc, (TransientFaultError, ConnectionError, TimeoutError,
+                        BrokenPipeError, InterruptedError)):
+        return True
+    if isinstance(exc, OSError):
+        return True
+    msg = str(exc).lower()
+    # XlaRuntimeError (jaxlib) carries the PJRT status in its message
+    if type(exc).__name__ == "XlaRuntimeError" or isinstance(exc, RuntimeError):
+        return any(p in msg for p in TRANSIENT_PATTERNS)
+    return False
+
+
+@dataclass
+class FaultReport:
+    """One recovery event. ``kind``: ``retry`` (operation succeeded after
+    ``attempts - 1`` retries), ``quarantine`` (candidate/family excluded
+    from selection), ``checkpoint_skipped`` (corrupt stage checkpoint
+    ignored on resume), or ``fatal`` (retries exhausted / unretryable)."""
+    site: str
+    kind: str
+    detail: Dict[str, Any] = field(default_factory=dict)
+    attempts: int = 1
+
+    @property
+    def retries(self) -> int:
+        return max(0, self.attempts - 1)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"site": self.site, "kind": self.kind,
+                "attempts": self.attempts, "retries": self.retries,
+                "detail": dict(self.detail)}
+
+
+_CURRENT_LOG: "contextvars.ContextVar[Optional[FaultLog]]" = \
+    contextvars.ContextVar("tg_fault_log", default=None)
+
+
+class FaultLog:
+    """Train-scoped accumulator of :class:`FaultReport` records.
+
+    ``OpWorkflow.train`` activates one log around the whole fit; components
+    deep in the stack (validators, transfer helpers, checkpoint loader)
+    record through the ambient :meth:`record` without threading the log
+    through every signature. Recording without an active log is a no-op, so
+    library code never needs to guard."""
+
+    def __init__(self):
+        self.reports: List[FaultReport] = []
+
+    @contextlib.contextmanager
+    def activate(self):
+        token = _CURRENT_LOG.set(self)
+        try:
+            yield self
+        finally:
+            _CURRENT_LOG.reset(token)
+
+    @staticmethod
+    def record(report: FaultReport) -> None:
+        log = _CURRENT_LOG.get()
+        if log is not None:
+            log.reports.append(report)
+
+    def of_kind(self, kind: str) -> List[FaultReport]:
+        return [r for r in self.reports if r.kind == kind]
+
+    def to_json(self) -> Dict[str, Any]:
+        """The ``summary()["faults"]`` section (schema: docs/robustness.md)."""
+        return {
+            "quarantined": [r.to_json() for r in self.of_kind("quarantine")],
+            "retries": [r.to_json() for r in self.of_kind("retry")],
+            "checkpointsSkipped": [r.to_json()
+                                   for r in self.of_kind("checkpoint_skipped")],
+            "fatal": [r.to_json() for r in self.of_kind("fatal")],
+        }
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + deterministic jitter over transient failures.
+
+    ``attempt_deadline``: an attempt whose wall-clock exceeds it is not
+    retried even on a transient error — a stuck link that ate the whole
+    budget should fail loud, not double the hang. ``classify`` overrides
+    the default :func:`is_transient_error`."""
+    max_retries: int = 2
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.25
+    attempt_deadline: Optional[float] = None
+    classify: Optional[Callable[[BaseException], bool]] = None
+
+    def is_transient(self, exc: BaseException) -> bool:
+        return (self.classify or is_transient_error)(exc)
+
+    def delay_for(self, attempt: int, site: str) -> float:
+        """Deterministic backoff: exponential in the attempt number, jittered
+        by a hash of (site, attempt) — reproducible across runs, while
+        distinct sites still decorrelate (no thundering herd on a shared
+        coordinator)."""
+        d = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        if self.jitter:
+            h = hashlib.md5(f"{site}:{attempt}".encode()).digest()
+            frac = h[0] / 255.0
+            d *= 1.0 + self.jitter * frac
+        return d
+
+    def execute(self, fn: Callable[[], Any], site: str) -> Any:
+        """Run ``fn``; on transient failure back off and retry up to
+        ``max_retries`` times. Success after >=1 retry records a ``retry``
+        FaultReport; exhaustion or a fatal error records ``fatal`` and
+        re-raises the last exception."""
+        errors: List[str] = []
+        attempt = 0
+        while True:
+            t0 = time.monotonic()
+            try:
+                out = fn()
+            except Exception as e:
+                elapsed = time.monotonic() - t0
+                errors.append(f"{type(e).__name__}: {e}")
+                over_deadline = (self.attempt_deadline is not None
+                                 and elapsed > self.attempt_deadline)
+                if (not self.is_transient(e) or attempt >= self.max_retries
+                        or over_deadline):
+                    FaultLog.record(FaultReport(
+                        site=site, kind="fatal", attempts=attempt + 1,
+                        detail={"errors": errors,
+                                "overDeadline": over_deadline}))
+                    raise
+                time.sleep(self.delay_for(attempt, site))
+                attempt += 1
+                continue
+            if attempt:
+                FaultLog.record(FaultReport(
+                    site=site, kind="retry", attempts=attempt + 1,
+                    detail={"errors": errors}))
+            return out

@@ -1,0 +1,26 @@
+"""Sweep-fidelity switch.
+
+Round 5's throughput defaults stack three approximations on the CV sweep
+(32k-row metric estimates, an 8k-row split-search sample, 16-tree RF /
+12-round GBT ranking ensembles). Each is fidelity-gated individually, but
+their COMBINED delta vs the round-4 defaults is what a caller comparing
+selections across versions actually experiences (docs/benchmarks.md "Sweep
+fidelity"). ``TG_SWEEP_FIDELITY=round4`` restores the round-4 defaults in
+one switch: ``max_eval_rows=65536``, split-search sample 16384, no
+ensemble caps. The env is read at call time so tests (and long-lived
+processes) can flip it without re-importing.
+"""
+from __future__ import annotations
+
+import os
+
+ENV = "TG_SWEEP_FIDELITY"
+
+#: round-4 default values restored by the switch
+ROUND4_MAX_EVAL_ROWS = 65536
+ROUND4_SWEEP_HIST_SAMPLE = 16384
+
+
+def round4_defaults() -> bool:
+    """True when the process opted into round-4 fidelity defaults."""
+    return os.environ.get(ENV, "").lower() in ("round4", "r4", "high")

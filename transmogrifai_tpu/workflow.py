@@ -139,6 +139,18 @@ class OpWorkflow(_WorkflowCore):
         self._checkpoint_dir = path
         return self
 
+    def with_fault_policy(self, policy=None) -> "OpWorkflow":
+        """Fault-isolated training: per-stage retries for TRANSIENT errors
+        under ``policy`` (a ``robustness.RetryPolicy``; default policy when
+        None), on top of the always-on guards (candidate quarantine,
+        guarded transfers, checkpoint skip-and-log). Every recovery is
+        recorded and surfaced in ``model.summary()["faults"]`` — the TPU
+        build's analog of the reference riding ``spark.task.maxFailures`` +
+        lineage recomputation (docs/robustness.md)."""
+        from .robustness.policy import RetryPolicy
+        self._fault_policy = policy or RetryPolicy()
+        return self
+
     def with_mesh(self, mesh) -> "OpWorkflow":
         """Distribute training over a ('data', 'model') device mesh: every
         stage exposing ``set_mesh`` (ModelSelector — rows over 'data',
@@ -187,7 +199,17 @@ class OpWorkflow(_WorkflowCore):
 
     def train(self) -> "OpWorkflowModel":
         """Materialize raw data, fit the DAG, return the fitted model
-        (reference OpWorkflow.train:332-357)."""
+        (reference OpWorkflow.train:332-357). The whole fit runs under an
+        activated FaultLog: retries, quarantines and skipped checkpoints
+        recorded anywhere in the stack surface in ``summary()["faults"]``."""
+        from .robustness.policy import FaultLog
+        fault_log = FaultLog()
+        with fault_log.activate():
+            model = self._train_logged()
+        model._fault_log = fault_log
+        return model
+
+    def _train_logged(self) -> "OpWorkflowModel":
         if not self.result_features:
             raise ValueError("call set_result_features before train")
         table = self._generate_raw_table()
@@ -220,13 +242,15 @@ class OpWorkflow(_WorkflowCore):
                                       save_stage_checkpoint)
             preloaded = load_stage_checkpoints(ckpt_dir)
             checkpoint = lambda model: save_stage_checkpoint(model, ckpt_dir)
+        retry_policy = getattr(self, "_fault_policy", None)
         if self._workflow_cv:
             table, fitted = self._fit_with_workflow_cv(table, layers)
         else:
             table, fitted = fit_and_transform_dag(table, layers,
                                                   profiler=self.profiler,
                                                   checkpoint=checkpoint,
-                                                  preloaded=preloaded)
+                                                  preloaded=preloaded,
+                                                  retry_policy=retry_policy)
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in result_features)
         model = OpWorkflowModel()
@@ -288,11 +312,13 @@ class OpWorkflow(_WorkflowCore):
         tainted_stage_uids = {f_.origin_stage.uid for f_ in ordered
                               if tainted[f_.uid] and not f_.is_raw}
 
+        retry_policy = getattr(self, "_fault_policy", None)
         before_layers = [[(s, d) for s, d in layer
                           if s.uid not in tainted_stage_uids]
                          for layer in layers]
         table1, fitted_before = fit_and_transform_dag(
-            table, before_layers, profiler=self.profiler)
+            table, before_layers, profiler=self.profiler,
+            retry_policy=retry_policy)
 
         # the in-CV DAG refit per fold: tainted estimator stages on the
         # selector-input ancestry (not the selector, not its downstream)
@@ -311,7 +337,8 @@ class OpWorkflow(_WorkflowCore):
         try:
             sel.find_best_estimator(table1, during_layers)
             table2, fitted_rest = fit_and_transform_dag(
-                table1, rest_layers, profiler=self.profiler)
+                table1, rest_layers, profiler=self.profiler,
+                retry_policy=retry_policy)
         except Exception:
             # don't leave a recorded winner behind: a later plain train()
             # on the same stage objects must validate from scratch, not
@@ -383,6 +410,9 @@ class OpWorkflowModel(_WorkflowCore):
         self.train_table: Optional[FeatureTable] = None
         self.rff_results = None
         self.profiler = None
+        #: train-scoped fault accounting (robustness.FaultLog); None for
+        #: models loaded from disk — wiring state, never serialized
+        self._fault_log = None
 
     @property
     def stages(self) -> List[Any]:
@@ -441,6 +471,12 @@ class OpWorkflowModel(_WorkflowCore):
             md = getattr(stage, "summary_metadata", None)
             if md:
                 out[stage.uid] = md
+        # fault accounting for THIS train run: quarantined candidates,
+        # successful retries, skipped checkpoints (docs/robustness.md; empty
+        # sections for models loaded from disk — the log is train-scoped)
+        from .robustness.policy import FaultLog
+        log = getattr(self, "_fault_log", None)
+        out["faults"] = (log or FaultLog()).to_json()
         return out
 
     def summary_json(self) -> str:
