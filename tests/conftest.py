@@ -49,7 +49,11 @@ def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray
     TG_FAULTS env without TG_CHAOS) would poison unrelated tests' — and
-    production paths' — behavior silently."""
+    production paths' — behavior silently. Covers every registered site,
+    the ``preempt.*`` preemption sites included — a leaked armed
+    SimulatedPreemption would kill an unrelated test's train() mid-DAG —
+    and the call counters, so a later chaos test never inherits a stale
+    fire position."""
     import os as _os
 
     from transmogrifai_tpu.robustness import faults
@@ -60,6 +64,9 @@ def _no_fault_injection_leak(request):
         assert not faults.active_sites(), (
             "fault-injection sites are armed outside a chaos test: "
             f"{faults.active_sites()}")
+        assert not faults._CALLS, (
+            "fault-injection call counters leaked from a previous test: "
+            f"{dict(faults._CALLS)}")
     yield
     if not is_chaos:
         assert not faults.active_sites(), (
@@ -67,5 +74,6 @@ def _no_fault_injection_leak(request):
             f"{faults.active_sites()}")
     else:
         # belt and braces: a chaos test that failed before its injected()
-        # context exited must not poison the rest of the session
+        # context exited — or died at an injected preemption — must not
+        # poison the rest of the session
         faults.clear()

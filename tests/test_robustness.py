@@ -113,7 +113,8 @@ def test_fault_log_inactive_record_is_noop():
     FaultLog.record(FaultReport(site="s", kind="retry"))  # must not raise
     log = FaultLog()
     assert log.to_json() == {"quarantined": [], "retries": [],
-                             "checkpointsSkipped": [], "fatal": []}
+                             "checkpointsSkipped": [], "restored": [],
+                             "fatal": []}
 
 
 # ---------------------------------------------------------------------------

@@ -92,6 +92,7 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
     Returns (transformed table, {estimator uid → fitted model}).
     """
     from .robustness import faults
+    from .robustness.policy import FaultLog, FaultReport
     prof = profiler or _NULL_PROFILER
     pre = preloaded or {}
     fitted: Dict[str, Any] = {}
@@ -104,8 +105,18 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                     # re-wire onto this DAG's features (uids match)
                     model.input_features = stage.input_features
                     model._output_feature = stage.get_output()
+                    # resume accounting: this stage's fit was skipped in
+                    # favor of verified checkpoint state —
+                    # summary()["resume"] reports restored vs refit
+                    FaultLog.record(FaultReport(
+                        site="dag.stage_fit", kind="restored",
+                        detail={"uid": stage.uid,
+                                "stage": type(stage).__name__}))
                 else:
                     def _fit(stage=stage, li=li):
+                        # deterministic preemption point: the process dies
+                        # mid-DAG with earlier stages already checkpointed
+                        faults.inject("preempt.stage_fit", key=stage.uid)
                         faults.inject("dag.stage_fit", key=stage.uid)
                         with prof.track(stage, "fit", li):
                             return stage.fit(table)

@@ -110,6 +110,18 @@ class ModelSelector(AllowLabelAsInput, Estimator):
         self.mesh = mesh
         return self
 
+    def set_sweep_checkpoint(self, ckpt) -> "ModelSelector":
+        """Preemption-tolerant sweeps (wired by ``with_checkpoint_dir``):
+        every evaluated candidate batch persists its fold metrics to the
+        given :class:`~...impl.tuning.sweep_checkpoint.SweepCheckpoint` as
+        it completes, and a resumed ``train()`` replays the persisted
+        records — fingerprint-matched to the data, folds, and sweep config
+        — instead of re-running them (docs/robustness.md "Resumable
+        sweeps"). Train-time wiring only; never serialized with the fitted
+        model."""
+        self.validator._sweep_ckpt = ckpt
+        return self
+
     def _resolve_models(self, models):
         resolved: List[Tuple[ModelFamily, List[Dict[str, Any]]]] = []
         from ...models import glm, trees  # noqa: F401 (registers families)
@@ -330,6 +342,11 @@ class ModelSelector(AllowLabelAsInput, Estimator):
             best = self.validator.validate(
                 self.models, Xd, yd, self.problem, metric_name, larger_better,
                 num_classes)
+
+        # deterministic preemption point: the sweep completed (and, under a
+        # checkpoint dir, persisted) but the winner never refit — a resume
+        # replays the sweep from disk and goes straight to the refit
+        faults.inject("preempt.refit")
 
         # refit winner on full prepared train (reference :158-159); rows
         # bucket-padded with zero weights for compile reuse

@@ -290,6 +290,26 @@ class OpValidator:
             val_masks = self.make_splits(np.asarray(y))  # (F, n)
         F, n = val_masks.shape
         vm_np = np.asarray(val_masks)
+        # sweep-level checkpointing (wired by the workflow through the
+        # selector): fingerprint this run BEFORE padding so a persisted
+        # candidate record can only replay onto identical data/folds/config
+        sweep_ckpt = getattr(self, "_sweep_ckpt", None)
+        fingerprint = None
+        if sweep_ckpt is not None:
+            import hashlib as _hashlib
+            fingerprint = {
+                "n": int(n), "F": int(F), "problem": problem,
+                "d": int(X.shape[-1]) if X.ndim > 1 else 1,
+                "metric": metric_name, "numClasses": int(num_classes),
+                "largerBetter": bool(larger_better),
+                "exact": bool(self.exact_sweep_fits),
+                "maxEvalRows": self.max_eval_rows,
+                "yhash": _hashlib.sha256(
+                    np.ascontiguousarray(np.asarray(y)[:n])
+                    .tobytes()).hexdigest(),
+                "foldHash": _hashlib.sha256(
+                    np.ascontiguousarray(vm_np).tobytes()).hexdigest(),
+            }
         # bucket the row count so every fit/predict/metric program is reused
         # across datasets/folds/stages (utils/padding.py); under a mesh the
         # bucket also aligns to the data axis for equal shards. Pad rows
@@ -497,8 +517,43 @@ class OpValidator:
         # lineage; only all-candidates-failed raises, aggregated, below)
         pending: List[Any] = []
         fit_failures: Dict[int, str] = {}
+        #: host-resident (F, G) metrics by family index — filled by sweep
+        #: checkpoint restore AND by the eager per-family fetch that
+        #: checkpointing requires (durability costs the single-sync
+        #: batching: each family's metrics must reach the host — and disk —
+        #: before the next family runs, or a preemption loses them)
+        host_metrics: Dict[int, np.ndarray] = {}
         for fi, (family, grid) in enumerate(models):
+            ckey = None
+            if sweep_ckpt is not None:
+                from .sweep_checkpoint import SweepCheckpoint, candidate_key
+                ckey = candidate_key(family.name, list(grid), fingerprint)
+                rec = sweep_ckpt.get(ckey)
+                if rec is not None:
+                    fm = SweepCheckpoint.decode_metrics(rec)
+                    if fm.shape == (F, len(grid)):
+                        host_metrics[fi] = fm
+                        if rec.get("quarantined"):
+                            fit_failures[fi] = (rec.get("reason")
+                                                or "restored quarantined "
+                                                   "candidate")
+                        pending.append((family.name, list(grid), None,
+                                        F * len(grid), len(grid)))
+                        FaultLog.record(FaultReport(
+                            site="sweep.candidate", kind="restored",
+                            detail={"family": family.name,
+                                    "configs": len(grid),
+                                    "candidateKey": ckey[:16],
+                                    "quarantined": bool(
+                                        rec.get("quarantined"))}))
+                        logger.info(
+                            "sweep resume: restored %d %s candidate(s) "
+                            "from checkpoint", len(grid), family.name)
+                        continue
             try:
+                # deterministic preemption point: the process dies between
+                # family branches — already-persisted candidates survive
+                faults.inject("preempt.sweep", key=family.name)
                 faults.inject("validator.family_fit", key=family.name)
                 pending.append(_dispatch(family, grid))
             except Exception as e:
@@ -508,6 +563,27 @@ class OpValidator:
                 pending.append((family.name, list(grid), None,
                                 F * len(grid), len(grid)))
                 fit_failures[fi] = reason
+            if sweep_ckpt is not None:
+                from ...parallel.distributed import fetch_to_host
+                from .sweep_checkpoint import SweepCheckpoint, params_hash
+                fam_name, grid_l, m, B_true, G = pending[-1]
+                if m is not None:
+                    fm_host = np.asarray(
+                        fetch_to_host(m)).reshape(-1)[:B_true].reshape(F, G)
+                    # drop the device handle: finish() reads the host copy
+                    pending[-1] = (fam_name, grid_l, None, B_true, G)
+                    host_metrics[fi] = fm_host
+                else:
+                    fm_host = np.full((F, len(grid)), np.nan)
+                sweep_ckpt.put(ckey, {
+                    "family": fam_name,
+                    "grid": [dict(g) for g in grid_l],
+                    "paramsHashes": [params_hash(g) for g in grid_l],
+                    "metricName": metric_name,
+                    **SweepCheckpoint.encode_metrics(fm_host),
+                    "quarantined": fi in fit_failures,
+                    "reason": fit_failures.get(fi),
+                })
 
         # fuse every family's metric vector into ONE device array so finish()
         # pays a single host transfer (measured ~70-130ms per warm transfer
@@ -528,7 +604,9 @@ class OpValidator:
             m_host = fetch_to_host(all_m) if all_m is not None else None
             off = 0
             for fi, (fam_name, grid_l, m, B_true, G) in enumerate(pending):
-                if m is None:  # the family's fit threw before dispatch
+                if fi in host_metrics:  # restored / eagerly persisted
+                    fold_metrics = host_metrics[fi]
+                elif m is None:  # the family's fit threw before dispatch
                     fold_metrics = np.full((F, G), np.nan, dtype=np.float64)
                 elif m_host is not None:
                     m_fam = m_host[off:off + m.size]

@@ -1,1 +1,4 @@
-from .scoring import score_function, micro_batch_score_function  # noqa: F401
+from .scoring import (  # noqa: F401
+    SCORE_ERROR_KEY, ScoreSchemaError, compiled_score_function,
+    micro_batch_score_function, score_function,
+)

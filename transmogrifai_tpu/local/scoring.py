@@ -11,12 +11,27 @@ micro-batches — the TPU replacement for MLeap row scoring.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence
+import logging
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
 from ..table import Column, FeatureTable
 from ..types import OPVector as OPVectorType
+
+logger = logging.getLogger(__name__)
+
+#: per-row error key emitted by micro-batch quarantine (the row could not be
+#: scored; every result feature is None and this key carries the reason)
+SCORE_ERROR_KEY = "__score_error__"
+
+
+class ScoreSchemaError(ValueError):
+    """Serve-time input does not match the fitted schema (missing column,
+    unconvertible dtype, wrong vector width). Raised with the offending
+    column and the expected-vs-actual detail *before* the data reaches the
+    jitted program — an XLA trace/shape error names none of that."""
 
 
 def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
@@ -137,11 +152,68 @@ def compiled_score_function(model):
     # metadata for fused outputs is data-independent; captured lazily from
     # one plain stage-by-stage pass on the first batch
     meta_cache: Dict[str, Dict[str, Any]] = {}
+    # the fitted column set: every column the serve pass reads that no
+    # stage of the model produces must arrive in the input table — checked
+    # up front with a descriptive error instead of a KeyError deep in a
+    # host stage or a trace error inside XLA
+    produced_all = {s.get_output().name for s in stages}
+    required_external: List[str] = []
+    for s in stages:
+        # response features are train-only: scoring never reads the label
+        names = (s.device_inputs() if hasattr(s, "device_inputs")
+                 else [f.name for f in s.input_features if not f.is_response])
+        for nm in names:
+            if nm not in produced_all and nm not in required_external:
+                required_external.append(nm)
+    for nm in in_names:
+        if nm not in produced_all and nm not in required_external:
+            required_external.append(nm)
+
+    # fitted input schema for the fused program: per-column trailing shape
+    # (vector width). Seeded from the training table when the model still
+    # carries one; otherwise pinned by the first scored batch. Violations
+    # raise ScoreSchemaError at the boundary instead of a shape/trace error
+    # inside XLA (which would also silently recompile on every new width).
+    expected_shapes: Dict[str, Tuple[int, ...]] = {}
+    ttbl = getattr(model, "train_table", None)
+    if ttbl is not None:
+        for nm in in_names:
+            if nm in ttbl.column_names:
+                expected_shapes[nm] = tuple(np.shape(ttbl[nm].values)[1:])
+
+    def _validated_input(tbl: FeatureTable, nm: str) -> Column:
+        if nm not in tbl.column_names:
+            raise ScoreSchemaError(
+                f"input is missing column '{nm}' required by the fitted "
+                f"serve program; table has {sorted(tbl.column_names)}")
+        col = tbl[nm]
+        try:
+            v = np.asarray(col.values, dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            dt = getattr(col.values, "dtype", type(col.values).__name__)
+            raise ScoreSchemaError(
+                f"column '{nm}': values of dtype {dt} cannot convert to "
+                f"float32 for the fused serve program ({e})") from e
+        want = expected_shapes.get(nm)
+        if want is not None and tuple(v.shape[1:]) != want:
+            raise ScoreSchemaError(
+                f"column '{nm}': per-row shape {tuple(v.shape[1:])} does "
+                f"not match the fitted schema {want}")
+        expected_shapes.setdefault(nm, tuple(v.shape[1:]))
+        return col
 
     def score(table: FeatureTable) -> FeatureTable:
+        missing = [nm for nm in required_external
+                   if nm not in table.column_names]
+        if missing:
+            raise ScoreSchemaError(
+                f"input is missing column(s) {missing} required by the "
+                f"fitted model; table has {sorted(table.column_names)}")
         tbl = table
         for s in host_prefix:
             tbl = s.transform(tbl)
+        for nm in in_names:   # validate BEFORE any jit sees the batch
+            _validated_input(tbl, nm)
         if not meta_cache:
             probe = tbl
             for s in fused:
@@ -190,21 +262,33 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
     runs the columnar/jitted DAG pass — the serving path that keeps the TPU
     busy (SURVEY §2.10 P4: streaming micro-batches). The numeric transformer
     tail runs as ONE compiled XLA program reused across micro-batches
-    (compiled_score_function)."""
+    (compiled_score_function).
+
+    Malformed input does not kill the batch: a batch that fails schema
+    validation (a string where a number is expected, a wrong-width vector)
+    falls back to per-row scoring, and only the offending rows are
+    **quarantined** — their result features come back None with the reason
+    under :data:`SCORE_ERROR_KEY` — while every valid row still scores."""
     raw_features = model.raw_features
     result_features = model.result_features
     compiled = compiled_score_function(model)
 
-    def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
-        cols = {
-            f.name: Column.of_values(
-                f.feature_type, [f.origin_stage.extract(r) for r in rows])
-            for f in raw_features
-        }
-        table = FeatureTable(cols, len(rows))
-        scored = compiled(table)
+    def _build_table(rows: Sequence[Dict[str, Any]]) -> FeatureTable:
+        cols = {}
+        for f in raw_features:
+            vals = [f.origin_stage.extract(r) for r in rows]
+            try:
+                cols[f.name] = Column.of_values(f.feature_type, vals)
+            except (TypeError, ValueError) as e:
+                raise ScoreSchemaError(
+                    f"raw feature '{f.name}' ({f.type_name}): value does "
+                    f"not conform to the fitted schema "
+                    f"({type(e).__name__}: {e})") from e
+        return FeatureTable(cols, len(rows))
+
+    def _records(scored: FeatureTable, n: int) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
-        for i in range(len(rows)):
+        for i in range(n):
             rec: Dict[str, Any] = {}
             for f in result_features:
                 col = scored[f.name]
@@ -221,5 +305,26 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
                         v.item() if isinstance(v, np.generic) else v)
             out.append(rec)
         return out
+
+    def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        try:
+            return _records(compiled(_build_table(rows)), len(rows))
+        except (ScoreSchemaError, TypeError, ValueError) as batch_err:
+            # isolate the offenders: score each row alone; rows that still
+            # fail are quarantined instead of poisoning the whole batch
+            out: List[Dict[str, Any]] = []
+            quarantined = 0
+            for row in rows:
+                try:
+                    out.append(_records(compiled(_build_table([row])), 1)[0])
+                except (ScoreSchemaError, TypeError, ValueError) as e:
+                    rec = {f.name: None for f in result_features}
+                    rec[SCORE_ERROR_KEY] = str(e) or str(batch_err)
+                    out.append(rec)
+                    quarantined += 1
+            logger.warning(
+                "micro-batch scoring quarantined %d/%d row(s) "
+                "(first batch error: %s)", quarantined, len(rows), batch_err)
+            return out
 
     return score

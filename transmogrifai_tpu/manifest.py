@@ -1,0 +1,200 @@
+"""Atomic file writes + per-checkpoint-dir integrity manifest.
+
+TPUs are preemptible: a ``train()`` can be killed at any instruction,
+including mid-``np.savez``. The reference leans on RDD lineage + HDFS
+rename-commit semantics for this (reference: Spark's
+FileCommitProtocol / HadoopMapReduceCommitProtocol — task output goes to a
+temporary attempt path and is renamed into place on commit); the JAX
+rebuild writes plain files, so the same discipline is rebuilt here:
+
+* :func:`atomic_write_bytes` / :func:`atomic_write_json` — write to
+  ``<path>.tmp``, flush + fsync, then ``os.replace`` into place. A kill at
+  any point leaves either the old file or the new file, never a torn one.
+  Orphaned ``*.tmp`` files are the only possible debris.
+* :class:`CheckpointManifest` — ``MANIFEST.json`` inside a checkpoint
+  directory recording the format version, a per-file sha256 + size, and
+  per-stage / per-sweep *completion records*. A file is only trustworthy if
+  (a) its completion record exists and (b) its checksum matches — so
+  corruption (truncated file, bit rot, a kill between two of a stage's
+  files) is *detected* at load and reported, never silently used.
+
+The manifest itself is rewritten atomically after every completion, so it
+always describes a consistent prefix of the training run.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST_FILE = "MANIFEST.json"
+MANIFEST_VERSION = 1
+
+
+def sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            b = fh.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Write ``data`` to ``path`` via tmp + fsync + rename; returns the
+    sha256 of what was written. A kill mid-write leaves only ``<path>.tmp``
+    debris — the destination is either absent or complete."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return sha256_bytes(data)
+
+
+def atomic_write_json(path: str, obj: Any, **dump_kw) -> str:
+    return atomic_write_bytes(
+        path, json.dumps(obj, **dump_kw).encode("utf-8"))
+
+
+def clean_tmp_debris(dirpath: str) -> List[str]:
+    """Remove ``*.tmp`` files left by a process killed mid-atomic-write.
+    They are by-construction incomplete; removing them is always safe."""
+    removed: List[str] = []
+    if not os.path.isdir(dirpath):
+        return removed
+    for fname in sorted(os.listdir(dirpath)):
+        if fname.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(dirpath, fname))
+                removed.append(fname)
+            except OSError:
+                pass
+    return removed
+
+
+class CheckpointManifest:
+    """The ``MANIFEST.json`` of one checkpoint directory.
+
+    Schema (docs/robustness.md "Checkpoint manifest")::
+
+        {
+          "manifestVersion": 1,
+          "formatVersion": 1,             // checkpoint payload format
+          "files":  {"<fname>": {"sha256": "...", "size": 123}},
+          "stages": {"<uid>":   {"files": ["<uid>.json", "<uid>.npz"]}},
+          "sweeps": {"<owner>": {"file": "sweep_<owner>.json"}}
+        }
+
+    Only files reachable through a ``stages``/``sweeps`` completion record
+    are ever loaded; everything else in the directory is debris from an
+    interrupted write and is reported, not used.
+    """
+
+    def __init__(self, dirpath: str, format_version: int):
+        self.dirpath = dirpath
+        self.format_version = format_version
+        self.files: Dict[str, Dict[str, Any]] = {}
+        self.stages: Dict[str, Dict[str, Any]] = {}
+        self.sweeps: Dict[str, Dict[str, Any]] = {}
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dirpath, MANIFEST_FILE)
+
+    # -- load / save ---------------------------------------------------------
+    @classmethod
+    def load(cls, dirpath: str, format_version: int
+             ) -> Tuple["CheckpointManifest", Optional[str]]:
+        """Read the directory's manifest. Returns ``(manifest, error)``:
+        a fresh empty manifest (nothing trustworthy) plus the reason when
+        the manifest is absent, unparsable, or of an unknown version."""
+        m = cls(dirpath, format_version)
+        path = m.path
+        if not os.path.isfile(path):
+            return m, None if not os.path.isdir(dirpath) else "missing"
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            return m, f"unreadable manifest: {type(e).__name__}: {e}"
+        if doc.get("manifestVersion") != MANIFEST_VERSION:
+            return m, (f"unsupported manifest version "
+                       f"{doc.get('manifestVersion')!r}")
+        if doc.get("formatVersion") != format_version:
+            return m, (f"checkpoint format {doc.get('formatVersion')!r} != "
+                       f"expected {format_version}")
+        m.files = dict(doc.get("files", {}))
+        m.stages = dict(doc.get("stages", {}))
+        m.sweeps = dict(doc.get("sweeps", {}))
+        return m, None
+
+    def save(self) -> None:
+        os.makedirs(self.dirpath, exist_ok=True)
+        atomic_write_json(self.path, {
+            "manifestVersion": MANIFEST_VERSION,
+            "formatVersion": self.format_version,
+            "files": self.files,
+            "stages": self.stages,
+            "sweeps": self.sweeps,
+        }, indent=1)
+
+    # -- recording -----------------------------------------------------------
+    def record_file(self, fname: str, sha256: str, size: int) -> None:
+        self.files[fname] = {"sha256": sha256, "size": size}
+
+    def complete_stage(self, uid: str, fnames: List[str]) -> None:
+        """Mark a stage checkpoint complete (all its files written +
+        recorded). Call AFTER the files are durably in place; the manifest
+        save that follows is the commit point."""
+        self.stages[uid] = {"files": list(fnames)}
+
+    def complete_sweep(self, owner_uid: str, fname: str) -> None:
+        self.sweeps[owner_uid] = {"file": fname}
+
+    # -- verification --------------------------------------------------------
+    def verify_file(self, fname: str) -> Optional[str]:
+        """None when ``fname`` exists and matches its recorded checksum;
+        otherwise a human-readable reason (missing record / missing file /
+        size mismatch / checksum mismatch)."""
+        rec = self.files.get(fname)
+        path = os.path.join(self.dirpath, fname)
+        if rec is None:
+            return "file has no manifest record (incomplete write)"
+        if not os.path.isfile(path):
+            return "file recorded in manifest but missing on disk"
+        size = os.path.getsize(path)
+        if size != rec.get("size"):
+            return (f"size mismatch: manifest says {rec.get('size')} bytes, "
+                    f"file has {size}")
+        actual = sha256_file(path)
+        if actual != rec.get("sha256"):
+            return (f"sha256 mismatch: manifest {rec.get('sha256')[:12]}..., "
+                    f"file {actual[:12]}...")
+        return None
+
+    def unrecorded_files(self) -> List[str]:
+        """Checkpoint payload files on disk with no completion record —
+        debris from a write the process never committed."""
+        if not os.path.isdir(self.dirpath):
+            return []
+        recorded = set(self.files)
+        for rec in self.stages.values():
+            recorded.update(rec.get("files", ()))
+        for rec in self.sweeps.values():
+            recorded.add(rec.get("file"))
+        out = []
+        for fname in sorted(os.listdir(self.dirpath)):
+            if fname == MANIFEST_FILE or fname.endswith(".tmp"):
+                continue
+            if fname not in recorded:
+                out.append(fname)
+        return out

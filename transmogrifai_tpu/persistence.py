@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import io
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
@@ -29,12 +30,33 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .features import Feature
+from .manifest import (
+    CheckpointManifest, atomic_write_bytes, clean_tmp_debris,
+)
 from .stages.base import STAGE_REGISTRY, FeatureGeneratorStage, OpPipelineStage
 from .types import feature_type_by_name
 
 PLAN_FILE = "plan.json"
 ARRAYS_FILE = "arrays.npz"
 FORMAT_VERSION = 1
+
+
+class CorruptModelError(RuntimeError):
+    """A saved model/checkpoint file failed integrity verification or could
+    not be decoded. Carries the failing file and the reason, so "the model
+    dir was truncated by a preempted copy" reads as exactly that instead of
+    a raw npz/json decode traceback."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(f"corrupt model artifact {path!r}: {reason}")
+
+
+def _npz_bytes(store: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **store)
+    return buf.getvalue()
 
 #: stage attributes that carry DAG wiring, rebuilt from the feature graph
 #: attributes that are workflow wiring / runtime placement, not model state:
@@ -282,7 +304,11 @@ def features_from_json(descs: List[Dict[str, Any]],
 
 def save_model(model, path: str) -> None:
     """Write the fitted workflow model to ``path`` (a directory):
-    plan.json + arrays.npz (reference OpWorkflowModelWriter.scala:52-80)."""
+    plan.json + arrays.npz + MANIFEST.json with per-file sha256 checksums
+    (reference OpWorkflowModelWriter.scala:52-80). Every file is written
+    atomically (tmp + fsync + rename), so a kill mid-save leaves either the
+    previous complete model or ``*.tmp`` debris — never a torn file that
+    :func:`load_model` would decode garbage from."""
     from .utils.version import version_info
     os.makedirs(path, exist_ok=True)
     arrays = _Arrays()
@@ -319,9 +345,14 @@ def save_model(model, path: str) -> None:
             f"as permanent placeholders. Include those stages in the "
             f"workflow or drop the references before saving.",
             stacklevel=2)
-    with open(os.path.join(path, PLAN_FILE), "w") as fh:
-        json.dump(plan, fh, indent=2)
-    np.savez_compressed(os.path.join(path, ARRAYS_FILE), **arrays.store)
+    plan_bytes = json.dumps(plan, indent=2).encode("utf-8")
+    npz_bytes = _npz_bytes(arrays.store)
+    plan_sha = atomic_write_bytes(os.path.join(path, PLAN_FILE), plan_bytes)
+    npz_sha = atomic_write_bytes(os.path.join(path, ARRAYS_FILE), npz_bytes)
+    manifest = CheckpointManifest(path, FORMAT_VERSION)
+    manifest.record_file(PLAN_FILE, plan_sha, len(plan_bytes))
+    manifest.record_file(ARRAYS_FILE, npz_sha, len(npz_bytes))
+    manifest.save()
 
 
 def _collect_stage_ref_uids(v: Any) -> set:
@@ -392,15 +423,42 @@ def load_model(path: str, workflow=None):
     If ``workflow`` (the original OpWorkflow) is given, stages with
     unserializable state (user lambdas) are patched from the workflow's stage
     of the same uid — the reference's OpWorkflowModelReader "resolve against
-    workflow" path."""
+    workflow" path.
+
+    Integrity: when the directory carries a MANIFEST.json (every model saved
+    by the current :func:`save_model` does), each file's size + sha256 is
+    verified before decoding; a mismatch raises :class:`CorruptModelError`
+    naming the failing file. Decode failures (truncated legacy files) are
+    wrapped in the same error instead of surfacing a raw traceback."""
     from .workflow import OpWorkflowModel
 
-    with open(os.path.join(path, PLAN_FILE)) as fh:
-        plan = json.load(fh)
+    plan_path = os.path.join(path, PLAN_FILE)
+    npz_path = os.path.join(path, ARRAYS_FILE)
+    manifest, merr = CheckpointManifest.load(path, FORMAT_VERSION)
+    if merr not in (None, "missing"):  # pre-manifest dirs load unverified
+        raise CorruptModelError(manifest.path, merr)
+    if merr is None and os.path.isdir(path) and manifest.files:
+        for fname in (PLAN_FILE, ARRAYS_FILE):
+            reason = manifest.verify_file(fname)
+            if reason is not None:
+                raise CorruptModelError(os.path.join(path, fname), reason)
+    try:
+        with open(plan_path) as fh:
+            plan = json.load(fh)
+    except ValueError as e:
+        raise CorruptModelError(plan_path,
+                                f"undecodable JSON: {e}") from e
     if plan.get("formatVersion") != FORMAT_VERSION:
         raise ValueError(f"unsupported model format {plan.get('formatVersion')}")
-    with np.load(os.path.join(path, ARRAYS_FILE), allow_pickle=False) as npz:
-        arrays = {k: npz[k] for k in npz.files}
+    try:
+        with np.load(npz_path, allow_pickle=False) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+    except (ValueError, OSError, KeyError) as e:
+        if not os.path.isfile(npz_path):
+            raise
+        raise CorruptModelError(npz_path,
+                                f"undecodable npz: {type(e).__name__}: {e}"
+                                ) from e
 
     stages: Dict[str, OpPipelineStage] = {}
     for d in plan["stages"] + plan["rawFeatureGenerators"]:
@@ -456,32 +514,128 @@ def load_model(path: str, workflow=None):
 # stage state instead of Spark lineage recomputation)
 # ---------------------------------------------------------------------------
 
-def save_stage_checkpoint(stage: OpPipelineStage, ckpt_dir: str) -> None:
-    """Persist one fitted stage as <uid>.json + <uid>.npz."""
+def open_checkpoint_manifest(ckpt_dir: str) -> CheckpointManifest:
+    """The directory's manifest, or a fresh one when absent/unreadable
+    (an unreadable manifest means nothing in the dir is trustworthy — it is
+    reported at load time; the new manifest recommits from scratch)."""
+    manifest, _err = CheckpointManifest.load(ckpt_dir, FORMAT_VERSION)
+    return manifest
+
+
+def save_stage_checkpoint(stage: OpPipelineStage, ckpt_dir: str,
+                          manifest: Optional[CheckpointManifest] = None,
+                          ) -> None:
+    """Persist one fitted stage as <uid>.json + <uid>.npz, atomically, and
+    commit it to the directory manifest.
+
+    Write protocol (kill-safe at every step): each payload file goes
+    through tmp + fsync + rename; the stage only becomes *loadable* when
+    the manifest — rewritten atomically last — records its completion and
+    checksums. A preemption anywhere mid-protocol leaves files the loader
+    classifies as debris (reported, refit) rather than state it trusts."""
+    from .robustness import faults
     os.makedirs(ckpt_dir, exist_ok=True)
+    if manifest is None:
+        manifest = open_checkpoint_manifest(ckpt_dir)
     arrays = _Arrays()
     desc = stage_to_json(stage, arrays)
-    with open(os.path.join(ckpt_dir, f"{stage.uid}.json"), "w") as fh:
-        json.dump(desc, fh)
-    np.savez_compressed(os.path.join(ckpt_dir, f"{stage.uid}.npz"),
-                        **arrays.store)
+    npz_name, json_name = f"{stage.uid}.npz", f"{stage.uid}.json"
+    npz_bytes = _npz_bytes(arrays.store)
+    npz_sha = atomic_write_bytes(os.path.join(ckpt_dir, npz_name), npz_bytes)
+    # deterministic kill point BETWEEN the payload files and the manifest
+    # commit: the .npz exists but nothing records it — resume must treat it
+    # as debris, not as a checkpoint
+    faults.inject("preempt.checkpoint_write", key=stage.uid)
+    json_bytes = json.dumps(desc).encode("utf-8")
+    json_sha = atomic_write_bytes(os.path.join(ckpt_dir, json_name),
+                                  json_bytes)
+    manifest.record_file(npz_name, npz_sha, len(npz_bytes))
+    manifest.record_file(json_name, json_sha, len(json_bytes))
+    manifest.complete_stage(stage.uid, [json_name, npz_name])
+    manifest.save()        # the commit point
 
 
-def load_stage_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
-    """Load every stage checkpoint in ``ckpt_dir``, keyed by uid. Corrupt or
-    partially-written entries (a crash mid-``np.savez``, a truncated copy)
-    are skipped with a logged warning and a ``checkpoint_skipped``
-    FaultReport — the stage refits from data instead of the whole resume
-    crashing on state it can deterministically rebuild."""
+def _report_skipped(uid: str, ckpt_dir: str, file: str, reason: str) -> None:
+    import logging
+    from .robustness.policy import FaultLog, FaultReport
+    logging.getLogger(__name__).warning(
+        "skipping stage checkpoint %s in %s (%s: %s); the stage will refit",
+        uid, ckpt_dir, file, reason)
+    FaultLog.record(FaultReport(
+        site="persistence.checkpoint", kind="checkpoint_skipped",
+        detail={"uid": uid, "dir": ckpt_dir, "file": file,
+                "reason": reason, "error": reason}))
+
+
+def load_stage_checkpoints(ckpt_dir: str,
+                           manifest: Optional[CheckpointManifest] = None,
+                           ) -> Dict[str, OpPipelineStage]:
+    """Load every *verified* stage checkpoint in ``ckpt_dir``, keyed by uid.
+
+    With a manifest present, only stages with a completion record load, and
+    each file's size + sha256 must match the manifest — corruption
+    (truncated file, bit flip, kill between a stage's two files) is
+    *detected* and reported as a ``checkpoint_skipped`` FaultReport carrying
+    the file path and the verification failure; the stage refits from data.
+    Payload files with no completion record (debris of an interrupted
+    write) are reported the same way. Pre-manifest directories fall back to
+    decode-or-skip with the same reporting."""
     import logging
 
-    from .robustness.policy import FaultLog, FaultReport
     logger = logging.getLogger(__name__)
     out: Dict[str, OpPipelineStage] = {}
     if not os.path.isdir(ckpt_dir):
         return out
+    removed = clean_tmp_debris(ckpt_dir)
+    if removed:
+        logger.info("removed %d partial-write tmp file(s) from %s",
+                    len(removed), ckpt_dir)
+    if manifest is None:
+        manifest, merr = CheckpointManifest.load(ckpt_dir, FORMAT_VERSION)
+        if merr not in (None, "missing"):
+            _report_skipped("*", ckpt_dir, manifest.path,
+                            f"manifest unusable ({merr}); no checkpoint in "
+                            f"the directory can be verified")
+            return out
+        if merr == "missing" and any(
+                f.endswith(".json") for f in os.listdir(ckpt_dir)):
+            return _load_legacy_checkpoints(ckpt_dir)
+    for fname in manifest.unrecorded_files():
+        uid = fname.rsplit(".", 1)[0]
+        _report_skipped(uid, ckpt_dir, os.path.join(ckpt_dir, fname),
+                        "file has no manifest completion record "
+                        "(interrupted write)")
+    for uid, rec in sorted(manifest.stages.items()):
+        fnames = rec.get("files", [])
+        bad = [(f, manifest.verify_file(f)) for f in fnames]
+        bad = [(f, r) for f, r in bad if r is not None]
+        if bad:
+            f0, r0 = bad[0]
+            _report_skipped(uid, ckpt_dir, os.path.join(ckpt_dir, f0), r0)
+            continue
+        try:
+            with open(os.path.join(ckpt_dir, f"{uid}.json")) as fh:
+                desc = json.load(fh)
+            with np.load(os.path.join(ckpt_dir, f"{uid}.npz"),
+                         allow_pickle=False) as npz:
+                arrays = dict(npz)
+            out[uid] = stage_from_json(desc, arrays)
+        except Exception as e:
+            # checksums matched but decode failed: a format bug or a stage
+            # class that moved — still refit rather than crash the resume
+            _report_skipped(uid, ckpt_dir, os.path.join(ckpt_dir,
+                                                        f"{uid}.json"),
+                            f"verified but undecodable: "
+                            f"{type(e).__name__}: {e}")
+    return out
+
+
+def _load_legacy_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
+    """Pre-manifest directories: best-effort decode-or-skip (the PR-1
+    behavior), with skips reported through the same FaultLog path."""
+    out: Dict[str, OpPipelineStage] = {}
     for fname in sorted(os.listdir(ckpt_dir)):
-        if not fname.endswith(".json"):
+        if not fname.endswith(".json") or fname.startswith("sweep_"):
             continue
         uid = fname[:-5]
         try:
@@ -492,12 +646,7 @@ def load_stage_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
                 arrays = dict(npz)
             out[uid] = stage_from_json(desc, arrays)
         except Exception as e:
-            logger.warning(
-                "skipping corrupt stage checkpoint %s in %s (%s: %s); the "
-                "stage will refit", uid, ckpt_dir, type(e).__name__, e)
-            FaultLog.record(FaultReport(
-                site="persistence.checkpoint", kind="checkpoint_skipped",
-                detail={"uid": uid, "dir": ckpt_dir,
-                        "error": f"{type(e).__name__}: {e}"}))
-            continue
+            _report_skipped(uid, ckpt_dir, os.path.join(ckpt_dir, fname),
+                            f"{type(e).__name__}: {e} (unverified legacy "
+                            f"checkpoint — no manifest)")
     return out

@@ -19,6 +19,7 @@ See docs/robustness.md for the fault-policy contract, the injection-site
 table, and the ``summary()["faults"]`` schema.
 """
 from . import faults  # noqa: F401
+from .faults import SimulatedPreemption  # noqa: F401
 from .guards import (  # noqa: F401
     AllCandidatesFailedError, params_finite, quarantine_non_finite,
 )

@@ -30,6 +30,18 @@ site                         fires in
 ``distributed.to_host``      before each guarded device→host transfer
 ``distributed.device_put``   before each guarded host→device placement
 ===========================  ====================================================
+
+Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
+a *BaseException* that models the process being killed: no ``except
+Exception`` recovery path may swallow it, exactly like a real SIGTERM):
+
+===========================  ====================================================
+``preempt.stage_fit``        mid-DAG, before an estimator's fit starts
+``preempt.checkpoint_write`` inside a stage-checkpoint write, between the
+                             payload files and the manifest commit
+``preempt.sweep``            mid-sweep, before a model family's branch runs
+``preempt.refit``            after the sweep, before the winner's refit
+===========================  ====================================================
 """
 from __future__ import annotations
 
@@ -61,16 +73,26 @@ class InjectedFaultError(RuntimeError):
     """Injected error classified fatal (never retried)."""
 
 
+class SimulatedPreemption(BaseException):
+    """A deterministic stand-in for the process being killed (TPU
+    preemption, SIGTERM, OOM-kill). Derives from ``BaseException`` — like
+    ``KeyboardInterrupt`` — so quarantine/retry handlers (``except
+    Exception``) can never absorb it: the only valid recovery is a fresh
+    process calling ``train(resume=True)``."""
+
+
 @dataclass
 class FaultSpec:
     """One armed site.
 
-    ``mode``: ``"raise"`` (throw from :func:`inject`) or ``"nan"`` (poison
-    the array passed to :func:`poison`). ``nth``/``count``: fire on matching
-    calls nth..nth+count-1 (1-based). ``key``: only fire when the call's
-    ``key`` matches (None = any). ``index``: nan mode — flat index to
-    poison; None poisons the whole array. ``transient``: raise mode — throw
-    :class:`TransientFaultError` (retryable) vs :class:`InjectedFaultError`.
+    ``mode``: ``"raise"`` (throw from :func:`inject`), ``"nan"`` (poison
+    the array passed to :func:`poison`), or ``"preempt"`` (throw
+    :class:`SimulatedPreemption` — a simulated process kill).
+    ``nth``/``count``: fire on matching calls nth..nth+count-1 (1-based).
+    ``key``: only fire when the call's ``key`` matches (None = any).
+    ``index``: nan mode — flat index to poison; None poisons the whole
+    array. ``transient``: raise mode — throw :class:`TransientFaultError`
+    (retryable) vs :class:`InjectedFaultError`.
     """
     site: str
     mode: str = "raise"
@@ -156,8 +178,12 @@ def inject(site: str, key: Optional[str] = None) -> None:
         return
     _load_env()
     spec = _fires(site, key)
-    if spec is None or spec.mode != "raise":
+    if spec is None or spec.mode not in ("raise", "preempt"):
         return
+    if spec.mode == "preempt":
+        raise SimulatedPreemption(
+            f"simulated preemption at site '{site}'"
+            + (f" (key={key})" if key else ""))
     exc = TransientFaultError if spec.transient else InjectedFaultError
     raise exc(f"injected fault at site '{site}'"
               + (f" (key={key})" if key else ""))

@@ -54,8 +54,10 @@ def is_transient_error(exc: BaseException) -> bool:
 class FaultReport:
     """One recovery event. ``kind``: ``retry`` (operation succeeded after
     ``attempts - 1`` retries), ``quarantine`` (candidate/family excluded
-    from selection), ``checkpoint_skipped`` (corrupt stage checkpoint
-    ignored on resume), or ``fatal`` (retries exhausted / unretryable)."""
+    from selection), ``checkpoint_skipped`` (corrupt/incomplete checkpoint
+    detected and ignored on resume), ``restored`` (a fitted stage or sweep
+    candidate rehydrated from a verified checkpoint instead of refitting),
+    or ``fatal`` (retries exhausted / unretryable)."""
     site: str
     kind: str
     detail: Dict[str, Any] = field(default_factory=dict)
@@ -111,6 +113,7 @@ class FaultLog:
             "retries": [r.to_json() for r in self.of_kind("retry")],
             "checkpointsSkipped": [r.to_json()
                                    for r in self.of_kind("checkpoint_skipped")],
+            "restored": [r.to_json() for r in self.of_kind("restored")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
         }
 

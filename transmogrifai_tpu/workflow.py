@@ -131,11 +131,18 @@ class OpWorkflow(_WorkflowCore):
     def with_checkpoint_dir(self, path: str) -> "OpWorkflow":
         """Crash-resumable training: every fitted estimator persists to
         ``path`` as it completes, and a re-run skips stages already
-        checkpointed there (matched by uid). The TPU build's analog of the
-        reference's persist-every-K-stages resilience
-        (OpWorkflowModel.scala:449-455, FitStagesUtil.scala:125-131) —
-        deterministic re-execution from saved state instead of Spark lineage
-        recomputation."""
+        checkpointed there (matched by uid). Writes are atomic (tmp +
+        fsync + rename) and committed through a per-directory integrity
+        manifest (format version + per-file sha256 + completion records);
+        every ModelSelector additionally persists per-candidate sweep
+        results as they are evaluated. A re-run (see ``train(resume=True)``)
+        restores *verified* stage checkpoints, replays the persisted sweep
+        state, and refits only the remainder; corrupt or torn files are
+        detected by checksum, reported in ``summary()["faults"]``, and
+        never silently used. The TPU build's analog of the reference's
+        persist-every-K-stages resilience (OpWorkflowModel.scala:449-455,
+        FitStagesUtil.scala:125-131) — deterministic re-execution from
+        saved state instead of Spark lineage recomputation."""
         self._checkpoint_dir = path
         return self
 
@@ -197,19 +204,29 @@ class OpWorkflow(_WorkflowCore):
     def stages(self) -> List[Any]:
         return [s for layer in (self._layers or []) for s, _ in layer]
 
-    def train(self) -> "OpWorkflowModel":
+    def train(self, resume: bool = False) -> "OpWorkflowModel":
         """Materialize raw data, fit the DAG, return the fitted model
         (reference OpWorkflow.train:332-357). The whole fit runs under an
-        activated FaultLog: retries, quarantines and skipped checkpoints
-        recorded anywhere in the stack surface in ``summary()["faults"]``."""
+        activated FaultLog: retries, quarantines, skipped checkpoints and
+        checkpoint restorations recorded anywhere in the stack surface in
+        ``summary()["faults"]``.
+
+        ``resume=True`` — preemption recovery: requires
+        ``with_checkpoint_dir``; fitted upstream stages restore from
+        *verified* checkpoints (manifest + sha256), persisted sweep state
+        replays so only unevaluated candidates run, and the returned
+        model's ``summary()["resume"]`` records exactly what was restored
+        vs refit. Checkpoints failing verification are reported and the
+        stage refits — a resume never crashes on (or silently uses) state
+        it can deterministically rebuild."""
         from .robustness.policy import FaultLog
         fault_log = FaultLog()
         with fault_log.activate():
-            model = self._train_logged()
+            model = self._train_logged(resume=resume)
         model._fault_log = fault_log
         return model
 
-    def _train_logged(self) -> "OpWorkflowModel":
+    def _train_logged(self, resume: bool = False) -> "OpWorkflowModel":
         if not self.result_features:
             raise ValueError("call set_result_features before train")
         table = self._generate_raw_table()
@@ -235,13 +252,30 @@ class OpWorkflow(_WorkflowCore):
                     if hasattr(s, "set_mesh"):
                         s.set_mesh(mesh)
         ckpt_dir = getattr(self, "_checkpoint_dir", None)
+        if resume and ckpt_dir is None:
+            raise ValueError(
+                "train(resume=True) requires with_checkpoint_dir(...): "
+                "there is no checkpoint state to resume from")
         checkpoint = None
         preloaded = None
         if ckpt_dir is not None:
+            from .impl.tuning.sweep_checkpoint import SweepCheckpoint
             from .persistence import (load_stage_checkpoints,
+                                      open_checkpoint_manifest,
                                       save_stage_checkpoint)
+            # restored stages are manifest-verified (sha256); failures are
+            # reported as checkpoint_skipped and the stage refits
             preloaded = load_stage_checkpoints(ckpt_dir)
-            checkpoint = lambda model: save_stage_checkpoint(model, ckpt_dir)
+            # ONE manifest object shared by stage checkpoints and sweep
+            # state, so sequential commits never clobber each other
+            manifest = open_checkpoint_manifest(ckpt_dir)
+            checkpoint = lambda model: save_stage_checkpoint(
+                model, ckpt_dir, manifest)
+            for layer in layers:
+                for s, _ in layer:
+                    if hasattr(s, "set_sweep_checkpoint"):
+                        s.set_sweep_checkpoint(
+                            SweepCheckpoint(ckpt_dir, s.uid, manifest))
         retry_policy = getattr(self, "_fault_policy", None)
         if self._workflow_cv:
             table, fitted = self._fit_with_workflow_cv(table, layers)
@@ -261,6 +295,10 @@ class OpWorkflow(_WorkflowCore):
         model.blacklisted_features = blacklisted
         model.rff_results = rff_results
         model.train_table = table
+        #: resume accounting: which estimator uids this train fitted (or
+        #: restored) — summary()["resume"] splits them via the fault log
+        model._fitted_stage_uids = sorted(fitted)
+        model._resume_requested = resume
         if self.profiler is not None:
             # score timings get their own collector — mixing them into the
             # train AppMetrics would conflate fit and serve costs
@@ -472,11 +510,28 @@ class OpWorkflowModel(_WorkflowCore):
             if md:
                 out[stage.uid] = md
         # fault accounting for THIS train run: quarantined candidates,
-        # successful retries, skipped checkpoints (docs/robustness.md; empty
-        # sections for models loaded from disk — the log is train-scoped)
+        # successful retries, skipped checkpoints, restorations
+        # (docs/robustness.md; empty sections for models loaded from disk —
+        # the log is train-scoped)
         from .robustness.policy import FaultLog
         log = getattr(self, "_fault_log", None)
         out["faults"] = (log or FaultLog()).to_json()
+        # resume accounting: what this train restored from verified
+        # checkpoints vs actually (re)fit (docs/robustness.md "Resume
+        # semantics"). Empty/false for models loaded from disk.
+        restored_stages = sorted(
+            r.detail.get("uid") for r in (log.reports if log else [])
+            if r.kind == "restored" and r.site == "dag.stage_fit")
+        out["resume"] = {
+            "requested": bool(getattr(self, "_resume_requested", False)),
+            "restoredStages": restored_stages,
+            "refitStages": [
+                uid for uid in getattr(self, "_fitted_stage_uids", [])
+                if uid not in set(restored_stages)],
+            "restoredSweepCandidates": [
+                dict(r.detail) for r in (log.reports if log else [])
+                if r.kind == "restored" and r.site == "sweep.candidate"],
+        }
         return out
 
     def summary_json(self) -> str:
