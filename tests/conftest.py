@@ -45,6 +45,29 @@ def _seed():
 
 
 @pytest.fixture(autouse=True)
+def _no_observability_leak():
+    """Span buffers and metric registries are process-global (like the
+    reference's one SparkListener per context): a test that enables
+    tracing/metrics and records telemetry must not bleed spans, counters,
+    or a forced-enabled state into later tests — cross-test metric bleed
+    would make latency/counter assertions order-dependent. Mirrors the
+    chaos-site no-leak check below: assert clean on entry, hard-reset on
+    exit (fresh tracer + registry + env-driven enablement)."""
+    from transmogrifai_tpu import observability
+    from transmogrifai_tpu.observability import metrics as _om
+    from transmogrifai_tpu.observability import trace as _ot
+
+    assert not _ot.tracer().finished(), (
+        "span buffer leaked from a previous test: "
+        f"{[s.name for s in _ot.tracer().finished()][:10]}")
+    assert not _om.registry().snapshot(), (
+        "metrics registry leaked from a previous test: "
+        f"{sorted(_om.registry().snapshot())}")
+    yield
+    observability.reset()
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

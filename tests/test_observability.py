@@ -1,0 +1,350 @@
+"""Observability subsystem tests (transmogrifai_tpu/observability/;
+docs/observability.md): span nesting/ordering, streaming-histogram quantile
+fidelity vs numpy, Chrome-trace and Prometheus exposition validity,
+faults→span-event wiring under TG_CHAOS, the disabled-path overhead guard
+(zero registry writes), and the ``trace`` CLI bundle."""
+import json
+import os
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.observability import (
+    export as oe, metrics as om, summarize, trace as ot,
+)
+from transmogrifai_tpu.robustness import faults
+from transmogrifai_tpu.utils.jax_cache import cache_stats, record_cache_event
+from transmogrifai_tpu.utils.profiler import StageProfiler
+from transmogrifai_tpu.workflow import OpWorkflow
+
+LR_GRID = [{"regParam": 0.01, "elasticNetParam": 0.0},
+           {"regParam": 0.1, "elasticNetParam": 0.0}]
+MODELS = [("OpLogisticRegression", LR_GRID),
+          ("OpLinearSVC", [{"regParam": 0.01}])]
+
+
+def _df(n=300, seed=7):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2) > 0).astype(float)
+    return pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+
+
+def _selector_workflow(df):
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    f1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+    f2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+    checked = tg.transmogrify([f1, f2]).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        models=MODELS).set_input(label, checked).get_output())
+    return OpWorkflow().set_input_dataset(df).set_result_features(pred)
+
+
+@pytest.fixture
+def traced():
+    ot.enable_tracing(True)
+    om.enable_metrics(True)
+    yield
+    ot.enable_tracing(None)
+    om.enable_metrics(None)
+
+
+# -- span model ---------------------------------------------------------------
+def test_span_nesting_and_ordering(traced):
+    with ot.span("outer", cat="t", k=1) as so:
+        with ot.span("inner") as si:
+            si.add_event("evt", n=2)
+        with ot.span("inner2"):
+            pass
+    spans = ot.tracer().finished()
+    by_name = {s.name: s for s in spans}
+    assert set(by_name) == {"outer", "inner", "inner2"}
+    outer, inner, inner2 = (by_name["outer"], by_name["inner"],
+                            by_name["inner2"])
+    assert inner.parent_id == outer.span_id
+    assert inner2.parent_id == outer.span_id
+    assert outer.parent_id is None
+    # monotonic, properly nested timestamps
+    assert outer.ts_ns <= inner.ts_ns <= inner2.ts_ns
+    assert inner.ts_ns + inner.dur_ns <= inner2.ts_ns
+    assert outer.dur_ns >= inner.dur_ns + inner2.dur_ns
+    # children finish (and are buffered) before the parent
+    assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+    assert outer.attrs == {"k": 1}
+    assert inner.events[0][0] == "evt"
+    assert inner.events[0][2] == {"n": 2}
+
+
+def test_env_switches(monkeypatch):
+    assert not ot.tracing_enabled()
+    monkeypatch.setenv("TG_TRACE", "1")
+    assert ot.tracing_enabled()
+    assert om.metrics_enabled()          # metrics follows TG_TRACE...
+    monkeypatch.setenv("TG_METRICS", "0")
+    assert not om.metrics_enabled()      # ...unless TG_METRICS overrides
+    monkeypatch.delenv("TG_TRACE")
+    monkeypatch.setenv("TG_METRICS", "1")
+    assert om.metrics_enabled() and not ot.tracing_enabled()
+
+
+def test_disabled_tracing_yields_null_span():
+    assert not ot.tracing_enabled()
+    with ot.span("x", k=1) as s:
+        s.set_attr(a=2).add_event("e")
+    assert s is ot.NULL_SPAN
+    assert ot.tracer().finished() == []
+
+
+def test_add_event_without_open_span_records_instant(traced):
+    ot.add_event("standalone", reason="r")
+    spans = ot.tracer().finished()
+    assert len(spans) == 1 and spans[0].name == "standalone"
+    assert spans[0].dur_ns is None  # instant, exported as ph: "i"
+
+
+def test_span_buffer_bounded():
+    t = ot.Tracer(max_spans=4)
+    for i in range(7):
+        s = t.start(f"s{i}")
+        t.end(s)
+    assert len(t.finished()) == 4
+    assert t.dropped == 3
+    assert [s.name for s in t.finished()] == ["s3", "s4", "s5", "s6"]
+
+
+# -- metrics registry ---------------------------------------------------------
+def test_histogram_quantiles_vs_numpy(traced):
+    rng = np.random.RandomState(0)
+    vals = rng.lognormal(mean=0.0, sigma=0.5, size=4000)
+    h = om.registry().histogram("h_test_seconds")
+    for v in vals:
+        h.observe(v)
+    spread = np.percentile(vals, 99) - np.percentile(vals, 1)
+    for q in (0.5, 0.95, 0.99):
+        est, ref = h.quantile(q), np.percentile(vals, q * 100)
+        assert abs(est - ref) < 0.05 * spread, (q, est, ref)
+    snap = h.snapshot()
+    assert snap["count"] == 4000
+    np.testing.assert_allclose(snap["sum"], vals.sum(), rtol=1e-9)
+    assert snap["min"] == vals.min() and snap["max"] == vals.max()
+    assert set(snap) >= {"p50", "p95", "p99"}
+
+
+def test_counter_gauge_labels_and_kinds(traced):
+    r = om.registry()
+    r.counter("c_total", kind="a").inc()
+    r.counter("c_total", kind="a").inc(2)
+    r.counter("c_total", kind="b").inc()
+    r.gauge("g").set(1.5)
+    snap = r.snapshot()
+    assert snap["c_total"] == {"kind=a": 3.0, "kind=b": 1.0}
+    assert snap["g"] == {"": 1.5}
+    with pytest.raises(ValueError):
+        r.gauge("c_total")  # one name, one instrument kind
+    with pytest.raises(ValueError):
+        r.counter("c_total").inc(-1)  # counters are monotonic
+
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})? "
+    r"[-+]?(?:[0-9.]+(?:e[-+]?[0-9]+)?|inf|nan))$")
+
+
+def test_prometheus_text_format_valid(traced):
+    r = om.registry()
+    r.counter("tg_things_total", help="things counted", kind="x").inc(3)
+    r.gauge("tg_level", help="a level").set(0.25)
+    h = r.histogram("tg_lat_seconds", help="latency")
+    for v in (0.01, 0.02, 0.04):
+        h.observe(v)
+    text = r.to_prometheus()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"invalid prometheus line: {line!r}"
+    # summary exposition: quantile series + _sum + _count
+    assert 'tg_lat_seconds{quantile="0.5"}' in text
+    assert "tg_lat_seconds_sum" in text
+    assert "tg_lat_seconds_count 3" in text
+    assert "# TYPE tg_things_total counter" in text
+    assert 'tg_things_total{kind="x"} 3.0' in text
+
+
+# -- exporters ---------------------------------------------------------------
+def test_chrome_trace_schema_and_atomicity(tmp_path, traced):
+    with ot.span("outer", cat="train", uid="u1"):
+        ot.add_event("marker", x=1)
+    path = str(tmp_path / "trace.json")
+    oe.write_chrome_trace(path)
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert events, "no trace events exported"
+    for e in events:
+        assert {"ph", "ts", "pid", "tid", "name"} <= set(e), e
+    phs = {e["ph"] for e in events}
+    assert "X" in phs and "i" in phs
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete[0]["name"] == "outer" and "dur" in complete[0]
+    assert complete[0]["args"]["uid"] == "u1"
+    # ts ordering + atomic write (no tmp debris)
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_jsonl_export_round_trips(tmp_path, traced):
+    with ot.span("a", k=1):
+        pass
+    path = str(tmp_path / "spans.jsonl")
+    oe.write_jsonl(path)
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert len(lines) == 1
+    assert lines[0]["name"] == "a" and lines[0]["attrs"] == {"k": 1}
+    assert lines[0]["durNs"] is not None
+
+
+# -- workflow integration -----------------------------------------------------
+def test_train_emits_span_per_fitted_stage(traced):
+    wf = _selector_workflow(_df())
+    model = wf.train()
+    spans = ot.tracer().finished()
+    fit_uids = {s.attrs["uid"] for s in spans if s.name == "stage.fit"}
+    from transmogrifai_tpu.stages.base import Estimator
+    est_uids = {s.uid for s in wf.stages if isinstance(s, Estimator)}
+    assert est_uids, "workflow has no estimators?"
+    assert est_uids <= fit_uids  # >= one span per fitted stage
+    # root span + per-family sweep spans, properly parented
+    roots = [s for s in spans if s.name == "workflow.train"]
+    assert len(roots) == 1
+    fams = [s for s in spans if s.name == "sweep.family"]
+    assert {s.attrs["family"] for s in fams} == {
+        "OpLogisticRegression", "OpLinearSVC"}
+    for s in fams:
+        assert s.attrs["configs"] in (1, 2) and s.attrs["folds"] == 3
+        assert "cacheHits" in s.attrs and "cacheMisses" in s.attrs
+    # summary aggregates per-stage + per-family timings
+    obs = model.summary()["observability"]
+    assert obs["enabled"] == {"tracing": True, "metrics": True}
+    assert "ModelSelector" in obs["stages"]
+    assert obs["stages"]["ModelSelector"]["fitSeconds"] > 0
+    assert set(obs["families"]) == {"OpLogisticRegression", "OpLinearSVC"}
+    assert {"hits", "misses"} <= set(obs["compileCache"])
+
+
+def test_scoring_latency_histograms_and_quarantine_counter(traced):
+    model = _selector_workflow(_df()).train()
+    sf = model.score_function()
+    for _ in range(4):
+        sf({"x1": 1.0, "x2": -0.5})
+    from transmogrifai_tpu.local import micro_batch_score_function
+    mb = micro_batch_score_function(model)
+    out = mb([{"x1": 1.0, "x2": 0.2}, {"x1": "bad", "x2": 0.1}])
+    from transmogrifai_tpu.local.scoring import SCORE_ERROR_KEY
+    assert SCORE_ERROR_KEY in out[1]
+    obs = summarize()
+    sc = obs["scoring"]
+    assert sc["request"]["count"] == 4
+    assert {"p50", "p95", "p99"} <= set(sc["request"])
+    assert sc["microBatch"]["count"] == 1
+    assert sc["rowsScored"] == 2.0
+    assert sc["rowsQuarantined"] == 1.0
+    # the quarantine is also a span event on the micro-batch span
+    mb_spans = [s for s in ot.tracer().finished()
+                if s.name == "score.micro_batch"]
+    assert len(mb_spans) == 1
+    assert [e for e in mb_spans[0].events if e[0] == "score.quarantine"]
+
+
+@pytest.mark.chaos
+def test_faults_become_span_events_and_counters(traced):
+    """A transient fit fault retried by the policy must surface as a
+    retry.backoff + fault.retry event on the stage's span and in
+    tg_faults_total / tg_retry_backoff_seconds."""
+    wf = _selector_workflow(_df()).with_fault_policy()
+    with faults.injected({"dag.stage_fit": {
+            "mode": "raise", "transient": True, "nth": 1, "count": 1}}):
+        model = wf.train()
+    assert model.summary()["faults"]["retries"], "retry did not happen"
+    snap = om.registry().snapshot()
+    assert snap["tg_faults_total"].get("kind=retry") == 1.0
+    assert snap["tg_retry_backoff_seconds"][""]["count"] == 1
+    events = [(e[0], s.name) for s in ot.tracer().finished()
+              for e in s.events]
+    names = {n for n, _ in events}
+    assert "retry.backoff" in names and "fault.retry" in names
+
+
+def test_overhead_guard_disabled_means_zero_writes():
+    """Observability off (the default): a full train + micro-batch score
+    must write NOTHING — no spans, no registry series — so the hot paths
+    pay only the flag checks."""
+    assert not ot.tracing_enabled() and not om.metrics_enabled()
+    model = _selector_workflow(_df(n=200)).train()
+    from transmogrifai_tpu.local import micro_batch_score_function
+    micro_batch_score_function(model)([{"x1": 0.5, "x2": 0.1}])
+    assert ot.tracer().finished() == []
+    assert om.registry().snapshot() == {}
+    obs = model.summary()["observability"]
+    assert obs["enabled"] == {"tracing": False, "metrics": False}
+    assert obs["spanCount"] == 0 and obs["counters"] == {}
+
+
+# -- profiler + compile-cache satellites -------------------------------------
+def test_profiler_app_metrics_spans_and_cache_counts():
+    class S:
+        uid = "s1"
+    prof = StageProfiler()
+    with prof.track(S(), "fit", 0):
+        pass
+    with prof.track(S(), "transform", 1):
+        pass
+    m = prof.app_metrics()
+    assert {"hits", "misses"} <= set(m["compileCache"])
+    assert all(isinstance(v, int) for v in m["compileCache"].values())
+    assert len(m["spans"]) == 2
+    for sp, op in zip(m["spans"], ("fit", "transform")):
+        assert {"name", "ph", "ts", "pid", "tid", "dur"} <= set(sp)
+        assert sp["ph"] == "X" and sp["name"] == f"S.{op}"
+        assert sp["args"]["uid"] == "s1" and sp["args"]["op"] == op
+    assert m["spans"][0]["ts"] <= m["spans"][1]["ts"]
+
+
+def test_cache_event_counters():
+    before = cache_stats()
+    record_cache_event(True)
+    record_cache_event(False)
+    record_cache_event(False)
+    after = cache_stats()
+    assert after["hits"] - before["hits"] == 1
+    assert after["misses"] - before["misses"] == 2
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_cli_trace_writes_bundle(tmp_path):
+    from transmogrifai_tpu.cli import main as cli_main
+    out_dir = tmp_path / "trace_out"
+    cli_main(["trace", "--output", str(out_dir), "--rows", "200"])
+    doc = json.load(open(out_dir / "trace.json"))
+    assert doc["traceEvents"]
+    assert any(e["name"] == "workflow.train" for e in doc["traceEvents"])
+    assert any(e["name"] == "score.micro_batch"
+               for e in doc["traceEvents"])
+    prom = open(out_dir / "metrics.prom").read()
+    assert "tg_score_microbatch_seconds_count" in prom
+    summary = json.load(open(out_dir / "summary.json"))
+    assert summary["spanCount"] > 0 and summary["stages"]
+    assert (out_dir / "spans.jsonl").exists()
+    # the CLI must restore env-driven enablement on exit
+    assert not ot.tracing_enabled() and not om.metrics_enabled()
+    # CLI leaves telemetry in the process buffers; scrub for the no-leak
+    # conftest check (the bundle on disk is the product, not the buffers)
+    from transmogrifai_tpu import observability
+    observability.reset()

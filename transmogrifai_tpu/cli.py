@@ -1,4 +1,4 @@
-"""``op gen`` — project generator.
+"""``op gen`` / ``op trace`` — project generator + trace capture.
 
 Mirrors the reference CLI (reference: cli/src/main/scala/com/salesforce/op/cli/
 — ``op gen`` parses an Avro schema (SchemaSource.scala, AvroField.scala) or
@@ -23,6 +23,19 @@ Answers file (the reference's non-interactive answers mechanism): one
     problem=binary                 # binary | multiclass | regression
     type.<field>=PickList          # override a field's inferred FeatureType
     role.<field>=drop              # predictor (default) | id | drop
+
+``trace`` (docs/observability.md) trains an example dataset with the
+observability subsystem force-enabled and writes the full telemetry bundle
+to a directory::
+
+    python -m transmogrifai_tpu.cli trace --output ./trace_out \
+        [--dataset synthetic|iris] [--rows 600] [--seed 42]
+
+    trace_out/trace.json     # Chrome trace-event JSON (chrome://tracing,
+                             # https://ui.perfetto.dev)
+    trace_out/spans.jsonl    # one JSON object per span (jq/pandas)
+    trace_out/metrics.prom   # Prometheus text exposition
+    trace_out/summary.json   # summary()["observability"] aggregates
 """
 from __future__ import annotations
 
@@ -313,6 +326,89 @@ def generate(input_csv: str, response: str, output: str, name: str,
     return files
 
 
+def _trace_workflow(dataset: str, rows: int, seed: int):
+    """→ (workflow, scoring rows) for the trace capture run. ``synthetic``
+    needs no data files; ``iris`` uses the bundled helloworld-parity
+    example (requires its dataset on disk)."""
+    import numpy as np
+    import pandas as pd
+
+    from .features import FeatureBuilder
+    from .impl.feature.transmogrifier import transmogrify
+    from .impl.selector.factories import BinaryClassificationModelSelector
+    from .workflow import OpWorkflow
+
+    if dataset == "iris":
+        from .examples.iris import build_workflow
+        wf, _label, _pred = build_workflow(seed=seed)
+        return wf, None
+    rng = np.random.RandomState(seed)
+    x1, x2, x3 = rng.randn(rows), rng.randn(rows), rng.randn(rows)
+    y = ((x1 + 0.5 * x2 - 0.25 * x3) > 0).astype(float)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "x3": x3, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    preds = [FeatureBuilder.Real(c).extract_field().as_predictor()
+             for c in ("x1", "x2", "x3")]
+    checked = transmogrify(preds).sanity_check(label)
+    # a small two-family sweep: enough for per-family spans + a winner
+    # refit without the full default grids' runtime
+    models = [("OpLogisticRegression",
+               [{"regParam": r, "elasticNetParam": 0.0}
+                for r in (0.01, 0.1)]),
+              ("OpLinearSVC", [{"regParam": 0.01}])]
+    pred = (BinaryClassificationModelSelector
+            .with_cross_validation(seed=seed, models=models)
+            .set_input(label, checked).get_output())
+    wf = OpWorkflow().set_input_dataset(df).set_result_features(pred)
+    score_rows = [dict(x1=float(a), x2=float(b), x3=float(c))
+                  for a, b, c in zip(x1[:32], x2[:32], x3[:32])]
+    return wf, score_rows
+
+
+def run_trace(output: str, dataset: str = "synthetic", rows: int = 600,
+              seed: int = 42) -> Dict[str, str]:
+    """Train under forced tracing+metrics and write the telemetry bundle
+    (trace.json / spans.jsonl / metrics.prom / summary.json) to ``output``."""
+    import json as _json
+
+    from . import observability
+    from .observability import export as obs_export
+    from .observability import metrics as obs_metrics
+    from .observability import trace as obs_trace
+
+    obs_trace.enable_tracing(True)
+    obs_metrics.enable_metrics(True)
+    try:
+        wf, score_rows = _trace_workflow(dataset, rows, seed)
+        model = wf.train()
+        if score_rows:
+            # drive the serving path too, so the latency histograms and
+            # micro-batch spans land in the bundle
+            from .local import micro_batch_score_function
+            scorer = micro_batch_score_function(model)
+            scorer(score_rows)
+        os.makedirs(output, exist_ok=True)
+        files = {
+            "trace.json": obs_export.write_chrome_trace(
+                os.path.join(output, "trace.json")),
+            "spans.jsonl": obs_export.write_jsonl(
+                os.path.join(output, "spans.jsonl")),
+            "metrics.prom": obs_export.write_prometheus(
+                os.path.join(output, "metrics.prom")),
+        }
+        summary = observability.summarize()
+        with open(os.path.join(output, "summary.json"), "w") as fh:
+            _json.dump(summary, fh, indent=2, default=str)
+        files["summary.json"] = os.path.join(output, "summary.json")
+        print(f"wrote {', '.join(sorted(files))} to {output}/ "
+              f"({summary['spanCount']} spans; open trace.json in "
+              f"chrome://tracing or https://ui.perfetto.dev)")
+        return files
+    finally:
+        obs_trace.enable_tracing(None)
+        obs_metrics.enable_metrics(None)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="op",
                                 description="transmogrifai_tpu CLI")
@@ -329,12 +425,27 @@ def main(argv: Optional[List[str]] = None) -> None:
     gen.add_argument("--answers", default=None,
                      help="key=value answers file (problem=, type.<f>=, "
                           "role.<f>=) for non-interactive generation")
+    tr = sub.add_parser(
+        "trace", help="train an example dataset under tracing and write "
+                      "trace.json + metrics.prom (docs/observability.md)")
+    tr.add_argument("--output", required=True,
+                    help="directory for trace.json / spans.jsonl / "
+                         "metrics.prom / summary.json")
+    tr.add_argument("--dataset", default="synthetic",
+                    choices=["synthetic", "iris"],
+                    help="synthetic needs no data files; iris requires the "
+                         "bundled example dataset on disk")
+    tr.add_argument("--rows", type=int, default=600,
+                    help="synthetic dataset row count")
+    tr.add_argument("--seed", type=int, default=42)
     a = p.parse_args(argv)
     if a.command == "gen":
         generate(a.input, a.response, a.output, a.name, a.id_field,
                  schema=a.schema, answers=a.answers)
         print(f"generated project in {a.output}/ "
               f"(app.py, README.md, test_app.py)")
+    elif a.command == "trace":
+        run_trace(a.output, dataset=a.dataset, rows=a.rows, seed=a.seed)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .features import Feature
+from .observability.trace import span as _obs_span
 from .stages.base import Estimator, FeatureGeneratorStage, Transformer
 from .table import FeatureTable
 
@@ -118,7 +119,11 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                         # mid-DAG with earlier stages already checkpointed
                         faults.inject("preempt.stage_fit", key=stage.uid)
                         faults.inject("dag.stage_fit", key=stage.uid)
-                        with prof.track(stage, "fit", li):
+                        with _obs_span("stage.fit", cat="train",
+                                       uid=stage.uid,
+                                       stage=type(stage).__name__,
+                                       layer=li), \
+                                prof.track(stage, "fit", li):
                             return stage.fit(table)
                     if retry_policy is not None:
                         model = retry_policy.execute(
@@ -134,7 +139,10 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
             else:
                 raise TypeError(f"unexpected stage kind {type(stage).__name__}")
         for model in models:
-            with prof.track(model, "transform", li):
+            with _obs_span("stage.transform", cat="train",
+                           uid=getattr(model, "uid", "?"),
+                           stage=type(model).__name__, layer=li), \
+                    prof.track(model, "transform", li):
                 table = model.transform(table)
     return table, fitted
 
@@ -151,6 +159,8 @@ def apply_transformations_dag(table: FeatureTable, layers: List[StageLayer],
                 raise ValueError(
                     f"stage {stage.uid} is an unfitted estimator; "
                     "score requires a fitted workflow model")
-            with prof.track(stage, "transform", li):
+            with _obs_span("stage.transform", cat="score", uid=stage.uid,
+                           stage=type(stage).__name__, layer=li), \
+                    prof.track(stage, "transform", li):
                 table = stage.transform(table)
     return table

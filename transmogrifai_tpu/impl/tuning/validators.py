@@ -24,6 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
+from ...observability.trace import span as _obs_span, tracing_enabled
 from ...robustness import faults
 from ...robustness.guards import (
     AllCandidatesFailedError, quarantine_non_finite,
@@ -550,19 +553,39 @@ class OpValidator:
                             "sweep resume: restored %d %s candidate(s) "
                             "from checkpoint", len(grid), family.name)
                         continue
-            try:
-                # deterministic preemption point: the process dies between
-                # family branches — already-persisted candidates survive
-                faults.inject("preempt.sweep", key=family.name)
-                faults.inject("validator.family_fit", key=family.name)
-                pending.append(_dispatch(family, grid))
-            except Exception as e:
-                reason = f"fit raised {type(e).__name__}: {e}"
-                logger.warning("quarantining model family %s: %s",
-                               family.name, reason)
-                pending.append((family.name, list(grid), None,
-                                F * len(grid), len(grid)))
-                fit_failures[fi] = reason
+            # sweep span per candidate family: grid size, folds, metric,
+            # and the compile-cache hit/miss delta of dispatching this
+            # branch (utils/jax_cache.py listener) — the attribution the
+            # 0.381x mesh regression lacked (compile vs execute)
+            with _obs_span("sweep.family", cat="sweep", family=family.name,
+                           configs=len(grid), folds=F,
+                           metric=metric_name) as sweep_span:
+                cs0 = None
+                if tracing_enabled():
+                    from ...utils.jax_cache import cache_stats
+                    cs0 = cache_stats()
+                try:
+                    # deterministic preemption point: the process dies
+                    # between family branches — already-persisted
+                    # candidates survive
+                    faults.inject("preempt.sweep", key=family.name)
+                    faults.inject("validator.family_fit", key=family.name)
+                    pending.append(_dispatch(family, grid))
+                except Exception as e:
+                    reason = f"fit raised {type(e).__name__}: {e}"
+                    logger.warning("quarantining model family %s: %s",
+                                   family.name, reason)
+                    pending.append((family.name, list(grid), None,
+                                    F * len(grid), len(grid)))
+                    fit_failures[fi] = reason
+                    sweep_span.add_event("sweep.family_quarantined",
+                                         family=family.name, reason=reason)
+                if cs0 is not None:
+                    from ...utils.jax_cache import cache_stats
+                    cs1 = cache_stats()
+                    sweep_span.set_attr(
+                        cacheHits=cs1["hits"] - cs0["hits"],
+                        cacheMisses=cs1["misses"] - cs0["misses"])
             if sweep_ckpt is not None:
                 from ...parallel.distributed import fetch_to_host
                 from .sweep_checkpoint import SweepCheckpoint, params_hash
@@ -594,6 +617,8 @@ class OpValidator:
                  if len(valid_m) > 1 else None)
 
         def finish() -> BestEstimator:
+            import time as _time
+
             from ...parallel.distributed import fetch_to_host
 
             # build the result list locally (not the closed-over `results`)
@@ -601,7 +626,15 @@ class OpValidator:
             results: List[ValidationResult] = []
             quarantined: List[Dict[str, Any]] = []
             best: Optional[BestEstimator] = None
+            # the device->host metric fetch is the sweep's "transfer" phase;
+            # its histogram lets bench.py split compile/execute/transfer
+            t0_fetch = _time.perf_counter()
             m_host = fetch_to_host(all_m) if all_m is not None else None
+            if m_host is not None:
+                _obs_metrics.observe(
+                    "tg_sweep_transfer_seconds",
+                    _time.perf_counter() - t0_fetch,
+                    help="device->host validation-metric fetch per sweep")
             off = 0
             for fi, (fam_name, grid_l, m, B_true, G) in enumerate(pending):
                 if fi in host_metrics:  # restored / eagerly persisted
@@ -640,6 +673,8 @@ class OpValidator:
                 raise AllCandidatesFailedError(quarantined)
             best.results = results
             best.quarantined = quarantined
+            _obs_trace.add_event("sweep.winner", family=best.family_name,
+                                 metricValue=float(best.metric_value))
             return best
 
         if resolve:

@@ -12,11 +12,14 @@ micro-batches — the TPU replacement for MLeap row scoring.
 from __future__ import annotations
 
 import logging
+import time
 
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import metrics as _obs_metrics
+from ..observability.trace import span as _obs_span
 from ..table import Column, FeatureTable
 from ..types import OPVector as OPVectorType
 
@@ -45,13 +48,23 @@ def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
     raw_gens = [(f.name, f.origin_stage) for f in model.raw_features]
 
     def score(row: Dict[str, Any]) -> Dict[str, Any]:
+        # per-request latency: the O(1)-memory streaming histogram keeps
+        # p50/p95/p99 live over unbounded request streams
+        # (docs/observability.md "Scoring telemetry")
+        t0 = (time.perf_counter()
+              if _obs_metrics.metrics_enabled() else None)
         # raw features come from each generator's extract_fn, exactly like the
         # batch reader path (DataReader.generateDataFrame row build)
         acc = {name: gen.extract(row) for name, gen in raw_gens}
         for stage in stages:
             out = stage.get_output()
             acc[out.name] = stage.transform_row(acc)
-        return {name: acc[name] for name in result_names}
+        result = {name: acc[name] for name in result_names}
+        if t0 is not None:
+            _obs_metrics.observe(
+                "tg_score_request_seconds", time.perf_counter() - t0,
+                help="per-request scoring latency (row path)")
+        return result
 
     return score
 
@@ -307,24 +320,45 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
         return out
 
     def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
-        try:
-            return _records(compiled(_build_table(rows)), len(rows))
-        except (ScoreSchemaError, TypeError, ValueError) as batch_err:
-            # isolate the offenders: score each row alone; rows that still
-            # fail are quarantined instead of poisoning the whole batch
-            out: List[Dict[str, Any]] = []
-            quarantined = 0
-            for row in rows:
-                try:
-                    out.append(_records(compiled(_build_table([row])), 1)[0])
-                except (ScoreSchemaError, TypeError, ValueError) as e:
-                    rec = {f.name: None for f in result_features}
-                    rec[SCORE_ERROR_KEY] = str(e) or str(batch_err)
-                    out.append(rec)
-                    quarantined += 1
-            logger.warning(
-                "micro-batch scoring quarantined %d/%d row(s) "
-                "(first batch error: %s)", quarantined, len(rows), batch_err)
-            return out
+        t0 = time.perf_counter()
+        quarantined = 0
+        with _obs_span("score.micro_batch", cat="score",
+                       rows=len(rows)) as sp:
+            try:
+                out = _records(compiled(_build_table(rows)), len(rows))
+            except (ScoreSchemaError, TypeError, ValueError) as batch_err:
+                # isolate the offenders: score each row alone; rows that
+                # still fail are quarantined instead of poisoning the batch
+                out = []
+                for row in rows:
+                    try:
+                        out.append(
+                            _records(compiled(_build_table([row])), 1)[0])
+                    except (ScoreSchemaError, TypeError, ValueError) as e:
+                        rec = {f.name: None for f in result_features}
+                        rec[SCORE_ERROR_KEY] = str(e) or str(batch_err)
+                        out.append(rec)
+                        quarantined += 1
+                sp.add_event("score.quarantine", rows=quarantined,
+                             batchError=str(batch_err)[:200])
+                logger.warning(
+                    "micro-batch scoring quarantined %d/%d row(s) "
+                    "(first batch error: %s)", quarantined, len(rows),
+                    batch_err)
+        if _obs_metrics.metrics_enabled():
+            # per-micro-batch latency + row/quarantine counters: the serve
+            # path's p50/p95/p99 surfaces in summary()["observability"]
+            # and metrics.prom (docs/observability.md)
+            _obs_metrics.observe(
+                "tg_score_microbatch_seconds", time.perf_counter() - t0,
+                help="per-micro-batch scoring latency (columnar path)")
+            _obs_metrics.inc_counter(
+                "tg_score_rows_total", float(len(rows)),
+                help="rows submitted to micro-batch scoring")
+            if quarantined:
+                _obs_metrics.inc_counter(
+                    "tg_score_quarantined_total", float(quarantined),
+                    help="rows quarantined under __score_error__")
+        return out
 
     return score

@@ -1,0 +1,107 @@
+"""Observability: structured tracing + metrics + exporters (docs/observability.md).
+
+The TPU build's replacement for the reference's Spark-listener telemetry
+(reference utils/.../spark/OpSparkListener.scala → AppMetrics): spans for
+every train/score unit of work (``trace``), a registry of counters, gauges
+and streaming-quantile latency histograms (``metrics``), and pure-stdlib
+exporters — Chrome trace-event JSON for ``chrome://tracing``/Perfetto,
+Prometheus text exposition, JSONL (``export``).
+
+Enable with ``TG_TRACE=1`` (spans + metrics) or ``TG_METRICS=1`` (metrics
+only); disabled, every instrumentation point is a single flag check.
+``OpWorkflowModel.summary()["observability"]`` returns :func:`summarize` —
+the aggregated per-stage / per-family timings, fault counters and scoring
+latency quantiles of the current process.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import export, metrics, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry, enable_metrics, inc_counter, metrics_enabled, observe,
+    registry, set_gauge,
+)
+from .trace import (  # noqa: F401
+    Span, Tracer, add_event, enable_tracing, span, tracer, tracing_enabled,
+)
+
+
+def reset() -> None:
+    """Fresh tracer + registry + env-driven enablement — the per-test
+    isolation hook (tests/conftest.py); production never needs it."""
+    trace.reset()
+    metrics.reset()
+
+
+def summarize(tr: Optional[trace.Tracer] = None,
+              reg: Optional[metrics.MetricsRegistry] = None
+              ) -> Dict[str, Any]:
+    """Aggregate the span buffer + registry into the
+    ``summary()["observability"]`` section: per-stage and per-model-family
+    wall-clock (from spans), fault/retry/quarantine counters, scoring
+    latency quantiles, and the process compile-cache hit/miss counts."""
+    t = tr or trace.tracer()
+    r = reg or metrics.registry()
+    spans = t.finished()
+
+    by_name: Dict[str, Dict[str, Any]] = {}
+    stages: Dict[str, Dict[str, Any]] = {}
+    families: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        if s.dur_ns is None:
+            continue
+        secs = s.dur_ns / 1e9
+        agg = by_name.setdefault(s.name, {"seconds": 0.0, "count": 0})
+        agg["seconds"] += secs
+        agg["count"] += 1
+        if s.name in ("stage.fit", "stage.transform"):
+            key = str(s.attrs.get("stage", "?"))
+            st = stages.setdefault(key, {"seconds": 0.0, "count": 0,
+                                         "fitSeconds": 0.0,
+                                         "transformSeconds": 0.0})
+            st["seconds"] += secs
+            st["count"] += 1
+            op = ("fitSeconds" if s.name == "stage.fit"
+                  else "transformSeconds")
+            st[op] += secs
+        elif s.name == "sweep.family":
+            key = str(s.attrs.get("family", "?"))
+            fam = families.setdefault(key, {"seconds": 0.0, "count": 0,
+                                            "configs": 0})
+            fam["seconds"] += secs
+            fam["count"] += 1
+            fam["configs"] += int(s.attrs.get("configs", 0) or 0)
+
+    snap = r.snapshot()
+    counters = {name: series for name, series in snap.items()
+                if not name.startswith("tg_score_")}
+    scoring: Dict[str, Any] = {}
+    for name, key in (("tg_score_request_seconds", "request"),
+                      ("tg_score_microbatch_seconds", "microBatch")):
+        series = snap.get(name)
+        if series:
+            # unlabelled single series — take it directly
+            scoring[key] = next(iter(series.values()))
+    for name, key in (("tg_score_rows_total", "rowsScored"),
+                      ("tg_score_quarantined_total", "rowsQuarantined")):
+        series = snap.get(name)
+        if series:
+            scoring[key] = sum(series.values())
+
+    from ..utils.jax_cache import cache_stats
+    return {
+        "enabled": {"tracing": trace.tracing_enabled(),
+                    "metrics": metrics.metrics_enabled()},
+        "spanCount": len(spans),
+        "droppedSpans": t.dropped,
+        "byName": dict(sorted(by_name.items(),
+                              key=lambda kv: -kv[1]["seconds"])),
+        "stages": dict(sorted(stages.items(),
+                              key=lambda kv: -kv[1]["seconds"])),
+        "families": dict(sorted(families.items(),
+                                key=lambda kv: -kv[1]["seconds"])),
+        "counters": counters,
+        "scoring": scoring,
+        "compileCache": cache_stats(),
+    }

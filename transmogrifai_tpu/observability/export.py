@@ -1,0 +1,79 @@
+"""Exporters: Chrome trace-event JSON, Prometheus text, JSONL event log.
+
+All pure-stdlib and written atomically through the checkpoint machinery's
+tmp+fsync+rename helper (``manifest.atomic_write_bytes``): a preempted
+export leaves either the previous file or the new one, never a torn JSON —
+the same discipline as every other artifact this framework writes.
+
+* :func:`chrome_trace` / :func:`write_chrome_trace` — the trace-event
+  format (``{"traceEvents": [{"name","ph","ts","pid","tid",...}]}``)
+  loadable in ``chrome://tracing`` or https://ui.perfetto.dev. Spans export
+  as complete events (``ph: "X"``, microsecond ``ts``/``dur``); span events
+  and free-standing instants as ``ph: "i"``.
+* :func:`write_prometheus` — the registry's text exposition format
+  (``metrics.prom``), scrape-able or pushable as-is.
+* :func:`write_jsonl` — one JSON object per finished span, for ad-hoc
+  ``jq``/pandas analysis of long runs.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..manifest import atomic_write_bytes
+from . import metrics as _metrics
+from . import trace as _trace
+
+
+def chrome_trace(tracer: Optional[_trace.Tracer] = None) -> Dict[str, Any]:
+    """Render the tracer's finished spans as a Chrome trace-event document."""
+    t = tracer or _trace.tracer()
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for s in t.finished():
+        base = {"name": s.name, "cat": s.cat or "span", "pid": pid,
+                "tid": s.tid, "ts": s.ts_ns / 1e3}
+        if s.dur_ns is None:       # instant event
+            events.append({**base, "ph": "i", "s": "t",
+                           "args": dict(s.attrs)})
+        else:
+            events.append({**base, "ph": "X", "dur": s.dur_ns / 1e3,
+                           "args": dict(s.attrs)})
+        for name, ts_ns, attrs in s.events:
+            events.append({"name": name, "cat": "event", "ph": "i",
+                           "s": "t", "pid": pid, "tid": s.tid,
+                           "ts": ts_ns / 1e3, "args": dict(attrs)})
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "epochUnix": t.epoch_unix,
+            "droppedSpans": t.dropped,
+            "maxSpans": t.max_spans,
+        },
+    }
+
+
+def write_chrome_trace(path: str,
+                       tracer: Optional[_trace.Tracer] = None) -> str:
+    doc = chrome_trace(tracer)
+    atomic_write_bytes(path, json.dumps(doc).encode("utf-8"))
+    return path
+
+
+def write_prometheus(path: str,
+                     registry: Optional[_metrics.MetricsRegistry] = None
+                     ) -> str:
+    reg = registry or _metrics.registry()
+    atomic_write_bytes(path, reg.to_prometheus().encode("utf-8"))
+    return path
+
+
+def write_jsonl(path: str, tracer: Optional[_trace.Tracer] = None) -> str:
+    t = tracer or _trace.tracer()
+    lines = [json.dumps(s.to_json()) for s in t.finished()]
+    atomic_write_bytes(path, ("\n".join(lines) + ("\n" if lines else ""))
+                       .encode("utf-8"))
+    return path

@@ -18,6 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 from .faults import InjectedFaultError, TransientFaultError
 
 #: substrings (lowercased) marking an error transient: the gRPC-style
@@ -102,6 +104,16 @@ class FaultLog:
         log = _CURRENT_LOG.get()
         if log is not None:
             log.reports.append(report)
+        # observability choke point: every recovery anywhere in the stack
+        # becomes a span event on whatever span is open (a trace shows the
+        # quarantine in line with the sweep it interrupted) and a counter
+        # keyed by kind (bounded cardinality; the site goes on the event
+        # only). Both are no-ops when observability is off.
+        _obs_trace.add_event("fault." + report.kind, site=report.site,
+                             attempts=report.attempts)
+        _obs_metrics.inc_counter(
+            "tg_faults_total", help="fault recoveries by kind "
+            "(docs/robustness.md)", kind=report.kind)
 
     def of_kind(self, kind: str) -> List[FaultReport]:
         return [r for r in self.reports if r.kind == kind]
@@ -171,7 +183,14 @@ class RetryPolicy:
                         detail={"errors": errors,
                                 "overDeadline": over_deadline}))
                     raise
-                time.sleep(self.delay_for(attempt, site))
+                delay = self.delay_for(attempt, site)
+                _obs_trace.add_event("retry.backoff", site=site,
+                                     attempt=attempt + 1,
+                                     delaySecs=round(delay, 4))
+                _obs_metrics.observe(
+                    "tg_retry_backoff_seconds", delay,
+                    help="backoff sleeps between transient-failure retries")
+                time.sleep(delay)
                 attempt += 1
                 continue
             if attempt:

@@ -11,8 +11,53 @@ always wins.
 from __future__ import annotations
 
 import os
+import threading
+from typing import Dict
 
 _done = False
+
+# -- compile-cache hit/miss accounting ---------------------------------------
+# jax announces persistent-cache outcomes through its internal monitoring
+# events ('/jax/compilation_cache/cache_hits' / 'cache_misses'); a
+# best-effort listener folds them into plain process counters that
+# StageProfiler.app_metrics() and observability.summarize() report, and that
+# sweep spans diff to tag each family branch hit/miss. The monitoring module
+# is private API — if it moves, the counters simply stay at zero.
+_CACHE_EVENTS: Dict[str, int] = {"hits": 0, "misses": 0}
+_listener_lock = threading.Lock()
+_listener_done = False
+
+
+def record_cache_event(hit: bool) -> None:
+    """Count one compile-cache outcome (the listener's target; also the
+    deterministic entry point for tests)."""
+    _CACHE_EVENTS["hits" if hit else "misses"] += 1
+
+
+def _install_listener() -> None:
+    global _listener_done
+    with _listener_lock:
+        if _listener_done:
+            return
+        _listener_done = True
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                record_cache_event(True)
+            elif event == "/jax/compilation_cache/cache_misses":
+                record_cache_event(False)
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass  # counters stay zero; never break compilation for telemetry
+
+
+def cache_stats() -> Dict[str, int]:
+    """Process-wide persistent compile-cache ``{"hits": n, "misses": n}``."""
+    _install_listener()
+    return dict(_CACHE_EVENTS)
 
 
 def ensure_compilation_cache() -> None:
@@ -20,6 +65,7 @@ def ensure_compilation_cache() -> None:
     if _done:
         return
     _done = True
+    _install_listener()
     try:
         import jax
         if jax.config.jax_compilation_cache_dir:

@@ -24,6 +24,8 @@ class StageProfiler:
     def __init__(self, max_records: int = 10_000):
         self.records: deque = deque(maxlen=max_records)
         self.app_start = time.time()
+        #: monotonic epoch for span-compatible record timestamps
+        self._epoch = time.perf_counter()
         self._total = 0.0
         self._count = 0
         self._by_stage: Dict[str, float] = {}
@@ -44,6 +46,9 @@ class StageProfiler:
                 "op": op,
                 "layer": layer,
                 "seconds": secs,
+                # microseconds since profiler construction — the span/chrome
+                # timestamp of this op (see spans())
+                "ts": (t0 - self._epoch) * 1e6,
             })
             self._total += secs
             self._count += 1
@@ -52,11 +57,29 @@ class StageProfiler:
             lk = f"layer_{layer}" if layer >= 0 else "unlayered"
             self._by_layer[lk] = self._by_layer.get(lk, 0.0) + secs
 
+    def spans(self) -> List[Dict[str, Any]]:
+        """The records ring as Chrome-trace complete events (``ph: "X"``,
+        microsecond ``ts``/``dur``) — droppable straight into a trace-event
+        document alongside the observability tracer's output. Bounded by the
+        ring: only the newest ``maxlen`` ops survive a long run."""
+        import os
+        pid = os.getpid()
+        return [{
+            "name": f"{r['stage']}.{r['op']}",
+            "ph": "X",
+            "ts": r.get("ts", 0.0),
+            "dur": r["seconds"] * 1e6,
+            "pid": pid,
+            "tid": 0,
+            "args": {"uid": r["uid"], "op": r["op"], "layer": r["layer"]},
+        } for r in self.records]
+
     # -- aggregation (reference AppMetrics, OpSparkListener.scala:55-110) ----
     def app_metrics(self) -> Dict[str, Any]:
         # accumulated in track() (NOT derived from the bounded records ring,
         # which would undercount runs past its maxlen)
         by_layer = self._by_layer
+        from .jax_cache import cache_stats
         out = {
             "appDurationSecs": time.time() - self.app_start,
             "stageSecondsTotal": self._total,
@@ -64,6 +87,11 @@ class StageProfiler:
             "byOp": dict(self._by_op),
             "byLayer": dict(sorted(by_layer.items())),
             "numRecords": self._count,
+            # span-compatible view of the (bounded) record ring + the
+            # process compile-cache outcomes — the two blind spots of the
+            # original wall-clock-sums-only report
+            "spans": self.spans(),
+            "compileCache": cache_stats(),
         }
         # device-side memory stats, best effort (the reference's analog is
         # the listener's executor GC/spill metrics)

@@ -219,9 +219,11 @@ class OpWorkflow(_WorkflowCore):
         vs refit. Checkpoints failing verification are reported and the
         stage refits — a resume never crashes on (or silently uses) state
         it can deterministically rebuild."""
+        from .observability.trace import span as _obs_span
         from .robustness.policy import FaultLog
         fault_log = FaultLog()
-        with fault_log.activate():
+        with fault_log.activate(), \
+                _obs_span("workflow.train", cat="train", resume=resume):
             model = self._train_logged(resume=resume)
         model._fault_log = fault_log
         return model
@@ -470,8 +472,10 @@ class OpWorkflowModel(_WorkflowCore):
             table = dataframe_to_table(df, self.raw_features)
         if table is None:
             table = self._generate_raw_table()
-        scored = apply_transformations_dag(table, self._layers,
-                                           profiler=self.profiler)
+        from .observability.trace import span as _obs_span
+        with _obs_span("workflow.score", cat="score", rows=table.num_rows):
+            scored = apply_transformations_dag(table, self._layers,
+                                               profiler=self.profiler)
         if keep_raw_features and keep_intermediate_features:
             return scored
         keep = [f.name for f in self.result_features if f.name in scored.column_names]
@@ -532,6 +536,14 @@ class OpWorkflowModel(_WorkflowCore):
                 dict(r.detail) for r in (log.reports if log else [])
                 if r.kind == "restored" and r.site == "sweep.candidate"],
         }
+        # live telemetry aggregates (docs/observability.md): per-stage /
+        # per-family span timings, fault counters, scoring latency
+        # quantiles, compile-cache hit/miss. Process-scoped (the tracer and
+        # registry outlive any one train — exactly like serving counters
+        # should); {"enabled": {... false}} sections when observability is
+        # off.
+        from .observability import summarize
+        out["observability"] = summarize()
         return out
 
     def summary_json(self) -> str:
