@@ -18,6 +18,10 @@ Modes (BENCH_MODE env):
   line per mode (dense LAST — the headline line). Driver-verifies the
   out-of-the-box number alongside the dense throughput number (round-3
   VERDICT asked for both).
+- ``transform``: eager-vs-planned A/B of the transform DAG (vectorize →
+  combine → sanity-slice → predict over BENCH_ROWS × BENCH_FEATURES) with
+  the compile/execute/transfer phase breakdown — the fused transform-plan
+  line (docs/plan.md, docs/benchmarks.md "Transform plan A/B").
 - ``dense``: a RandomParamBuilder-scale sweep — 108 configs across the 4
   families × 3 folds = 324 fits. This is the throughput number: AutoML
   sweeps at this density are what the 8-thread reference pool grinds
@@ -143,6 +147,100 @@ def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
     }), flush=True)
 
 
+def _plan_transfer_sum():
+    from transmogrifai_tpu.observability import metrics as obs_metrics
+    snap = obs_metrics.registry().snapshot().get(
+        "tg_plan_transfer_seconds", {})
+    return sum(v["sum"] for v in snap.values()) if snap else 0.0
+
+
+def _run_transform_ab(n, d, platform, reps):
+    """Eager-vs-planned transform DAG A/B (ISSUE 4 satellite): one fitted
+    vectorize→combine→sanity→predict tail over an n×d table, dispatched
+    stage-by-stage vs as a compiled transform plan. Prints one JSON line
+    per arm (planned LAST) with the compile/execute/transfer breakdown;
+    the ratio is the layer-fusion win the plan cache makes durable."""
+    import numpy as np
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu import plan as plan_mod
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.preparators import SanityChecker
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.observability import metrics as obs_metrics
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import Real, RealNN
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(0)
+    cols = {f"x{i}": Column(Real, rng.randn(n).astype(np.float32),
+                            rng.rand(n) < 0.95)
+            for i in range(d)}
+    w = rng.randn(d).astype(np.float32)
+    logits = sum(np.where(np.asarray(cols[f"x{i}"].mask),
+                          np.asarray(cols[f"x{i}"].values), 0.0) * w[i]
+                 for i in range(d))
+    cols["y"] = Column(RealNN, (logits > 0).astype(np.float32), None)
+    # fit on a small prefix (the fit is not what this line measures),
+    # transform the full table
+    fit_rows = min(n, 50_000)
+    table = FeatureTable(cols, n)
+    fit_table = table.take(np.arange(fit_rows))
+
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.transform_with(SanityChecker(seed=1),
+                                   tg.transmogrify(feats))
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=1, models=[("OpLogisticRegression",
+                         [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    model = (OpWorkflow().set_input_table(fit_table)
+             .set_result_features(pred, checked).train())
+    score_table = table.drop(["y"])
+
+    obs_metrics.enable_metrics(True)
+    try:
+        results = {}
+        for arm in ("eager", "planned"):
+            plan_mod.clear_plan_cache()
+            plan_mod.enable_planning(arm == "planned")
+            try:
+                t0 = time.perf_counter()
+                model.score(table=score_table)   # compile warmup
+                cold = time.perf_counter() - t0
+                tr0 = _plan_transfer_sum()
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    out = model.score(table=score_table)
+                    np.asarray(out[pred.name].values)  # force materialize
+                    times.append(time.perf_counter() - t0)
+                transfer = (_plan_transfer_sum() - tr0) / reps
+            finally:
+                plan_mod.enable_planning(None)
+            dt = float(np.median(times))
+            results[arm] = dt
+            rows_per_sec = n / dt
+            print(json.dumps({
+                "metric": f"transform_rows_per_sec_{arm}_{n}rows_{d}feat_"
+                          f"{platform}",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec",
+                "vs_baseline": (round(results["eager"] / dt, 3)
+                                if "eager" in results else 1.0),
+                "phases": {
+                    "compileSecs": round(max(0.0, cold - dt), 3),
+                    "executeSecs": round(max(0.0, dt - transfer), 4),
+                    "transferSecs": round(transfer, 4),
+                },
+            }), flush=True)
+    finally:
+        obs_metrics.enable_metrics(None)
+        plan_mod.clear_plan_cache()
+
+
 def _run_mesh_line():
     """Virtual-8-device CPU mesh sweep fits/sec — a NUMBER for mesh-path
     regressions (round-4 VERDICT weak #5: the dryrun's wall-ratio assert
@@ -246,6 +344,12 @@ def main():
     d = int(os.environ.get("BENCH_FEATURES", 64))
     folds = 3
     reps = int(os.environ.get("BENCH_REPS", 5))
+
+    if mode == "transform":
+        n_t = int(os.environ.get(
+            "BENCH_ROWS", 1_000_000 if platform == "tpu" else 200_000))
+        _run_transform_ab(n_t, d, platform, reps)
+        return
 
     rng = np.random.RandomState(0)
     X = rng.randn(n, d).astype(np.float32)

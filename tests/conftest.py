@@ -68,6 +68,32 @@ def _no_observability_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_plan_cache_leak():
+    """Compiled transform plans pin jitted executables (and the stage
+    objects they closed over), so the LRU must be provably bounded and must
+    not bleed plans — or a forced-enabled/disabled planner state — between
+    tests: a stale plan keyed to dead stage objects would silently serve
+    the wrong fitted constants if an id() were ever recycled. Assert clean
+    + bounded on entry, hard-reset on exit."""
+    from transmogrifai_tpu import plan as _plan
+
+    assert isinstance(_plan._PLAN_CACHE_MAX, int) and _plan._PLAN_CACHE_MAX > 0, (
+        f"plan cache bound must be a positive int, got {_plan._PLAN_CACHE_MAX!r}")
+    assert len(_plan._PLAN_CACHE) <= _plan._PLAN_CACHE_MAX, (
+        "plan cache exceeded its LRU bound: "
+        f"{len(_plan._PLAN_CACHE)} > {_plan._PLAN_CACHE_MAX}")
+    assert _plan._enabled_override is None, (
+        "a test leaked a forced planner enable/disable override")
+    # module-scoped fixtures train models during setup (before this
+    # function-scoped fixture runs), so the cache may hold their plans —
+    # drop them so every TEST starts with an empty cache
+    _plan.clear_plan_cache()
+    yield
+    _plan.clear_plan_cache()
+    _plan.enable_planning(None)
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

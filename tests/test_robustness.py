@@ -114,7 +114,7 @@ def test_fault_log_inactive_record_is_noop():
     log = FaultLog()
     assert log.to_json() == {"quarantined": [], "retries": [],
                              "checkpointsSkipped": [], "restored": [],
-                             "fatal": []}
+                             "planFallbacks": [], "fatal": []}
 
 
 # ---------------------------------------------------------------------------

@@ -9,8 +9,10 @@ estimators then applying transformers (fitAndTransformDAG:213-240).
 Execution differences, by design: where the reference fuses all row lambdas of
 a layer into a single RDD map (applyOpTransformations:96-119) and persists
 every K Spark stages to sidestep Catalyst (applySparkTransformations:134-165),
-here each transformer produces whole columns via jitted kernels and XLA does
-the fusing; there is no Catalyst to work around, so no persist dance.
+here each transformer produces whole columns via jitted kernels — and the
+transform-plan compiler (``plan.py``) goes one step further, tracing each
+layer run's device-fusable stages into ONE jitted program so XLA fuses
+*across* stage boundaries instead of dispatching N separate executables.
 """
 from __future__ import annotations
 
@@ -138,29 +140,56 @@ def fit_and_transform_dag(table: FeatureTable, layers: List[StageLayer],
                 models.append(stage)
             else:
                 raise TypeError(f"unexpected stage kind {type(stage).__name__}")
-        for model in models:
-            with _obs_span("stage.transform", cat="train",
-                           uid=getattr(model, "uid", "?"),
-                           stage=type(model).__name__, layer=li), \
-                    prof.track(model, "transform", li):
-                table = model.transform(table)
+        table = _transform_stages(table, models, cat="train", layer=li,
+                                  profiler=profiler,
+                                  retry_policy=retry_policy)
     return table, fitted
+
+
+def _transform_stages(table: FeatureTable, models: Sequence[Any], *,
+                      cat: str, layer: int = -1,
+                      profiler: Optional[Any] = None,
+                      retry_policy: Optional[Any] = None) -> FeatureTable:
+    """Run a topologically-ordered transformer sequence: as a compiled plan
+    (one XLA program per device-fusable segment, ``plan.apply_planned``)
+    when eligible, else eagerly stage by stage.
+
+    Eager runs whenever per-stage semantics matter: a profiler wants
+    per-stage wall-clock, a retry policy wants per-stage fault isolation
+    (PR 1), or chaos is active (``plan.planning_applicable``). A planned
+    run that raises falls back to eager for the run — recorded, never
+    silent — so results are identical either way."""
+    from . import plan as _plan
+    if profiler is None and retry_policy is None and len(models) > 1:
+        # ≥2 fusable stages: a lone-stage run gains nothing over eager
+        # dispatch but would still pay the plan's probe/compile cost
+        out = _plan.apply_planned(models, table, keep_intermediates=True,
+                                  cat=cat, min_device_stages=2)
+        if out is not None:
+            return out
+    prof = profiler or _NULL_PROFILER
+    for model in models:
+        _plan.count_eager_dispatch(model)
+        with _obs_span("stage.transform", cat=cat,
+                       uid=getattr(model, "uid", "?"),
+                       stage=type(model).__name__, layer=layer), \
+                prof.track(model, "transform", layer):
+            table = model.transform(table)
+    return table
 
 
 def apply_transformations_dag(table: FeatureTable, layers: List[StageLayer],
                               profiler: Optional[Any] = None,
                               ) -> FeatureTable:
     """Score-time pass: all stages must already be transformers (reference
-    OpWorkflowCore.applyTransformationsDAG:321-345)."""
-    prof = profiler or _NULL_PROFILER
-    for li, layer in enumerate(layers):
+    OpWorkflowCore.applyTransformationsDAG:321-345). The flattened
+    farthest-first layer order is topological, so the whole pass plans as
+    one sequence — bigger fusable segments than the per-layer train runs."""
+    for layer in layers:
         for stage, _ in layer:
             if isinstance(stage, Estimator):
                 raise ValueError(
                     f"stage {stage.uid} is an unfitted estimator; "
                     "score requires a fitted workflow model")
-            with _obs_span("stage.transform", cat="score", uid=stage.uid,
-                           stage=type(stage).__name__, layer=li), \
-                    prof.track(stage, "transform", li):
-                table = stage.transform(table)
-    return table
+    flat = [stage for layer in layers for stage, _ in layer]
+    return _transform_stages(table, flat, cat="score", profiler=profiler)

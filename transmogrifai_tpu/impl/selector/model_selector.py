@@ -498,12 +498,21 @@ class SelectedModel(AllowLabelAsInput, Transformer):
         return np.vectorize(lambda v: inverse.get(int(v), int(v)),
                             otypes=[np.float32])(pred)
 
+    #: the predict is reduction-bearing (gemm / matvec / softmax): its
+    #: summation order is only reproducible when X arrives as a program
+    #: parameter, so the transform-plan compiler traces the Prediction
+    #: emission into its OWN jitted program instead of mid-segment —
+    #: keeping planned output bit-identical to the eager predict_one path
+    #: (plan.py; docs/plan.md "Segment partitioning")
+    device_fusion_barrier = True
+
     @property
     def device_fusable(self) -> bool:
         """True when the winning family has a jit-traceable predict — the
-        Prediction emission then compiles INTO the fused serve program
-        (local/scoring.compiled_score_function; reference analog: the one
-        serve pass of FitStagesUtil.scala:96-119)."""
+        Prediction emission then compiles into its own planned segment
+        (plan.py, consumed by local/scoring.compiled_score_function;
+        reference analog: the one serve pass of
+        FitStagesUtil.scala:96-119)."""
         from ...models.api import ModelFamily
         family = MODEL_REGISTRY[self.fitted.family]
         return type(family).predict_parts is not ModelFamily.predict_parts

@@ -21,7 +21,6 @@ import numpy as np
 from ..observability import metrics as _obs_metrics
 from ..observability.trace import span as _obs_span
 from ..table import Column, FeatureTable
-from ..types import OPVector as OPVectorType
 
 logger = logging.getLogger(__name__)
 
@@ -70,101 +69,31 @@ def score_function(model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
 
 
 def compiled_score_function(model):
-    """ONE jitted XLA program for the fitted transformer tail.
+    """Fused serve path: ONE jitted XLA program per device-fusable segment.
 
-    The TPU-first analog of the reference's layer fusion + MLeap serving
-    (reference FitStagesUtil.applyOpTransformations:96-119,
-    OpWorkflowModelLocal.scala:93-197): every stage exposing
-    ``device_columnar`` (numeric vectorizers → VectorsCombiner →
-    SanityChecker keep-slice) whose dataflow permits it compiles into a
-    single jit, reused across micro-batches via row bucket padding;
-    host-only stages (string pivots, tokenizers) run stage-by-stage before
-    it, and host stages consuming fused outputs (the winning model's
-    Prediction emission) run after, on device arrays.
+    A thin consumer of the shared transform-plan compiler
+    (``transmogrifai_tpu/plan.py``) — the TPU-first analog of the
+    reference's layer fusion + MLeap serving (reference
+    FitStagesUtil.applyOpTransformations:96-119,
+    OpWorkflowModelLocal.scala:93-197). The planner partitions the fitted
+    stage run into host waves (string pivots, tokenizers — eager) and
+    device segments (numeric vectorizers → VectorsCombiner → SanityChecker
+    keep-slice → traceable Prediction emission — one jit each, reused
+    across micro-batches via row bucket padding). What this wrapper adds is
+    the serve-time schema guard: descriptive :class:`ScoreSchemaError`
+    *before* any data reaches a jitted program.
 
     Returns ``score(table: FeatureTable) -> FeatureTable`` with the result
     features plus every column the retained host stages produce; fused
-    INTERMEDIATE columns not consumed downstream are not materialized
-    (unlike ``model.score``'s keep-everything default).
+    INTERMEDIATE columns not consumed downstream are not materialized —
+    XLA dead-code-eliminates them (unlike ``model.score``'s
+    keep-everything default).
     """
-    import jax
-    import jax.numpy as jnp
-
-    from ..utils.padding import bucket_for
+    from .. import plan as _plan
 
     stages = list(model.stages)
-    # dataflow partition (not list-suffix): fuse every device-capable stage
-    # unless it reads a column produced by a host stage that itself depends
-    # on a fused output (that host stage must run AFTER the fused program).
-    # ``device_fusable`` lets a stage opt out dynamically (e.g. a
-    # SelectedModel whose winning family has no traceable predict).
-    fused_set = {id(s) for s in stages if hasattr(s, "device_columnar")
-                 and getattr(s, "device_fusable", True)}
+    result_names = [f.name for f in model.result_features]
 
-    def _inputs(s):
-        return (s.device_inputs() if hasattr(s, "device_inputs")
-                else [f.name for f in s.input_features])
-
-    while True:
-        fused_out = {s.get_output().name for s in stages
-                     if id(s) in fused_set}
-        # host stages transitively downstream of a fused output — iterated
-        # to a fixpoint so correctness does not depend on model.stages being
-        # topologically ordered (a single forward pass would mis-place a
-        # fused-output consumer appearing before its producer in the list)
-        tainted_stages: set = set()
-        downstream = set(fused_out)
-        changed = True
-        while changed:
-            changed = False
-            for s in stages:
-                if id(s) in fused_set or id(s) in tainted_stages:
-                    continue
-                if any(f.name in downstream for f in s.input_features):
-                    tainted_stages.add(id(s))
-                    downstream.add(s.get_output().name)
-                    changed = True
-        demote = [s for s in stages if id(s) in fused_set
-                  and any(nm in downstream - fused_out
-                          for nm in _inputs(s))]
-        if not demote:
-            break
-        for s in demote:
-            fused_set.discard(id(s))
-    host_prefix = [s for s in stages
-                   if id(s) not in fused_set and id(s) not in tainted_stages]
-    tail_host = [s for s in stages if id(s) in tainted_stages]
-    fused = [s for s in stages if id(s) in fused_set]
-    if not fused:
-        return lambda table: model.score(table=table)
-
-    produced = {s.get_output().name for s in fused}
-    in_names: List[str] = []
-    for s in fused:
-        names = (s.device_inputs() if hasattr(s, "device_inputs")
-                 else [f.name for f in s.input_features])
-        for nm in names:
-            if nm not in produced and nm not in in_names:
-                in_names.append(nm)
-    out_needed = [s.get_output().name for s in fused]
-    # outputs consumed outside the fused region (or result features)
-    ext = {f.name for st in tail_host for f in st.input_features}
-    ext |= {f.name for f in model.result_features}
-    out_names = [nm for nm in out_needed if nm in ext]
-    if not out_names:        # at least expose the last fused output
-        out_names = [out_needed[-1]]
-
-    @jax.jit
-    def chain(vals_list, mask_list):
-        env = {nm: (v, m) for nm, v, m in
-               zip(in_names, vals_list, mask_list)}
-        for s in fused:
-            env[s.get_output().name] = s.device_columnar(env)
-        return tuple((env[nm][0], env[nm][1]) for nm in out_names)
-
-    # metadata for fused outputs is data-independent; captured lazily from
-    # one plain stage-by-stage pass on the first batch
-    meta_cache: Dict[str, Dict[str, Any]] = {}
     # the fitted column set: every column the serve pass reads that no
     # stage of the model produces must arrive in the input table — checked
     # up front with a descriptive error instead of a KeyError deep in a
@@ -178,9 +107,6 @@ def compiled_score_function(model):
         for nm in names:
             if nm not in produced_all and nm not in required_external:
                 required_external.append(nm)
-    for nm in in_names:
-        if nm not in produced_all and nm not in required_external:
-            required_external.append(nm)
 
     # fitted input schema for the fused program: per-column trailing shape
     # (vector width). Seeded from the training table when the model still
@@ -190,7 +116,7 @@ def compiled_score_function(model):
     expected_shapes: Dict[str, Tuple[int, ...]] = {}
     ttbl = getattr(model, "train_table", None)
     if ttbl is not None:
-        for nm in in_names:
+        for nm in required_external:
             if nm in ttbl.column_names:
                 expected_shapes[nm] = tuple(np.shape(ttbl[nm].values)[1:])
 
@@ -222,50 +148,18 @@ def compiled_score_function(model):
             raise ScoreSchemaError(
                 f"input is missing column(s) {missing} required by the "
                 f"fitted model; table has {sorted(table.column_names)}")
-        tbl = table
-        for s in host_prefix:
-            tbl = s.transform(tbl)
-        for nm in in_names:   # validate BEFORE any jit sees the batch
-            _validated_input(tbl, nm)
-        if not meta_cache:
-            probe = tbl
-            for s in fused:
-                probe = s.transform(probe)
-                nm = s.get_output().name
-                meta_cache[nm] = (
-                    probe[nm].feature_type,
-                    {k2: v for k2, v in probe[nm].metadata.items()})
-        n = tbl.num_rows
-        n_pad = bucket_for(n)
-        vals_list, mask_list = [], []
-        for nm in in_names:
-            col = tbl[nm]
-            v = np.asarray(col.values, dtype=np.float32)
-            m = None if col.mask is None else np.asarray(col.mask)
-            if n_pad != n:
-                v = np.concatenate(
-                    [v, np.zeros((n_pad - n,) + v.shape[1:], v.dtype)])
-                if m is None:
-                    m = np.zeros(n_pad, bool)
-                    m[:n] = True
-                else:
-                    m = np.concatenate([m, np.zeros(n_pad - n, bool)])
-            vals_list.append(jnp.asarray(v))
-            mask_list.append(None if m is None else jnp.asarray(m))
-        outs = chain(tuple(vals_list), tuple(mask_list))
-        new_cols = dict(tbl._columns)
-        for nm, (arr, msk) in zip(out_names, outs):
-            # keep the validity mask the stage-by-stage path would have
-            # propagated (sliced back to the unpadded row count)
-            msk_np = None if msk is None else np.asarray(msk)[:n]
-            if msk_np is not None and msk_np.all():
-                msk_np = None
-            ftype, md = meta_cache.get(nm, (OPVectorType, {}))
-            new_cols[nm] = Column(ftype, arr[:n], msk_np, dict(md))
-        tbl = FeatureTable(new_cols, n, key=tbl.key)
-        for s in tail_host:
-            tbl = s.transform(tbl)
-        return tbl
+        plan = _plan.get_plan(stages, table, keep_intermediates=False,
+                              extra_keep=result_names, cat="score")
+        if plan is None:       # planning off / chaos / nothing to fuse
+            return model.score(table=table)
+        for nm in plan.device_table_inputs(table):
+            # validate BEFORE any jit sees the batch
+            _validated_input(table, nm)
+        out = _plan.apply_planned(stages, table, keep_intermediates=False,
+                                  extra_keep=result_names, cat="score")
+        if out is None:        # planned run raised; recorded → eager
+            return model.score(table=table)
+        return out
 
     return score
 
@@ -274,8 +168,9 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
     """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
     runs the columnar/jitted DAG pass — the serving path that keeps the TPU
     busy (SURVEY §2.10 P4: streaming micro-batches). The numeric transformer
-    tail runs as ONE compiled XLA program reused across micro-batches
-    (compiled_score_function).
+    tail runs as one compiled XLA program per device-fusable segment,
+    reused across micro-batch sizes via the schema-fingerprinted plan
+    cache (compiled_score_function → plan.py; docs/plan.md).
 
     Malformed input does not kill the batch: a batch that fails schema
     validation (a string where a number is expected, a wrong-width vector)

@@ -89,6 +89,7 @@ def summarize(tr: Optional[trace.Tracer] = None,
         if series:
             scoring[key] = sum(series.values())
 
+    from ..plan import cache_stats as plan_cache_stats
     from ..utils.jax_cache import cache_stats
     return {
         "enabled": {"tracing": trace.tracing_enabled(),
@@ -104,4 +105,5 @@ def summarize(tr: Optional[trace.Tracer] = None,
         "counters": counters,
         "scoring": scoring,
         "compileCache": cache_stats(),
+        "planCache": plan_cache_stats(),
     }

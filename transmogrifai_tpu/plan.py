@@ -1,0 +1,555 @@
+"""Transform-plan compiler: one XLA program per device-fusable segment.
+
+The paper's substrate swap is "jit-compiled kernels instead of Catalyst";
+round 5 proved the shape of the win by fusing the per-family sweep glue into
+single jitted programs (docs/benchmarks.md). This module applies the same
+cure to the fit-and-transform DAG: instead of dispatching every transformer
+as its own executable (each with a ~2.7 ms dispatch bubble, ~70-130 ms on
+tunneled backends), a *plan* partitions a topologically-ordered run of
+fitted/pure transformer stages into maximal device-fusable segments — stages
+exposing a pure-jax ``device_columnar`` dual — separated by host stages
+(object-array text/map fronts, row lambdas), and traces each segment into
+ONE jitted program. XLA then fuses across stage boundaries and dead-code
+eliminates intermediates nobody reads — the reference's
+``applyOpTransformations`` layer fusion (FitStagesUtil.scala:96-119) and
+whole-stage-codegen idea, landed on our side of the swap.
+
+Consumers: ``fit_and_transform_dag`` (each layer's transformer run),
+``apply_transformations_dag`` (→ ``OpWorkflow.score()``), and
+``local/scoring.compiled_score_function`` (→ ``micro_batch_score_function``)
+all call :func:`apply_planned`. Plans are cached in a bounded LRU
+(``TG_PLAN_CACHE_MAX``, defaulting to the validators' ``_FUSED_CACHE``
+bound) keyed by stage-uid sequence + input schema fingerprint.
+
+Robustness interplay is part of the design, not an afterthought
+(docs/plan.md "Fallback semantics"):
+
+* planning is skipped outright when per-stage fault semantics are active —
+  ``OpWorkflow.with_fault_policy()`` (the caller passes eager) or
+  ``TG_CHAOS`` / armed non-``plan.*`` injection sites — so PR 1's per-stage
+  retry/quarantine behavior is byte-for-byte preserved under chaos;
+* a planned run that *raises* (including the ``plan.segment_execute``
+  injection site) falls back to eager per-stage dispatch for that run, and
+  the fallback is recorded as a FaultLog ``plan_fallback`` event + span
+  event — never silent.
+
+Observability: ``plan.compile`` / ``plan.execute`` / ``plan.segment`` spans,
+the ``tg_dispatch_total`` counter (top-level device executable launches:
+one per device-capable stage in eager mode, one per fused segment planned)
+and ``tg_device_transfer_total`` (host→device uploads). All zero-write when
+observability is off.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .observability import metrics as _obs_metrics
+from .observability.trace import span as _obs_span
+from .table import Column, FeatureTable
+
+logger = logging.getLogger(__name__)
+
+#: env switch: TG_PLAN=0 disables the planner process-wide (eager dispatch)
+PLAN_ENV = "TG_PLAN"
+
+_FALSY = ("", "0", "false", "False", "no")
+
+_enabled_override: Optional[bool] = None
+
+#: plan LRU: (stage identity seq, schema fp, options) → TransformPlan | None
+#: (None caches "planning infeasible for this shape" so the probe cost is
+#: paid once). Bounded like the validators' _FUSED_CACHE: each entry pins
+#: jitted executables, so a long-lived server fitting many schemas must not
+#: grow compiled-program memory without bound.
+_PLAN_CACHE: "OrderedDict[Any, Optional[TransformPlan]]" = OrderedDict()
+_PLAN_CACHE_MAX = int(os.environ.get(
+    "TG_PLAN_CACHE_MAX", os.environ.get("TG_FUSED_CACHE_MAX", "32")))
+
+
+def plan_enabled() -> bool:
+    """True when the transform-plan compiler may be used (TG_PLAN, unless
+    overridden programmatically)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get(PLAN_ENV, "1") not in _FALSY
+
+
+def enable_planning(on: Optional[bool]) -> None:
+    """Force planning on/off from code (tests, A/B benches); ``None`` hands
+    control back to the ``TG_PLAN`` environment switch."""
+    global _enabled_override
+    _enabled_override = None if on is None else bool(on)
+
+
+def planning_applicable() -> bool:
+    """Planning is allowed only when per-stage fault semantics are not in
+    play: under ``TG_CHAOS`` or any armed non-``plan.*`` injection site the
+    eager per-stage path runs so PR 1 retry/quarantine behavior is exactly
+    preserved (sites prefixed ``plan.`` target the planner itself and keep
+    it active — they exercise the runtime fallback)."""
+    if not plan_enabled():
+        return False
+    from .robustness import faults
+    if os.environ.get(faults.CHAOS_ENV):
+        return False
+    armed = faults.active_sites()
+    if any(not s.startswith("plan.") for s in armed):
+        return False
+    return True
+
+
+def clear_plan_cache() -> None:
+    """Drop every cached plan (test isolation; see tests/conftest.py)."""
+    _PLAN_CACHE.clear()
+
+
+def cache_stats() -> Dict[str, int]:
+    """{"entries", "max"} — surfaced in summary()["observability"]."""
+    return {"entries": len(_PLAN_CACHE), "max": _PLAN_CACHE_MAX}
+
+
+# ---------------------------------------------------------------------------
+# Stage classification
+# ---------------------------------------------------------------------------
+
+def is_device_capable(stage: Any) -> bool:
+    """A stage that exposes the pure-jax columnar dual and has not opted out
+    dynamically (e.g. a SelectedModel whose family has no traceable
+    predict)."""
+    return (hasattr(stage, "device_columnar")
+            and getattr(stage, "device_fusable", True))
+
+
+def count_eager_dispatch(stage: Any) -> None:
+    """Account one eager transform of a device-capable stage. Eager (unjitted)
+    columnar execution launches at least one executable per input column
+    chain — op-by-op dispatch never fuses across columns — so the counter
+    adds ``max(1, |device inputs|)``: a conservative lower bound of the
+    launches the fused segment replaces with ONE (docs/plan.md)."""
+    if not is_device_capable(stage):
+        return
+    _obs_metrics.inc_counter(
+        "tg_dispatch_total", float(max(1, len(_device_inputs(stage)))),
+        kind="stage",
+        help="top-level device executable launches on the transform path "
+        "(docs/plan.md)")
+
+
+def _device_inputs(stage: Any) -> List[str]:
+    if hasattr(stage, "device_inputs"):
+        return list(stage.device_inputs())
+    return [f.name for f in stage.input_features]
+
+
+def _host_inputs(stage: Any) -> List[str]:
+    return [f.name for f in stage.input_features]
+
+
+def _numeric_table_col(col: Column) -> bool:
+    dt = getattr(col.values, "dtype", None)
+    return dt is not None and np.dtype(dt).kind in "fiub"
+
+
+# ---------------------------------------------------------------------------
+# Plan structure
+# ---------------------------------------------------------------------------
+
+class _DeviceSegment:
+    """One maximal run of device-fusable stages traced into one jitted
+    program. ``in_names`` are the columns the program reads (external to the
+    segment), ``out_names`` the columns it materializes."""
+
+    __slots__ = ("stages", "in_names", "out_names", "chain", "out_meta")
+
+    def __init__(self, stages: List[Any], in_names: List[str],
+                 out_names: List[str]):
+        self.stages = stages
+        self.in_names = in_names
+        self.out_names = out_names
+        self.out_meta: Dict[str, Tuple[Any, Dict[str, Any]]] = {}
+        import jax
+        fused = list(stages)
+        outs = list(out_names)
+
+        @jax.jit
+        def chain(vals_list, mask_list):
+            env = {nm: (v, m)
+                   for nm, v, m in zip(in_names, vals_list, mask_list)}
+            for s in fused:
+                env[s.get_output().name] = s.device_columnar(env)
+            return tuple(env[nm] for nm in outs)
+
+        self.chain = chain
+
+
+class TransformPlan:
+    """An executable schedule: alternating host waves (eager per-stage
+    dispatch) and device segments (one jitted program each)."""
+
+    def __init__(self, steps: List[Tuple[str, Any]], cat: str):
+        self.steps = steps
+        self.cat = cat
+
+    @property
+    def num_segments(self) -> int:
+        return sum(1 for k, _ in self.steps if k == "device")
+
+    @property
+    def num_host_stages(self) -> int:
+        return sum(len(p) for k, p in self.steps if k == "host")
+
+    def device_table_inputs(self, table: FeatureTable) -> List[str]:
+        """Segment inputs that come straight from the caller's table (the
+        user-input surface serve-time schema guards validate)."""
+        produced = {s.get_output().name
+                    for k, p in self.steps
+                    for s in (p if k == "host" else p.stages)}
+        out: List[str] = []
+        for k, p in self.steps:
+            if k != "device":
+                continue
+            for nm in p.in_names:
+                if nm not in produced and nm in table and nm not in out:
+                    out.append(nm)
+        return out
+
+    # -- execution -----------------------------------------------------------
+    def execute(self, table: FeatureTable) -> FeatureTable:
+        with _obs_span("plan.execute", cat=self.cat, rows=table.num_rows,
+                       segments=self.num_segments,
+                       hostStages=self.num_host_stages):
+            for kind, payload in self.steps:
+                if kind == "host":
+                    for s in payload:
+                        # a device-capable stage demoted to host (non-
+                        # numeric inputs) still launches eager programs
+                        count_eager_dispatch(s)
+                        with _obs_span("stage.transform", cat=self.cat,
+                                       uid=getattr(s, "uid", "?"),
+                                       stage=type(s).__name__, planned=True):
+                            table = s.transform(table)
+                else:
+                    table = self._run_segment(payload, table)
+        return table
+
+    def _run_segment(self, seg: _DeviceSegment,
+                     table: FeatureTable) -> FeatureTable:
+        import jax.numpy as jnp
+
+        from .robustness import faults
+        from .utils.padding import bucket_for
+        # deterministic chaos entry: a fault here models an XLA runtime
+        # error mid-plan; apply_planned catches it and falls back to eager
+        faults.inject("plan.segment_execute", key=seg.stages[0].uid)
+        n = table.num_rows
+        n_pad = bucket_for(n)
+        t0 = (time.perf_counter()
+              if _obs_metrics.metrics_enabled() else None)
+        transfers = 0
+        vals_list, mask_list = [], []
+        for nm in seg.in_names:
+            col = table[nm]
+            v, m = col.values, col.mask
+            if isinstance(v, np.ndarray):
+                v = np.asarray(v, dtype=np.float32)
+                if n_pad != n:
+                    v = np.concatenate(
+                        [v, np.zeros((n_pad - n,) + v.shape[1:], v.dtype)])
+                m = self._pad_mask_host(m, n, n_pad)
+                v, m = jnp.asarray(v), jnp.asarray(m)
+                transfers += 2
+            else:
+                if v.dtype != jnp.float32:
+                    v = v.astype(jnp.float32)
+                if n_pad != n:
+                    v = jnp.pad(v, ((0, n_pad - n),) + ((0, 0),) * (v.ndim - 1))
+                if m is None:
+                    m = self._pad_mask_host(None, n, n_pad)
+                    m = jnp.asarray(m)
+                    transfers += 1
+                else:
+                    m = jnp.asarray(m)
+                    if n_pad != n:
+                        m = jnp.pad(m, (0, n_pad - n))
+            vals_list.append(v)
+            mask_list.append(m)
+        if t0 is not None:
+            _obs_metrics.observe(
+                "tg_plan_transfer_seconds", time.perf_counter() - t0,
+                help="host→device input staging per planned segment")
+            _obs_metrics.inc_counter(
+                "tg_device_transfer_total", float(transfers),
+                help="host→device uploads (packed: see docs/plan.md)")
+        _obs_metrics.inc_counter(
+            "tg_dispatch_total", kind="plan_segment",
+            help="top-level device executable launches on the transform "
+            "path (docs/plan.md)")
+        with _obs_span("plan.segment", cat=self.cat,
+                       stages=len(seg.stages), rows=n,
+                       inputs=len(seg.in_names), outputs=len(seg.out_names)):
+            outs = seg.chain(tuple(vals_list), tuple(mask_list))
+        new_cols: Dict[str, Column] = {}
+        for nm, (arr, msk) in zip(seg.out_names, outs):
+            # slice padding back off; keep values device-resident (exactly
+            # what the eager fused-substrate stages hand downstream)
+            msk_np = None if msk is None else np.asarray(msk)[:n]
+            if msk_np is not None and msk_np.all():
+                msk_np = None
+            ftype, md = seg.out_meta[nm]
+            new_cols[nm] = Column(ftype, arr[:n], msk_np, dict(md))
+        return table.with_columns(new_cols)
+
+    @staticmethod
+    def _pad_mask_host(m, n: int, n_pad: int) -> np.ndarray:
+        """Masks always materialize as bool arrays (padding rows False) so
+        the traced program has one stable structure across batch sizes."""
+        out = np.zeros(n_pad, dtype=bool)
+        if m is None:
+            out[:n] = True
+        else:
+            out[:n] = np.asarray(m)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Plan construction
+# ---------------------------------------------------------------------------
+
+def _build_plan(stages: List[Any], table: FeatureTable,
+                keep_intermediates: bool, extra_keep: Sequence[str],
+                cat: str) -> Optional[TransformPlan]:
+    """Partition ``stages`` (topological order) into host waves and device
+    segments, trace each segment, and probe metadata. Returns None when the
+    sequence has nothing worth fusing."""
+    producer: Dict[str, Any] = {}      # column name → producing stage
+    is_dev: Dict[int, bool] = {}
+    numeric: Dict[str, bool] = {}      # column name → float32-convertible
+    for nm in table.column_names:
+        numeric[nm] = _numeric_table_col(table[nm])
+
+    from .table import DEVICE_KINDS
+    for s in stages:
+        dev = is_device_capable(s)
+        if dev:
+            # demote to host when any runtime input is non-numeric for the
+            # fused program (e.g. a vectorizer front over object arrays)
+            for nm in _device_inputs(s):
+                if not numeric.get(nm, False):
+                    dev = False
+                    break
+        is_dev[id(s)] = dev
+        out = s.get_output()
+        producer[out.name] = s
+        numeric[out.name] = (dev
+                             or out.feature_type.column_kind in DEVICE_KINDS)
+    if not any(is_dev[id(s)] for s in stages):
+        return None        # nothing to fuse — eager is already minimal
+
+    # wave assignment: host wave w runs before device segment w; a stage
+    # lands in the earliest slot its producers allow, so device segments are
+    # maximal (stages fuse across interleaved-but-independent host stages)
+    wave: Dict[int, int] = {}
+    for s in stages:
+        dev = is_dev[id(s)]
+        ins = _device_inputs(s) if dev else _host_inputs(s)
+        w = 0
+        for nm in ins:
+            p = producer.get(nm)
+            if p is None:
+                continue
+            pw = wave[id(p)]
+            # host wave w runs before device segment w, so only the
+            # device→host crossing forces the consumer into the next wave
+            w = max(w, pw + 1 if (is_dev[id(p)] and not dev) else pw)
+        wave[id(s)] = w
+
+    max_wave = max(wave.values()) if wave else 0
+    sched: List[Tuple[str, List[Any]]] = []
+    for w in range(max_wave + 1):
+        host = [s for s in stages if not is_dev[id(s)] and wave[id(s)] == w]
+        if host:
+            sched.append(("host", host))
+        dev_stages = [s for s in stages if is_dev[id(s)] and wave[id(s)] == w]
+        # fusion barriers (reduction-bearing stages like the winning
+        # model's Prediction emission) trace into their OWN program: a
+        # reduction's summation order is only reproducible when its operand
+        # arrives as a program parameter, so fusing it mid-segment would
+        # break the planned≡eager bit-exactness contract (docs/plan.md)
+        run: List[Any] = []
+        for s in dev_stages:
+            if getattr(s, "device_fusion_barrier", False):
+                if run:
+                    sched.append(("dev", run))
+                    run = []
+                sched.append(("dev", [s]))
+            else:
+                run.append(s)
+        if run:
+            sched.append(("dev", run))
+
+    steps: List[Tuple[str, Any]] = []
+    for i, (kind, group) in enumerate(sched):
+        if kind == "host":
+            steps.append(("host", group))
+            continue
+        seg_out = {s.get_output().name for s in group}
+        in_names: List[str] = []
+        for s in group:
+            for nm in _device_inputs(s):
+                if nm not in seg_out and nm not in in_names:
+                    in_names.append(nm)
+        if keep_intermediates:
+            out_names = [s.get_output().name for s in group]
+        else:
+            # materialize only what escapes the segment: XLA DCE's the rest
+            ext = set(extra_keep)
+            for _, later in sched[i + 1:]:
+                for t in later:
+                    ext.update(_device_inputs(t) if is_dev[id(t)]
+                               else _host_inputs(t))
+            out_names = [s.get_output().name for s in group
+                         if s.get_output().name in ext]
+            if not out_names:
+                continue   # fully dead segment: plan-level DCE, skip it
+        steps.append(("device", _DeviceSegment(group, in_names, out_names)))
+
+    if not any(k == "device" for k, _ in steps):
+        return None        # DCE dropped every segment — plan is all-host
+    plan = TransformPlan(steps, cat)
+
+    # zero-row probe: output feature types + metadata are data-independent
+    # (fill/pivot/slice provenance comes from fitted state and input
+    # *metadata*, never values), so one eager pass over an empty table
+    # captures them without paying a real eager run
+    read_names: List[str] = []
+    produced = {s.get_output().name for s in stages}
+    for s in stages:
+        for nm in set(_host_inputs(s)) | set(_device_inputs(s)):
+            if nm not in produced and nm in table and nm not in read_names:
+                read_names.append(nm)
+    probe_cols: Dict[str, Column] = {}
+    for nm in read_names:
+        col = table[nm]
+        v = col.values
+        dt = np.dtype(getattr(v, "dtype", object))
+        trailing = tuple(int(x) for x in v.shape[1:])
+        probe_cols[nm] = Column(
+            col.feature_type, np.zeros((0,) + trailing, dtype=dt),
+            None if col.mask is None else np.zeros(0, dtype=bool),
+            dict(col.metadata))
+    probe = FeatureTable(probe_cols, 0)
+    for s in stages:
+        probe = s.transform(probe)
+    for kind, payload in plan.steps:
+        if kind != "device":
+            continue
+        for nm in payload.out_names:
+            col = probe[nm]
+            payload.out_meta[nm] = (col.feature_type, dict(col.metadata))
+    return plan
+
+
+def _schema_fingerprint(stages: List[Any],
+                        table: FeatureTable) -> Optional[Tuple]:
+    """Per-column (name, dtype, trailing shape, mask-presence) of everything
+    the sequence reads from the table: a plan is reusable exactly when this
+    matches (row count is free — padding buckets absorb it)."""
+    produced = {s.get_output().name for s in stages}
+    items: List[Tuple] = []
+    seen = set()
+    for s in stages:
+        for nm in list(_host_inputs(s)) + list(_device_inputs(s)):
+            if nm in produced or nm in seen:
+                continue
+            seen.add(nm)
+            col = table.get(nm)
+            if col is None:
+                # response features are train-only; anything else missing
+                # is the eager path's (descriptive) error to raise
+                continue
+            v = col.values
+            items.append((nm, str(getattr(v, "dtype", "object")),
+                          tuple(int(x) for x in v.shape[1:]),
+                          col.mask is None))
+    return tuple(items)
+
+
+def get_plan(stages: Sequence[Any], table: FeatureTable, *,
+             keep_intermediates: bool = True,
+             extra_keep: Sequence[str] = (),
+             cat: str = "score",
+             min_device_stages: int = 1) -> Optional[TransformPlan]:
+    """Compile (or fetch from the LRU) the plan for this stage sequence ×
+    input schema. Returns None when planning is off, chaos is active, or
+    the sequence has fewer than ``min_device_stages`` fusable stages (the
+    serve path plans even a single stage — padding + program reuse still
+    pay; the per-layer train runs ask for ≥2 so a lone-stage layer skips
+    the probe/compile cost fusion cannot repay)."""
+    if not planning_applicable():
+        return None
+    stages = list(stages)
+    if sum(1 for s in stages if is_device_capable(s)) < min_device_stages:
+        return None
+    key = (tuple((s.uid, id(s)) for s in stages),
+           _schema_fingerprint(stages, table),
+           keep_intermediates, tuple(sorted(extra_keep)))
+    if key in _PLAN_CACHE:
+        _PLAN_CACHE.move_to_end(key)
+        return _PLAN_CACHE[key]
+    with _obs_span("plan.compile", cat=cat, stages=len(stages)) as sp:
+        try:
+            plan = _build_plan(stages, table, keep_intermediates,
+                               extra_keep, cat)
+        except Exception as e:  # infeasible shape → cached eager fallback
+            logger.warning("plan compile failed (%s: %s); falling back to "
+                           "eager dispatch for this stage sequence",
+                           type(e).__name__, e)
+            sp.set_attr(failed=f"{type(e).__name__}: {e}"[:200])
+            plan = None
+        if plan is not None:
+            sp.set_attr(segments=plan.num_segments,
+                        hostStages=plan.num_host_stages)
+    _PLAN_CACHE[key] = plan
+    _PLAN_CACHE.move_to_end(key)
+    while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+        _PLAN_CACHE.popitem(last=False)
+    return plan
+
+
+def apply_planned(stages: Sequence[Any], table: FeatureTable, *,
+                  keep_intermediates: bool = True,
+                  extra_keep: Sequence[str] = (),
+                  cat: str = "score",
+                  min_device_stages: int = 1) -> Optional[FeatureTable]:
+    """Run the stage sequence as a compiled plan. Returns the transformed
+    table, or None when the caller should dispatch eagerly (planning off /
+    chaos active / nothing to fuse / the planned run raised and fell back).
+
+    The fallback contract: a raised planned run records a FaultLog
+    ``plan_fallback`` report (+ span event + tg_faults_total counter) and
+    returns None; the caller's eager loop then produces identical results —
+    plans never transform the input table in place."""
+    plan = get_plan(stages, table, keep_intermediates=keep_intermediates,
+                    extra_keep=extra_keep, cat=cat,
+                    min_device_stages=min_device_stages)
+    if plan is None:
+        return None
+    try:
+        return plan.execute(table)
+    except Exception as e:
+        from .robustness.policy import FaultLog, FaultReport
+        FaultLog.record(FaultReport(
+            site="plan.execute", kind="plan_fallback",
+            detail={"error": f"{type(e).__name__}: {e}"[:300],
+                    "segments": plan.num_segments,
+                    "stages": [getattr(s, "uid", "?") for s in stages]}))
+        logger.warning(
+            "planned transform run failed (%s: %s); falling back to eager "
+            "per-stage dispatch for this run", type(e).__name__, e)
+        return None

@@ -29,6 +29,11 @@ site                         fires in
 ``dag.stage_fit``            before each estimator fit in the DAG
 ``distributed.to_host``      before each guarded device→host transfer
 ``distributed.device_put``   before each guarded host→device placement
+``plan.segment_execute``     before each fused transform-plan segment runs
+                             (plan.py; a raise here exercises the planned→
+                             eager fallback — ``plan.*`` sites deliberately
+                             do NOT disable the planner the way other armed
+                             sites do)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,

@@ -59,7 +59,9 @@ class FaultReport:
     from selection), ``checkpoint_skipped`` (corrupt/incomplete checkpoint
     detected and ignored on resume), ``restored`` (a fitted stage or sweep
     candidate rehydrated from a verified checkpoint instead of refitting),
-    or ``fatal`` (retries exhausted / unretryable)."""
+    ``plan_fallback`` (a fused transform run raised and degraded to eager
+    per-stage dispatch, plan.py), or ``fatal`` (retries exhausted /
+    unretryable)."""
     site: str
     kind: str
     detail: Dict[str, Any] = field(default_factory=dict)
@@ -126,6 +128,10 @@ class FaultLog:
             "checkpointsSkipped": [r.to_json()
                                    for r in self.of_kind("checkpoint_skipped")],
             "restored": [r.to_json() for r in self.of_kind("restored")],
+            # fused transform runs that raised and degraded to eager
+            # per-stage dispatch (docs/plan.md "Fallback semantics")
+            "planFallbacks": [r.to_json()
+                              for r in self.of_kind("plan_fallback")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
         }
 

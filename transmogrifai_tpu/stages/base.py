@@ -294,11 +294,62 @@ def _iter_cell_values(cols: Sequence[Column]):
         yield tuple(out)
 
 
+def _vectorized_value_transform(transform_fn: Callable[..., Any],
+                                output_type: Type[FeatureType],
+                                cols: Sequence[Column]) -> Optional[Column]:
+    """Whole-column numpy fast path for value-level lambdas: when every
+    input column is numeric and fully valid (no ``None`` the lambda could
+    see), apply ``transform_fn`` to the arrays directly — arithmetic
+    lambdas are ufunc-compatible and run in one vectorized sweep instead of
+    a python loop rebuilding a list per cell. Returns None (→ row-map
+    fallback) when inputs are object/masked, the fn rejects arrays
+    (truthiness / branching lambdas raise), or the result doesn't look like
+    one value per row. The produced Column replicates ``of_values``
+    semantics exactly: NaN results are missing (mask False, slot 0)."""
+    kind = output_type.column_kind
+    if kind not in ("real", "binary", "integral") or not cols:
+        return None
+    n = len(cols[0])
+    if n == 0:     # zero-row probes: the row map is free and warning-free
+        return None
+    arrs = []
+    for c in cols:
+        a = np.asarray(c.values)
+        if a.dtype.kind not in "fiub" or a.ndim != 1:
+            return None
+        if c.mask is not None and not np.asarray(c.mask).all():
+            return None
+        # mirror the row map's value types exactly: ``.item()`` hands the
+        # lambda python floats (f64) / ints, so compute in f64/int64 — a
+        # float32 sweep would round transcendentals differently
+        arrs.append(a.astype(np.float64) if a.dtype.kind in "fb"
+                    else a.astype(np.int64))
+    try:
+        out = transform_fn(*arrs)
+    except Exception:
+        return None
+    if not isinstance(out, np.ndarray) or out.shape != (n,) \
+            or out.dtype.kind not in "fiub":
+        return None
+    missing = np.isnan(out) if out.dtype.kind == "f" else np.zeros(n, bool)
+    mask = ~missing
+    if kind == "real":
+        vals = np.where(missing, 0.0, out).astype(np.float32)
+    elif kind == "binary":
+        vals = np.where(missing, False,
+                        out != 0).astype(np.float32)
+    else:  # integral → host int64 (reference Long semantics)
+        vals = np.where(missing, 0, out).astype(np.int64)
+    return Column(output_type, vals, mask)
+
+
 class _LambdaTransformer(Transformer):
     """Shared machinery: a value-level ``transform_fn`` over plain python values
     (None == missing) plus an optional ``columnar_fn`` over Columns. Without a
-    columnar_fn the transform falls back to a host-side row map — fine for
-    string-ish host columns, which is exactly where row lambdas remain."""
+    columnar_fn the transform tries a vectorized numpy sweep
+    (:func:`_vectorized_value_transform`) and only then falls back to a
+    host-side row map — which remains exactly where it belongs: string-ish
+    object columns and lambdas that branch per value."""
 
     def __init__(self, operation_name: str,
                  transform_fn: Callable[..., Any],
@@ -314,6 +365,10 @@ class _LambdaTransformer(Transformer):
         cols = [table[f.name] for f in self.input_features]
         if self.columnar_fn is not None:
             return self.columnar_fn(*cols)
+        out = _vectorized_value_transform(self.transform_fn,
+                                          self.output_type, cols)
+        if out is not None:
+            return out
         vals = [self.transform_fn(*args) for args in _iter_cell_values(cols)]
         return Column.of_values(self.output_type, vals)
 

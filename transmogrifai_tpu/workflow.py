@@ -468,6 +468,11 @@ class OpWorkflowModel(_WorkflowCore):
     def score(self, table: Optional[FeatureTable] = None, df=None,
               keep_raw_features: bool = True,
               keep_intermediate_features: bool = True) -> FeatureTable:
+        """Batch scoring over the fitted transformer DAG. The pass runs on
+        the fused substrate: the transform-plan compiler (``plan.py``)
+        traces each device-fusable segment into one XLA program (eager
+        per-stage dispatch under a profiler, ``TG_PLAN=0``, or active
+        chaos — results are bit-identical either way, docs/plan.md)."""
         if df is not None:
             table = dataframe_to_table(df, self.raw_features)
         if table is None:
