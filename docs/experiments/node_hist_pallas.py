@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from transmogrifai_tpu.ops.tree_hist import (_BLK_S, _interpret, _pad_to,
+                                             _tile_lanes,
                                              _t_pad128)
 
 
@@ -99,7 +100,7 @@ def _node_hist_pallas(codes, node, sws, Wl_eff, n_bins, stride, k,
         b = pl.program_id(0)
         s = pl.program_id(2)
         # bin one-hot tile, bin-major (see module docstring)
-        c_rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)
+        c_rep = _tile_lanes(codes_ref[:], n_bins)
         b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, out_lanes), 1)
                   // blk_d)
         oh = (c_rep == b_iota).astype(jnp.bfloat16)
@@ -107,8 +108,8 @@ def _node_hist_pallas(codes, node, sws, Wl_eff, n_bins, stride, k,
         # j = j0 + i // T_pad (rep j's per block when T_pad < 128) of tree
         # t = t0 + i % T_pad, stat k fixed per block
         if rep > 1:
-            nd = pltpu.repeat(node_ref[:], rep, axis=1)       # (blk_s, 128)
-            sw = pltpu.repeat(sws_ref[0], rep, axis=1)
+            nd = _tile_lanes(node_ref[:], rep)                # (blk_s, 128)
+            sw = _tile_lanes(sws_ref[0], rep)
         else:
             nd = node_ref[:]
             sw = sws_ref[0]

@@ -94,6 +94,35 @@ def _no_plan_cache_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_mesh_sharding_leak():
+    """Mesh/global-sharding state must not bleed across tests (mirrors the
+    plan-cache and observability no-leak fixtures): an active ``with mesh:``
+    context entered by one test would silently re-shard every later test's
+    jitted programs, and a mesh-keyed fused sweep program left in the
+    validator LRU pins a dead test mesh plus per-device buffers for the
+    whole session. Assert no ambient mesh context on entry and exit;
+    hard-drop mesh-keyed programs on exit (mesh tests recompile cheaply —
+    CPU programs — and must not subsidize later tests)."""
+    from jax._src import mesh as _jmesh
+
+    from transmogrifai_tpu.impl.tuning import validators as _validators
+
+    def _ambient_mesh():
+        env = getattr(_jmesh, "thread_resources", None)
+        if env is None:  # pragma: no cover - jax version drift
+            return None
+        m = env.env.physical_mesh
+        return None if m.empty else m
+
+    assert _ambient_mesh() is None, (
+        f"a mesh context leaked from a previous test: {_ambient_mesh()}")
+    yield
+    leaked = _ambient_mesh()
+    _validators.clear_mesh_programs()
+    assert leaked is None, f"a test leaked an active mesh context: {leaked}"
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

@@ -46,6 +46,13 @@ def test_documented_dials_match_code():
     assert int(dials["_SWEEP_GBT_ROUNDS"]) == T._SWEEP_GBT_ROUNDS
     assert int(dials["_CHAIN_SIBLING_MIN_TB"]) == T._CHAIN_SIBLING_MIN_TB
     assert float(dials["_MESH_RATIO_BOUND"]) == graft._MESH_RATIO_BOUND
+    assert float(dials["_MESH_FORCED_RATIO_BOUND"]) \
+        == graft._MESH_FORCED_RATIO_BOUND
+    from transmogrifai_tpu.parallel import mesh as M
+    assert int(dials["DEFAULT_MIN_ROWS_PER_CHIP"]) \
+        == M.DEFAULT_MIN_ROWS_PER_CHIP
+    assert int(dials["DEFAULT_MIN_CONFIGS_PER_CHIP"]) \
+        == M.DEFAULT_MIN_CONFIGS_PER_CHIP
 
 
 def test_documented_default_grid_fit_count():

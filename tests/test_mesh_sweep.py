@@ -1,0 +1,441 @@
+"""Fused mesh sweep: one sharded XLA program per family
+(impl/tuning/validators._make_fused_program mesh branch), on-device fold
+masks, the cost-model downgrade, donation safety, and chaos/resume semantics
+under the mesh — all on the conftest's 8-virtual-device CPU mesh
+(docs/parallel.md).
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+import jax.numpy as jnp
+
+import transmogrifai_tpu.models.linear   # noqa: F401 (registers families)
+import transmogrifai_tpu.models.trees    # noqa: F401
+from transmogrifai_tpu.impl.tuning.validators import (
+    OpCrossValidation, mesh_program_keys,
+)
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+from transmogrifai_tpu.parallel import MeshSpec, make_mesh
+from transmogrifai_tpu.parallel.mesh import sweep_mesh_decision
+from transmogrifai_tpu.robustness import faults
+from transmogrifai_tpu.utils.padding import bucket_for
+
+pytestmark = pytest.mark.mesh
+
+LR_GRID = [{"regParam": r, "elasticNetParam": e}
+           for r in (0.01, 0.1, 0.2) for e in (0.0, 0.5)]
+SVC_GRID = [{"regParam": 0.01}, {"regParam": 0.1}]
+
+
+def _synth(n=333, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _models(*names_grids):
+    return [(MODEL_REGISTRY[n], g) for n, g in names_grids]
+
+
+@pytest.fixture
+def force_mesh(monkeypatch):
+    """Pin the mesh on: the test shapes sit far below the cost-model
+    thresholds, and these tests target the ENGAGED fused-mesh path."""
+    monkeypatch.setenv("TG_MESH_FORCE", "1")
+
+
+# ---------------------------------------------------------------------------
+# fused mesh vs single device: bit-exact winner / params / metrics
+# ---------------------------------------------------------------------------
+
+def test_fused_mesh_bit_exact_linear_families(force_mesh):
+    """Linear families (one vmapped program, config axis sharded over
+    'model', grids traced+donated) must reproduce the single-device fused
+    sweep BIT-exactly: same winner, same hyper, identical metric bytes."""
+    X, y = _synth()
+    models = _models(("OpLogisticRegression", LR_GRID),
+                     ("OpLinearSVC", SVC_GRID))
+    plain = OpCrossValidation(num_folds=3, seed=7).validate(
+        models, X, y, "binary", "AuPR", True, 2)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = OpCrossValidation(num_folds=3, seed=7, mesh=mesh).validate(
+        models, X, y, "binary", "AuPR", True, 2)
+    assert sharded.family_name == plain.family_name
+    assert sharded.hyper == plain.hyper
+    assert sharded.metric_value == plain.metric_value
+    for rp, rs in zip(plain.results, sharded.results):
+        np.testing.assert_array_equal(rs.fold_metrics, rp.fold_metrics,
+                                      err_msg=rp.family)
+        np.testing.assert_array_equal(rs.mean_metrics, rp.mean_metrics)
+
+
+def test_fused_mesh_odd_grid_not_divisible_by_model_axis(force_mesh):
+    """F·G = 3·3 = 9 does not divide the model axis (2): the packed grid
+    block must pad to the shard multiple and slice in-trace — an unpadded
+    block fails device_put outright and silently QUARANTINED the family
+    (caught live: SVC's 3-config default grid under a forced mesh)."""
+    X, y = _synth()
+    models = _models(("OpLinearSVC", [{"regParam": r}
+                                      for r in (0.01, 0.1, 0.2)]))
+    plain = OpCrossValidation(num_folds=3, seed=7).validate(
+        models, X, y, "binary", "AuROC", True, 2)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = OpCrossValidation(num_folds=3, seed=7, mesh=mesh).validate(
+        models, X, y, "binary", "AuROC", True, 2)
+    assert not sharded.quarantined
+    np.testing.assert_array_equal(sharded.results[0].fold_metrics,
+                                  plain.results[0].fold_metrics)
+    assert sharded.hyper == plain.hyper
+
+
+def test_fused_mesh_nonsliced_bit_exact(force_mesh):
+    """Full-row masked scoring (fold_sliced=False) under the mesh — the
+    shared (n,) label vector is replicated into the config-parallel metric
+    stage — also reproduces single-device bytes."""
+    X, y = _synth(n=300)
+    models = _models(("OpLogisticRegression", LR_GRID))
+    plain = OpCrossValidation(num_folds=3, seed=5).validate(
+        models, X, y, "binary", "AuROC", True, 2, fold_sliced=False)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = OpCrossValidation(num_folds=3, seed=5, mesh=mesh).validate(
+        models, X, y, "binary", "AuROC", True, 2, fold_sliced=False)
+    np.testing.assert_array_equal(sharded.results[0].fold_metrics,
+                                  plain.results[0].fold_metrics)
+
+
+def test_fused_mesh_tree_family_close(force_mesh):
+    """Tree growth makes DISCRETE split choices from f32 gain sums, and
+    row-sharding reorders those partial sums (psum) — flipped near-tie
+    splits are inherent to data-parallel tree growth (the reference's Spark
+    RF is nondeterministic the same way). The mesh sweep must still land
+    within metric noise of the single-device sweep."""
+    X, y = _synth(n=400)
+    grid = [{"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+             "numTrees": 5, "subsamplingRate": 1.0}]
+    models = _models(("OpRandomForestClassifier", grid))
+    plain = OpCrossValidation(num_folds=3, seed=3).validate(
+        models, X, y, "binary", "AuROC", True, 2)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    sharded = OpCrossValidation(num_folds=3, seed=3, mesh=mesh).validate(
+        models, X, y, "binary", "AuROC", True, 2)
+    np.testing.assert_allclose(sharded.results[0].fold_metrics,
+                               plain.results[0].fold_metrics, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# on-device fold masks == the eager (F, n) tensors they replaced
+# ---------------------------------------------------------------------------
+
+def test_on_device_fold_masks_match_eager_tensors():
+    """The fused program derives train-weights/val-masks from the uint8
+    fold-id vector INSIDE the trace; the round-5 mesh path assembled (F, n)
+    tensors eagerly. Both constructions are integer/boolean — they must be
+    bit-identical, including bucket padding (id F+1: never train, never
+    validate) and TVS train-only rows (id F: train everywhere, validate
+    nowhere)."""
+    n, F = 333, 3
+    rng = np.random.RandomState(7)
+    vm = np.zeros((F, n), bool)
+    perm = rng.permutation(n)
+    # leave a tail of train-only rows (the TVS shape)
+    for f in range(F):
+        vm[f, perm[f::F][:40]] = True
+    fold_ids = np.where(vm.any(axis=0), vm.argmax(axis=0), F).astype(np.uint8)
+    n_pad = bucket_for(n, multiple_of=4)
+    ids = np.pad(fold_ids, (0, n_pad - n), constant_values=F + 1)
+
+    # eager reference (pre-change mesh path): mask-built tensors
+    f_iota = np.arange(F, dtype=np.uint8)[:, None]
+    train_eager = (ids[None, :] != f_iota).astype(np.float32)
+    train_eager[:, n:] = 0.0                       # pad rows carried 0 weight
+    val_eager = ids[None, :] == f_iota
+
+    # in-trace construction (exactly _make_fused_program's expressions)
+    ids_d = jnp.asarray(ids)
+
+    @jax.jit
+    def build(ids_d):
+        fi = jnp.arange(F, dtype=jnp.uint8)[:, None]
+        train = ((ids_d[None, :] != fi)
+                 & (ids_d[None, :] != jnp.uint8(F + 1))).astype(jnp.float32)
+        val = ids_d[None, :] == fi
+        return train, val
+
+    train_dev, val_dev = build(ids_d)
+    np.testing.assert_array_equal(np.asarray(train_dev), train_eager)
+    np.testing.assert_array_equal(np.asarray(val_dev), val_eager)
+
+
+# ---------------------------------------------------------------------------
+# cost-model downgrade
+# ---------------------------------------------------------------------------
+
+def test_downgrade_boundaries(monkeypatch):
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    monkeypatch.setenv("TG_MESH_MIN_ROWS_PER_CHIP", "1000")
+    monkeypatch.setenv("TG_MESH_MIN_CONFIGS_PER_CHIP", "4")
+    # exactly at both thresholds → engage
+    assert sweep_mesh_decision(mesh, 4000, 8)[0]
+    # one row below the per-chip floor → downgrade
+    engage, detail = sweep_mesh_decision(mesh, 3999, 8)
+    assert not engage and detail["rowsPerChip"] < 1000
+    # configs below the model-shard floor → downgrade
+    assert not sweep_mesh_decision(mesh, 4000, 7)[0]
+    # a zeroed threshold disables that axis of the check
+    monkeypatch.setenv("TG_MESH_MIN_CONFIGS_PER_CHIP", "0")
+    assert sweep_mesh_decision(mesh, 4000, 1)[0]
+    # force wins over everything
+    monkeypatch.setenv("TG_MESH_MIN_ROWS_PER_CHIP", "10**9")
+    monkeypatch.setenv("TG_MESH_FORCE", "1")
+    assert sweep_mesh_decision(mesh, 1, 1)[0]
+
+
+def test_downgraded_sweep_is_bit_identical_and_observable():
+    """Below-threshold sweeps run the single-device fused path byte-for-byte
+    and record the decision (counter + span event)."""
+    from transmogrifai_tpu.observability import metrics as obs_metrics
+    from transmogrifai_tpu.observability import trace as obs_trace
+
+    X, y = _synth()  # 333 rows: far below the default rows-per-chip floor
+    models = _models(("OpLogisticRegression", LR_GRID))
+    plain = OpCrossValidation(num_folds=3, seed=7).validate(
+        models, X, y, "binary", "AuPR", True, 2)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    obs_trace.enable_tracing(True)
+    try:
+        down = OpCrossValidation(num_folds=3, seed=7, mesh=mesh).validate(
+            models, X, y, "binary", "AuPR", True, 2)
+        snap = obs_metrics.registry().snapshot()
+        assert sum(snap.get("tg_mesh_downgrade_total", {}).values()) == 1
+        names = [s.name for s in obs_trace.tracer().finished()]
+        assert "sweep.mesh_downgrade" in names
+    finally:
+        obs_trace.enable_tracing(None)
+    np.testing.assert_array_equal(down.results[0].fold_metrics,
+                                  plain.results[0].fold_metrics)
+    assert down.hyper == plain.hyper
+    # no mesh-compiled program was built for the downgraded sweep
+    assert not mesh_program_keys()
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+def test_grid_donation_no_use_after_donate(force_mesh):
+    """The packed per-family grid block is donated into the fused program:
+    the validator must upload a FRESH block per dispatch (repeat calls stay
+    correct) and the donated buffer must actually be consumed — holding a
+    reference and reading it back after the call is an error by design."""
+    X, y = _synth()
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    cv = OpCrossValidation(num_folds=3, seed=7, mesh=mesh)
+    models = _models(("OpLogisticRegression", LR_GRID))
+    first = cv.validate(models, X, y, "binary", "AuPR", True, 2)
+    second = cv.validate(models, X, y, "binary", "AuPR", True, 2)
+    np.testing.assert_array_equal(first.results[0].fold_metrics,
+                                  second.results[0].fold_metrics)
+
+    # direct probe of the donation contract on the compiled program
+    from transmogrifai_tpu.impl.tuning import validators as V
+    keys = mesh_program_keys()
+    assert keys, "forced mesh sweep should compile mesh-keyed programs"
+    fam = MODEL_REGISTRY["OpLogisticRegression"]
+    assert getattr(fam, "traced_grid_ok", False)
+
+
+def test_donated_grid_buffer_is_consumed(force_mesh):
+    """The grid block is handed to the program with donate_argnums: either
+    XLA aliased it (reading it back raises — the usual accelerator case) or
+    XLA declined the alias (tiny CPU buffers) and the block must be byte-
+    unchanged — donation must never silently clobber a still-readable
+    input."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from transmogrifai_tpu.impl.tuning.validators import _make_fused_program
+    fam = MODEL_REGISTRY["OpLogisticRegression"]
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    F, grid = 2, LR_GRID
+    G = len(grid)
+    garr = {k: np.asarray(v) for k, v in fam.grid_to_arrays(grid).items()}
+    prog, gkeys = _make_fused_program(
+        fam, garr, G, F, "binary", "AuROC", 2, False, False, None,
+        mesh=mesh, x_ndim=2)
+    assert gkeys is not None
+    n = 256
+    X, y = _synth(n=n)
+    ids = np.zeros(n, np.uint8)
+    ids[n // 2:] = 1
+    gb_host = np.stack([np.tile(garr[k], F) for k in gkeys]
+                       ).astype(np.float32)
+    gb = jax.device_put(jnp.asarray(gb_host),
+                        NamedSharding(mesh, P(None, "model")))
+    m = prog(X, y, jnp.asarray(ids), gb)
+    np.asarray(m)  # sync
+    try:
+        back = np.asarray(gb)
+    except RuntimeError:
+        return  # donated buffer consumed — the accelerator contract
+    np.testing.assert_array_equal(back, gb_host)
+
+
+# ---------------------------------------------------------------------------
+# chaos + resume semantics under the mesh (PR 1–2 byte-preservation)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_family_quarantine_under_mesh(force_mesh):
+    """An armed validator.family_fit fault under the mesh quarantines that
+    family and the sweep continues on the rest — same semantics, same
+    records, as the single-device path."""
+    X, y = _synth()
+    models = _models(("OpLogisticRegression", LR_GRID),
+                     ("OpLinearSVC", SVC_GRID))
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    spec = {"validator.family_fit": {"mode": "raise",
+                                     "key": "OpLogisticRegression"}}
+    with faults.injected(spec):
+        best_mesh = OpCrossValidation(num_folds=3, seed=7,
+                                      mesh=mesh).validate(
+            models, X, y, "binary", "AuPR", True, 2)
+    with faults.injected(spec):
+        best_plain = OpCrossValidation(num_folds=3, seed=7).validate(
+            models, X, y, "binary", "AuPR", True, 2)
+    assert best_mesh.family_name == best_plain.family_name == "OpLinearSVC"
+    q_mesh = {q["family"] for q in best_mesh.quarantined}
+    q_plain = {q["family"] for q in best_plain.quarantined}
+    assert q_mesh == q_plain and "OpLogisticRegression" in q_mesh
+    lr_m = next(r for r in best_mesh.results
+                if r.family == "OpLogisticRegression")
+    assert np.all(np.isnan(lr_m.fold_metrics))
+
+
+@pytest.mark.chaos
+def test_preempt_sweep_resume_under_mesh(tmp_path, monkeypatch):
+    """Kill the train at preempt.sweep with the sweep running under a
+    FORCED mesh, resume, and reproduce the uninterrupted mesh run's winner
+    + metrics — preemption propagation and sweep-checkpoint replay
+    (PRs 1–2) must survive the fused mesh path byte-for-byte."""
+    from transmogrifai_tpu.features import reset_uids
+    from transmogrifai_tpu.robustness.faults import SimulatedPreemption
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    monkeypatch.setenv("TG_MESH_FORCE", "1")
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+
+    rng = np.random.RandomState(7)
+    n = 300
+    x1, x2 = rng.randn(n), rng.randn(n)
+    df = pd.DataFrame({"x1": x1, "x2": x2,
+                       "y": ((x1 + 0.5 * x2) > 0).astype(float)})
+    models = [("OpLogisticRegression", LR_GRID[:2]),
+              ("OpLinearSVC", [{"regParam": 0.01}])]
+
+    def _pred():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        f1 = FeatureBuilder.Real("x1").extract_field().as_predictor()
+        f2 = FeatureBuilder.Real("x2").extract_field().as_predictor()
+        checked = tg.transmogrify([f1, f2]).sanity_check(label)
+        return (BinaryClassificationModelSelector.with_cross_validation(
+            models=models).set_input(label, checked).get_output())
+
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+
+    reset_uids()
+    base_pred = _pred()
+    base = (OpWorkflow().set_input_dataset(df).set_result_features(base_pred)
+            .with_mesh(mesh).train())
+
+    ck = str(tmp_path / "ckpt")
+    reset_uids()
+    pred1 = _pred()
+    with faults.injected({"preempt.sweep": {"mode": "preempt", "nth": 2}}):
+        with pytest.raises(SimulatedPreemption):
+            (OpWorkflow().set_input_dataset(df).set_result_features(pred1)
+             .with_mesh(mesh).with_checkpoint_dir(ck).train())
+
+    reset_uids()
+    pred2 = _pred()
+    model = (OpWorkflow().set_input_dataset(df).set_result_features(pred2)
+             .with_mesh(mesh).with_checkpoint_dir(ck).train(resume=True))
+    assert model.summary()["resume"]["restoredSweepCandidates"]
+
+    def _sel(m):
+        return next(v for k, v in m.summary().items()
+                    if k != "faults" and isinstance(v, dict)
+                    and "bestModelType" in v)
+    b, r = _sel(base), _sel(model)
+    assert r["bestModelType"] == b["bestModelType"]
+    assert r["bestHyperparameters"] == b["bestHyperparameters"]
+    np.testing.assert_allclose(r["bestMetricValue"], b["bestMetricValue"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(model.score(df=df)[pred2.name].values),
+        np.asarray(base.score(df=df)[base_pred.name].values), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packed sharded table upload
+# ---------------------------------------------------------------------------
+
+def test_shard_table_packed_uploads_and_layout():
+    """shard_table moves ALL device-kind columns in ≤2 sharded transfers
+    (one value block + one mask block) and every resulting column is a
+    row-sharded on-device view with bit-identical values/masks."""
+    from transmogrifai_tpu.observability import metrics as obs_metrics
+    from transmogrifai_tpu.parallel import shard_table
+    from transmogrifai_tpu.table import Column, FeatureTable
+    from transmogrifai_tpu.types import Real, Text
+
+    rng = np.random.RandomState(0)
+    n = 333
+    cols = {
+        "a": Column(Real, rng.randn(n).astype(np.float32), rng.rand(n) > .2),
+        "b": Column(Real, rng.randn(n).astype(np.float32), None),
+        "t": Column(Text, np.asarray(["s%d" % i for i in range(n)],
+                                     dtype=object), None),
+    }
+    table = FeatureTable(dict(cols), n)
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    obs_metrics.enable_metrics(True)
+    try:
+        before = obs_metrics.registry().snapshot().get(
+            "tg_device_transfer_total", {})
+        n_before = sum(before.values()) if before else 0.0
+        sharded = shard_table(table, mesh)
+        snap = obs_metrics.registry().snapshot()
+        n_after = sum(snap["tg_device_transfer_total"].values())
+        assert n_after - n_before <= 2
+        tbytes = sum(snap.get("tg_transfer_bytes_total", {}).values())
+        assert tbytes > 0
+    finally:
+        obs_metrics.enable_metrics(None)
+    assert sharded.num_rows == 336                     # padded to 4·84
+    for name in ("a", "b"):
+        got = np.asarray(sharded[name].values)
+        np.testing.assert_array_equal(got[:n], np.asarray(cols[name].values))
+        assert np.all(got[n:] == 0)
+        mask = np.asarray(sharded[name].mask)
+        np.testing.assert_array_equal(
+            mask[:n],
+            np.ones(n, bool) if cols[name].mask is None
+            else np.asarray(cols[name].mask))
+        assert not mask[n:].any()
+        assert "data" in str(sharded[name].values.sharding)
+    # object column padded with None, host-resident
+    assert sharded["t"].values[n] is None
+
+
+def test_no_mesh_program_leak_fixture_probe():
+    """Companion to the conftest no-leak fixture: compiling a mesh program
+    registers a mesh-keyed cache entry; the fixture clears it after each
+    test, so entry here must be clean."""
+    assert not mesh_program_keys()

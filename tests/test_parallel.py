@@ -257,8 +257,8 @@ def test_ring_allreduce_matches_psum():
     8-device mesh."""
     from functools import partial
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
     from transmogrifai_tpu.parallel import collectives as C
+    from transmogrifai_tpu.parallel.collectives import shard_map
 
     mesh = make_mesh(MeshSpec(data=8, model=1))
     x = jnp.asarray(np.random.RandomState(0).randn(64, 5).astype(np.float32))
@@ -284,8 +284,8 @@ def test_reduce_by_key_across_shards():
     contingency pattern, reference SanityChecker.scala:433-440)."""
     from functools import partial
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
     from transmogrifai_tpu.parallel import collectives as C
+    from transmogrifai_tpu.parallel.collectives import shard_map
 
     mesh = make_mesh(MeshSpec(data=8, model=1))
     rng = np.random.RandomState(1)
@@ -308,8 +308,8 @@ def test_reduce_by_key_across_shards():
 def test_broadcast_from_primary():
     from functools import partial
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
     from transmogrifai_tpu.parallel import collectives as C
+    from transmogrifai_tpu.parallel.collectives import shard_map
 
     mesh = make_mesh(MeshSpec(data=8, model=1))
     x = jnp.arange(8, dtype=jnp.float32) + 1.0   # device 0 holds 1.0

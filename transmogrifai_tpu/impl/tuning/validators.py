@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import warnings
 
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -157,9 +158,27 @@ def _fused_cache_put(key, prog) -> None:
         _FUSED_CACHE.popitem(last=False)
 
 
+def clear_mesh_programs() -> None:
+    """Drop mesh-keyed fused programs. Each pins a ``jax.sharding.Mesh``
+    plus per-device executable buffers; the test harness asserts none leak
+    across tests (a stale program keyed to a dead 8-device test mesh would
+    silently hold every device's buffers alive for the whole session)."""
+    from jax.sharding import Mesh
+    for k in [k for k in _FUSED_CACHE
+              if any(isinstance(e, Mesh) for e in k)]:
+        _FUSED_CACHE.pop(k, None)
+
+
+def mesh_program_keys():
+    """Cache keys of mesh-compiled fused programs (no-leak fixture probe)."""
+    from jax.sharding import Mesh
+    return [k for k in _FUSED_CACHE
+            if any(isinstance(e, Mesh) for e in k)]
+
+
 def _make_fused_program(family, garr_np, G: int, F: int, problem: str,
                         metric_name: str, num_classes: int, exact: bool,
-                        sliced: bool, binned):
+                        sliced: bool, binned, mesh=None, x_ndim: int = 2):
     """ONE jitted program for a family's whole sweep branch: build the fold
     weights from the per-row fold ids, fit all F·G configs, score each
     fold's validation partition, and reduce to the padded metric vector.
@@ -171,20 +190,62 @@ def _make_fused_program(family, garr_np, G: int, F: int, problem: str,
     sweep never reads (only the metric vector leaves the program; e.g. tree
     raw-threshold tables exist solely for the refit path). The grid arrays
     are host constants, so the tree families' per-depth bucketing stays
-    static under the trace."""
+    static under the trace.
+
+    ``mesh``: compile the same branch as one GSPMD program with explicit
+    ``NamedSharding`` in/out specs — rows over 'data', the (F·G) config
+    batch over 'model' (families with ``shardable=False`` keep their
+    configs whole and only shard rows). The fold train-weights are built
+    INSIDE the trace from the uint8 fold-id vector, so no (F, n) tensor is
+    ever assembled on the host or device_put per family. The metric stage
+    is re-sharded config-parallel/row-replicated (``P('model', None)``):
+    the sort/cumulative-scan chain of AuROC/AuPR is partitioner-hostile
+    along the row axis (XLA's SPMD pass miscompiles the composed
+    scan+concat sequence when rows are sharded — see docs/parallel.md),
+    and per-config metrics over replicated rows are both correct and the
+    natural parallel axis. Families with ``traced_grid_ok`` take their
+    tiled grid as ONE packed (keys, F·G) f32 device argument, sharded over
+    'model' and DONATED — XLA may alias the block for per-family scratch
+    instead of re-allocating; tree families keep host-constant grids (their
+    per-depth bucketing must stay static under the trace). Returns
+    ``(prog, grid_keys)`` where ``grid_keys`` is None for constant-grid
+    families and the packed-block key order otherwise.
+    """
     B_true = F * G
     B_m = -(-B_true // 32) * 32
     metric = _metric_fn(problem, metric_name, batched_y=sliced, binned=binned)
     tiled = {k: np.tile(v, F) for k, v in garr_np.items()}
+    shardable = getattr(family, "shardable", True) if mesh is not None \
+        else True
+    traced_grid = (mesh is not None and shardable
+                   and getattr(family, "traced_grid_ok", False))
+    grid_keys = tuple(sorted(tiled)) if traced_grid else None
 
-    def prog(X, y, ids_d, Xf=None, yf=None, fvalid=None):
+    def prog(X, y, ids_d, *rest):
+        # call convention: [Xf, yf, fvalid] when sliced, then [gblock]
+        # when the family takes its grid as a traced (donated) argument
+        Xf = yf = fvalid = gblock = None
+        if sliced:
+            Xf, yf, fvalid = rest[0], rest[1], rest[2]
+            rest = rest[3:]
+        if traced_grid:
+            gblock = rest[0]
         f_iota = jnp.arange(F, dtype=jnp.uint8)[:, None]
         train_w = ((ids_d[None, :] != f_iota)
                    & (ids_d[None, :] != jnp.uint8(F + 1))
                    ).astype(jnp.float32)                    # (F, n)
         W = jnp.repeat(train_w, G, axis=0)                  # (F*G, n)
-        params = (family.fit_batch(X, y, W, tiled, num_classes) if exact
-                  else family.sweep_fit_batch(X, y, W, tiled, num_classes))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            W = jax.lax.with_sharding_constraint(
+                W, NamedSharding(mesh, P("model" if shardable else None,
+                                         "data")))
+        # gblock's config axis is zero-padded up to the 'model'-shard
+        # multiple (device_put demands divisibility); slice before the fit
+        g = ({k: gblock[i][:B_true] for i, k in enumerate(grid_keys)}
+             if traced_grid else tiled)
+        params = (family.fit_batch(X, y, W, g, num_classes) if exact
+                  else family.sweep_fit_batch(X, y, W, g, num_classes))
         if sliced:
             per_fold = [
                 family.predict_batch(
@@ -205,11 +266,44 @@ def _make_fused_program(family, garr_np, G: int, F: int, problem: str,
             VM = jnp.pad(VM, ((0, B_m - B_true), (0, 0)))
             if sliced:
                 Y = jnp.pad(Y, ((0, B_m - B_true), (0, 0)))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            cfg_sh = NamedSharding(
+                mesh, P("model", *([None] * (scores.ndim - 1))))
+            row_sh = NamedSharding(mesh, P("model", None))
+            scores = jax.lax.with_sharding_constraint(scores, cfg_sh)
+            VM = jax.lax.with_sharding_constraint(VM, row_sh)
+            # Y: (B, nf) per-config labels when sliced, the shared (n,)
+            # vector otherwise — either way the metric stage needs its row
+            # axis REPLICATED (see the partitioner note above)
+            Y = jax.lax.with_sharding_constraint(
+                Y, row_sh if sliced else NamedSharding(mesh, P(None)))
         if problem == "multiclass":
             return metric(scores, Y, VM, num_classes)
         return metric(scores, Y, VM)
 
-    return jax.jit(prog)
+    if mesh is None:
+        return jax.jit(prog), grid_keys
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    row = lambda nd: NamedSharding(mesh, P("data", *([None] * (nd - 1))))
+    in_sh = [row(x_ndim), row(1), row(1)]
+    if sliced:
+        # Xf feeds the row-parallel per-fold predicts → rows over 'data';
+        # yf / fvalid are consumed ONLY by the config-parallel metric
+        # stage, which needs rows replicated — uploading them sharded just
+        # buys an all-gather (and an XLA "involuntary rematerialization"
+        # warning) inside every family's program
+        in_sh += [NamedSharding(mesh, P(None, "data",
+                                        *([None] * (x_ndim - 1)))),
+                  NamedSharding(mesh, P(None)),
+                  NamedSharding(mesh, P(None))]
+    donate = ()
+    if traced_grid:
+        in_sh.append(NamedSharding(mesh, P(None, "model")))
+        donate = (len(in_sh) - 1,)
+    return jax.jit(prog, in_shardings=tuple(in_sh),
+                   out_shardings=NamedSharding(mesh, P(None)),
+                   donate_argnums=donate), grid_keys
 
 
 class OpValidator:
@@ -313,11 +407,32 @@ class OpValidator:
                 "foldHash": _hashlib.sha256(
                     np.ascontiguousarray(vm_np).tobytes()).hexdigest(),
             }
+        # cost-model gate (docs/parallel.md): engaging the mesh costs
+        # collectives + cross-device layout on EVERY fit/predict/metric of
+        # the sweep; when the per-chip slice is too small to amortize that,
+        # transparently downgrade to the single-device fused path — which
+        # is bit-identical to running with no mesh at all (same programs,
+        # same buckets). The decision is observable: tg_mesh_downgrade_total
+        # + a sweep.mesh_downgrade span event carrying the measured sizes.
+        mesh = self.mesh
+        if mesh is not None:
+            from ...parallel.mesh import sweep_mesh_decision
+            n_configs = F * sum(len(g) for _, g in models)
+            engage, detail = sweep_mesh_decision(mesh, n, n_configs)
+            if not engage:
+                _obs_metrics.inc_counter(
+                    "tg_mesh_downgrade_total", 1.0,
+                    help="sweeps downgraded to the single-device fused path "
+                         "by the mesh cost model")
+                _obs_trace.add_event("sweep.mesh_downgrade", **detail)
+                logger.info("mesh sweep downgraded to single-device: %s",
+                            detail)
+                mesh = None
         # bucket the row count so every fit/predict/metric program is reused
         # across datasets/folds/stages (utils/padding.py); under a mesh the
         # bucket also aligns to the data axis for equal shards. Pad rows
         # carry zero weight and False val masks — results are unchanged.
-        n_data = self.mesh.shape["data"] if self.mesh is not None else 1
+        n_data = mesh.shape["data"] if mesh is not None else 1
         n_pad = bucket_for(n, multiple_of=n_data)
         if n_pad != n:
             X = jnp.pad(X, ((0, n_pad - n),) + ((0, 0),) * (X.ndim - 1))
@@ -337,14 +452,6 @@ class OpValidator:
         ids_d = jnp.asarray(fold_ids)
         if n_pad != n:  # sentinel F+1: never trains, never validates
             ids_d = jnp.pad(ids_d, (0, n_pad - n), constant_values=F + 1)
-        if self.mesh is not None:
-            # the fused single-device path builds these inside its program;
-            # the mesh path still assembles them eagerly for device_put
-            f_iota = jnp.arange(F, dtype=jnp.uint8)[:, None]
-            train_w = (ids_d[None, :] != f_iota).astype(jnp.float32)  # (F, n)
-            if n_pad != n:
-                train_w = train_w.at[:, n:].set(0.0)
-            val_m = ids_d[None, :] == f_iota                          # (F, n)
         # fold-sliced scoring: every (fold, config) pair only needs ITS
         # fold's validation rows, so predict + metric run on the gathered
         # per-fold partitions (~n/F rows each, capped at max_eval_rows)
@@ -387,18 +494,26 @@ class OpValidator:
                 Xf = X[fidx_d].reshape((F, nf_b) + X.shape[1:])
                 yf = y[fidx_d].reshape(F, nf_b)
                 fvalid_d = jnp.asarray(fvalid)
-                if self.mesh is not None:
-                    # fold row axis sharded over 'data' so the per-fold
-                    # predicts + metrics stay row-parallel across chips
+                if mesh is not None:
+                    # Xf rows shard over 'data' (feeds the row-parallel
+                    # per-fold predicts); yf / fvalid replicate — they are
+                    # only read by the config-parallel metric stage. Placed
+                    # ONCE into the sweep-scoped cache and shared by every
+                    # family's fused program.
                     from jax.sharding import NamedSharding
                     from jax.sharding import PartitionSpec as P
-                    Xf = jax.device_put(Xf, NamedSharding(
-                        self.mesh,
-                        P(None, "data", *([None] * (X.ndim - 1)))))
-                    yf = jax.device_put(yf, NamedSharding(
-                        self.mesh, P(None, "data")))
-                    fvalid_d = jax.device_put(fvalid_d, NamedSharding(
-                        self.mesh, P(None, "data")))
+
+                    from ...parallel.distributed import retrying_device_put
+                    Xf = retrying_device_put(
+                        Xf, NamedSharding(
+                            mesh, P(None, "data", *([None] * (X.ndim - 1)))),
+                        site="sweep.fold_upload")
+                    yf = retrying_device_put(
+                        yf, NamedSharding(mesh, P(None)),
+                        site="sweep.fold_upload")
+                    fvalid_d = retrying_device_put(
+                        fvalid_d, NamedSharding(mesh, P(None)),
+                        site="sweep.fold_upload")
                 _fold_cache["Xf"] = Xf
                 _fold_cache["yf"] = yf
                 _fold_cache["valid"] = fvalid_d
@@ -412,105 +527,87 @@ class OpValidator:
         def _binned(sliced: bool):
             return (n_pad >= _BINNED_MIN_N) if sliced else None
 
-        def _metric(sliced: bool):
-            return _metric_fn(problem, metric_name, batched_y=sliced,
-                              binned=_binned(sliced))
-        if self.mesh is not None:
+        if mesh is not None:
+            # sweep-scoped device cache: X / y / fold-id bytes are placed
+            # with their mesh sharding ONCE and shared by every family's
+            # fused program (the per-family device_put of (F·G, n) weight
+            # tensors is gone — fold masks are built inside each trace from
+            # the uint8 id vector)
             from jax.sharding import NamedSharding, PartitionSpec as P
-            row_sh = NamedSharding(self.mesh, P("data"))
-            X = jax.device_put(X, NamedSharding(
-                self.mesh, P("data", *([None] * (X.ndim - 1)))))
-            y = jax.device_put(y, row_sh)
+
+            from ...parallel.distributed import retrying_device_put
+            row_sh = NamedSharding(mesh, P("data"))
+            X = retrying_device_put(
+                X, NamedSharding(mesh, P("data", *([None] * (X.ndim - 1)))),
+                site="sweep.table_upload")
+            y = retrying_device_put(y, row_sh, site="sweep.table_upload")
+            ids_d = retrying_device_put(ids_d, row_sh,
+                                        site="sweep.table_upload")
 
         def _dispatch(family, grid):
             """One family's sweep branch → a pending (name, grid, metric
             program output, B_true, G) entry. Runs under the quarantine
             try/except below: a throw here (trace error, diverging fused
             fit, injected fault) quarantines the family instead of
-            aborting the sweep."""
+            aborting the sweep. With or without a mesh the branch is ONE
+            fused jitted program (see _make_fused_program); the mesh
+            variant carries explicit NamedSharding in/out specs and is
+            cached under a mesh-inclusive key."""
             G = len(grid)
             sliced_f = fold_sliced and getattr(family, "fold_sliced_predict",
                                                True)
-            if self.mesh is None:
-                # single-device: the family's entire sweep branch runs as
-                # one fused jitted program (see _make_fused_program)
-                binned_f = _binned(sliced_f)
-                key = (family, repr([sorted(g.items()) for g in grid]),
-                       F, G, problem, metric_name, num_classes,
-                       self.exact_sweep_fits, sliced_f, binned_f)
-                prog = _fused_cache_get(key)
-                if prog is None:
-                    garr_np = {k: np.asarray(v)
-                               for k, v in family.grid_to_arrays(grid).items()}
-                    prog = _make_fused_program(
-                        family, garr_np, G, F, problem, metric_name,
-                        num_classes, self.exact_sweep_fits, sliced_f,
-                        binned_f)
-                    _fused_cache_put(key, prog)
-                if sliced_f:
-                    Xf, yf, fvalid_d = _fold_data()
-                    m = prog(X, y, ids_d, Xf, yf, fvalid_d)
-                else:
-                    m = prog(X, y, ids_d)
-                return (family.name, list(grid), m, F * G, G)
-            garr = family.grid_to_arrays(grid)                   # each (G,)
-            # tile: config b = fold f * G + g
-            W = jnp.repeat(train_w, G, axis=0)                   # (F*G, n)
-            tiled = {k: jnp.tile(v, F) for k, v in garr.items()}  # (F*G,)
-            B_true = W.shape[0]
-            if self.mesh is not None and getattr(family, "shardable", True):
-                n_model = self.mesh.shape["model"]
-                B_pad = ((B_true + n_model - 1) // n_model) * n_model
-                if B_pad != B_true:
-                    idx = jnp.arange(B_pad) % B_true
-                    W = W[idx]
-                    tiled = {k: v[idx] for k, v in tiled.items()}
-                W = jax.device_put(W, NamedSharding(self.mesh,
-                                                    P("model", "data")))
-                tiled = {k: jax.device_put(v, NamedSharding(self.mesh,
-                                                            P("model")))
-                         for k, v in tiled.items()}
-            params = (family.fit_batch(X, y, W, tiled, num_classes)
-                      if self.exact_sweep_fits
-                      else family.sweep_fit_batch(X, y, W, tiled, num_classes))
-            sliced = sliced_f
-            if sliced:
-                Xf, yf, fvalid_d = _fold_data()
-                per_fold = [
-                    family.predict_batch(
-                        family.slice_params(params, f * G, (f + 1) * G),
-                        Xf[f], num_classes)
-                    for f in range(F)
-                ]
-                scores = jnp.concatenate(per_fold, axis=0)  # (F*G, nf[, C])
-                Y = jnp.repeat(yf, G, axis=0)               # (F*G, nf)
-                VM = jnp.repeat(fvalid_d, G, axis=0)
-            else:
-                scores = family.predict_batch(params, X, num_classes)
-                scores = scores[:B_true]                    # (F*G, n[, C])
-                Y = y
-                VM = jnp.repeat(val_m, G, axis=0)           # (F*G, n)
-            metric = _metric(sliced)
-            # round the config axis up to a multiple of 32 so the jitted
-            # metric program is shared across families of similar grid sizes
-            # — compiles dominate on backends where the persistent cache
-            # cannot deserialize. (NOT bucket_for: its 256-row floor would
-            # pad a 12-config sweep 21x.)
-            B_m = -(-B_true // 32) * 32
-            if B_m != B_true:
-                scores = jnp.pad(scores, ((0, B_m - B_true),)
-                                 + ((0, 0),) * (scores.ndim - 1))
-                VM = jnp.pad(VM, ((0, B_m - B_true), (0, 0)))
-                if sliced:
-                    Y = jnp.pad(Y, ((0, B_m - B_true), (0, 0)))
-            if problem == "multiclass":
-                m = metric(scores, Y, VM, num_classes)
-            else:
-                m = metric(scores, Y, VM)
+            binned_f = _binned(sliced_f)
+            key = (family, repr([sorted(g.items()) for g in grid]),
+                   F, G, problem, metric_name, num_classes,
+                   self.exact_sweep_fits, sliced_f, binned_f, mesh,
+                   X.ndim)
+            entry = _fused_cache_get(key)
+            if entry is None:
+                garr_np = {k: np.asarray(v)
+                           for k, v in family.grid_to_arrays(grid).items()}
+                entry = _make_fused_program(
+                    family, garr_np, G, F, problem, metric_name,
+                    num_classes, self.exact_sweep_fits, sliced_f,
+                    binned_f, mesh=mesh, x_ndim=X.ndim)
+                _fused_cache_put(key, entry)
+            prog, grid_keys = entry
+            args = [X, y, ids_d]
+            if sliced_f:
+                args += list(_fold_data())
+            if grid_keys is not None:
+                # per-family scratch: the tiled grid packed into ONE
+                # (keys, F·G) f32 block, uploaded sharded over 'model' and
+                # DONATED into the program — one transfer per family and a
+                # buffer XLA may alias instead of re-allocating. Never
+                # reused after the call (donation safety).
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ...parallel.distributed import retrying_device_put
+                garr_np = {k: np.asarray(v)
+                           for k, v in family.grid_to_arrays(grid).items()}
+                gb = np.stack([np.tile(garr_np[k], F) for k in grid_keys]
+                              ).astype(np.float32)
+                n_model = mesh.shape["model"]
+                gb_pad = -(-gb.shape[1] // n_model) * n_model
+                if gb_pad != gb.shape[1]:
+                    # zero-padded tail so the config axis divides the
+                    # 'model' shards; the program slices it off before the
+                    # fit (an unpadded block fails device_put outright)
+                    gb = np.pad(gb, ((0, 0), (0, gb_pad - gb.shape[1])))
+                args.append(retrying_device_put(
+                    jnp.asarray(gb), NamedSharding(mesh, P(None, "model")),
+                    site="sweep.grid_upload"))
             # defer host materialization: every family's full program queues
             # on the device back-to-back, then ONE sync reads all metrics
             # (a per-family sync costs a link round-trip each)
-            return (family.name, list(grid), m, B_true, G)
+            with warnings.catch_warnings():
+                # donated grid blocks too small for XLA to alias (tiny CPU
+                # grids) emit a first-compile "donated buffers were not
+                # usable" warning — expected, not actionable
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                m = prog(*args)
+            return (family.name, list(grid), m, F * G, G)
 
         # per-candidate quarantine at family granularity: a family's whole
         # branch is one fused program, so a throw (trace error, diverging

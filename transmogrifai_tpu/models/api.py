@@ -33,12 +33,33 @@ class FittedParams:
 
 
 class ModelFamily(abc.ABC):
-    """A homogeneous model family whose hyperparameter grid can be vmapped."""
+    """A homogeneous model family whose hyperparameter grid can be vmapped.
+
+    Mesh sharding contract (docs/parallel.md): when the ModelSelector sweep
+    runs over a ('data', 'model') mesh, ``fit_batch`` / ``sweep_fit_batch``
+    are traced into one GSPMD program whose operands carry these shardings —
+    X rows over 'data' (features replicated), y over 'data', weights
+    ('model', 'data'), grid arrays over 'model' — and the returned stacked
+    params must keep their leading config axis partitionable over 'model'
+    (no cross-config reductions; per-config math only, which every vmapped
+    fit satisfies by construction). ``shardable=False`` opts a family's
+    config axis out (sequential-scan fits whose chunk loop is not a single
+    vmapped program); rows still shard over 'data'.
+    """
 
     #: family name, e.g. "OpLogisticRegression"
     name: str = ""
     #: problem kinds: subset of {"binary", "multiclass", "regression"}
     supports: frozenset = frozenset()
+    #: config (B) axis may shard over the mesh 'model' axis; False keeps
+    #: configs whole per device (see the sharding contract above)
+    shardable: bool = True
+    #: grid arrays may be passed as ONE packed traced f32 device block
+    #: (uploaded sharded over 'model', donated for buffer reuse) instead of
+    #: host constants baked into the trace. Only safe for families whose fit
+    #: reads grid values as arrays; families deriving STATIC trace structure
+    #: from the grid (tree depth bucketing) must keep host constants
+    traced_grid_ok: bool = False
     #: fitted-param keys where ±inf is a STRUCTURAL sentinel, not divergence
     #: (tree thresholds use +inf for "stopped node routes every row left");
     #: the refit non-finite guard (robustness/guards.params_finite) checks

@@ -240,6 +240,9 @@ class LogisticRegressionFamily(ModelFamily):
     elasticNetParam [0,0.5] — DefaultSelectorParams.scala)."""
 
     name = "OpLogisticRegression"
+    #: grid values are consumed purely as (B,) arrays — safe to
+    #: trace as a packed, donated device block under the mesh
+    traced_grid_ok = True
     supports = frozenset({"binary", "multiclass"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -405,6 +408,9 @@ class LinearRegressionFamily(ModelFamily):
     elasticNetParam [0,0.5])."""
 
     name = "OpLinearRegression"
+    #: grid values are consumed purely as (B,) arrays — safe to
+    #: trace as a packed, donated device block under the mesh
+    traced_grid_ok = True
     supports = frozenset({"regression"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -487,6 +493,9 @@ class LinearSVCFamily(ModelFamily):
     """reference OpLinearSVC (defaults: regParam [0.01,0.1,0.2])."""
 
     name = "OpLinearSVC"
+    #: grid values are consumed purely as (B,) arrays — safe to
+    #: trace as a packed, donated device block under the mesh
+    traced_grid_ok = True
     supports = frozenset({"binary"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:
@@ -546,6 +555,9 @@ class NaiveBayesFamily(ModelFamily):
     """reference OpNaiveBayes (default smoothing 1.0)."""
 
     name = "OpNaiveBayes"
+    #: grid values are consumed purely as (B,) arrays — safe to
+    #: trace as a packed, donated device block under the mesh
+    traced_grid_ok = True
     supports = frozenset({"binary", "multiclass"})
 
     def default_grid(self, problem: str) -> List[Dict[str, Any]]:

@@ -31,7 +31,8 @@ HBM traffic per config drops to: read codes once (n·d int32), write either
 Replaces the reference's per-executor SparkML `Node.predictImpl` recursion
 and the XGBoost JNI predictor (reference: SURVEY §2.9) with a TPU-native
 kernel. Layout notes: lanes are j-major — lane = j·T_pad + t — because
-`pltpu.repeat` tiles whole vectors along lanes, so repeating the (R, T_pad)
+`_tile_lanes` (Mosaic RepeatOp on TPU) tiles whole vectors along lanes, so
+repeating the (R, T_pad)
 node vector m times lines tree t up with every candidate j at lane j·T_pad+t.
 
 Fallback: non-TPU backends (CPU test mesh, dry runs) and shapes outside the
@@ -46,7 +47,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 
-from .tree_hist import _interpret, _pad_to, _use_pallas
+from .tree_hist import _interpret, _pad_to, _tile_lanes, _use_pallas
 
 import os as _os
 
@@ -58,7 +59,7 @@ _MAX_TREES_PALLAS = 128
 def _t_pad(T: int, depth: int) -> int:
     """Tree-axis padding: a multiple of 64 keeps every RAGGED level's lane
     width (T_pad × even node count) a 128-multiple AND an exact multiple of
-    T_pad, so `pltpu.repeat(node, m_eff)` lands each tree at lane
+    T_pad, so `_tile_lanes(node, m_eff)` lands each tree at lane
     j·T_pad + t without any in-kernel pad."""
     return max(64, _pad_to(T, 64))
 
@@ -100,8 +101,6 @@ def _descend(codes_f, f_flat_ref, b_flat_ref, *, depth, T_pad, d_pad):
     Ragged levels: level l reads its own T_pad·_m_eff(l)-lane slice of the
     flat split tables, so early levels do 1/m_max-th the deepest level's
     VPU/MXU work instead of padding up to it."""
-    from jax.experimental.pallas import tpu as pltpu
-
     R = codes_f.shape[0]
     codes_bf = codes_f.astype(jnp.bfloat16)
     node = jnp.zeros((R, T_pad), jnp.int32)
@@ -118,7 +117,7 @@ def _descend(codes_f, f_flat_ref, b_flat_ref, *, depth, T_pad, d_pad):
                            preferred_element_type=jnp.float32)  # (R, w)
         go_lane = (code_sel > b_row.astype(jnp.float32)
                    ).astype(jnp.bfloat16)
-        node_rep = pltpu.repeat(node, m_eff, axis=1)          # (R, w)
+        node_rep = _tile_lanes(node, m_eff)                   # (R, w)
         lane = jax.lax.broadcasted_iota(jnp.int32, (R, w), 1)
         oh = (node_rep == lane // T_pad).astype(jnp.bfloat16)
         gl = jax.lax.broadcasted_iota(jnp.int32, (w, T_pad), 0) % T_pad
@@ -132,12 +131,10 @@ def _descend(codes_f, f_flat_ref, b_flat_ref, *, depth, T_pad, d_pad):
 
 def _leaf_onehot(node, *, depth, T_pad):
     """(R, T_pad) leaf ids → (R, T_pad·L) bf16 one-hot, lane = leaf·T_pad+t."""
-    from jax.experimental.pallas import tpu as pltpu
-
     R = node.shape[0]
     L = 2 ** depth
     lane = jax.lax.broadcasted_iota(jnp.int32, (R, T_pad * L), 1)
-    node_rep = pltpu.repeat(node, L, axis=1)
+    node_rep = _tile_lanes(node, L)
     return (node_rep == lane // T_pad).astype(jnp.bfloat16)
 
 
@@ -414,8 +411,6 @@ def _descend_chain(codes_f, f_ref, b_ref, a_ref, *, depth, W, T_pad, d_pad):
     Same matmul skeleton as `_descend`, plus the base-pointer gather: the
     next slot is Σ_j oh[j]·(base[j] + go[j]) — one fused group-sum matmul
     (base values < 256 are exact in the bf16 operand, accumulated f32)."""
-    from jax.experimental.pallas import tpu as pltpu
-
     R = codes_f.shape[0]
     codes_bf = codes_f.astype(jnp.bfloat16)
     slot = jnp.zeros((R, T_pad), jnp.int32)
@@ -433,7 +428,7 @@ def _descend_chain(codes_f, f_ref, b_ref, a_ref, *, depth, W, T_pad, d_pad):
                            preferred_element_type=jnp.float32)  # (R, w)
         go_lane = (code_sel > b_row.astype(jnp.float32)
                    ).astype(jnp.bfloat16)
-        slot_rep = pltpu.repeat(slot, We, axis=1)             # (R, w)
+        slot_rep = _tile_lanes(slot, We)                      # (R, w)
         lane = jax.lax.broadcasted_iota(jnp.int32, (R, w), 1)
         oh = (slot_rep == lane // T_pad).astype(jnp.bfloat16)
         val = (go_lane + a_row.astype(jnp.bfloat16)) * oh     # (R, w)
@@ -446,11 +441,9 @@ def _descend_chain(codes_f, f_ref, b_ref, a_ref, *, depth, W, T_pad, d_pad):
 
 
 def _leaf_onehot_chain(slot, *, W_out, T_pad):
-    from jax.experimental.pallas import tpu as pltpu
-
     R = slot.shape[0]
     lane = jax.lax.broadcasted_iota(jnp.int32, (R, T_pad * W_out), 1)
-    slot_rep = pltpu.repeat(slot, W_out, axis=1)
+    slot_rep = _tile_lanes(slot, W_out)
     return (slot_rep == lane // T_pad).astype(jnp.bfloat16)
 
 

@@ -68,6 +68,22 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _tile_lanes(x, repeats: int):
+    """``[x, x, …]`` concatenated ``repeats`` times along lanes (axis 1).
+
+    Mosaic's RepeatOp — what ``pltpu.repeat`` lowers to ON TPU — tiles the
+    whole vector, and every kernel lane layout here is built on that. But
+    jax 0.4.36+ registers a generic lowering for the same primitive that is
+    ELEMENT-WISE ``jnp.repeat`` — so in interpret mode (CPU CI) the lanes
+    came back permuted and every kernel test silently compared bin-major
+    against feature-major garbage. Keep the hardware op on TPU; emulate the
+    tile semantics with an explicit concatenate everywhere else."""
+    if _interpret():
+        return jnp.concatenate([x] * repeats, axis=1)
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.repeat(x, repeats, axis=1)
+
+
 def _pad_to(x: int, m: int) -> int:
     return -(-x // m) * m
 
@@ -125,7 +141,7 @@ def _hist_pallas(codes: jnp.ndarray, A: jnp.ndarray,
 
     def kernel(codes_ref, a_ref, out_ref):
         s = pl.program_id(2)
-        rep = pltpu.repeat(codes_ref[:], n_bins, axis=1)    # (blk_s, nb*blk_d)
+        rep = _tile_lanes(codes_ref[:], n_bins)             # (blk_s, nb*blk_d)
         b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, lanes), 1)
                   // blk_d)
         if exact:

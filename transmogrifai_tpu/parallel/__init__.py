@@ -6,15 +6,20 @@ row data-parallelism (Spark RDD partitions) becomes row-axis sharding over the
 becomes batch-axis sharding over the 'model' mesh axis. XLA inserts the
 collectives (psum over ICI) that Spark's shuffle/treeAggregate did.
 """
-from .mesh import MeshSpec, make_mesh, default_mesh, data_parallel_sharding
+from .mesh import (
+    MeshSpec, make_mesh, default_mesh, data_parallel_sharding,
+    sweep_mesh_decision,
+)
 from .collectives import (
-    psum, pmean, pmax, all_gather, reduce_scatter, host_gather,
+    psum, pmean, pmax, all_gather, reduce_scatter, host_gather, shard_map,
 )
 from .sharded import shard_table, sharded_fit_batch, sharded_col_stats
 from . import distributed
 
 __all__ = [
     "MeshSpec", "make_mesh", "default_mesh", "data_parallel_sharding",
+    "sweep_mesh_decision",
     "psum", "pmean", "pmax", "all_gather", "reduce_scatter", "host_gather",
+    "shard_map",
     "shard_table", "sharded_fit_batch", "sharded_col_stats", "distributed",
 ]

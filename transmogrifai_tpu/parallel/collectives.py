@@ -17,6 +17,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# jax moved shard_map from jax.experimental to the top-level namespace
+# (0.4.35 added jax.shard_map; the experimental path still exists but warns
+# on newer releases). Export the resolved symbol so framework + tests bind
+# one name across jax versions.
+try:  # pragma: no cover - version dependent
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - version dependent
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def axis_size(axis_name: str) -> int:
+    """STATIC size of a mapped mesh axis from inside ``shard_map`` (drives
+    Python-level hop loops, so it must be a concrete int, not a traced
+    ``psum(1)``). jax 0.4.38+ exposes ``jax.lax.axis_size``; fall back to
+    the trace-env frame on older releases."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:  # pragma: no cover - version dependent
+        return size(axis_name)
+    from jax._src import core as _core
+    return int(_core.axis_frame(axis_name))  # returns the size directly
+
 
 def psum(x, axis_name: str = "data"):
     return jax.lax.psum(x, axis_name)
@@ -57,7 +78,7 @@ def ring_allreduce(x, axis_name: str = "data"):
     unless you need to overlap the hops with compute — this exists so the
     comm layer's semantics are testable against psum hop by hop.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     idx = jax.lax.axis_index(axis_name)

@@ -83,6 +83,22 @@ def initialize(coordinator_address: Optional[str] = None,
         raise
 
 
+def _count_transfer_bytes(arr, direction: str) -> None:
+    """Fold one successful link crossing into the transfer accounting
+    (tg_transfer_bytes_total{direction=h2d|d2h}) — zero-write when metrics
+    are off, so the hot path pays nothing un-observed. Device→device
+    re-placements count as h2d: on tunneled backends they ride the same
+    link, and the packed-upload A/B wants every crossing visible."""
+    from ..observability import metrics as _obs_metrics
+    if not _obs_metrics.metrics_enabled():
+        return
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes:
+        _obs_metrics.inc_counter(
+            "tg_transfer_bytes_total", float(nbytes), direction=direction,
+            help="bytes moved across the host<->device link")
+
+
 def fetch_to_host(arr, policy=None, site: str = "distributed.to_host"):
     """Device→host transfer guarded by a retry policy.
 
@@ -101,7 +117,9 @@ def fetch_to_host(arr, policy=None, site: str = "distributed.to_host"):
         faults.inject(site)
         return np.asarray(arr)
 
-    return policy.execute(pull, site=site)
+    out = policy.execute(pull, site=site)
+    _count_transfer_bytes(out, "d2h")
+    return out
 
 
 def retrying_device_put(x, sharding=None, policy=None,
@@ -117,7 +135,9 @@ def retrying_device_put(x, sharding=None, policy=None,
         return (jax.device_put(x, sharding) if sharding is not None
                 else jax.device_put(x))
 
-    return policy.execute(put, site=site)
+    out = policy.execute(put, site=site)
+    _count_transfer_bytes(out, "h2d")
+    return out
 
 
 def is_primary() -> bool:

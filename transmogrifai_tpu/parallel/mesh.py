@@ -15,12 +15,57 @@ list, ICI within a slice and DCN across slices — nothing here changes.
 """
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: sweep-engagement cost model (docs/parallel.md "The downgrade cost
+#: model"). Engaging the mesh prices in per-program collectives (psums over
+#: every cross-row reduce of the fit), cross-device layout moves around the
+#: config axis, and the GSPMD partitioner's fixed per-program overhead —
+#: none of which shrink with the problem. Measured on the 8-virtual-device
+#: CPU host (shared cores, so the ratio isolates overhead from parallel
+#: win): at 8192 rows/chip the sharded sweep executes ~2.5x the
+#: single-device fused wall; the overhead first falls inside run-to-run
+#: noise above ~16k rows per chip and a handful of configs per model shard
+#: (docs/benchmarks.md "Mesh cost model"). Below the thresholds the sweep
+#: transparently downgrades to the single-device fused path — bit-identical
+#: results, observable via tg_mesh_downgrade_total + span event.
+MESH_MIN_ROWS_PER_CHIP_ENV = "TG_MESH_MIN_ROWS_PER_CHIP"
+MESH_MIN_CONFIGS_PER_CHIP_ENV = "TG_MESH_MIN_CONFIGS_PER_CHIP"
+MESH_FORCE_ENV = "TG_MESH_FORCE"
+DEFAULT_MIN_ROWS_PER_CHIP = 16384
+DEFAULT_MIN_CONFIGS_PER_CHIP = 4
+
+
+def sweep_mesh_decision(mesh: Mesh, n_rows: int,
+                        n_configs: int) -> Tuple[bool, Dict[str, object]]:
+    """Engage-or-downgrade decision for a ``|configs| × rows`` sweep.
+
+    Returns ``(engage, detail)``; ``detail`` carries the measured sizes and
+    thresholds for the downgrade span event. ``TG_MESH_FORCE=1`` pins the
+    mesh on regardless (bench A/B and mesh-path tests); setting either
+    threshold env var to 0 disables that axis of the check."""
+    if os.environ.get(MESH_FORCE_ENV, "") in ("1", "true"):
+        return True, {"forced": True}
+    min_rows = int(os.environ.get(MESH_MIN_ROWS_PER_CHIP_ENV,
+                                  DEFAULT_MIN_ROWS_PER_CHIP))
+    min_cfg = int(os.environ.get(MESH_MIN_CONFIGS_PER_CHIP_ENV,
+                                 DEFAULT_MIN_CONFIGS_PER_CHIP))
+    rows_per_chip = n_rows / max(mesh.shape.get("data", 1), 1)
+    cfg_per_chip = n_configs / max(mesh.shape.get("model", 1), 1)
+    detail = {
+        "rowsPerChip": int(rows_per_chip), "minRowsPerChip": min_rows,
+        "configsPerChip": int(cfg_per_chip), "minConfigsPerChip": min_cfg,
+        "meshShape": dict(mesh.shape),
+    }
+    engage = rows_per_chip >= min_rows and cfg_per_chip >= min_cfg
+    return engage, detail
 
 
 @dataclass(frozen=True)

@@ -23,37 +23,79 @@ def shard_table(table, mesh: Mesh):
     """Re-place every device-resident column row-sharded over 'data'.
 
     Rows are padded (with invalid/masked slots) to a multiple of the data-axis
-    size so shards are equal — the analog of Spark repartitioning.
+    size so shards are equal — the analog of Spark repartitioning. Device-kind
+    columns upload PACKED: all same-dtype columns stack into one (n_pad, W)
+    block and all masks into one (n_pad, M) bool block, each transferred once
+    with sharded layout (``P('data', None)``) and split back into per-column
+    on-device views — O(dtypes) transfers instead of one 70–130 ms round trip
+    per column on tunneled backends, and the shards land directly on their
+    owning chips (no replicate-then-reshard hop).
     """
+    from ..observability import metrics as _obs_metrics
     from ..table import Column, FeatureTable
+    from ..utils.padding import pad_rows, padded_valid_mask
+    from .distributed import retrying_device_put
     n_data = mesh.shape["data"]
     n = table.num_rows
     n_pad = _pad_to(max(n, n_data), n_data)
     pad = n_pad - n
+
+    # gather the packable device-kind columns: per-dtype value planes
+    # (width-1 columns count as width-1 planes) + one shared mask plane list
+    by_dtype: dict = {}
+    masked: list = []
+    for name in table.column_names:
+        col = table[name]
+        if col.kind not in ("real", "binary", "vector", "prediction"):
+            continue
+        v = pad_rows(col.values, n_pad)
+        by_dtype.setdefault(str(v.dtype), []).append(
+            (name, v.reshape(n_pad, -1)))
+        if pad or col.mask is not None:
+            masked.append((name, padded_valid_mask(col.mask, n, n_pad)))
+
+    # byte accounting (tg_transfer_bytes_total) happens once inside
+    # retrying_device_put — only the upload COUNT is recorded here
+    transfers = 0
+    dev_vals: dict = {}
+    for dt, parts in by_dtype.items():
+        host = (np.concatenate([v for _, v in parts], axis=1)
+                if len(parts) > 1 else parts[0][1])
+        block = retrying_device_put(
+            jnp.asarray(host),
+            NamedSharding(mesh, P("data", None)), site="shard_table.upload")
+        transfers += 1
+        off = 0
+        for name, v in parts:
+            w = v.shape[1]
+            dev_vals[name] = block[:, off:off + w]
+            off += w
+    dev_masks: dict = {}
+    if masked:
+        mhost = np.stack([m for _, m in masked], axis=1)     # (n_pad, M)
+        mblock = retrying_device_put(
+            jnp.asarray(mhost),
+            NamedSharding(mesh, P("data", None)), site="shard_table.upload")
+        transfers += 1
+        for i, (name, _) in enumerate(masked):
+            dev_masks[name] = mblock[:, i]
+    if transfers:
+        _obs_metrics.inc_counter(
+            "tg_device_transfer_total", float(transfers),
+            help="host→device uploads (packed: see docs/plan.md)")
+
     cols = {}
     for name in table.column_names:
         col = table[name]
         vals, mask = col.values, col.mask
-        if col.kind in ("real", "binary", "vector", "prediction"):
-            v = np.asarray(vals)
-            if pad:
-                v = np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
-                m = np.zeros(n_pad, bool)
-                m[:n] = True if mask is None else np.asarray(mask)
-                mask = m
-            sh = NamedSharding(mesh, P("data", *([None] * (v.ndim - 1))))
-            vals = jax.device_put(jnp.asarray(v), sh)
-            if mask is not None:
-                mask = jax.device_put(jnp.asarray(mask),
-                                      NamedSharding(mesh, P("data")))
+        if name in dev_vals:
+            v = np.asarray(col.values)
+            vals = (dev_vals[name] if v.ndim > 1
+                    else dev_vals[name].reshape(n_pad))
+            mask = dev_masks.get(name)
         elif pad:
-            v = np.asarray(vals)
-            filler = np.zeros((pad,) + v.shape[1:], v.dtype) \
-                if v.dtype != object else np.full(pad, None, dtype=object)
-            vals = np.concatenate([v, filler])
-            m = np.zeros(n_pad, bool)
-            m[:n] = True if mask is None else np.asarray(mask)
-            mask = m
+            vals = pad_rows(vals, n_pad)
+            mask = padded_valid_mask(mask, n, n_pad)
         cols[name] = Column(col.feature_type, vals, mask, col.metadata)
     key = table.key
     if key is not None and pad:

@@ -233,21 +233,27 @@ class FeatureTable:
             if c.mask is not None:
                 masked.append((n, np.asarray(c.mask)))
         transfers = 0
+        nbytes = 0
         flat_dev: Dict[str, Any] = {}
         for dt, parts in by_dtype.items():
             host = (np.concatenate([v for _, v in parts])
                     if len(parts) > 1 else parts[0][1])
             flat_dev[dt] = jnp.asarray(host)
             transfers += 1
+            nbytes += host.nbytes
         mask_dev = None
         if masked:
-            mask_dev = jnp.asarray(
-                np.concatenate([m for _, m in masked])
-                if len(masked) > 1 else masked[0][1])
+            mhost = (np.concatenate([m for _, m in masked])
+                     if len(masked) > 1 else masked[0][1])
+            mask_dev = jnp.asarray(mhost)
             transfers += 1
+            nbytes += mhost.nbytes
         _obs_metrics.inc_counter(
             "tg_device_transfer_total", float(transfers),
             help="host→device uploads (packed: see docs/plan.md)")
+        _obs_metrics.inc_counter(
+            "tg_transfer_bytes_total", float(nbytes), direction="h2d",
+            help="bytes moved across the host<->device link")
         offs = {dt: 0 for dt in flat_dev}
         moff = 0
         mask_at: Dict[str, Any] = {}

@@ -68,6 +68,15 @@ def ensure_compilation_cache() -> None:
     _install_listener()
     try:
         import jax
+        # partition-invariant counter-based threefry: the RF/GBT bootstrap
+        # streams (models/trees.py jax.random calls inside sharded fit
+        # programs) must generate the SAME bits whether the sweep runs on
+        # one device or row-sharded over the mesh 'data' axis — the legacy
+        # stream is not partition-stable and forces XLA to serialize the
+        # generator. jax flipped this default back and forth across 0.4.x;
+        # pin it (an explicit user/env setting still wins).
+        if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
+            jax.config.update("jax_threefry_partitionable", True)
         if jax.config.jax_compilation_cache_dir:
             return  # user already configured one
         d = os.environ.get(

@@ -38,3 +38,30 @@ def bucket_for(n: int, multiple_of: int = 1) -> int:
     if multiple_of > 1:
         b = int(math.ceil(b / multiple_of) * multiple_of)
     return b
+
+
+def pad_rows(values, n_pad: int):
+    """Pad a column's row axis (axis 0) to ``n_pad`` with neutral filler:
+    zeros for numeric dtypes, ``None`` for object columns. The shared
+    padding primitive for every row-align site (mesh equal-sharding,
+    bucket padding) — pad rows must always pair with a False validity mask
+    (see :func:`padded_valid_mask`), never carry weight."""
+    import numpy as np
+    v = np.asarray(values)
+    pad = n_pad - v.shape[0]
+    if pad <= 0:
+        return v
+    if v.dtype == object:
+        filler = np.full((pad,) + v.shape[1:], None, dtype=object)
+    else:
+        filler = np.zeros((pad,) + v.shape[1:], v.dtype)
+    return np.concatenate([v, filler])
+
+
+def padded_valid_mask(mask, n: int, n_pad: int):
+    """(n_pad,) bool validity mask: the original mask (or all-valid when
+    ``mask`` is None) over the first ``n`` rows, False over the pad."""
+    import numpy as np
+    m = np.zeros(n_pad, bool)
+    m[:n] = True if mask is None else np.asarray(mask)
+    return m
