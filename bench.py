@@ -26,6 +26,12 @@ Modes (BENCH_MODE env):
   families × 3 folds = 324 fits. This is the throughput number: AutoML
   sweeps at this density are what the 8-thread reference pool grinds
   through in minutes.
+- ``serve``: the resilient serving runtime under open-loop synthetic load
+  (docs/serving.md). Two lines: a clean line at ~70% of measured
+  micro-batch capacity (sustained rows/sec + p50/p99 tail), then a chaos
+  soak at 2× capacity with faults armed at all three ``serve.*`` sites —
+  the line must complete with overflow shed as typed errors and the
+  breaker/shed/degraded counts visible (zero process crashes).
 - ``default``: the exact stock default grids (45 configs incl. the
   depth-12 trees, 135 fits) — the path every
   ``BinaryClassificationModelSelector()`` user gets; fixed costs dominate.
@@ -45,7 +51,8 @@ import numpy as np
 def _models(mode, registry):
     if mode not in ("dense", "default", "linear"):
         raise SystemExit(f"unknown BENCH_MODE {mode!r}: "
-                         "use both | dense | default | linear")
+                         "use both | dense | default | linear | "
+                         "transform | serve")
     if mode == "linear":
         grid = [{"regParam": r, "elasticNetParam": e}
                 for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
@@ -241,6 +248,129 @@ def _run_transform_ab(n, d, platform, reps):
         plan_mod.clear_plan_cache()
 
 
+def _serve_model(n, d, seed=0):
+    """A small fitted model for the serve lines: the serve bench measures
+    the runtime (queueing, batching, dispatch), not the sweep."""
+    import numpy as np
+    import pandas as pd
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.selector.factories import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    df = pd.DataFrame({f"x{i}": X[:, i] for i in range(d)})
+    df["y"] = y
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed, models=[("OpLogisticRegression",
+                            [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+def _run_serve(platform):
+    """BENCH_MODE=serve: sustained rows/sec + tail latency + shed rate
+    from the open-loop generator, clean and under chaos at 2× capacity
+    (docs/benchmarks.md "Serving"; acceptance: the faulted line completes
+    with typed sheds and visible breaker/degraded counts — no crashes)."""
+    from transmogrifai_tpu.local import micro_batch_score_function
+    from transmogrifai_tpu.robustness import faults
+    from transmogrifai_tpu.serving import ServeConfig, ServingRuntime
+    from transmogrifai_tpu.serving.loadgen import (
+        run_open_loop, synthetic_rows)
+
+    n = int(os.environ.get("BENCH_SERVE_FIT_ROWS", 4000))
+    d = int(os.environ.get("BENCH_SERVE_FEATURES", 16))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 3.0))
+    model = _serve_model(n, d)
+    max_batch = int(os.environ.get("TG_SERVE_MAX_BATCH", 256))
+    rows = synthetic_rows(model, 1024, seed=1)
+
+    # capacity probes. The raw micro-batch number bounds what the device
+    # path can do; the runtime number (loadgen + batcher sharing this
+    # process) is what open-loop rates must calibrate against — offering
+    # 0.7× the RAW capacity would turn the "clean" line into a second
+    # overload line on CPU, where the generator and the scorer contend
+    # for the same GIL.
+    mb = micro_batch_score_function(model)
+    batch = rows[:max_batch]
+    mb(batch)  # compile warmup
+    t0 = time.perf_counter()
+    for _ in range(3):
+        mb(batch)
+    capacity = 3 * len(batch) / (time.perf_counter() - t0)
+    cfg = ServeConfig.from_env()
+    cfg.max_batch = max_batch
+    cfg.max_queue = int(os.environ.get("TG_SERVE_QUEUE_MAX", 512))
+    with ServingRuntime(model, "calibrate", cfg) as rt:
+        rt.warm()
+        cal = run_open_loop(rt, rows, min(1.5, seconds), capacity)
+    runtime_capacity = max(cal["rowsPerSec"], 1.0)
+
+    deadline_ms = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", 250.0))
+    # clean fraction 0.35: the saturated calibration number rides full-256
+    # batches; at partial fill every flush still pays the full padded
+    # dispatch, so 0.35× keeps the clean line inside the SLO region (zero
+    # sheds) instead of producing a second overload line
+    clean_frac = float(os.environ.get("BENCH_SERVE_CLEAN_FRACTION", 0.35))
+    for faulted in (False, True):
+        rps = runtime_capacity * (2.0 if faulted else clean_frac)
+        if faulted:
+            # deterministic chaos at every serve site: admission faults, a
+            # batching fault, and enough consecutive dispatch faults to
+            # open the breaker (threshold 3) and exercise its probe
+            faults.configure({
+                "serve.enqueue": {"mode": "raise", "nth": 40, "count": 3,
+                                  "transient": True},
+                "serve.flush": {"mode": "raise", "nth": 2, "count": 1,
+                                "transient": True},
+                "serve.dispatch": {"mode": "raise", "nth": 3, "count": 5,
+                                   "transient": True},
+            })
+        try:
+            with ServingRuntime(model, "bench", cfg) as rt:
+                rt.warm()
+                rep = run_open_loop(rt, rows, seconds, rps,
+                                    deadline_ms=deadline_ms)
+                summary = rt.summary()
+        finally:
+            faults.clear()
+        suffix = "_chaos2x" if faulted else ""
+        print(json.dumps({
+            "metric": f"serve_rows_per_sec{suffix}_{d}feat_{platform}",
+            "value": rep["rowsPerSec"],
+            "unit": "rows/sec",
+            # vs the saturated runtime capacity measured this run: the
+            # clean line should sit near its offered 0.7×, the chaos line
+            # shows what survives faults + 2× overload
+            "vs_baseline": round(rep["rowsPerSec"] / runtime_capacity, 3),
+            "phases": {
+                "scorerRowsPerSec": round(capacity, 1),
+                "runtimeRowsPerSec": round(runtime_capacity, 1),
+                "offeredRps": rep["offeredRps"],
+                "p50Ms": rep["p50Ms"],
+                "p99Ms": rep["p99Ms"],
+                "shedOverload": rep["shedOverload"],
+                "shedDeadline": rep["shedDeadline"],
+                "submitErrors": rep["submitErrors"],
+                "failed": rep["failed"],
+                "degradedRows": rep["degradedRows"],
+                "quarantined": rep["quarantined"],
+                "breakerOpens": summary["breaker"]["opens"],
+                "breakerState": summary["breaker"]["state"],
+            },
+        }), flush=True)
+
+
 def _run_mesh_line():
     """Virtual-8-device CPU mesh sweep fits/sec — a NUMBER for mesh-path
     regressions (round-4 VERDICT weak #5: the dryrun's wall-ratio assert
@@ -387,6 +517,9 @@ def main():
         n_t = int(os.environ.get(
             "BENCH_ROWS", 1_000_000 if platform == "tpu" else 200_000))
         _run_transform_ab(n_t, d, platform, reps)
+        return
+    if mode == "serve":
+        _run_serve(platform)
         return
 
     rng = np.random.RandomState(0)

@@ -123,6 +123,34 @@ def _no_mesh_sharding_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_serving_leak():
+    """Serving runtimes own a batcher thread, a bounded queue, and breaker
+    state — all process-visible. A test that leaks a running runtime would
+    keep scoring (and writing metrics) underneath every later test, and a
+    leaked tg-serve thread would pin its model alive for the session.
+    Assert none are live on entry; on exit force-close leftovers and fail
+    the test that leaked them (mirrors the observability/plan/mesh no-leak
+    fixtures: assert clean entry, hard-reset exit)."""
+    import threading
+
+    from transmogrifai_tpu.serving import runtime as _srt
+
+    assert not _srt.live_runtimes(), (
+        "serving runtime(s) leaked from a previous test: "
+        f"{[r.name for r in _srt.live_runtimes()]}")
+    yield
+    leaked = _srt.live_runtimes()
+    for rt in leaked:
+        rt.close(drain=False)
+    assert not leaked, (
+        "a test leaked running serving runtime(s): "
+        f"{[r.name for r in leaked]}")
+    stray = [t.name for t in threading.enumerate()
+             if t.name.startswith("tg-serve") and t.is_alive()]
+    assert not stray, f"serving batcher thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

@@ -114,7 +114,8 @@ def test_fault_log_inactive_record_is_noop():
     log = FaultLog()
     assert log.to_json() == {"quarantined": [], "retries": [],
                              "checkpointsSkipped": [], "restored": [],
-                             "planFallbacks": [], "fatal": []}
+                             "planFallbacks": [], "breakerDegraded": [],
+                             "fatal": [], "droppedReports": 0}
 
 
 # ---------------------------------------------------------------------------

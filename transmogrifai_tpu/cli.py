@@ -409,6 +409,70 @@ def run_trace(output: str, dataset: str = "synthetic", rows: int = 600,
         obs_metrics.enable_metrics(None)
 
 
+def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
+              deadline_ms: Optional[float] = None, max_batch: int = 256,
+              queue_max: int = 1024, name: str = "model",
+              output: Optional[str] = None, seed: int = 42) -> Dict[str, Any]:
+    """``op serve`` (docs/serving.md): load a saved model into the serving
+    registry (warm plan caches from its MANIFEST), drive the open-loop
+    synthetic load generator for ``seconds``, print the SLO / shed /
+    breaker summary, and optionally write the telemetry bundle.
+
+    ``rps=0`` auto-calibrates: a short saturating run measures what the
+    runtime sustains in this process, and the measured load runs at half
+    of it — sustained throughput with an SLO-shaped tail, not a shed
+    report (pass an explicit --rps to study overload)."""
+    import json as _json
+    import time as _time
+
+    from .observability import export as obs_export
+    from .observability import metrics as obs_metrics
+    from .observability import trace as obs_trace
+    from .serving import ModelRegistry, ServeConfig
+    from .serving.loadgen import run_open_loop, synthetic_rows
+
+    obs_trace.enable_tracing(True)
+    obs_metrics.enable_metrics(True)
+    try:
+        cfg = ServeConfig.from_env()
+        cfg.max_batch = max_batch
+        cfg.max_queue = queue_max
+        with ModelRegistry(cfg) as reg:
+            rt = reg.load(name, model_path)
+            rows = synthetic_rows(rt.model, 512, seed=seed)
+            if rps <= 0:
+                from .local import micro_batch_score_function
+                mb = micro_batch_score_function(rt.model)
+                batch = rows[:max_batch]
+                mb(batch)  # compile warmup beyond the registry warm
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    mb(batch)
+                cap = 3 * len(batch) / (_time.perf_counter() - t0)
+                cal = run_open_loop(rt, rows, min(1.0, seconds), cap)
+                rps = max(10.0, 0.5 * cal["rowsPerSec"])
+            report = run_open_loop(rt, rows, seconds, rps,
+                                   deadline_ms=deadline_ms)
+            health = reg.health()
+        summary = {"model": model_path, "rpsOffered": round(rps, 1),
+                   "load": report, "health": health["models"][name]}
+        print(_json.dumps(summary, indent=2, default=str))
+        if output:
+            os.makedirs(output, exist_ok=True)
+            obs_export.write_chrome_trace(os.path.join(output, "trace.json"))
+            obs_export.write_jsonl(os.path.join(output, "spans.jsonl"))
+            obs_export.write_prometheus(
+                os.path.join(output, "metrics.prom"))
+            with open(os.path.join(output, "serve_summary.json"), "w") as fh:
+                _json.dump(summary, fh, indent=2, default=str)
+            print(f"wrote trace.json, spans.jsonl, metrics.prom, "
+                  f"serve_summary.json to {output}/")
+        return summary
+    finally:
+        obs_trace.enable_tracing(None)
+        obs_metrics.enable_metrics(None)
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="op",
                                 description="transmogrifai_tpu CLI")
@@ -438,6 +502,30 @@ def main(argv: Optional[List[str]] = None) -> None:
     tr.add_argument("--rows", type=int, default=600,
                     help="synthetic dataset row count")
     tr.add_argument("--seed", type=int, default=42)
+    sv = sub.add_parser(
+        "serve", help="load a saved model and drive the resilient serving "
+                      "runtime under synthetic open-loop load "
+                      "(docs/serving.md)")
+    sv.add_argument("--model", required=True,
+                    help="saved model directory (OpWorkflowModel.save)")
+    sv.add_argument("--seconds", type=float, default=5.0,
+                    help="load duration")
+    sv.add_argument("--rps", type=float, default=0.0,
+                    help="offered requests/sec (0 = auto-calibrate to "
+                         "~70%% of measured micro-batch capacity)")
+    sv.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline; expired requests are shed "
+                         "before dispatch")
+    sv.add_argument("--max-batch", type=int, default=256,
+                    help="continuous-batching flush size")
+    sv.add_argument("--queue-max", type=int, default=1024,
+                    help="admission bound (beyond it requests shed with "
+                         "OverloadError)")
+    sv.add_argument("--name", default="model", help="registry model name")
+    sv.add_argument("--output", default=None,
+                    help="directory for the telemetry bundle (trace.json / "
+                         "spans.jsonl / metrics.prom / serve_summary.json)")
+    sv.add_argument("--seed", type=int, default=42)
     a = p.parse_args(argv)
     if a.command == "gen":
         generate(a.input, a.response, a.output, a.name, a.id_field,
@@ -446,6 +534,11 @@ def main(argv: Optional[List[str]] = None) -> None:
               f"(app.py, README.md, test_app.py)")
     elif a.command == "trace":
         run_trace(a.output, dataset=a.dataset, rows=a.rows, seed=a.seed)
+    elif a.command == "serve":
+        run_serve(a.model, seconds=a.seconds, rps=a.rps,
+                  deadline_ms=a.deadline_ms, max_batch=a.max_batch,
+                  queue_max=a.queue_max, name=a.name, output=a.output,
+                  seed=a.seed)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,5 @@
 from .scoring import (  # noqa: F401
     SCORE_ERROR_KEY, ScoreSchemaError, compiled_score_function,
-    micro_batch_score_function, score_function,
+    micro_batch_score_function, score_function, serve_record_builder,
+    serve_table_builder,
 )

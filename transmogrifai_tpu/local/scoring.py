@@ -164,24 +164,16 @@ def compiled_score_function(model):
     return score
 
 
-def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], List[Dict[str, Any]]]:
-    """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
-    runs the columnar/jitted DAG pass — the serving path that keeps the TPU
-    busy (SURVEY §2.10 P4: streaming micro-batches). The numeric transformer
-    tail runs as one compiled XLA program per device-fusable segment,
-    reused across micro-batch sizes via the schema-fingerprinted plan
-    cache (compiled_score_function → plan.py; docs/plan.md).
-
-    Malformed input does not kill the batch: a batch that fails schema
-    validation (a string where a number is expected, a wrong-width vector)
-    falls back to per-row scoring, and only the offending rows are
-    **quarantined** — their result features come back None with the reason
-    under :data:`SCORE_ERROR_KEY` — while every valid row still scores."""
+def serve_table_builder(model) -> Callable[[Sequence[Dict[str, Any]]], FeatureTable]:
+    """The serve-time table front: ``build(rows) -> FeatureTable`` running
+    each raw feature's extract over the request rows. Shared by
+    :func:`micro_batch_score_function`, the serving runtime
+    (``serving/runtime.py``), and the warm-start plan fingerprint
+    (``serving/warmup.py``) — all three must build byte-identical tables or
+    the fingerprinted plan cache would miss on the first real request."""
     raw_features = model.raw_features
-    result_features = model.result_features
-    compiled = compiled_score_function(model)
 
-    def _build_table(rows: Sequence[Dict[str, Any]]) -> FeatureTable:
+    def build(rows: Sequence[Dict[str, Any]]) -> FeatureTable:
         cols = {}
         for f in raw_features:
             vals = [f.origin_stage.extract(r) for r in rows]
@@ -194,7 +186,16 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
                     f"({type(e).__name__}: {e})") from e
         return FeatureTable(cols, len(rows))
 
-    def _records(scored: FeatureTable, n: int) -> List[Dict[str, Any]]:
+    return build
+
+
+def serve_record_builder(model) -> Callable[[FeatureTable, int], List[Dict[str, Any]]]:
+    """``records(scored_table, n) -> [result dict]`` — the serve-time
+    row-major view of a scored table (Prediction columns as {key: float}
+    maps, masked slots as None)."""
+    result_features = model.result_features
+
+    def records(scored: FeatureTable, n: int) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
         for i in range(n):
             rec: Dict[str, Any] = {}
@@ -213,6 +214,29 @@ def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], Li
                         v.item() if isinstance(v, np.generic) else v)
             out.append(rec)
         return out
+
+    return records
+
+
+def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], List[Dict[str, Any]]]:
+    """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
+    runs the columnar/jitted DAG pass — the serving path that keeps the TPU
+    busy (SURVEY §2.10 P4: streaming micro-batches). The numeric transformer
+    tail runs as one compiled XLA program per device-fusable segment,
+    reused across micro-batch sizes via the schema-fingerprinted plan
+    cache (compiled_score_function → plan.py; docs/plan.md). For driving
+    this under concurrent load — continuous batching, deadlines, a
+    circuit breaker — see ``transmogrifai_tpu/serving`` (docs/serving.md).
+
+    Malformed input does not kill the batch: a batch that fails schema
+    validation (a string where a number is expected, a wrong-width vector)
+    falls back to per-row scoring, and only the offending rows are
+    **quarantined** — their result features come back None with the reason
+    under :data:`SCORE_ERROR_KEY` — while every valid row still scores."""
+    result_features = model.result_features
+    compiled = compiled_score_function(model)
+    _build_table = serve_table_builder(model)
+    _records = serve_record_builder(model)
 
     def score(rows: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
         t0 = time.perf_counter()

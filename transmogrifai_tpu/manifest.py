@@ -105,6 +105,11 @@ class CheckpointManifest:
         self.files: Dict[str, Dict[str, Any]] = {}
         self.stages: Dict[str, Dict[str, Any]] = {}
         self.sweeps: Dict[str, Dict[str, Any]] = {}
+        #: optional warm-start hint for saved models: the serve-path plan
+        #: schema fingerprint the registry pre-traces at load
+        #: (serving/warmup.py; absent/empty on stage-checkpoint dirs and
+        #: pre-serving manifests — loaders must tolerate that)
+        self.serving: Dict[str, Any] = {}
 
     @property
     def path(self) -> str:
@@ -135,17 +140,21 @@ class CheckpointManifest:
         m.files = dict(doc.get("files", {}))
         m.stages = dict(doc.get("stages", {}))
         m.sweeps = dict(doc.get("sweeps", {}))
+        m.serving = dict(doc.get("serving", {}))
         return m, None
 
     def save(self) -> None:
         os.makedirs(self.dirpath, exist_ok=True)
-        atomic_write_json(self.path, {
+        doc = {
             "manifestVersion": MANIFEST_VERSION,
             "formatVersion": self.format_version,
             "files": self.files,
             "stages": self.stages,
             "sweeps": self.sweeps,
-        }, indent=1)
+        }
+        if self.serving:
+            doc["serving"] = self.serving
+        atomic_write_json(self.path, doc, indent=1)
 
     # -- recording -----------------------------------------------------------
     def record_file(self, fname: str, sha256: str, size: int) -> None:

@@ -74,8 +74,13 @@ def summarize(tr: Optional[trace.Tracer] = None,
             fam["configs"] += int(s.attrs.get("configs", 0) or 0)
 
     snap = r.snapshot()
+    # serving series (tg_serve_* + the breaker gauge, labelled per model)
+    # get their own section — mirrored there from each runtime's
+    # serve-local registry when metrics are enabled (docs/serving.md)
+    serving = {name: series for name, series in snap.items()
+               if name.startswith("tg_serve_") or name == "tg_breaker_state"}
     counters = {name: series for name, series in snap.items()
-                if not name.startswith("tg_score_")}
+                if not name.startswith("tg_score_") and name not in serving}
     scoring: Dict[str, Any] = {}
     for name, key in (("tg_score_request_seconds", "request"),
                       ("tg_score_microbatch_seconds", "microBatch")):
@@ -104,6 +109,7 @@ def summarize(tr: Optional[trace.Tracer] = None,
                                 key=lambda kv: -kv[1]["seconds"])),
         "counters": counters,
         "scoring": scoring,
+        "serving": serving,
         "compileCache": cache_stats(),
         "planCache": plan_cache_stats(),
     }

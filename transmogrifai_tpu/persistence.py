@@ -352,6 +352,16 @@ def save_model(model, path: str) -> None:
     manifest = CheckpointManifest(path, FORMAT_VERSION)
     manifest.record_file(PLAN_FILE, plan_sha, len(plan_bytes))
     manifest.record_file(ARRAYS_FILE, npz_sha, len(npz_bytes))
+    # warm-start hint: the serve-path plan schema fingerprint, pre-traced
+    # by the serving registry at load so a fresh process serves its first
+    # request without retracing (serving/warmup.py; docs/serving.md). A
+    # model whose raw extracts cannot take the synthetic probe simply
+    # ships no hint — the hint must never fail a save.
+    try:
+        from .serving.warmup import manifest_serving_entry
+        manifest.serving = manifest_serving_entry(model)
+    except Exception:
+        pass
     manifest.save()
 
 

@@ -89,17 +89,20 @@ def enable_planning(on: Optional[bool]) -> None:
 
 def planning_applicable() -> bool:
     """Planning is allowed only when per-stage fault semantics are not in
-    play: under ``TG_CHAOS`` or any armed non-``plan.*`` injection site the
-    eager per-stage path runs so PR 1 retry/quarantine behavior is exactly
-    preserved (sites prefixed ``plan.`` target the planner itself and keep
-    it active — they exercise the runtime fallback)."""
+    play: under ``TG_CHAOS`` or any armed non-``plan.*``/``serve.*``
+    injection site the eager per-stage path runs so PR 1 retry/quarantine
+    behavior is exactly preserved. Sites prefixed ``plan.`` target the
+    planner itself and keep it active — they exercise the runtime
+    fallback; sites prefixed ``serve.`` target the serving runtime *above*
+    the planner (serving/runtime.py), whose chaos tests must exercise the
+    real planned dispatch path, not an eager stand-in."""
     if not plan_enabled():
         return False
     from .robustness import faults
     if os.environ.get(faults.CHAOS_ENV):
         return False
     armed = faults.active_sites()
-    if any(not s.startswith("plan.") for s in armed):
+    if any(not s.startswith(("plan.", "serve.")) for s in armed):
         return False
     return True
 
@@ -478,6 +481,19 @@ def _schema_fingerprint(stages: List[Any],
                           tuple(int(x) for x in v.shape[1:]),
                           col.mask is None))
     return tuple(items)
+
+
+def schema_fingerprint(stages: Sequence[Any],
+                       table: FeatureTable) -> List[List[Any]]:
+    """Public, JSON-ready view of the plan cache's schema fingerprint:
+    ``[[column, dtype, trailing shape, mask-is-None], ...]`` over every
+    external column the stage sequence reads from ``table``. Row count is
+    deliberately absent (padding buckets absorb it), so a fingerprint
+    recorded at save time matches any request batch of the same schema —
+    the contract the serving warm-start rides (serving/warmup.py)."""
+    fp = _schema_fingerprint(list(stages), table) or ()
+    return [[nm, dt, list(shape), bool(maskless)]
+            for nm, dt, shape, maskless in fp]
 
 
 def get_plan(stages: Sequence[Any], table: FeatureTable, *,

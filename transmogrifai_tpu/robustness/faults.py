@@ -34,6 +34,18 @@ site                         fires in
                              eager fallback — ``plan.*`` sites deliberately
                              do NOT disable the planner the way other armed
                              sites do)
+``serve.enqueue``            in ``ServingRuntime.submit``, before admission
+                             (serving/runtime.py; models the admission layer
+                             failing — surfaces as a typed error to the one
+                             caller, the runtime stays up)
+``serve.flush``              in the batcher, after deadline shedding and
+                             before dispatch (a raise degrades the batch to
+                             the eager per-row path)
+``serve.dispatch``           before the compiled micro-batch dispatch (a
+                             raise feeds the per-model circuit breaker and
+                             degrades the batch to the eager path; like
+                             ``plan.*``, ``serve.*`` sites do NOT disable
+                             the transform planner)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,

@@ -14,9 +14,11 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import hashlib
+import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from ..observability import metrics as _obs_metrics
 from ..observability import trace as _obs_trace
@@ -81,17 +83,40 @@ _CURRENT_LOG: "contextvars.ContextVar[Optional[FaultLog]]" = \
     contextvars.ContextVar("tg_fault_log", default=None)
 
 
+#: ring bound for FaultLog.reports; a long-lived serving process under
+#: sustained faults (an open breaker degrades every batch) must not grow
+#: fault memory without bound — oldest reports drop, counted
+FAULTS_MAX_ENV = "TG_FAULTS_MAX"
+DEFAULT_FAULTS_MAX = 1024
+
+
+def _faults_max() -> int:
+    try:
+        return max(1, int(os.environ.get(FAULTS_MAX_ENV, "")
+                          or DEFAULT_FAULTS_MAX))
+    except ValueError:
+        return DEFAULT_FAULTS_MAX
+
+
 class FaultLog:
-    """Train-scoped accumulator of :class:`FaultReport` records.
+    """Accumulator of :class:`FaultReport` records — train-scoped for
+    ``OpWorkflow.train`` (activated around the whole fit), serve-scoped for
+    ``serving.ServingRuntime`` (one per runtime).
 
-    ``OpWorkflow.train`` activates one log around the whole fit; components
-    deep in the stack (validators, transfer helpers, checkpoint loader)
-    record through the ambient :meth:`record` without threading the log
-    through every signature. Recording without an active log is a no-op, so
-    library code never needs to guard."""
+    Components deep in the stack (validators, transfer helpers, checkpoint
+    loader) record through the ambient :meth:`record` without threading the
+    log through every signature; recording without an active log is a
+    no-op, so library code never needs to guard. ``reports`` is a ring
+    bounded by ``TG_FAULTS_MAX`` (default 1024): the newest reports win,
+    drops are counted in :attr:`dropped` and the
+    ``tg_faults_dropped_total`` counter — sustained serving faults must
+    not leak memory."""
 
-    def __init__(self):
-        self.reports: List[FaultReport] = []
+    def __init__(self, max_reports: Optional[int] = None):
+        self.max_reports = (max(1, int(max_reports))
+                            if max_reports is not None else _faults_max())
+        self.reports: Deque[FaultReport] = deque()
+        self.dropped = 0
 
     @contextlib.contextmanager
     def activate(self):
@@ -101,21 +126,27 @@ class FaultLog:
         finally:
             _CURRENT_LOG.reset(token)
 
+    def add(self, report: FaultReport) -> None:
+        """Append with the ring bound applied (the instance-level entry
+        point; the serving runtime records here directly — its batcher
+        thread has no ambient log)."""
+        while len(self.reports) >= self.max_reports:
+            self.reports.popleft()
+            self.dropped += 1
+            _obs_metrics.inc_counter(
+                "tg_faults_dropped_total",
+                help="fault reports dropped by the TG_FAULTS_MAX ring "
+                "(docs/robustness.md)")
+        self.reports.append(report)
+        _emit_fault_observability(report)
+
     @staticmethod
     def record(report: FaultReport) -> None:
         log = _CURRENT_LOG.get()
         if log is not None:
-            log.reports.append(report)
-        # observability choke point: every recovery anywhere in the stack
-        # becomes a span event on whatever span is open (a trace shows the
-        # quarantine in line with the sweep it interrupted) and a counter
-        # keyed by kind (bounded cardinality; the site goes on the event
-        # only). Both are no-ops when observability is off.
-        _obs_trace.add_event("fault." + report.kind, site=report.site,
-                             attempts=report.attempts)
-        _obs_metrics.inc_counter(
-            "tg_faults_total", help="fault recoveries by kind "
-            "(docs/robustness.md)", kind=report.kind)
+            log.add(report)
+        else:
+            _emit_fault_observability(report)
 
     def of_kind(self, kind: str) -> List[FaultReport]:
         return [r for r in self.reports if r.kind == kind]
@@ -132,8 +163,27 @@ class FaultLog:
             # per-stage dispatch (docs/plan.md "Fallback semantics")
             "planFallbacks": [r.to_json()
                               for r in self.of_kind("plan_fallback")],
+            # serve batches scored through the eager per-row fallback
+            # (breaker open / dispatch failure; docs/serving.md)
+            "breakerDegraded": [r.to_json()
+                                for r in self.of_kind("breaker_degraded")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
+            # ring accounting: reports evicted under TG_FAULTS_MAX
+            "droppedReports": self.dropped,
         }
+
+
+def _emit_fault_observability(report: FaultReport) -> None:
+    # observability choke point: every recovery anywhere in the stack
+    # becomes a span event on whatever span is open (a trace shows the
+    # quarantine in line with the sweep it interrupted) and a counter
+    # keyed by kind (bounded cardinality; the site goes on the event
+    # only). Both are no-ops when observability is off.
+    _obs_trace.add_event("fault." + report.kind, site=report.site,
+                         attempts=report.attempts)
+    _obs_metrics.inc_counter(
+        "tg_faults_total", help="fault recoveries by kind "
+        "(docs/robustness.md)", kind=report.kind)
 
 
 @dataclass

@@ -1,0 +1,19 @@
+"""Resilient serving runtime (docs/serving.md; ROADMAP item 1).
+
+Continuous batching over the compiled micro-batch scorer with
+backpressure (bounded queue + typed :class:`OverloadError` shedding),
+per-request deadlines (shed before dispatch), a per-model circuit breaker
+that degrades to the bit-equal eager path instead of failing requests, a
+multi-model registry with warm plan caches, and per-model p50/p95/p99 SLO
+reporting from ``observability/metrics.py``.
+"""
+from .breaker import BREAKER_GAUGE, CircuitBreaker  # noqa: F401
+from .loadgen import run_open_loop, synthetic_rows  # noqa: F401
+from .registry import ModelRegistry  # noqa: F401
+from .runtime import (  # noqa: F401
+    DeadlineExceededError, OverloadError, RuntimeStoppedError, ServeConfig,
+    ServingError, ServingRuntime, live_runtimes,
+)
+from .warmup import (  # noqa: F401
+    manifest_serving_entry, serve_plan_fingerprint, warm_runtime,
+)

@@ -1,0 +1,122 @@
+"""Open-loop synthetic load generator (``BENCH_MODE=serve``, ``op serve``).
+
+Open-loop means arrivals follow a fixed schedule regardless of how fast
+the server answers — the honest way to measure a serving tier, because a
+closed-loop driver (wait-for-response-then-send) self-throttles exactly
+when the system is overloaded and hides the tail (coordinated omission).
+At 2× capacity an open-loop driver keeps offering load, and the runtime
+must *shed* — which is precisely the behavior under test.
+
+The generator drives ``ServingRuntime.submit`` at ``rps`` for
+``seconds``, then drains, and reports sustained rows/sec, SLO quantiles
+(from the runtime's serve-local histograms — enqueue→result, so queueing
+delay is included), shed/degraded/quarantine counts, and the breaker
+snapshot. Submit-side failures (``OverloadError``, injected
+``serve.enqueue`` chaos) are counted, never raised — a load generator
+that dies on the first shed cannot measure shedding.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..local.scoring import SCORE_ERROR_KEY
+from .runtime import DeadlineExceededError, OverloadError, ServingRuntime
+
+
+def synthetic_rows(model, n: int, seed: int = 0) -> List[Dict[str, Any]]:
+    """``n`` synthetic request rows shaped by the model's raw-feature
+    types (the serve-side analog of testkit/random_data.py): numeric kinds
+    get gaussians/ints, host kinds get small-vocabulary tokens, ~3% of
+    values are missing so the masked paths stay exercised."""
+    rng = np.random.RandomState(seed)
+    rows: List[Dict[str, Any]] = []
+    feats = [(f.name, f.feature_type.column_kind) for f in model.raw_features]
+    for _ in range(n):
+        row: Dict[str, Any] = {}
+        for name, kind in feats:
+            if rng.rand() < 0.03:
+                row[name] = None
+            elif kind == "real":
+                row[name] = float(rng.randn())
+            elif kind == "binary":
+                row[name] = bool(rng.randint(0, 2))
+            elif kind in ("integral", "date"):
+                row[name] = int(rng.randint(0, 100))
+            else:  # text / picklist / map kinds: small shared vocabulary
+                row[name] = f"tok{rng.randint(0, 8)}"
+        rows.append(row)
+    return rows
+
+
+def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
+                  seconds: float, rps: float,
+                  deadline_ms: Optional[float] = None,
+                  drain_timeout: float = 30.0) -> Dict[str, Any]:
+    """Offer ``rps`` requests/sec for ``seconds`` (cycling through
+    ``rows``), drain, and return the load report."""
+    if rps <= 0:
+        raise ValueError(f"rps must be > 0, got {rps}")
+    interval = 1.0 / rps
+    start = time.monotonic()
+    t_end = start + seconds
+    next_at = start
+    futures = []
+    offered = shed_submit = submit_errors = 0
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now >= t_end:
+            break
+        # submit every arrival whose schedule time has passed (bursts when
+        # the process fell behind — open-loop arrivals do not wait)
+        while next_at <= now and next_at < t_end:
+            try:
+                futures.append(runtime.submit(rows[i % len(rows)],
+                                              deadline_ms=deadline_ms))
+            except OverloadError:
+                shed_submit += 1
+            except Exception:
+                # injected serve.enqueue chaos / runtime stopping: counted,
+                # the generator keeps offering load
+                submit_errors += 1
+            offered += 1
+            i += 1
+            next_at += interval
+        time.sleep(min(0.001, max(0.0, next_at - time.monotonic())))
+    # drain: every accepted request must resolve (result or typed shed)
+    completed = quarantined = shed_deadline = failed = 0
+    drain_deadline = time.monotonic() + drain_timeout
+    for fut in futures:
+        try:
+            rec = fut.result(timeout=max(0.1, drain_deadline
+                                         - time.monotonic()))
+            if SCORE_ERROR_KEY in rec:
+                quarantined += 1
+            completed += 1
+        except DeadlineExceededError:
+            shed_deadline += 1
+        except Exception:
+            failed += 1
+    wall = time.monotonic() - start
+    summary = runtime.summary()
+    lat = summary.get("latency", {}) or {}
+    return {
+        "seconds": round(wall, 3),
+        "offered": offered,
+        "offeredRps": round(offered / wall, 1) if wall else 0.0,
+        "completed": completed,
+        "rowsPerSec": round(completed / wall, 1) if wall else 0.0,
+        "quarantined": quarantined,
+        "shedOverload": shed_submit,
+        "shedDeadline": shed_deadline,
+        "submitErrors": submit_errors,
+        "failed": failed,
+        "p50Ms": round(lat.get("p50", float("nan")) * 1e3, 3),
+        "p95Ms": round(lat.get("p95", float("nan")) * 1e3, 3),
+        "p99Ms": round(lat.get("p99", float("nan")) * 1e3, 3),
+        "degradedRows": summary.get("degradedRows", 0.0),
+        "breaker": summary.get("breaker", {}),
+    }

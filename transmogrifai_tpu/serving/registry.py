@@ -1,0 +1,127 @@
+"""Multi-model registry: named runtimes, warm plan caches, health/SLO.
+
+The serving analog of the reference's one-model-per-MLeap-bundle local
+scorer, grown to the multi-model process ROADMAP item 1 asks for: each
+registered model gets its own :class:`~.runtime.ServingRuntime` (own
+bounded queue, batcher thread, circuit breaker, serve-local metrics), so
+one failing model degrades *itself* while its neighbors keep their SLOs.
+
+``load()`` goes through ``persistence.load_model`` (manifest-verified)
+and, by default, warm-starts the plan cache from the ``serving`` section
+``save_model`` recorded in ``MANIFEST.json`` (serving/warmup.py) — a
+fresh process serves its first request without retracing.
+
+``health()`` is the readiness endpoint payload: per-model state
+(ready / degraded / stopped), breaker snapshot, queue depth, p50/p95/p99
+latency, shed + degraded + quarantine counts, and the warm report.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .breaker import CircuitBreaker
+from .runtime import ServeConfig, ServingRuntime
+from . import warmup as _warmup
+
+
+class ModelRegistry:
+    """Name → :class:`ServingRuntime` map with lifecycle management."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self._default_config = config
+        self._lock = threading.Lock()
+        self._runtimes: Dict[str, ServingRuntime] = {}
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, model,
+                 config: Optional[ServeConfig] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 warm: bool = False,
+                 warm_entry: Optional[Dict[str, Any]] = None
+                 ) -> ServingRuntime:
+        """Start a runtime for ``model`` under ``name``. ``warm=True``
+        pre-traces the serve plans before the runtime takes traffic."""
+        with self._lock:
+            if name in self._runtimes:
+                raise ValueError(
+                    f"model '{name}' is already registered; "
+                    f"unregister() it first")
+            rt = ServingRuntime(
+                model, name=name,
+                config=config or self._default_config,
+                breaker=breaker, auto_start=False)
+            self._runtimes[name] = rt
+        if warm:
+            _warmup.warm_runtime(rt, warm_entry)
+        rt.start()
+        return rt
+
+    def load(self, name: str, path: str, workflow=None,
+             config: Optional[ServeConfig] = None,
+             warm: bool = True) -> ServingRuntime:
+        """Load a saved model (manifest-verified) and register it; by
+        default pre-traces the plans recorded in its ``MANIFEST.json``
+        ``serving`` section so the first request is served warm."""
+        from ..manifest import CheckpointManifest
+        from ..persistence import FORMAT_VERSION, load_model
+        model = load_model(path, workflow=workflow)
+        manifest, err = CheckpointManifest.load(path, FORMAT_VERSION)
+        entry = dict(manifest.serving) if err is None else {}
+        return self.register(name, model, config=config, warm=warm,
+                             warm_entry=entry or None)
+
+    def unregister(self, name: str, drain: bool = True) -> None:
+        with self._lock:
+            rt = self._runtimes.pop(name, None)
+        if rt is not None:
+            rt.close(drain=drain)
+
+    # -- access --------------------------------------------------------------
+    def runtime(self, name: str) -> ServingRuntime:
+        with self._lock:
+            try:
+                return self._runtimes[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model '{name}' registered "
+                    f"(have: {sorted(self._runtimes)})") from None
+
+    __getitem__ = runtime
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._runtimes)
+
+    def submit(self, name: str, row: Dict[str, Any], **kw):
+        return self.runtime(name).submit(row, **kw)
+
+    def score(self, name: str, row: Dict[str, Any], **kw) -> Dict[str, Any]:
+        return self.runtime(name).score(row, **kw)
+
+    # -- health --------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Readiness snapshot: ``ready`` is True only when every registered
+        model is serving with its device path live (breaker not open)."""
+        with self._lock:
+            rts = dict(self._runtimes)
+        models = {name: rt.summary() for name, rt in sorted(rts.items())}
+        return {
+            "ready": bool(models) and all(
+                m["state"] == "ready" for m in models.values()),
+            "models": models,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            rts = list(self._runtimes.values())
+            self._runtimes.clear()
+        for rt in rts:
+            rt.close(drain=drain)
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

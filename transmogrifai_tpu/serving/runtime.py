@@ -1,0 +1,509 @@
+"""Resilient serving runtime: continuous batching with backpressure,
+deadlines, and per-model circuit breaking (docs/serving.md).
+
+``micro_batch_score_function`` (local/scoring.py) is the throughput path —
+one compiled XLA program per device-fusable segment, reused across batch
+sizes via the bucketed plan cache — but nothing drives it under concurrent
+load. This runtime does, and it treats serving as a robustness problem
+first (ROADMAP item 1; the Spark executor fault model the reference got
+for free, rebuilt for the serving tier):
+
+* **bounded queue + admission control** — ``submit`` enqueues up to
+  ``max_queue`` requests; beyond that the request is *shed* with a typed
+  :class:`OverloadError` instead of growing memory without bound. Shedding
+  at the door is what keeps p99 bounded under a 2× overload.
+* **continuous batching** — a single batcher thread coalesces queued
+  requests into micro-batches and flushes on size-or-deadline: a full
+  ``max_batch`` (sized to the padding bucket grid of ``plan.py``, so one
+  compiled program serves every flush) or the oldest request aging past
+  ``max_wait_ms``. While a batch is on the device the queue keeps
+  accepting — the next batch is already forming.
+* **per-request deadlines** — an expired request is shed *before*
+  dispatch (:class:`DeadlineExceededError` on its future), so a slow
+  batch never spends device time on work nobody is waiting for.
+* **per-model circuit breaker** — dispatch/plan failures feed a
+  :class:`~.breaker.CircuitBreaker`; while open, batches degrade to the
+  eager per-row ``score_function`` path (bit-equal results) instead of
+  failing requests, recorded via FaultLog (``breaker_degraded``) and the
+  ``tg_breaker_state`` gauge. A half-open probe re-tries the device path
+  and closes on success.
+
+Failure injection: the ``serve.enqueue`` / ``serve.flush`` /
+``serve.dispatch`` chaos sites (robustness/faults.py) make every one of
+those paths deterministically testable.
+
+Metrics: every instrument is kept in a **serve-local**
+``MetricsRegistry`` (always on — health/SLO snapshots must work with
+observability disabled) and mirrored into the process-global registry
+through the gated helpers when ``TG_METRICS``/``TG_TRACE`` is enabled, so
+``summary()["observability"]["serving"]`` and ``metrics.prom`` pick the
+series up. Per-model p50/p95/p99 comes straight from the streaming
+histogram in ``observability/metrics.py``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, List, Optional, Sequence
+
+from ..local.scoring import (
+    SCORE_ERROR_KEY, micro_batch_score_function, score_function,
+)
+from ..observability import metrics as _obs_metrics
+from ..observability.trace import add_event as _obs_event
+from ..observability.trace import span as _obs_span
+from ..robustness import faults
+from ..robustness.policy import FaultLog, FaultReport
+from .breaker import BREAKER_GAUGE, CLOSED, CircuitBreaker, OPEN
+
+
+class ServingError(RuntimeError):
+    """Base of the typed serving-runtime failures."""
+
+
+class OverloadError(ServingError):
+    """The bounded request queue is full — the request was shed at
+    admission (backpressure). Retry with backoff or route elsewhere."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired while it was queued; it was shed
+    before any device work was spent on it."""
+
+
+class RuntimeStoppedError(ServingError):
+    """The runtime is not accepting requests (stopped or never started)."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServeConfig:
+    """Runtime knobs; every field has a ``TG_SERVE_*`` environment default
+    (documented in docs/serving.md "Env knobs").
+
+    ``max_batch`` defaults to the plan compiler's minimum padding bucket
+    (utils/padding.py: 256): every flush of up to ``max_batch`` rows pads
+    to the same bucket, so ONE compiled program serves all of them."""
+    max_batch: int = 256
+    max_queue: int = 1024
+    max_wait_ms: float = 2.0
+    default_deadline_ms: Optional[float] = None
+    breaker_failures: int = 3
+    breaker_reset_ms: float = 500.0
+    drain_on_close: bool = True
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        return cls(
+            max_batch=_env_int("TG_SERVE_MAX_BATCH", 256),
+            max_queue=_env_int("TG_SERVE_QUEUE_MAX", 1024),
+            max_wait_ms=_env_float("TG_SERVE_MAX_WAIT_MS", 2.0) or 2.0,
+            default_deadline_ms=_env_float("TG_SERVE_DEADLINE_MS", None),
+            breaker_failures=_env_int("TG_SERVE_BREAKER_FAILURES", 3),
+            breaker_reset_ms=_env_float(
+                "TG_SERVE_BREAKER_RESET_MS", 500.0) or 500.0,
+        )
+
+
+@dataclass
+class _Request:
+    row: Dict[str, Any]
+    future: Future
+    enqueued: float
+    deadline: Optional[float]  # absolute monotonic, None = no deadline
+
+
+#: live (started, not yet closed) runtimes — the conftest no-leak fixture
+#: asserts this is empty around every test
+_LIVE_LOCK = threading.Lock()
+_LIVE: List["ServingRuntime"] = []
+
+
+def live_runtimes() -> List["ServingRuntime"]:
+    with _LIVE_LOCK:
+        return list(_LIVE)
+
+
+class ServingRuntime:
+    """One model's serving loop. Use as a context manager::
+
+        with ServingRuntime(model, name="churn") as rt:
+            fut = rt.submit({"x1": 0.2, "x2": -1.0}, deadline_ms=50)
+            record = fut.result(timeout=5)
+
+    or synchronously: ``rt.score(row, timeout=5)``. ``close()`` drains the
+    queue (by default) and joins the batcher thread.
+    """
+
+    def __init__(self, model, name: str = "model",
+                 config: Optional[ServeConfig] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 fault_log: Optional[FaultLog] = None,
+                 metrics_registry: Optional[_obs_metrics.MetricsRegistry] = None,
+                 auto_start: bool = True):
+        self.model = model
+        self.name = name
+        self.config = config or ServeConfig.from_env()
+        #: serve-local instruments — always on (see module docstring)
+        self.metrics = metrics_registry or _obs_metrics.MetricsRegistry()
+        #: serve-scoped fault accounting (ring-bounded; TG_FAULTS_MAX)
+        self.fault_log = fault_log or FaultLog()
+        self.warm_info: Optional[Dict[str, Any]] = None
+        self._scorer = micro_batch_score_function(model)
+        self._eager_row = score_function(model)
+        self._result_names = [f.name for f in model.result_features]
+        self._cond = threading.Condition()
+        self._queue: Deque[_Request] = deque()
+        self._running = False    # batcher thread live
+        self._accepting = True   # submit() admits (True before start too,
+        #                          so tests can stage a queue deterministically)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self.breaker = breaker or CircuitBreaker(
+            name=name,
+            failure_threshold=self.config.breaker_failures,
+            reset_after=self.config.breaker_reset_ms / 1000.0)
+        self.breaker.on_transition = self._on_breaker_transition
+        self._set_gauge("tg_breaker_state", BREAKER_GAUGE[CLOSED],
+                        help="per-model circuit breaker state "
+                        "(0=closed, 1=half_open, 2=open; docs/serving.md)")
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServingRuntime":
+        with self._cond:
+            if self._closed:
+                raise RuntimeStoppedError(
+                    f"runtime '{self.name}' is closed")
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tg-serve[{self.name}]", daemon=True)
+        self._thread.start()
+        with _LIVE_LOCK:
+            _LIVE.append(self)
+        return self
+
+    def close(self, drain: Optional[bool] = None) -> None:
+        """Stop accepting requests. ``drain=True`` (the config default)
+        scores everything already queued before returning; ``drain=False``
+        fails queued requests with :class:`RuntimeStoppedError`."""
+        drain = self.config.drain_on_close if drain is None else drain
+        with self._cond:
+            if self._closed:
+                return
+            self._running = False
+            self._accepting = False
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    self._fail_future(r.future, RuntimeStoppedError(
+                        f"runtime '{self.name}' closed before dispatch"))
+                self._set_gauge("tg_serve_queue_depth", 0.0)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        with self._cond:
+            self._closed = True
+        with _LIVE_LOCK:
+            if self in _LIVE:
+                _LIVE.remove(self)
+
+    def __enter__(self) -> "ServingRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def running(self) -> bool:
+        with self._cond:
+            return self._running
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- request API ---------------------------------------------------------
+    def submit(self, row: Dict[str, Any],
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the result
+        record (``{feature name: value}``; quarantined rows carry
+        ``__score_error__``). Raises :class:`OverloadError` when the queue
+        is full and :class:`RuntimeStoppedError` when not running."""
+        # deterministic chaos entry: an injected fault here models an
+        # admission-layer failure (e.g. the listener thread dying)
+        faults.inject("serve.enqueue", key=self.name)
+        dl_ms = (deadline_ms if deadline_ms is not None
+                 else self.config.default_deadline_ms)
+        now = time.monotonic()
+        deadline = now + dl_ms / 1000.0 if dl_ms else None
+        fut: Future = Future()
+        with self._cond:
+            if not self._accepting:
+                raise RuntimeStoppedError(
+                    f"runtime '{self.name}' is not accepting requests")
+            if len(self._queue) >= self.config.max_queue:
+                self._count("tg_serve_shed_total", reason="overload",
+                            help="requests shed (docs/serving.md)")
+                raise OverloadError(
+                    f"serve queue for model '{self.name}' is full "
+                    f"({self.config.max_queue} pending); request shed")
+            self._queue.append(_Request(row, fut, now, deadline))
+            self._set_gauge("tg_serve_queue_depth", float(len(self._queue)),
+                            help="requests waiting for a flush")
+            self._cond.notify()
+        return fut
+
+    def score(self, row: Dict[str, Any], timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(row, deadline_ms=deadline_ms).result(timeout)
+
+    def warm(self, rows: int = 8) -> List[Dict[str, Any]]:
+        """Drive the compiled serve path once with synthetic all-missing
+        rows — compiles the plan + jitted programs for the padding bucket
+        the first real flush will land in (serving/warmup.py)."""
+        return self._scorer([{} for _ in range(max(1, rows))])
+
+    # -- batcher -------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._flush(batch)
+            except Exception as e:  # belt-and-braces: never kill the loop
+                for r in batch:
+                    self._fail_future(r.future, e)
+
+    def _take_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch is ready: a full ``max_batch``, the oldest
+        request aging past ``max_wait_ms``, or shutdown (drain). Returns
+        None when stopped and drained."""
+        cfg = self.config
+        with self._cond:
+            while not self._queue and self._running:
+                self._cond.wait(0.05)
+            if not self._queue:
+                return None  # stopped and drained
+            flush_at = self._queue[0].enqueued + cfg.max_wait_ms / 1000.0
+            while (len(self._queue) < cfg.max_batch and self._running):
+                remaining = flush_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+            k = min(len(self._queue), cfg.max_batch)
+            batch = [self._queue.popleft() for _ in range(k)]
+            self._set_gauge("tg_serve_queue_depth", float(len(self._queue)))
+            return batch
+
+    def _flush(self, batch: List[_Request]) -> None:
+        with _obs_span("serve.flush", cat="serve", model=self.name,
+                       rows=len(batch)):
+            alive = self._shed_expired(batch)
+            if not alive:
+                return
+            try:
+                # chaos: a fault assembling the batch (the batching layer
+                # itself failing) — requests degrade, they do not fail
+                faults.inject("serve.flush", key=self.name)
+            except Exception as e:
+                self._record_degraded("serve.flush", len(alive), error=e)
+                self._finish(alive, self._eager_records(alive),
+                             degraded=True)
+                return
+            self._dispatch(alive)
+
+    def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
+        """Deadline enforcement happens HERE, after dequeue and before any
+        device work — dead requests never reach the compiled program."""
+        now = time.monotonic()
+        alive: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now >= r.deadline:
+                self._count("tg_serve_shed_total", reason="deadline",
+                            help="requests shed (docs/serving.md)")
+                self._fail_future(r.future, DeadlineExceededError(
+                    f"deadline expired after "
+                    f"{(now - r.enqueued) * 1000:.1f}ms in queue "
+                    f"(model '{self.name}'); shed before dispatch"))
+            elif r.future.cancelled():
+                continue
+            else:
+                alive.append(r)
+        return alive
+
+    def _dispatch(self, alive: List[_Request]) -> None:
+        rows = [r.row for r in alive]
+        if self.breaker.allow_device():
+            try:
+                with _obs_span("serve.dispatch", cat="serve",
+                               model=self.name, rows=len(rows)):
+                    # chaos: a fault here models the compiled micro-batch
+                    # path failing (wedged XLA dispatch, poisoned plan)
+                    faults.inject("serve.dispatch", key=self.name)
+                    recs = self._scorer(rows)
+            except Exception as e:
+                self.breaker.record_failure(error=e)
+                self._record_degraded("serve.dispatch", len(rows), error=e)
+                self._finish(alive, self._eager_records(alive),
+                             degraded=True)
+                return
+            self.breaker.record_success()
+            self._finish(alive, recs, degraded=False)
+        else:
+            # breaker open: the device path is failing — serve the batch
+            # through the eager per-row scorer (bit-equal) instead of
+            # failing requests
+            self._record_degraded("serve.dispatch", len(rows))
+            self._finish(alive, self._eager_records(alive), degraded=True)
+
+    def _eager_records(self, reqs: Sequence[_Request]) -> List[Dict[str, Any]]:
+        """The degraded path: eager per-row ``score_function``. Rows the
+        eager path cannot score are quarantined under ``__score_error__``
+        exactly like the micro-batch path does."""
+        out: List[Dict[str, Any]] = []
+        for r in reqs:
+            try:
+                out.append(self._eager_row(r.row))
+            except Exception as e:
+                rec: Dict[str, Any] = {nm: None for nm in self._result_names}
+                rec[SCORE_ERROR_KEY] = f"{type(e).__name__}: {e}"
+                out.append(rec)
+        return out
+
+    def _finish(self, reqs: Sequence[_Request],
+                recs: Sequence[Dict[str, Any]], degraded: bool) -> None:
+        now = time.monotonic()
+        quarantined = 0
+        for r, rec in zip(reqs, recs):
+            if SCORE_ERROR_KEY in rec:
+                quarantined += 1
+            try:
+                r.future.set_result(rec)
+            except InvalidStateError:
+                continue  # cancelled while in flight
+            self._observe("tg_serve_request_seconds", now - r.enqueued,
+                          help="enqueue-to-result latency per request "
+                          "(p50/p95/p99; docs/serving.md)")
+        n = len(reqs)
+        self._count("tg_serve_rows_total", float(n),
+                    help="requests scored by the serving runtime")
+        self._observe("tg_serve_batch_rows", float(n),
+                      help="coalesced flush sizes (continuous batching)")
+        if degraded:
+            self._count("tg_serve_degraded_total", float(n),
+                        help="requests served via the eager per-row "
+                        "fallback (breaker open or dispatch failure)")
+        if quarantined:
+            self._count("tg_serve_quarantined_total", float(quarantined),
+                        help="requests quarantined under __score_error__")
+
+    # -- accounting ----------------------------------------------------------
+    def _record_degraded(self, site: str, rows: int,
+                         error: Optional[BaseException] = None) -> None:
+        detail: Dict[str, Any] = {"model": self.name, "rows": rows,
+                                  "breakerState": self.breaker.state}
+        if error is not None:
+            detail["error"] = f"{type(error).__name__}: {error}"[:300]
+        self.fault_log.add(FaultReport(site=site, kind="breaker_degraded",
+                                       detail=detail))
+
+    def _on_breaker_transition(self, state: str) -> None:
+        self._set_gauge("tg_breaker_state", BREAKER_GAUGE[state],
+                        help="per-model circuit breaker state "
+                        "(0=closed, 1=half_open, 2=open; docs/serving.md)")
+        _obs_event("serve.breaker", model=self.name, state=state)
+
+    def _count(self, name: str, n: float = 1.0, help: str = "",
+               **labels: str) -> None:
+        self.metrics.counter(name, help, model=self.name, **labels).inc(n)
+        _obs_metrics.inc_counter(name, n, help, model=self.name, **labels)
+
+    def _observe(self, name: str, v: float, help: str = "") -> None:
+        self.metrics.histogram(name, help, model=self.name).observe(v)
+        _obs_metrics.observe(name, v, help, model=self.name)
+
+    def _set_gauge(self, name: str, v: float, help: str = "") -> None:
+        self.metrics.gauge(name, help, model=self.name).set(v)
+        _obs_metrics.set_gauge(name, v, help, model=self.name)
+
+    @staticmethod
+    def _fail_future(fut: Future, exc: BaseException) -> None:
+        try:
+            fut.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    # -- introspection -------------------------------------------------------
+    def _series(self, snap: Dict[str, Dict[str, Any]], name: str,
+                **match: str) -> float:
+        total = 0.0
+        for key, v in snap.get(name, {}).items():
+            kv = dict(p.split("=", 1) for p in key.split(",") if "=" in p)
+            if all(kv.get(k) == val for k, val in match.items()):
+                total += float(v)
+        return total
+
+    def summary(self) -> Dict[str, Any]:
+        """The serve-side ``summary()`` section: SLO quantiles, shed /
+        degraded / quarantine counts, breaker + queue state, fault-log
+        tail size (docs/serving.md "SLO metrics")."""
+        snap = self.metrics.snapshot()
+        latency = snap.get("tg_serve_request_seconds", {}).get(
+            f"model={self.name}", {})
+        return {
+            "model": self.name,
+            "state": self.health_state(),
+            "breaker": self.breaker.snapshot(),
+            "queueDepth": self.queue_depth(),
+            "latency": latency,
+            "batchRows": snap.get("tg_serve_batch_rows", {}).get(
+                f"model={self.name}", {}),
+            "rowsScored": self._series(snap, "tg_serve_rows_total"),
+            "degradedRows": self._series(snap, "tg_serve_degraded_total"),
+            "quarantinedRows": self._series(
+                snap, "tg_serve_quarantined_total"),
+            "shed": {
+                "overload": self._series(snap, "tg_serve_shed_total",
+                                         reason="overload"),
+                "deadline": self._series(snap, "tg_serve_shed_total",
+                                         reason="deadline"),
+            },
+            "faults": {"reports": len(self.fault_log.reports),
+                       "dropped": self.fault_log.dropped},
+            "warm": self.warm_info,
+        }
+
+    def health_state(self) -> str:
+        """``ready`` (running, device path live), ``degraded`` (running but
+        the breaker is open — eager fallback serving), or ``stopped``."""
+        if not self.running:
+            return "stopped"
+        return "degraded" if self.breaker.state == OPEN else "ready"

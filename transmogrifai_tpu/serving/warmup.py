@@ -1,0 +1,99 @@
+"""Warm-start: zero-retrace first requests (first slice of ROADMAP item 1).
+
+A fresh process pays the transform-plan build + XLA trace on its first
+micro-batch — tens of ms to seconds of p99 on request one. The cure has
+two halves:
+
+* **save time** — :func:`manifest_serving_entry` records the micro-batch
+  plan *schema fingerprint* (what ``plan.py`` keys its cache on: per
+  external column name / dtype / trailing shape / mask presence) in the
+  model's ``MANIFEST.json``. The fingerprint is computed from a synthetic
+  all-missing request batch, which is schema-identical to any real batch:
+  ``Column.of_values`` derives dtype and mask presence from the *feature
+  type*, never the data.
+* **load time** — :func:`warm_runtime` drives the runtime's compiled
+  scorer once over the same synthetic batch, building the plan and
+  compiling the jitted segment programs for the padding bucket every
+  flush of up to ``max_batch`` rows lands in — so the first real request
+  is served from warm caches. The recorded fingerprint is verified
+  against the loaded model's (a mismatch means the plan cache would miss
+  — reported in the health snapshot, never fatal).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ..local.scoring import serve_table_builder
+
+#: synthetic rows used for the warm trace; any value <= max_batch compiles
+#: the same (256-minimum) padding bucket, so small is fine
+WARM_ROWS_ENV = "TG_SERVE_WARM_ROWS"
+DEFAULT_WARM_ROWS = 8
+
+
+def _warm_rows(rows: Optional[int] = None) -> int:
+    if rows is not None:
+        return max(1, int(rows))
+    try:
+        return max(1, int(os.environ.get(WARM_ROWS_ENV, "")
+                          or DEFAULT_WARM_ROWS))
+    except ValueError:
+        return DEFAULT_WARM_ROWS
+
+
+def serve_plan_fingerprint(model, rows: int = 1) -> List[List[Any]]:
+    """The JSON-ready plan schema fingerprint of the model's serve path:
+    what ``plan.get_plan`` will key on for any request batch (row count is
+    not part of it — padding buckets absorb that)."""
+    from .. import plan as _plan
+    table = serve_table_builder(model)([{} for _ in range(max(1, rows))])
+    return _plan.schema_fingerprint(model.stages, table)
+
+
+def manifest_serving_entry(model) -> Dict[str, Any]:
+    """The ``serving`` section written into the model's ``MANIFEST.json``
+    at save time (persistence.save_model)."""
+    return {
+        "planFingerprint": serve_plan_fingerprint(model),
+        "warmRows": _warm_rows(),
+        "resultFeatures": [f.name for f in model.result_features],
+    }
+
+
+def warm_runtime(runtime, entry: Optional[Dict[str, Any]] = None,
+                 rows: Optional[int] = None) -> Dict[str, Any]:
+    """Pre-trace the runtime's serve plans; returns the warm report that
+    lands in ``runtime.warm_info`` / the registry health snapshot:
+    ``{"rows", "plansWarmed", "ok", "fingerprintMatch", "error"}``.
+
+    Never raises — a model whose raw extracts cannot handle an all-missing
+    probe row simply serves its first request cold (reported)."""
+    from .. import plan as _plan
+    n = _warm_rows(rows if rows is not None
+                   else (entry or {}).get("warmRows"))
+    before = _plan.cache_stats()["entries"]
+    info: Dict[str, Any] = {"rows": n, "plansWarmed": 0, "ok": True,
+                            "fingerprintMatch": None, "error": None}
+    try:
+        runtime.warm(n)
+    except Exception as e:
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"[:300]
+    info["plansWarmed"] = max(0, _plan.cache_stats()["entries"] - before)
+    recorded = (entry or {}).get("planFingerprint")
+    if recorded is not None:
+        try:
+            actual = serve_plan_fingerprint(runtime.model)
+            info["fingerprintMatch"] = (
+                _normalize(actual) == _normalize(recorded))
+        except Exception as e:
+            info["fingerprintMatch"] = False
+            info["error"] = info["error"] or f"{type(e).__name__}: {e}"[:300]
+    runtime.warm_info = info
+    return info
+
+
+def _normalize(fp: Any) -> List[List[Any]]:
+    # JSON round-trips tuples to lists; compare shape-insensitively
+    return [[c[0], c[1], list(c[2]), bool(c[3])] for c in fp]
