@@ -32,6 +32,12 @@ Modes (BENCH_MODE env):
   soak at 2× capacity with faults armed at all three ``serve.*`` sites —
   the line must complete with overflow shed as typed errors and the
   breaker/shed/degraded counts visible (zero process crashes).
+- ``stream``: the out-of-core line — a 10M×64 synthetic chunk stream
+  trained end-to-end via ``OpWorkflow.train(stream=...)`` (vectorize →
+  sanity-check → streaming GBT), reporting rows/sec, peak device-resident
+  bytes (asserted O(chunk)), and the feed's transfer/compute overlap
+  (docs/streaming.md; BENCH_STREAM_ROWS / BENCH_STREAM_FEATURES /
+  TG_STREAM_CHUNK_ROWS override the shape).
 - ``default``: the exact stock default grids (45 configs incl. the
   depth-12 trees, 135 fits) — the path every
   ``BinaryClassificationModelSelector()`` user gets; fixed costs dominate.
@@ -52,7 +58,7 @@ def _models(mode, registry):
     if mode not in ("dense", "default", "linear"):
         raise SystemExit(f"unknown BENCH_MODE {mode!r}: "
                          "use both | dense | default | linear | "
-                         "transform | serve")
+                         "transform | serve | stream")
     if mode == "linear":
         grid = [{"regParam": r, "elasticNetParam": e}
                 for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
@@ -371,6 +377,68 @@ def _run_serve(platform):
         }), flush=True)
 
 
+def _run_stream(platform):
+    """BENCH_MODE=stream: the out-of-core line (docs/streaming.md). Trains
+    vectorize → sanity-check → streaming-GBT over a BENCH_STREAM_ROWS ×
+    BENCH_STREAM_FEATURES synthetic chunk source (default 10M × 64 —
+    ~2.5 GB of feature data, regenerated deterministically per pass, never
+    materialized) and reports end-to-end rows/sec, uploaded bytes, the
+    peak device-resident bytes (the O(chunk) bound — asserted), and the
+    transfer/compute overlap fraction from the double-buffered feed."""
+    import transmogrifai_tpu as tg
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu.streaming import (
+        SyntheticChunkSource, StreamingGBT, env_chunk_rows)
+    from transmogrifai_tpu.workflow import OpWorkflow
+
+    n = int(os.environ.get("BENCH_STREAM_ROWS", 10_000_000))
+    d = int(os.environ.get("BENCH_STREAM_FEATURES", 64))
+    chunk_rows = env_chunk_rows()
+    source = SyntheticChunkSource(n, d, chunk_rows=chunk_rows, seed=0,
+                                  problem="binary")
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.transform_with(SanityChecker(seed=1),
+                                   tg.transmogrify(feats))
+    pred = (StreamingGBT(problem="binary", num_trees=1, max_depth=3,
+                         n_bins=32, learning_rate=1.0)
+            .set_input(label, checked).get_output())
+    wf = OpWorkflow().set_result_features(pred)
+    t0 = time.perf_counter()
+    model = wf.train(stream=source)
+    wall = time.perf_counter() - t0
+    stats = model.summary()["streaming"]
+    # the O(chunk)-not-O(dataset) claim, enforced: at most prefetch+1
+    # (transformed) chunks device-resident, and the peak is a vanishing
+    # fraction of the raw dataset bytes
+    assert stats["peakDeviceBytes"] <= 2 * stats["maxChunkBytes"], stats
+    assert stats["peakDeviceBytes"] <= (n * d * 4) / 10, stats
+    passes = stats["rows"] / max(n, 1)
+    print(json.dumps({
+        "metric": f"stream_train_rows_per_sec_{n}rows_{d}feat_{platform}",
+        "value": round(n / wall, 1),
+        "unit": "rows/sec",
+        # vs in-core is not meaningful (in-core cannot hold the table);
+        # report against the feed's pure upload throughput instead
+        "vs_baseline": round(stats["overlapFraction"], 3),
+        "phases": {
+            "wallSecs": round(wall, 2),
+            "passes": round(passes, 2),
+            "chunks": stats["chunks"],
+            "chunkRows": chunk_rows,
+            "uploadBytes": stats["uploadBytes"],
+            "maxChunkBytes": stats["maxChunkBytes"],
+            "peakDeviceBytes": stats["peakDeviceBytes"],
+            "peakResidentChunks": stats["peakResidentChunks"],
+            "overlapFraction": stats["overlapFraction"],
+            "uploadSeconds": stats["uploadSeconds"],
+            "waitSeconds": stats["waitSeconds"],
+        },
+    }), flush=True)
+
+
 def _run_mesh_line():
     """Virtual-8-device CPU mesh sweep fits/sec — a NUMBER for mesh-path
     regressions (round-4 VERDICT weak #5: the dryrun's wall-ratio assert
@@ -520,6 +588,9 @@ def main():
         return
     if mode == "serve":
         _run_serve(platform)
+        return
+    if mode == "stream":
+        _run_stream(platform)
         return
 
     rng = np.random.RandomState(0)

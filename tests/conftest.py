@@ -151,6 +151,30 @@ def _no_serving_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_stream_leak():
+    """The streaming device feed owns a producer thread and up to
+    prefetch+1 host/device-resident chunk buffers. A leaked feed would
+    keep reading + uploading chunks (and counting transfer bytes into the
+    metrics registry) underneath later tests; a leaked tg-stream thread
+    pins its chunk source alive for the session. Mirrors the serving
+    no-leak fixture: assert clean entry, force-close + fail on exit."""
+    import threading
+
+    from transmogrifai_tpu.streaming import feed as _feed
+
+    assert not _feed.live_feeds(), (
+        "stream feed(s) leaked from a previous test")
+    yield
+    leaked = _feed.live_feeds()
+    for f in leaked:
+        f.close()
+    assert not leaked, f"a test leaked {len(leaked)} open DeviceFeed(s)"
+    stray = [t.name for t in threading.enumerate()
+             if t.name.startswith("tg-stream") and t.is_alive()]
+    assert not stray, f"stream feed thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

@@ -974,6 +974,28 @@ class TestModelSelectorSpec(OpEstimatorSpec):
                            v=(OPVector, x.tolist())), None
 
 
+class TestStreamingGBTSpec(OpEstimatorSpec):
+    from transmogrifai_tpu.streaming.model import StreamingGBT
+    stage_cls = StreamingGBT
+    #: like ModelSelector: the row dual emits prediction PARTS (dict),
+    #: the columnar path the packed Prediction column; their parity is
+    #: asserted in tests/test_streaming.py via score() vs score_function
+    check_row_parity = False
+
+    @classmethod
+    def build(cls):
+        from transmogrifai_tpu.streaming.model import StreamingGBT
+        stage = StreamingGBT(
+            problem="binary", num_trees=1, max_depth=2, n_bins=8,
+            learning_rate=1.0,
+        ).set_input(_resp(), _f("v", "OPVector"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(60, 2)
+        y = (x[:, 0] > 0).astype(float)
+        return stage, _tbl(y=(RealNN, y.tolist()),
+                           v=(OPVector, x.tolist())), None
+
+
 def _loco_fixture():
     """Tiny fitted SelectedModel + its scored table for the insights specs."""
     from transmogrifai_tpu.impl.selector.model_selector import ModelSelector

@@ -451,3 +451,44 @@ def test_serve_local_metrics_do_not_touch_global_registry(model):
         rt.score({"x1": 0.2, "x2": 0.1}, timeout=30)
     assert om.registry().snapshot() == {}
     assert rt.summary()["latency"]["count"] == 1
+
+
+def test_vectorized_table_builder_byte_identical(model):
+    """The serve hot-path satellite (docs/benchmarks.md "Serving
+    runtime"): the vectorized request→FeatureTable assembly must build a
+    byte-identical table to the per-cell ``Column.of_values`` path for
+    homogeneous batches, heterogeneous batches (None/strings) must fall
+    back with the same result, and the row-major record view must emit
+    the same python values."""
+    from transmogrifai_tpu.local.scoring import (
+        serve_record_builder, serve_table_builder)
+    from transmogrifai_tpu.table import Column
+
+    build = serve_table_builder(model)
+    rows = _rows(64)
+    rows[5] = {"x1": None, "x2": float("nan")}   # missing cells
+    rows[6] = {"x2": 0.25}                       # missing field
+    rows[7] = {"x1": True, "x2": 3}              # bool/int scalars
+    table = build(rows)
+    for f in model.raw_features:
+        if f.is_response:
+            continue
+        vals = [f.origin_stage.extract(r) for r in rows]
+        ref = Column.of_values(f.feature_type, vals)
+        got = table[f.name]
+        np.testing.assert_array_equal(np.asarray(ref.values),
+                                      np.asarray(got.values))
+        np.testing.assert_array_equal(ref.valid_mask(), got.valid_mask())
+        assert np.asarray(got.values).dtype == np.asarray(ref.values).dtype
+    # record view: same python values as the per-cell path
+    scored = model.score(table=build(_rows(8)))
+    recs = serve_record_builder(model)(scored, 8)
+    for i, rec in enumerate(recs):
+        for f in model.result_features:
+            col = scored[f.name]
+            v = np.asarray(col.values)[i]
+            if f.type_name == "Prediction":
+                keys = col.metadata.get("keys", ())
+                assert rec[f.name] == {k: float(x) for k, x in zip(keys, v)}
+            else:
+                assert rec[f.name] == (v.tolist() if v.ndim else v.item())

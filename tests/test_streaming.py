@@ -1,0 +1,571 @@
+"""Out-of-core streaming training (transmogrifai_tpu/streaming/,
+docs/streaming.md): fold-vs-in-core equivalence, histogram merge
+invariants, feed depth bounds, chunk-boundary edges, and
+kill-at-every-chaos-site → resume → bit-equal model."""
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.robustness import faults
+from transmogrifai_tpu.robustness.faults import SimulatedPreemption
+from transmogrifai_tpu.streaming import (
+    AvroChunkSource, ColStatsFold, ContingencyFold, CorrelationFold,
+    DeviceFeed, HistogramFold, StreamingGBT, StreamingNotSupportedError,
+    SyntheticChunkSource, TableChunkSource,
+)
+from transmogrifai_tpu.streaming import feed as feed_mod
+from transmogrifai_tpu.table import Column, FeatureTable
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.stream
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _table(n=3000, d=8, seed=0, missing=0.05):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    mask = rng.rand(n, d) >= missing
+    y = (np.where(mask, X, 0.0)[:, 0] > 0.3).astype(np.float32)
+    cols = {f"x{i}": Column(Real, X[:, i], mask[:, i]) for i in range(d)}
+    cols["y"] = Column(RealNN, y, None)
+    return FeatureTable(cols, n), X, mask, y
+
+
+def _pipeline(d=8, num_trees=2, depth=3, seed=1):
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.transform_with(SanityChecker(seed=seed),
+                                   tg.transmogrify(feats))
+    pred = (StreamingGBT(problem="binary", num_trees=num_trees,
+                         max_depth=depth, n_bins=16, learning_rate=0.5)
+            .set_input(label, checked).get_output())
+    return pred
+
+
+def _gbt_of(model):
+    return [s for s in model.stages
+            if type(s).__name__ == "StreamingGBTModel"][0]
+
+
+def _trees_equal(a, b):
+    ta, tb = a.trees, b.trees
+    if len(ta) != len(tb) or a.f0 != b.f0:
+        return False
+    for x, y in zip(ta, tb):
+        if not all((p == q).all() for p, q in zip(x["feat_lv"], y["feat_lv"])):
+            return False
+        if not all(np.array_equal(p, q, equal_nan=True)
+                   for p, q in zip(x["thr_lv"], y["thr_lv"])):
+            return False
+        if not (x["leaf"] == y["leaf"]).all():
+            return False
+    return True
+
+
+def _fold_over_schedule(fold, X, mask, bounds, extract=None):
+    """Left-fold a ColStats-style fold over contiguous [lo, hi) chunks."""
+    state = fold.zero()
+    for lo, hi in bounds:
+        if extract is None:
+            state = fold.accumulate(state, X[lo:hi], mask[lo:hi])
+        else:
+            state = fold.accumulate(state, *extract(lo, hi))
+    return state
+
+
+def _schedules(n, seed=0):
+    """The whole-table schedule plus two random contiguous partitions."""
+    rng = np.random.RandomState(seed)
+    out = [[(0, n)]]
+    for _ in range(2):
+        cuts = np.sort(rng.choice(np.arange(1, n), size=5, replace=False))
+        pts = [0] + cuts.tolist() + [n]
+        out.append(list(zip(pts[:-1], pts[1:])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# folds vs in-core kernels
+# ---------------------------------------------------------------------------
+
+def test_col_stats_fold_bit_equal_across_schedules():
+    _, X, mask, _ = _table(4000, 6, seed=3)
+    row_mask = mask[:, 0]
+    fold = ColStatsFold(6)
+    finals = [fold.finalize(_fold_over_schedule(fold, X, row_mask, b))
+              for b in _schedules(4000)]
+    ref = finals[0]          # single chunk == the in-core fold
+    for res in finals[1:]:
+        for field in ref._fields:
+            a, b = getattr(ref, field), getattr(res, field)
+            # f32-precision bit-equality: f64 partials merged in any
+            # grouping agree far below one f32 ulp
+            assert (a.astype(np.float32) == b.astype(np.float32)).all(), field
+        # exact fields are bit-equal even in f64
+        assert (ref.count == res.count).all()
+        assert (ref.min == res.min).all() and (ref.max == res.max).all()
+        assert (ref.num_nonzeros == res.num_nonzeros).all()
+
+
+def test_col_stats_fold_matches_jit_kernel():
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.stats import col_stats
+    _, X, mask, _ = _table(2000, 5, seed=4)
+    row_mask = mask[:, 0]
+    fold = ColStatsFold(5)
+    res = fold.finalize(fold.accumulate(fold.zero(), X, row_mask))
+    ref = col_stats(jnp.asarray(X), jnp.asarray(row_mask))
+    # the jit kernel's count broadcasts a (1,) row-mask sum over columns
+    np.testing.assert_array_equal(
+        np.broadcast_to(np.asarray(ref.count), (5,)), res.count)
+    np.testing.assert_allclose(np.asarray(ref.mean), res.mean, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref.variance), res.variance,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref.min), res.min, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ref.max), res.max, atol=1e-6)
+
+
+def test_correlation_fold_matches_jit_kernel_and_schedules():
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.stats import pearson_correlation
+    _, X, mask, y = _table(4000, 6, seed=5)
+    fold = CorrelationFold(6)
+    finals = []
+    for bounds in _schedules(4000, seed=1):
+        st = fold.zero()
+        for lo, hi in bounds:
+            st = fold.accumulate(st, X[lo:hi], y[lo:hi])
+        finals.append(fold.finalize(st))
+    for res in finals[1:]:
+        assert (finals[0].astype(np.float32) == res.astype(np.float32)).all()
+    ref = np.asarray(pearson_correlation(jnp.asarray(X), jnp.asarray(y)))
+    np.testing.assert_allclose(finals[0], ref, atol=2e-5)
+
+
+def test_contingency_fold_bit_equal_to_kernel():
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.ops.stats import contingency_table
+    rng = np.random.RandomState(7)
+    n, k = 3000, 5
+    ind = (rng.rand(n, k) < 0.3).astype(np.float32)
+    y = rng.randint(0, 3, size=n).astype(np.float32)
+    fold = ContingencyFold(k)
+    finals = []
+    for bounds in _schedules(n, seed=2):
+        st = fold.zero()
+        for lo, hi in bounds:
+            st = fold.accumulate(st, ind[lo:hi], y[lo:hi])
+        finals.append(fold.finalize(st))
+    ref = np.asarray(contingency_table(
+        jnp.asarray(ind), jnp.asarray(y.astype(np.int32)), 3)).astype(np.int64)
+    for res in finals:
+        # integer counts: bit-equal to the one-hot matmul, any schedule
+        np.testing.assert_array_equal(res, ref)
+
+
+def test_contingency_fold_flags_non_integer_labels():
+    fold = ContingencyFold(3)
+    st = fold.accumulate(fold.zero(), np.ones((10, 3), np.float32),
+                         np.linspace(0.1, 0.9, 10))
+    assert fold.finalize(st) is None
+
+
+# ---------------------------------------------------------------------------
+# streaming-histogram hardening (satellite: merge invariants + associativity)
+# ---------------------------------------------------------------------------
+
+def test_histogram_merge_invariants_and_mixed_impls():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4000)
+    a = StreamingHistogram(32).update(x[:1500])
+    b = StreamingHistogram(32).update(x[1500:])
+    total = a.total + b.total
+    a.merge(b)
+    assert len(a.bins()) <= 32
+    assert a.total == total
+    assert a.min == x.min() and a.max == x.max()
+    # python-fallback merge is bit-identical to the native merge
+    def py_hist(vals):
+        h = StreamingHistogram(32)
+        if h._lib is not None:     # force the pure-python twin
+            h._lib = None
+            h._bins, h._total = [], 0.0
+            h._min, h._max = np.inf, -np.inf
+        return h.update(vals)
+    pa, pb = py_hist(x[:1500]), py_hist(x[1500:])
+    pa.merge(pb)
+    na = StreamingHistogram(32).update(x[:1500])
+    na.merge(StreamingHistogram(32).update(x[1500:]))
+    assert pa.bins() == na.bins()
+    assert pa.total == na.total
+    # mixed pairing works and conserves mass
+    ma = StreamingHistogram(32).update(x[:1500])
+    ma.merge(py_hist(x[1500:]))
+    assert ma.total == total and len(ma.bins()) <= 32
+
+
+def test_histogram_merged_is_permutation_invariant():
+    """The fold-order property: merged() is a pure function of the multiset
+    of per-chunk summaries — any permutation gives bit-equal bins, total,
+    and therefore bit-equal quantiles."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(6000)
+    for trial in range(3):
+        cuts = np.sort(rng.choice(np.arange(1, 6000), 7, replace=False))
+        pts = [0] + cuts.tolist() + [6000]
+        parts = [StreamingHistogram(24).update(x[lo:hi])
+                 for lo, hi in zip(pts[:-1], pts[1:])]
+        ref = StreamingHistogram.merged(parts)
+        for _ in range(3):
+            perm = rng.permutation(len(parts))
+            got = StreamingHistogram.merged([parts[i] for i in perm])
+            assert got.bins() == ref.bins()
+            assert got.total == ref.total
+            assert got.quantile(0.5) == ref.quantile(0.5)
+            np.testing.assert_array_equal(got.uniform(8), ref.uniform(8))
+
+
+def test_histogram_state_roundtrip():
+    h = StreamingHistogram(16).update(np.random.RandomState(2).randn(1000))
+    r = StreamingHistogram.from_state(h.to_state())
+    assert r.bins() == h.bins() and r.total == h.total
+    assert r.min == h.min and r.max == h.max
+    assert r.quantile(0.9) == h.quantile(0.9)
+
+
+def test_histogram_fold_fill_rates_and_quantiles():
+    _, X, mask, _ = _table(5000, 4, seed=9)
+    fold = HistogramFold(4, max_bins=64)
+    st = fold.zero()
+    for lo in range(0, 5000, 1000):
+        st = fold.accumulate(st, X[lo:lo + 1000], mask[lo:lo + 1000])
+    rates = fold.fill_rates(st)
+    np.testing.assert_allclose(rates, mask.mean(axis=0), atol=1e-12)
+    hists = fold.finalize(st)
+    for j, h in enumerate(hists):
+        exact = np.quantile(X[mask[:, j], j].astype(np.float64), 0.5)
+        assert abs(h.quantile(0.5) - exact) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# chunk sources + feed
+# ---------------------------------------------------------------------------
+
+def test_chunk_ids_deterministic_and_boundaries():
+    table, _, _, _ = _table(1050, 4)
+    src = TableChunkSource(table, chunk_rows=500)
+    chunks = list(src.chunks())
+    assert [c.rows for c in chunks] == [500, 500, 50]   # short last chunk
+    assert src.num_chunks == 3
+    again = list(TableChunkSource(table, chunk_rows=500).chunks())
+    assert [c.chunk_id for c in chunks] == [c.chunk_id for c in again]
+    # resume offset yields the identical suffix
+    tail = list(src.chunks(start=2))
+    assert len(tail) == 1 and tail[0].chunk_id == chunks[2].chunk_id
+    # single-chunk dataset
+    one = TableChunkSource(table, chunk_rows=5000)
+    assert one.num_chunks == 1
+    assert next(iter(one.chunks())).rows == 1050
+
+
+def test_synthetic_source_chunks_are_pure_functions_of_index():
+    src = SyntheticChunkSource(2500, 5, chunk_rows=1000, seed=7)
+    a = list(src.chunks())
+    b = list(src.chunks(start=2))
+    np.testing.assert_array_equal(
+        np.asarray(a[2].table["x0"].values), np.asarray(b[0].table["x0"].values))
+    assert [c.rows for c in a] == [1000, 1000, 500]
+
+
+def test_avro_chunk_source_roundtrip(tmp_path):
+    from transmogrifai_tpu.utils.avro import write_avro
+    rows = [{"x0": float(i), "y": float(i % 2)} for i in range(130)]
+    path = str(tmp_path / "stream.avro")
+    write_avro(path, rows)
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    x0 = FeatureBuilder.Real("x0").extract_field().as_predictor()
+    src = AvroChunkSource(path, chunk_rows=50)
+    src.bind((label, x0))
+    chunks = list(src.chunks())
+    assert [c.rows for c in chunks] == [50, 50, 30]
+    got = np.concatenate([np.asarray(c.table["x0"].values) for c in chunks])
+    np.testing.assert_allclose(got, np.arange(130, dtype=np.float32))
+    # resume skips decoded-but-unwanted chunks deterministically
+    tail = list(src.chunks(start=2))
+    assert len(tail) == 1 and tail[0].rows == 30
+
+
+def test_feed_bounded_depth_and_accounting():
+    table, _, _, _ = _table(4096, 4)
+    src = TableChunkSource(table, chunk_rows=256)
+    with DeviceFeed(src.chunks(), prefetch=1) as feed:
+        seen = 0
+        import time
+        for chunk in feed:
+            seen += chunk.rows
+            time.sleep(0.002)     # slow consumer → producer fills the queue
+        assert seen == 4096
+    st = feed.stats
+    assert st.chunks == 16
+    # depth bound: prefetch chunks queued + 1 being consumed
+    assert st.peak_resident_chunks <= 2
+    assert st.peak_device_bytes <= 2 * (256 * 4 * 4 + 256 * 5 + 256 * 4)
+    assert st.upload_bytes > 0
+    assert not feed_mod.live_feeds()
+
+
+def test_feed_forwards_producer_errors():
+    def boom():
+        table, _, _, _ = _table(100, 2)
+        yield from TableChunkSource(table, chunk_rows=50).chunks()
+        raise RuntimeError("source exploded")
+    with DeviceFeed(boom()) as feed:
+        with pytest.raises(RuntimeError, match="source exploded"):
+            for _ in feed:
+                pass
+    assert not feed_mod.live_feeds()
+
+
+# ---------------------------------------------------------------------------
+# streamed train ≡ in-core train
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_pair():
+    table, X, mask, y = _table(3000, 8)
+    m_core = (OpWorkflow().set_input_table(table)
+              .set_result_features(_pipeline()).train())
+    src = TableChunkSource(table, chunk_rows=450)
+    m_stream = (OpWorkflow().set_result_features(_pipeline())
+                .train(stream=src))
+    return table, m_core, m_stream
+
+
+def test_streamed_prep_stats_match_in_core(trained_pair):
+    table, m_core, m_stream = trained_pair
+    rv = [[s for s in m.stages if type(s).__name__ == "RealVectorizerModel"][0]
+          for m in (m_core, m_stream)]
+    # exact-f64 fold mean vs in-core f64 mean: equal to f32 rounding
+    assert np.allclose(rv[0].fills, rv[1].fills, atol=1e-9)
+    sc = [[s for s in m.stages if type(s).__name__ == "SanityCheckerModel"][0]
+          for m in (m_core, m_stream)]
+    assert sc[0].keep_indices == sc[1].keep_indices
+
+
+def test_streamed_model_scores_close_to_in_core(trained_pair):
+    table, m_core, m_stream = trained_pair
+    pc = [f for f in m_core.result_features][0]
+    ps = [f for f in m_stream.result_features][0]
+    a = np.asarray(m_core.score(table=table)[pc.name].values)
+    b = np.asarray(m_stream.score(table=table)[ps.name].values)
+    # trees bin by SPDT sketch quantiles: documented tolerance, not
+    # bit-equality (docs/streaming.md "Trees") — class agreement + close
+    # probabilities on a well-separated problem
+    assert (a[:, 0] == b[:, 0]).mean() > 0.98
+    assert np.abs(a[:, 1] - b[:, 1]).mean() < 0.05
+
+
+def test_streamed_summary_and_memory_bound(trained_pair):
+    table, _, m_stream = trained_pair
+    st = m_stream.summary()["streaming"]
+    # O(chunk) residency: at most prefetch+1 transformed chunks on device
+    assert st["peakResidentChunks"] <= 2
+    assert st["peakDeviceBytes"] <= 2 * st["maxChunkBytes"]
+    assert st["rows"] == 3000 * (st["chunks"] // (3000 // 450 + 1))
+    # probe train_table stands in for the real one: small, fitted schema
+    assert m_stream.train_table.num_rows <= 256
+
+
+def test_streamed_model_persistence_roundtrip(trained_pair, tmp_path):
+    table, _, m_stream = trained_pair
+    path = str(tmp_path / "streamed_model")
+    m_stream.save(path)
+    from transmogrifai_tpu.workflow import OpWorkflowModel
+    loaded = OpWorkflowModel.load(path)
+    pf = [f for f in m_stream.result_features][0]
+    a = np.asarray(m_stream.score(table=table)[pf.name].values)
+    b = np.asarray(loaded.score(table=table)[pf.name].values)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_streaming_not_supported_stage_raises():
+    table, _, _, _ = _table(500, 3)
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(3)]
+    vec = tg.transmogrify(feats)
+    pred = (tg.BinaryClassificationModelSelector.with_train_validation_split(
+        seed=0).set_input(label, vec).get_output())
+    wf = OpWorkflow().set_result_features(pred)
+    with pytest.raises(StreamingNotSupportedError, match="ModelSelector"):
+        wf.train(stream=TableChunkSource(table, chunk_rows=100))
+
+
+def test_spearman_sanity_checker_rejected_on_stream():
+    table, _, _, _ = _table(500, 3)
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(3)]
+    checked = label.transform_with(
+        SanityChecker(seed=1, correlation_type_spearman=True),
+        tg.transmogrify(feats))
+    pred = (StreamingGBT(problem="binary", num_trees=1, max_depth=2)
+            .set_input(label, checked).get_output())
+    with pytest.raises(ValueError, match="Spearman|ranks"):
+        (OpWorkflow().set_result_features(pred)
+         .train(stream=TableChunkSource(table, chunk_rows=100)))
+
+
+def test_empty_mask_column_streams():
+    """A column that is entirely missing in some (or all) chunks must fold
+    to its fill_value, not NaN."""
+    n = 900
+    rng = np.random.RandomState(3)
+    cols = {
+        "x0": Column(Real, rng.randn(n).astype(np.float32), None),
+        "x1": Column(Real, np.zeros(n, np.float32), np.zeros(n, bool)),
+        "y": Column(RealNN, (rng.rand(n) > 0.5).astype(np.float32), None),
+    }
+    table = FeatureTable(cols, n)
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real("x0").extract_field().as_predictor(),
+             FeatureBuilder.Real("x1").extract_field().as_predictor()]
+    vec = tg.transmogrify(feats)
+    pred = (StreamingGBT(problem="binary", num_trees=1, max_depth=2)
+            .set_input(label, vec).get_output())
+    m = (OpWorkflow().set_result_features(pred)
+         .train(stream=TableChunkSource(table, chunk_rows=200)))
+    rv = [s for s in m.stages if type(s).__name__ == "RealVectorizerModel"][0]
+    assert np.isfinite(rv.fills).all()
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill at every stream site → resume → bit-equal model
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,nth", [
+    ("stream.read", 9), ("stream.upload", 15), ("stream.fold", 22),
+    ("stream.read", 1), ("stream.fold", 1),
+])
+def test_kill_at_stream_site_resumes_bit_equal(site, nth):
+    table, _, _, _ = _table(2000, 6, seed=11)
+    src = TableChunkSource(table, chunk_rows=300)
+
+    def pipeline():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+                 for i in range(6)]
+        checked = label.transform_with(SanityChecker(seed=1),
+                                       tg.transmogrify(feats))
+        return (StreamingGBT(problem="binary", num_trees=1, max_depth=2,
+                             n_bins=8, learning_rate=1.0)
+                .set_input(label, checked).get_output())
+
+    ref = _gbt_of(OpWorkflow().set_result_features(pipeline())
+                  .train(stream=src))
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(pipeline())
+              .with_checkpoint_dir(ck))
+        with pytest.raises(SimulatedPreemption):
+            with faults.injected({site: {"mode": "preempt", "nth": nth}}):
+                wf.train(stream=src)
+        assert not feed_mod.live_feeds()      # the kill tore nothing open
+        resumed = wf.train(resume=True, stream=src)
+        assert _trees_equal(ref, _gbt_of(resumed))
+        res = resumed.summary()["resume"]
+        assert res["requested"] is True
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+
+
+@pytest.mark.chaos
+def test_double_preemption_still_bit_equal():
+    table, _, _, _ = _table(1500, 5, seed=13)
+    src = TableChunkSource(table, chunk_rows=250)
+
+    def pipeline():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+                 for i in range(5)]
+        checked = label.transform_with(SanityChecker(seed=1),
+                                       tg.transmogrify(feats))
+        return (StreamingGBT(problem="binary", num_trees=1, max_depth=2,
+                             n_bins=8, learning_rate=1.0)
+                .set_input(label, checked).get_output())
+
+    ref = _gbt_of(OpWorkflow().set_result_features(pipeline())
+                  .train(stream=src))
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(pipeline())
+              .with_checkpoint_dir(ck))
+        for nth in (5, 3):
+            with pytest.raises(SimulatedPreemption):
+                with faults.injected(
+                        {"stream.fold": {"mode": "preempt", "nth": nth}}):
+                    wf.train(resume=os.path.exists(
+                        os.path.join(ck, "MANIFEST.json")), stream=src)
+        resumed = wf.train(resume=True, stream=src)
+        assert _trees_equal(ref, _gbt_of(resumed))
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+
+
+@pytest.mark.chaos
+def test_corrupt_stream_checkpoint_detected_and_refolded():
+    """Truncating a committed fold state must be detected by checksum; the
+    pass refolds from scratch and the model still comes out bit-equal."""
+    table, _, _, _ = _table(1200, 4, seed=17)
+    src = TableChunkSource(table, chunk_rows=200)
+
+    def pipeline():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+                 for i in range(4)]
+        checked = label.transform_with(SanityChecker(seed=1),
+                                       tg.transmogrify(feats))
+        return (StreamingGBT(problem="binary", num_trees=1, max_depth=2,
+                             n_bins=8, learning_rate=1.0)
+                .set_input(label, checked).get_output())
+
+    ref = _gbt_of(OpWorkflow().set_result_features(pipeline())
+                  .train(stream=src))
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(pipeline())
+              .with_checkpoint_dir(ck))
+        with pytest.raises(SimulatedPreemption):
+            with faults.injected(
+                    {"stream.fold": {"mode": "preempt", "nth": 20}}):
+                wf.train(stream=src)
+        # corrupt every committed stream state
+        for fname in os.listdir(ck):
+            if fname.startswith("stream_"):
+                path = os.path.join(ck, fname)
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                with open(path, "wb") as fh:
+                    fh.write(data[: max(1, len(data) // 2)])
+        resumed = wf.train(resume=True, stream=src)
+        assert _trees_equal(ref, _gbt_of(resumed))
+        skipped = resumed.summary()["faults"]["checkpointsSkipped"]
+        assert any(r["site"] == "stream.checkpoint" for r in skipped)
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)

@@ -31,6 +31,10 @@ _LAZY = {
     "Evaluators": ".evaluators.factory",
     "RetryPolicy": ".robustness.policy",
     "FaultReport": ".robustness.policy",
+    "StreamingGBT": ".streaming.model",
+    "TableChunkSource": ".streaming.source",
+    "AvroChunkSource": ".streaming.source",
+    "SyntheticChunkSource": ".streaming.source",
 }
 
 

@@ -142,6 +142,34 @@ class RealVectorizer(Estimator):
         model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
         return self._finalize_model(model)
 
+    # -- streaming fit (OpWorkflow.train(stream=...), docs/streaming.md) -----
+    def fit_streaming(self, run) -> Transformer:
+        """Mean fills as one chunked col-stats fold: per-column (count, Σx)
+        accumulate in exact f64 exactly like the in-core f64 host path, so
+        the streamed fills agree with in-core fills to the last float
+        rounding of the identical sum/count division."""
+        if not self.fill_with_mean:
+            model = RealVectorizerModel(
+                fills=[self.fill_value] * len(self.input_features),
+                track_nulls=self.track_nulls)
+            return self._finalize_model(model)
+        from ...streaming.folds import ColStatsFold
+        k = len(self.input_features)
+        fold = ColStatsFold(k)
+
+        def extract(table):
+            cols = [table[f.name] for f in self.input_features]
+            X = np.stack([np.asarray(c.values, dtype=np.float64).reshape(-1)
+                          for c in cols], axis=1)
+            mask = np.stack([c.valid_mask() for c in cols], axis=1)
+            return X, mask
+
+        res = fold.finalize(run.fold("fills", fold, extract))
+        fills = [float(res.mean[i]) if res.count[i] > 0 else self.fill_value
+                 for i in range(k)]
+        model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
+        return self._finalize_model(model)
+
 
 def _device_fill_blocks(input_features, fills, track_nulls, env):
     """Shared pure-jax fill+null-track dual used by the fused serve program

@@ -245,112 +245,199 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         sharding_note = getattr(self, "_stats_input_sharding", None)
 
         def finish(host: Dict[str, np.ndarray]) -> Transformer:
-            stats = {k: host[k]
-                     for k in ("count", "mean", "variance", "min", "max")}
-            corr = host["corr"]
-            feature_corr = host.get("feature_corr")
-            cramers_by_col = np.full(d, np.nan)
-            rule_conf_by_col = np.full(d, np.nan)
-            support_by_col = np.full(d, np.nan)
-            group_cramers: Dict[str, float] = {}
-            group_mi: Dict[str, float] = {}
-            group_pmi: Dict[str, List[List[float]]] = {}
-            if groups:
-                counts = host["counts"]
-                off = 0
-                for group, idxs in groups:
-                    m = len(idxs)
-                    cs = _contingency_stats_np(counts[off:off + m])
-                    off += m
-                    group_cramers[group] = cs["cramers_v"]
-                    group_mi[group] = cs["mutual_info"]
-                    group_pmi[group] = [
-                        [round(float(x), 6) for x in r]
-                        for r in cs["pointwise_mutual_info"]]
-                    for j, i_col in enumerate(idxs):
-                        cramers_by_col[i_col] = cs["cramers_v"]
-                        rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
-                        support_by_col[i_col] = cs["support"][j]
-
-            # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
-            reasons: Dict[int, List[str]] = {}
-
-            def flag(i: int, why: str):
-                reasons.setdefault(i, []).append(why)
-
-            for i in range(d):
-                if stats["variance"][i] < self.min_variance:
-                    flag(i, f"variance {stats['variance'][i]:.3g} below min {self.min_variance}")
-                c = corr[i]
-                if not np.isnan(c):
-                    if abs(c) > self.max_correlation:
-                        flag(i, f"label correlation {c:.3f} above max {self.max_correlation} (leakage)")
-                    elif abs(c) < self.min_correlation:
-                        flag(i, f"label correlation {c:.3f} below min {self.min_correlation}")
-                if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
-                    flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
-                if (not np.isnan(rule_conf_by_col[i])
-                        and rule_conf_by_col[i] >= self.max_rule_confidence
-                        and support_by_col[i] >= 0
-                        and support_by_col[i] * n_sample >= self.min_required_rule_support):
-                    flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
-                            f"at/above max {self.max_rule_confidence} (leakage)")
-
-            # feature-group propagation (reference: if one indicator of a pivot
-            # group leaks, the whole group goes). protect_text_shared_hash
-            # exempts shared-hash text columns — a hash slot aggregates many
-            # tokens, so a sibling's leak says nothing about it (reference
-            # reasonsToRemove :821 + isTextSharedHash :840)
-            if self.remove_feature_group and vm is not None and reasons:
-                all_groups = vm.index_of_group()
-                leak = {i for i, why in reasons.items()
-                        if any("leakage" in w or "Cramér" in w for w in why)}
-                for group, idxs in all_groups.items():
-                    if leak.intersection(idxs):
-                        for i in idxs:
-                            if i in reasons:
-                                continue
-                            if (self.protect_text_shared_hash
-                                    and _is_text_shared_hash(vm.columns[i])):
-                                continue
-                            flag(i, f"sibling column in group '{group}' flagged for leakage")
-
-            to_remove = sorted(reasons) if self.remove_bad_features else []
-            keep = [i for i in range(d) if i not in set(to_remove)]
-            if not keep:
-                raise ValueError(
-                    "SanityChecker would remove ALL feature columns — loosen thresholds")
-
-            names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
-            summary = SanityCheckerSummary(
-                stats=ColumnStatistics(
-                    names=names,
-                    count=stats["count"].tolist(),
-                    mean=stats["mean"].tolist(),
-                    variance=stats["variance"].tolist(),
-                    min=stats["min"].tolist(),
-                    max=stats["max"].tolist()),
-                categorical=CategoricalGroupStats(
-                    cramers_v={g: v for g, v in group_cramers.items()},
-                    mutual_info=group_mi,
-                    pointwise_mutual_info=group_pmi),
-                correlations_with_label=[None if np.isnan(c) else float(c)
-                                         for c in corr],
-                correlation_type=("spearman" if self.correlation_type_spearman
-                                  else "pearson"),
-                dropped=[names[i] for i in to_remove],
-                reasons={names[i]: why for i, why in reasons.items()},
-                sample_size=n_sample,
-                feature_correlations=feature_corr,
-            )
-            model = SanityCheckerModel(keep_indices=keep, summary=summary)
-            model.summary_metadata = summary.to_json()
-            # diagnostic: how the stats pass was placed (asserted by the
-            # multichip dryrun — 'data'-sharded under with_mesh)
-            model._stats_input_sharding = sharding_note
-            return self._finalize_model(model)
+            return self._finish_from_host(host, d=d, vm=vm, groups=groups,
+                                          n_sample=n_sample,
+                                          sharding_note=sharding_note)
 
         return PendingFit(dev, finish)
+
+    # -- streaming fit (OpWorkflow.train(stream=...), docs/streaming.md) -----
+    def fit_streaming(self, run) -> Transformer:
+        """One chunked pass of monoid folds — the out-of-core dual of the
+        device stats pass: col moments, label correlations (co-moment
+        merge), optional full correlation matrix, and contingency counts
+        all accumulate in exact-f64 host folds and feed the SAME
+        ``_finish_from_host`` decision logic the in-core fit uses. Two
+        documented deviations: no sampling (the stream folds every row —
+        ``check_sample``/limits describe the in-core reservoir) and no
+        Spearman (exact streaming ranks need a sort over the full
+        dataset)."""
+        from ...streaming.folds import (
+            ColStatsFold, CompositeFold, ContingencyFold, CorrelationFold,
+        )
+        if self.correlation_type_spearman:
+            raise ValueError(
+                "SanityChecker(correlation_type_spearman=True) cannot fit "
+                "on a stream: exact ranks need the full dataset. Use "
+                "Pearson, or train in-core.")
+        label_f, vec_f = self.input_features
+        probe = run.probe_table()
+        col = probe[vec_f.name]
+        vm: Optional[VectorMetadata] = col.metadata.get("vector_meta")
+        d = col.width
+
+        groups: List[Any] = []
+        all_idx = np.zeros(0, np.int64)
+        if vm is not None:
+            groups = [(g, idxs) for g, idxs in vm.index_of_group().items()
+                      if all(vm.columns[i].indicator_value is not None
+                             for i in idxs)]
+            if groups:
+                all_idx = np.concatenate(
+                    [np.asarray(idxs) for _, idxs in groups])
+        folds: Dict[str, Any] = {
+            "stats": ColStatsFold(d),
+            "corr": CorrelationFold(
+                d, full=getattr(self, "correlations", "label") == "full"),
+        }
+        if groups:
+            folds["cont"] = ContingencyFold(len(all_idx))
+        composite = CompositeFold(folds)
+
+        def extract(table: FeatureTable):
+            X = np.asarray(table[vec_f.name].values, dtype=np.float32)
+            y = np.asarray(table[label_f.name].values,
+                           dtype=np.float32).reshape(-1)
+            parts = {"stats": (X,), "corr": (X, y)}
+            if groups:
+                parts["cont"] = (X[:, all_idx], y)
+            return (parts,)
+
+        state = run.fold("sanity", composite, extract)
+        res = composite.finalize(state)
+        stats = res["stats"]
+        host: Dict[str, np.ndarray] = {
+            "count": stats.count, "mean": stats.mean,
+            "variance": stats.variance, "min": stats.min, "max": stats.max,
+            "corr": res["corr"],
+        }
+        if folds["corr"].full:
+            host["feature_corr"] = folds["corr"].finalize_matrix(
+                state["corr"])
+        n_sample = int(state["corr"]["n"])
+        if groups:
+            counts = res["cont"]
+            if counts is None:
+                # labels were not binary-like: same branch as in-core
+                groups = []
+            else:
+                host["counts"] = counts.astype(np.float64)
+        return self._finish_from_host(host, d=d, vm=vm, groups=groups,
+                                      n_sample=n_sample)
+
+    def _finish_from_host(self, host: Dict[str, np.ndarray], *, d: int,
+                          vm: Optional[VectorMetadata], groups: List[Any],
+                          n_sample: int,
+                          sharding_note: Optional[str] = None) -> Transformer:
+        """Column decisions from the materialized stat arrays — shared by
+        the device fit (``fit_queued``) and the streaming fold fit
+        (``fit_streaming``): both paths hand the identical host dict
+        (count/mean/variance/min/max, corr, optional feature_corr, stacked
+        contingency counts) to the identical removal logic."""
+        stats = {k: host[k]
+                 for k in ("count", "mean", "variance", "min", "max")}
+        corr = host["corr"]
+        feature_corr = host.get("feature_corr")
+        cramers_by_col = np.full(d, np.nan)
+        rule_conf_by_col = np.full(d, np.nan)
+        support_by_col = np.full(d, np.nan)
+        group_cramers: Dict[str, float] = {}
+        group_mi: Dict[str, float] = {}
+        group_pmi: Dict[str, List[List[float]]] = {}
+        if groups:
+            counts = host["counts"]
+            off = 0
+            for group, idxs in groups:
+                m = len(idxs)
+                cs = _contingency_stats_np(counts[off:off + m])
+                off += m
+                group_cramers[group] = cs["cramers_v"]
+                group_mi[group] = cs["mutual_info"]
+                group_pmi[group] = [
+                    [round(float(x), 6) for x in r]
+                    for r in cs["pointwise_mutual_info"]]
+                for j, i_col in enumerate(idxs):
+                    cramers_by_col[i_col] = cs["cramers_v"]
+                    rule_conf_by_col[i_col] = cs["max_rule_confidence"][j]
+                    support_by_col[i_col] = cs["support"][j]
+
+        # removal reasons (reference ColumnStatistics.reasonsToRemove :783-832)
+        reasons: Dict[int, List[str]] = {}
+
+        def flag(i: int, why: str):
+            reasons.setdefault(i, []).append(why)
+
+        for i in range(d):
+            if stats["variance"][i] < self.min_variance:
+                flag(i, f"variance {stats['variance'][i]:.3g} below min {self.min_variance}")
+            c = corr[i]
+            if not np.isnan(c):
+                if abs(c) > self.max_correlation:
+                    flag(i, f"label correlation {c:.3f} above max {self.max_correlation} (leakage)")
+                elif abs(c) < self.min_correlation:
+                    flag(i, f"label correlation {c:.3f} below min {self.min_correlation}")
+            if not np.isnan(cramers_by_col[i]) and cramers_by_col[i] > self.max_cramers_v:
+                flag(i, f"Cramér's V {cramers_by_col[i]:.3f} above max {self.max_cramers_v}")
+            if (not np.isnan(rule_conf_by_col[i])
+                    and rule_conf_by_col[i] >= self.max_rule_confidence
+                    and support_by_col[i] >= 0
+                    and support_by_col[i] * n_sample >= self.min_required_rule_support):
+                flag(i, f"association rule confidence {rule_conf_by_col[i]:.3f} "
+                        f"at/above max {self.max_rule_confidence} (leakage)")
+
+        # feature-group propagation (reference: if one indicator of a pivot
+        # group leaks, the whole group goes). protect_text_shared_hash
+        # exempts shared-hash text columns — a hash slot aggregates many
+        # tokens, so a sibling's leak says nothing about it (reference
+        # reasonsToRemove :821 + isTextSharedHash :840)
+        if self.remove_feature_group and vm is not None and reasons:
+            all_groups = vm.index_of_group()
+            leak = {i for i, why in reasons.items()
+                    if any("leakage" in w or "Cramér" in w for w in why)}
+            for group, idxs in all_groups.items():
+                if leak.intersection(idxs):
+                    for i in idxs:
+                        if i in reasons:
+                            continue
+                        if (self.protect_text_shared_hash
+                                and _is_text_shared_hash(vm.columns[i])):
+                            continue
+                        flag(i, f"sibling column in group '{group}' flagged for leakage")
+
+        to_remove = sorted(reasons) if self.remove_bad_features else []
+        keep = [i for i in range(d) if i not in set(to_remove)]
+        if not keep:
+            raise ValueError(
+                "SanityChecker would remove ALL feature columns — loosen thresholds")
+
+        names = vm.column_names() if vm is not None else [f"c{i}" for i in range(d)]
+        summary = SanityCheckerSummary(
+            stats=ColumnStatistics(
+                names=names,
+                count=stats["count"].tolist(),
+                mean=stats["mean"].tolist(),
+                variance=stats["variance"].tolist(),
+                min=stats["min"].tolist(),
+                max=stats["max"].tolist()),
+            categorical=CategoricalGroupStats(
+                cramers_v={g: v for g, v in group_cramers.items()},
+                mutual_info=group_mi,
+                pointwise_mutual_info=group_pmi),
+            correlations_with_label=[None if np.isnan(c) else float(c)
+                                     for c in corr],
+            correlation_type=("spearman" if self.correlation_type_spearman
+                              else "pearson"),
+            dropped=[names[i] for i in to_remove],
+            reasons={names[i]: why for i, why in reasons.items()},
+            sample_size=n_sample,
+            feature_correlations=feature_corr,
+        )
+        model = SanityCheckerModel(keep_indices=keep, summary=summary)
+        model.summary_metadata = summary.to_json()
+        # diagnostic: how the stats pass was placed (asserted by the
+        # multichip dryrun — 'data'-sharded under with_mesh)
+        model._stats_input_sharding = sharding_note
+        return self._finalize_model(model)
 
 
 class SanityCheckerModel(AllowLabelAsInput, Transformer):

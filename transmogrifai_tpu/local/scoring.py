@@ -14,7 +14,7 @@ from __future__ import annotations
 import logging
 import time
 
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -170,20 +170,45 @@ def serve_table_builder(model) -> Callable[[Sequence[Dict[str, Any]]], FeatureTa
     :func:`micro_batch_score_function`, the serving runtime
     (``serving/runtime.py``), and the warm-start plan fingerprint
     (``serving/warmup.py``) — all three must build byte-identical tables or
-    the fingerprinted plan cache would miss on the first real request."""
+    the fingerprinted plan cache would miss on the first real request.
+
+    Homogeneous numeric batches — the overwhelmingly common serve shape —
+    take a vectorized path: plain-field extractors gather with one dict
+    lookup per cell and convert through ``table.column_of_scalars`` (one
+    numpy sweep) instead of ``Column.of_values``'s per-cell python loop;
+    anything non-homogeneous (a None, a string, a custom extractor) falls
+    back to the exact original path, so outputs are byte-identical
+    (docs/benchmarks.md "Serving runtime" has the before/after)."""
+    from ..readers.readers import _field_name_of
+    from ..table import column_of_scalars
     raw_features = model.raw_features
+    #: (feature, plain record field to gather, or None → stage.extract)
+    extractors = [(f, _field_name_of(f.origin_stage.extract_fn))
+                  for f in raw_features]
 
     def build(rows: Sequence[Dict[str, Any]]) -> FeatureTable:
         cols = {}
-        for f in raw_features:
-            vals = [f.origin_stage.extract(r) for r in rows]
-            try:
-                cols[f.name] = Column.of_values(f.feature_type, vals)
-            except (TypeError, ValueError) as e:
-                raise ScoreSchemaError(
-                    f"raw feature '{f.name}' ({f.type_name}): value does "
-                    f"not conform to the fitted schema "
-                    f"({type(e).__name__}: {e})") from e
+        dict_rows = all(isinstance(r, dict) for r in rows)
+        for f, field in extractors:
+            col = None
+            if field is not None and dict_rows:
+                # fast gather skips the FeatureType-unwrap extract() makes;
+                # a wrapper (or any non-scalar) fails the numpy sweep and
+                # re-extracts below, so semantics never diverge
+                col = column_of_scalars(
+                    f.feature_type, [r.get(field) for r in rows])
+            if col is None:
+                vals = [f.origin_stage.extract(r) for r in rows]
+                col = column_of_scalars(f.feature_type, vals)
+            if col is None:
+                try:
+                    col = Column.of_values(f.feature_type, vals)
+                except (TypeError, ValueError) as e:
+                    raise ScoreSchemaError(
+                        f"raw feature '{f.name}' ({f.type_name}): value "
+                        f"does not conform to the fitted schema "
+                        f"({type(e).__name__}: {e})") from e
+            cols[f.name] = col
         return FeatureTable(cols, len(rows))
 
     return build
@@ -196,22 +221,30 @@ def serve_record_builder(model) -> Callable[[FeatureTable, int], List[Dict[str, 
     result_features = model.result_features
 
     def records(scored: FeatureTable, n: int) -> List[Dict[str, Any]]:
+        # columnar → row-major in one ``tolist()`` C sweep per column
+        # (identical python values: tolist() and .item() both produce the
+        # nearest python float/int), instead of a numpy scalar indexing +
+        # .item() round-trip per cell — with the table build, this was the
+        # serve hot path (docs/benchmarks.md "Serving runtime")
+        per_col: List[Tuple[str, Optional[list], list, Optional[Tuple]]] = []
+        for f in result_features:
+            col = scored[f.name]
+            masks = None if col.mask is None else \
+                np.asarray(col.mask).tolist()
+            vals = np.asarray(col.values).tolist()
+            keys = (tuple(col.metadata.get("keys", ()))
+                    if f.type_name == "Prediction" else None)
+            per_col.append((f.name, masks, vals, keys))
         out: List[Dict[str, Any]] = []
         for i in range(n):
             rec: Dict[str, Any] = {}
-            for f in result_features:
-                col = scored[f.name]
-                valid = col.mask is None or bool(np.asarray(col.mask)[i])
-                if not valid:
-                    rec[f.name] = None
-                    continue
-                v = np.asarray(col.values)[i]
-                if f.type_name == "Prediction":
-                    keys = col.metadata.get("keys", ())
-                    rec[f.name] = {k: float(x) for k, x in zip(keys, v)}
+            for name, masks, vals, keys in per_col:
+                if masks is not None and not masks[i]:
+                    rec[name] = None
+                elif keys is not None:
+                    rec[name] = dict(zip(keys, vals[i]))
                 else:
-                    rec[f.name] = v.tolist() if isinstance(v, np.ndarray) else (
-                        v.item() if isinstance(v, np.generic) else v)
+                    rec[name] = vals[i]
             out.append(rec)
         return out
 

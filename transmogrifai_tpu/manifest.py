@@ -105,6 +105,10 @@ class CheckpointManifest:
         self.files: Dict[str, Dict[str, Any]] = {}
         self.stages: Dict[str, Dict[str, Any]] = {}
         self.sweeps: Dict[str, Dict[str, Any]] = {}
+        #: streaming fold states: per (stage, pass) completion records with
+        #: the last committed chunk index (streaming/checkpoint.py; absent
+        #: on pre-streaming manifests — loaders must tolerate that)
+        self.streams: Dict[str, Dict[str, Any]] = {}
         #: optional warm-start hint for saved models: the serve-path plan
         #: schema fingerprint the registry pre-traces at load
         #: (serving/warmup.py; absent/empty on stage-checkpoint dirs and
@@ -141,6 +145,7 @@ class CheckpointManifest:
         m.stages = dict(doc.get("stages", {}))
         m.sweeps = dict(doc.get("sweeps", {}))
         m.serving = dict(doc.get("serving", {}))
+        m.streams = dict(doc.get("streams", {}))
         return m, None
 
     def save(self) -> None:
@@ -154,6 +159,8 @@ class CheckpointManifest:
         }
         if self.serving:
             doc["serving"] = self.serving
+        if self.streams:
+            doc["streams"] = self.streams
         atomic_write_json(self.path, doc, indent=1)
 
     # -- recording -----------------------------------------------------------
@@ -168,6 +175,21 @@ class CheckpointManifest:
 
     def complete_sweep(self, owner_uid: str, fname: str) -> None:
         self.sweeps[owner_uid] = {"file": fname}
+
+    def complete_stream(self, key: str, fname: str,
+                        meta: Dict[str, Any]) -> None:
+        """Commit a streaming fold state: ``key`` is ``<stage uid>/<pass>``,
+        ``meta`` records the source fingerprint + last folded chunk. The
+        manifest save that follows is the commit point — a kill before it
+        leaves the previous committed chunk authoritative."""
+        self.streams[key] = {"file": fname, **meta}
+
+    def drop_streams(self, stage_uid: str) -> None:
+        """Forget a stage's stream states (after its full stage checkpoint
+        commits, the per-pass fold states are redundant)."""
+        for key in [k for k in self.streams
+                    if k.split("/", 1)[0] == stage_uid]:
+            del self.streams[key]
 
     # -- verification --------------------------------------------------------
     def verify_file(self, fname: str) -> Optional[str]:

@@ -118,6 +118,20 @@ void sh_merge(SHist* dst, const SHist* src) {
   compress(dst);
 }
 
+// Replace the sketch's whole state (checkpoint restore / host-normalized
+// merge write-back). Bins must arrive sorted by centroid; compress() keeps
+// the max_bins invariant if the caller hands more.
+void sh_load(SHist* h, const double* centers, const double* masses, int64_t n,
+             double total, double min_v, double max_v) {
+  h->bins.clear();
+  h->bins.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) h->bins.push_back(Bin{centers[i], masses[i]});
+  h->total = total;
+  h->min_v = min_v;
+  h->max_v = max_v;
+  compress(h);
+}
+
 int64_t sh_num_bins(const SHist* h) {
   return static_cast<int64_t>(h->bins.size());
 }

@@ -46,6 +46,18 @@ site                         fires in
                              degrades the batch to the eager path; like
                              ``plan.*``, ``serve.*`` sites do NOT disable
                              the transform planner)
+``stream.read``              in the chunk-feed producer thread, before each
+                             chunk is pulled from the ChunkSource
+                             (streaming/feed.py; errors — preemption
+                             included — forward through the bounded queue
+                             and re-raise in the consumer)
+``stream.upload``            in the producer, before the chunk's packed
+                             host→device upload (``to_device``)
+``stream.fold``              in the consumer, before a chunk folds into the
+                             estimator's monoid state (key = pass id);
+                             ``mode: "preempt"`` here is the canonical
+                             kill-mid-epoch test — resume continues from
+                             the last committed chunk bit-exactly
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,

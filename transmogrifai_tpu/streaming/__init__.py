@@ -1,0 +1,24 @@
+"""Out-of-core streaming training (docs/streaming.md).
+
+Datasets larger than device (and host) memory train as chunked monoid
+folds: a :class:`ChunkSource` yields fixed-row-budget FeatureTable chunks,
+a double-buffered :class:`DeviceFeed` packs + uploads chunk N+1 while
+chunk N folds, estimator fits run as accumulate/merge/finalize monoids
+(:mod:`.folds`), and per-chunk checkpoints through the PR 2 manifest make
+a kill at any ``stream.*`` chaos site resume bit-exactly. Entry point:
+``OpWorkflow.train(stream=source)``.
+"""
+from .checkpoint import StreamCheckpoint  # noqa: F401
+from .feed import DeviceFeed, FeedStats, device_bytes, live_feeds  # noqa: F401
+from .folds import (  # noqa: F401
+    ArraySumFold, ColStatsFold, CompositeFold, ContingencyFold,
+    CorrelationFold, HistogramFold, MonoidFold,
+)
+from .model import StreamingGBT, StreamingGBTModel  # noqa: F401
+from .source import (  # noqa: F401
+    AvroChunkSource, Chunk, ChunkSource, SyntheticChunkSource,
+    TableChunkSource, env_chunk_rows,
+)
+from .trainer import (  # noqa: F401
+    StreamingNotSupportedError, StreamRun, fit_dag_streaming,
+)

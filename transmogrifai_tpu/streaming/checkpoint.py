@@ -1,0 +1,111 @@
+"""Per-chunk fold-state checkpointing through the integrity manifest.
+
+Every ``TG_STREAM_CKPT_EVERY`` chunks (default 1) the in-flight fold state
+serializes to an npz written atomically (tmp + fsync + rename,
+manifest.atomic_write_bytes) and commits through the checkpoint
+directory's ``MANIFEST.json`` ``streams`` section — the same
+write-then-commit protocol stage checkpoints use (PR 2), so a kill at ANY
+instruction leaves either the previous committed chunk or the new one
+authoritative, never a torn state:
+
+* the state file for chunk ``k`` gets a fresh name (``...:<k>.npz``); the
+  previous chunk's file is deleted only AFTER the manifest commit, so a
+  kill between payload write and commit leaves the old record intact;
+* every record embeds the source fingerprint + pass id + stage uid;
+  restore verifies all three plus the sha256 before trusting a state, and
+  refolds the pass from scratch (deterministically identical) on any
+  mismatch — corruption is detected and reported, never silently used.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..manifest import CheckpointManifest, atomic_write_bytes
+from ..robustness.policy import FaultLog, FaultReport
+
+CKPT_EVERY_ENV = "TG_STREAM_CKPT_EVERY"
+
+#: chunk marker recorded when a pass's fold is complete
+PASS_COMPLETE = -1
+
+
+def env_ckpt_every() -> int:
+    try:
+        return max(1, int(os.environ.get(CKPT_EVERY_ENV, "") or 1))
+    except ValueError:
+        return 1
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+class StreamCheckpoint:
+    """Fold-state persistence for one checkpoint directory + one source."""
+
+    def __init__(self, dirpath: str, manifest: CheckpointManifest,
+                 source_fingerprint: str):
+        self.dirpath = dirpath
+        self.manifest = manifest
+        self.fingerprint = source_fingerprint
+        self.every = env_ckpt_every()
+
+    def _fname(self, key: str, chunk: int) -> str:
+        safe = key.replace("/", "_").replace(":", "_")
+        return f"stream_{safe}_{max(chunk, 0)}.npz"
+
+    # -- commit ---------------------------------------------------------------
+    def commit(self, key: str, arrays: Dict[str, np.ndarray],
+               next_chunk: int) -> None:
+        """Persist ``arrays`` as the fold state with chunks < ``next_chunk``
+        folded in (``PASS_COMPLETE`` = the pass finished)."""
+        os.makedirs(self.dirpath, exist_ok=True)
+        rec = self.manifest.streams.get(key)
+        prev_file = rec.get("file") if rec else None
+        fname = self._fname(key, next_chunk)
+        data = _npz_bytes(arrays)
+        sha = atomic_write_bytes(os.path.join(self.dirpath, fname), data)
+        self.manifest.record_file(fname, sha, len(data))
+        self.manifest.complete_stream(key, fname, {
+            "fingerprint": self.fingerprint, "chunk": int(next_chunk)})
+        if prev_file and prev_file != fname:
+            self.manifest.files.pop(prev_file, None)
+        self.manifest.save()          # ← the commit point
+        if prev_file and prev_file != fname:
+            try:
+                os.remove(os.path.join(self.dirpath, prev_file))
+            except OSError:
+                pass
+
+    # -- restore --------------------------------------------------------------
+    def restore(self, key: str) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        """(state arrays, next chunk to fold) — ``(None, 0)`` when nothing
+        committed/verifiable for this key+fingerprint. A verified complete
+        pass returns ``(state, PASS_COMPLETE)``."""
+        rec = self.manifest.streams.get(key)
+        if rec is None:
+            return None, 0
+        reason = None
+        if rec.get("fingerprint") != self.fingerprint:
+            reason = ("source fingerprint mismatch — resumed against "
+                      "different data or chunking")
+        else:
+            reason = self.manifest.verify_file(rec["file"])
+        if reason is None:
+            try:
+                with np.load(os.path.join(self.dirpath, rec["file"]),
+                             allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+                return arrays, int(rec.get("chunk", 0))
+            except (OSError, ValueError) as e:
+                reason = f"unreadable state: {type(e).__name__}: {e}"
+        FaultLog.record(FaultReport(
+            site="stream.checkpoint", kind="checkpoint_skipped",
+            detail={"key": key, "file": rec.get("file"), "reason": reason}))
+        return None, 0

@@ -1,0 +1,273 @@
+"""Double-buffered host→device chunk feed.
+
+While the consumer folds chunk N, a single producer thread prepares chunk
+N+1: pulls it from the :class:`~.source.ChunkSource` (chaos site
+``stream.read``), applies the already-fitted upstream transformers
+host-side, and uploads the packed per-dtype blocks via
+``FeatureTable.to_device()`` (chaos site ``stream.upload``; the PR 4
+packed path, counted in ``tg_transfer_bytes_total{direction="h2d"}``).
+A bounded queue of depth ``prefetch`` (TG_STREAM_PREFETCH, default 1)
+keeps host+device residency at O(prefetch + 1 chunks) — never O(dataset).
+
+Accounting (:class:`FeedStats`) is what the stream bench line reports:
+uploaded bytes, peak concurrently-resident device bytes (the O(chunk)
+claim, asserted in tests), and the overlap fraction — the share of
+consumer wall-clock NOT stalled waiting on the feed.
+
+Error contract: any exception in the producer — ``SimulatedPreemption``
+(a BaseException, modeling a kill mid-read/mid-upload) included — is
+forwarded through the queue and re-raised in the consumer thread, so a
+streamed ``train()`` dies exactly like an in-core one would, with the
+last committed chunk checkpoint intact.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import metrics as _obs_metrics
+from ..robustness import faults
+from ..table import DEVICE_KINDS, FeatureTable
+from .source import Chunk
+
+PREFETCH_ENV = "TG_STREAM_PREFETCH"
+DEFAULT_PREFETCH = 1
+
+#: live feeds (weak) — the conftest no-leak fixture asserts none survive
+_LIVE: "weakref.WeakSet[DeviceFeed]" = weakref.WeakSet()
+
+
+def live_feeds() -> List["DeviceFeed"]:
+    return [f for f in list(_LIVE) if not f.closed]
+
+
+def env_prefetch(prefetch: Optional[int] = None) -> int:
+    if prefetch is not None:
+        return max(1, int(prefetch))
+    try:
+        return max(1, int(os.environ.get(PREFETCH_ENV, "")
+                          or DEFAULT_PREFETCH))
+    except ValueError:
+        return DEFAULT_PREFETCH
+
+
+def device_bytes(table: FeatureTable) -> int:
+    """Bytes of device-kind column storage a chunk pins while resident."""
+    total = 0
+    for name in table.column_names:
+        col = table[name]
+        if col.kind not in DEVICE_KINDS:
+            continue
+        vals = col.values
+        total += int(np.dtype(getattr(vals, "dtype", np.float32)).itemsize
+                     * int(np.prod(np.shape(vals))))
+        if col.mask is not None:
+            total += int(np.shape(col.mask)[0])
+    return total
+
+
+@dataclass
+class FeedStats:
+    chunks: int = 0
+    rows: int = 0
+    upload_bytes: int = 0
+    max_chunk_bytes: int = 0
+    peak_device_bytes: int = 0
+    peak_resident_chunks: int = 0
+    upload_seconds: float = 0.0
+    wait_seconds: float = 0.0
+    wall_seconds: float = 0.0
+
+    def overlap_fraction(self) -> float:
+        """Share of consumer wall-clock NOT stalled on the feed: 1.0 means
+        read+transform+upload hid entirely behind fold compute."""
+        if self.wall_seconds <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.wait_seconds / self.wall_seconds)
+
+    def merge(self, other: "FeedStats") -> "FeedStats":
+        self.chunks += other.chunks
+        self.rows += other.rows
+        self.upload_bytes += other.upload_bytes
+        self.max_chunk_bytes = max(self.max_chunk_bytes,
+                                   other.max_chunk_bytes)
+        self.peak_device_bytes = max(self.peak_device_bytes,
+                                     other.peak_device_bytes)
+        self.peak_resident_chunks = max(self.peak_resident_chunks,
+                                        other.peak_resident_chunks)
+        self.upload_seconds += other.upload_seconds
+        self.wait_seconds += other.wait_seconds
+        self.wall_seconds += other.wall_seconds
+        return self
+
+    def to_json(self) -> dict:
+        return {
+            "chunks": self.chunks, "rows": self.rows,
+            "uploadBytes": self.upload_bytes,
+            "maxChunkBytes": self.max_chunk_bytes,
+            "peakDeviceBytes": self.peak_device_bytes,
+            "peakResidentChunks": self.peak_resident_chunks,
+            "uploadSeconds": round(self.upload_seconds, 4),
+            "waitSeconds": round(self.wait_seconds, 4),
+            "overlapFraction": round(self.overlap_fraction(), 4),
+        }
+
+
+class DeviceFeed:
+    """Iterate device-resident chunks with one prefetching producer thread.
+
+    Usage (always close — ``with`` or the trainer's finally)::
+
+        with DeviceFeed(source.chunks(), transforms=models) as feed:
+            for chunk in feed:
+                ...fold chunk.table...
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, chunks: Iterable[Chunk],
+                 transforms: Sequence[Any] = (),
+                 prefetch: Optional[int] = None,
+                 to_device: bool = True):
+        self._chunks = iter(chunks)
+        self._transforms = list(transforms)
+        self.prefetch = env_prefetch(prefetch)
+        self._to_device = to_device
+        self.stats = FeedStats()
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.prefetch + 1)
+        #: production gate: the producer may hold at most ``prefetch``
+        #: chunks beyond the one being consumed — acquired BEFORE a chunk
+        #: is read/transformed/uploaded, released when the consumer takes
+        #: the next chunk. This is what makes residency O(prefetch + 1),
+        #: not O(prefetch + 2): without the gate the producer would prepare
+        #: chunk N+2 while N+1 sits queued and N is being consumed.
+        self._slots = threading.Semaphore(self.prefetch)
+        self._stop = threading.Event()
+        self._resident = 0           # device bytes of yielded-but-live chunks
+        self._resident_chunks = 0
+        self._lock = threading.Lock()
+        self._prev_bytes = 0
+        self.closed = False
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._produce, name="tg-stream-feed", daemon=True)
+        _LIVE.add(self)
+        self._thread.start()
+
+    # -- producer -------------------------------------------------------------
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self._slots.acquire(timeout=0.1):
+                    continue
+                faults.inject("stream.read")
+                try:
+                    chunk = next(self._chunks)
+                except StopIteration:
+                    self._put((self._SENTINEL, None))
+                    return
+                table = chunk.table
+                for model in self._transforms:
+                    table = model.transform(table)
+                t0 = time.perf_counter()
+                faults.inject("stream.upload")
+                if self._to_device:
+                    table = table.to_device()
+                nbytes = device_bytes(table)
+                self.stats.upload_seconds += time.perf_counter() - t0
+                self.stats.upload_bytes += nbytes
+                with self._lock:
+                    self._resident += nbytes
+                    self._resident_chunks += 1
+                    self.stats.max_chunk_bytes = max(
+                        self.stats.max_chunk_bytes, nbytes)
+                    self.stats.peak_device_bytes = max(
+                        self.stats.peak_device_bytes, self._resident)
+                    self.stats.peak_resident_chunks = max(
+                        self.stats.peak_resident_chunks,
+                        self._resident_chunks)
+                self._put((Chunk(chunk.index, chunk.chunk_id, table), nbytes))
+        except BaseException as e:  # noqa: BLE001 — preemption must forward
+            self._put((self._SENTINEL, e))
+
+    def _put(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer -------------------------------------------------------------
+    def __iter__(self) -> Iterator[Chunk]:
+        return self
+
+    def __next__(self) -> Chunk:
+        self._release_prev()
+        t0 = time.perf_counter()
+        while True:
+            try:
+                item, extra = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    raise RuntimeError(
+                        "stream feed producer died without a sentinel")
+        self.stats.wait_seconds += time.perf_counter() - t0
+        self._slots.release()
+        if item is self._SENTINEL:
+            self.stats.wall_seconds = time.perf_counter() - self._t0
+            if extra is not None:
+                self.close()
+                raise extra
+            self.close()
+            raise StopIteration
+        self._prev_bytes = extra
+        self.stats.chunks += 1
+        self.stats.rows += item.rows
+        return item
+
+    def _release_prev(self) -> None:
+        if self._prev_bytes:
+            with self._lock:
+                self._resident -= self._prev_bytes
+                self._resident_chunks -= 1
+            self._prev_bytes = 0
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._stop.set()
+        # drain so a blocked producer put() unblocks and exits
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        if self.stats.wall_seconds == 0.0:
+            self.stats.wall_seconds = time.perf_counter() - self._t0
+        if _obs_metrics.metrics_enabled():
+            _obs_metrics.inc_counter(
+                "tg_stream_chunks_total", float(self.stats.chunks),
+                help="chunks consumed through the streaming device feed")
+            _obs_metrics.inc_counter(
+                "tg_stream_rows_total", float(self.stats.rows),
+                help="rows consumed through the streaming device feed")
+            _obs_metrics.observe(
+                "tg_stream_wait_seconds", self.stats.wait_seconds,
+                help="consumer seconds stalled waiting on the chunk feed")
+
+    def __enter__(self) -> "DeviceFeed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

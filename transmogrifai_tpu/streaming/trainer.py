@@ -1,0 +1,196 @@
+"""Streaming DAG trainer: layer-wise estimator fits as chunk folds.
+
+The streaming dual of ``dag.fit_and_transform_dag``: estimators fit
+layer-by-layer, but instead of one in-memory table each fit makes one or
+more *passes* over the :class:`~.source.ChunkSource` through the
+double-buffered :class:`~.feed.DeviceFeed`, with every chunk transformed
+through the already-fitted upstream stages inside the producer thread (so
+transform + upload overlap the fold compute). An estimator opts in by
+implementing::
+
+    def fit_streaming(self, run: StreamRun) -> Transformer
+
+and drives its passes through ``run.fold(pass_id, fold, extract)`` — which
+is where chunk checkpointing (streaming/checkpoint.py), the ``stream.fold``
+chaos site, observability spans, and the O(chunk) memory bound all live.
+Estimators without the hook fail the train with a descriptive error
+(docs/streaming.md "What can stream") — a streamed fit must never silently
+materialize the dataset.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.trace import span as _obs_span
+from ..robustness import faults
+from ..robustness.policy import FaultLog, FaultReport
+from ..stages.base import Estimator, Transformer
+from ..table import FeatureTable
+from .checkpoint import PASS_COMPLETE, StreamCheckpoint
+from .feed import DeviceFeed, FeedStats
+from .folds import MonoidFold
+from .source import ChunkSource
+
+
+class StreamingNotSupportedError(TypeError):
+    """A DAG stage cannot fit as a streaming fold. Names the stage and the
+    hook it would need — the streamed train fails up front instead of
+    materializing the dataset behind the caller's back."""
+
+
+class StreamRun:
+    """One estimator's view of the stream: fold passes + a schema probe."""
+
+    def __init__(self, source: ChunkSource, upstream: List[Transformer],
+                 stage_uid: str, checkpoint: Optional[StreamCheckpoint] = None,
+                 prefetch: Optional[int] = None,
+                 stats: Optional[FeedStats] = None):
+        self.source = source
+        self.upstream = list(upstream)
+        self.stage_uid = stage_uid
+        self.checkpoint = checkpoint
+        self.prefetch = prefetch
+        self.stats = stats if stats is not None else FeedStats()
+        self._probe: Optional[FeatureTable] = None
+
+    @property
+    def num_chunks(self) -> int:
+        return self.source.num_chunks
+
+    @property
+    def chunk_rows(self) -> int:
+        return self.source.chunk_rows
+
+    def probe_table(self, rows: int = 256) -> FeatureTable:
+        """A small transformed head-of-stream table for schema/metadata
+        (vector widths, vector_meta groups) — never the data itself."""
+        if self._probe is None:
+            chunk = next(iter(self.source.chunks(0)))
+            table = chunk.table
+            if table.num_rows > rows:
+                table = table.take(np.arange(rows))
+            for model in self.upstream:
+                table = model.transform(table)
+            self._probe = table
+        return self._probe
+
+    def fold(self, pass_id: str, fold: MonoidFold,
+             extract: Callable[[FeatureTable], Tuple]) -> Any:
+        """Run one full pass: ``state = fold(extract(chunk) for chunks)``.
+
+        Restores a committed state for this (stage, pass) and continues
+        from the next un-folded chunk; commits after every
+        TG_STREAM_CKPT_EVERY chunks and marks the pass complete at the
+        end — so a resumed train re-executes no completed pass and no
+        committed chunk, bit-exactly."""
+        key = f"{self.stage_uid}/{pass_id}"
+        state, start = None, 0
+        if self.checkpoint is not None:
+            arrays, start = self.checkpoint.restore(key)
+            if arrays is not None:
+                state = fold.state_from_arrays(arrays)
+                if start == PASS_COMPLETE:
+                    FaultLog.record(FaultReport(
+                        site="stream.fold", kind="restored",
+                        detail={"key": key, "pass": pass_id}))
+                    return state
+                FaultLog.record(FaultReport(
+                    site="stream.fold", kind="restored",
+                    detail={"key": key, "pass": pass_id,
+                            "fromChunk": start}))
+        if state is None:
+            state, start = fold.zero(), 0
+        every = self.checkpoint.every if self.checkpoint is not None else 0
+        with _obs_span("stream.pass", cat="train", uid=self.stage_uid,
+                       passId=pass_id, fromChunk=start), \
+                DeviceFeed(self.source.chunks(start),
+                           transforms=self.upstream,
+                           prefetch=self.prefetch) as feed:
+            for chunk in feed:
+                faults.inject("stream.fold", key=pass_id)
+                state = fold.accumulate(state, *extract(chunk.table))
+                done = chunk.index + 1
+                if (self.checkpoint is not None
+                        and done < self.num_chunks
+                        and (done - start) % every == 0):
+                    self.checkpoint.commit(
+                        key, fold.state_to_arrays(state), done)
+            self.stats.merge(feed.stats)
+        if self.checkpoint is not None:
+            self.checkpoint.commit(key, fold.state_to_arrays(state),
+                                   PASS_COMPLETE)
+        return state
+
+
+def fit_dag_streaming(source: ChunkSource, layers, *,
+                      checkpoint: Optional[Callable] = None,
+                      stream_checkpoint: Optional[StreamCheckpoint] = None,
+                      preloaded: Optional[Dict[str, Any]] = None,
+                      retry_policy: Optional[Any] = None,
+                      prefetch: Optional[int] = None,
+                      ) -> Tuple[Dict[str, Any], List[Transformer], FeedStats]:
+    """Fit every estimator in the layered DAG as streaming folds.
+
+    Returns ``(fitted {uid → model}, topological transformer order,
+    aggregate feed stats)``. Mirrors ``dag.fit_and_transform_dag``'s
+    checkpoint/preload/retry contract (docs/robustness.md) — ``preloaded``
+    stages restore instead of refitting, ``checkpoint(model)`` commits each
+    fitted stage, transient errors retry under ``retry_policy``.
+    """
+    pre = preloaded or {}
+    fitted: Dict[str, Any] = {}
+    upstream: List[Transformer] = []
+    stats = FeedStats()
+    for li, layer in enumerate(layers):
+        models: List[Transformer] = []
+        for stage, _ in layer:
+            if isinstance(stage, Estimator):
+                if stage.uid in pre:
+                    model = pre[stage.uid]
+                    model.input_features = stage.input_features
+                    model._output_feature = stage.get_output()
+                    FaultLog.record(FaultReport(
+                        site="dag.stage_fit", kind="restored",
+                        detail={"uid": stage.uid,
+                                "stage": type(stage).__name__}))
+                elif hasattr(stage, "fit_streaming"):
+                    def _fit(stage=stage, li=li):
+                        faults.inject("preempt.stage_fit", key=stage.uid)
+                        run = StreamRun(source, upstream, stage.uid,
+                                        checkpoint=stream_checkpoint,
+                                        prefetch=prefetch, stats=stats)
+                        with _obs_span("stream.fit", cat="train",
+                                       uid=stage.uid,
+                                       stage=type(stage).__name__,
+                                       layer=li,
+                                       chunks=source.num_chunks):
+                            return stage.fit_streaming(run)
+                    if retry_policy is not None:
+                        model = retry_policy.execute(
+                            _fit, site=f"stream.stage_fit[{stage.uid}]")
+                    else:
+                        model = _fit()
+                    if checkpoint is not None:
+                        checkpoint(model)
+                        if stream_checkpoint is not None:
+                            # per-pass fold states are now redundant
+                            stream_checkpoint.manifest.drop_streams(stage.uid)
+                            stream_checkpoint.manifest.save()
+                else:
+                    raise StreamingNotSupportedError(
+                        f"stage {type(stage).__name__} ({stage.uid}) does "
+                        f"not implement fit_streaming(run) — it cannot fit "
+                        f"on a chunk stream. Streaming-capable stages: "
+                        f"RealVectorizer, SanityChecker, StreamingGBT "
+                        f"(docs/streaming.md)")
+                fitted[stage.uid] = model
+                models.append(model)
+            elif isinstance(stage, Transformer):
+                models.append(stage)
+            else:
+                raise TypeError(
+                    f"unexpected stage kind {type(stage).__name__}")
+        upstream.extend(models)
+    return fitted, upstream, stats

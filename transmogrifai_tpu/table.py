@@ -127,6 +127,43 @@ class Column:
         return Column(feature_type, arr, mask)
 
 
+def column_of_scalars(feature_type: Type[FeatureType],
+                      raw: Sequence[Any]) -> Optional[Column]:
+    """Vectorized dual of ``Column.of_values`` for numeric scalar kinds:
+    one ``np.asarray`` sweep instead of a python loop calling
+    ``float()``/``int()`` per cell — the serve-time request→table hot path
+    (local/scoring.serve_table_builder; docs/benchmarks.md "Serving
+    runtime"). Returns None whenever the batch is not homogeneous numeric
+    (a None, a string, a FeatureType wrapper) — the caller falls back to
+    ``of_values``, so semantics are byte-identical by construction:
+    NaN = missing, invalid slots hold 0, binary truth-tests, integral
+    truncation all match the per-cell path."""
+    kind = feature_type.column_kind
+    if kind not in ("real", "binary", "integral", "date") or not len(raw):
+        return None
+    try:
+        vals = np.asarray(raw, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    if vals.shape != (len(raw),):
+        return None
+    mask = ~np.isnan(vals)
+    if kind == "real":
+        return Column(feature_type,
+                      np.where(mask, vals, 0.0).astype(np.float32), mask)
+    if kind == "binary":
+        return Column(feature_type,
+                      (np.where(mask, vals, 0.0) != 0.0).astype(np.float32),
+                      mask)
+    # integral/date → host int64 (reference Long semantics); float cells
+    # truncate toward zero exactly like int(v)
+    if kind == "integral" or kind == "date":
+        with np.errstate(invalid="ignore"):
+            ints = np.where(mask, vals, 0.0).astype(np.int64)
+        return Column(feature_type, ints, mask)
+    return None
+
+
 def _is_missing_scalar(v: Any) -> bool:
     if v is None:
         return True

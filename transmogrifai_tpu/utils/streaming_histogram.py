@@ -66,6 +66,10 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             lib.sh_sum.argtypes = [ctypes.c_void_p, ctypes.c_double]
             lib.sh_uniform.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+            lib.sh_load.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                ctypes.c_double, ctypes.c_double, ctypes.c_double]
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -74,6 +78,28 @@ def _build_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _build_lib() is not None
+
+
+def _compress_bins(bins: List[Tuple[float, float]], max_bins: int,
+                   ) -> List[Tuple[float, float]]:
+    """SPDT compaction on a sorted (centroid, mass) list: repeatedly merge
+    the leftmost smallest-gap adjacent pair until <= max_bins remain —
+    the exact loop the native compress() runs, kept in python so merges
+    involving a python-fallback sketch stay bit-identical to native."""
+    if len(bins) <= max_bins:
+        return list(bins)
+    centers = np.asarray([p for p, _ in bins], dtype=np.float64)
+    masses = np.asarray([m for _, m in bins], dtype=np.float64)
+    centers = centers.tolist()
+    masses = masses.tolist()
+    while len(centers) > max_bins:
+        gaps = np.diff(np.asarray(centers))
+        j = int(np.argmin(gaps))            # leftmost minimum, like C++
+        m = masses[j] + masses[j + 1]
+        centers[j] = (centers[j] * masses[j] + centers[j + 1] * masses[j + 1]) / m
+        masses[j] = m
+        del centers[j + 1], masses[j + 1]
+    return list(zip(centers, masses))
 
 
 class StreamingHistogram:
@@ -111,14 +137,131 @@ class StreamingHistogram:
         return self
 
     def merge(self, other: "StreamingHistogram") -> "StreamingHistogram":
+        """SPDT Merge: union of bins, one compaction pass (the paper's Merge
+        procedure — NOT per-point insertion, whose repeated compactions give
+        a different, impl-dependent sketch). All four native/python impl
+        pairings run the identical algorithm, and the result always honors
+        the bin-count + mass invariants (``_check_invariants``): this is
+        what makes chunk folds reproducible across hosts with and without a
+        C++ toolchain."""
+        if not isinstance(other, StreamingHistogram):
+            raise TypeError(f"cannot merge {type(other).__name__} into a "
+                            "StreamingHistogram")
+        total = self.total + other.total
+        lo = min(self.min, other.min)
+        hi = max(self.max, other.max)
         if self._lib is not None and other._lib is not None:
             self._lib.sh_merge(self._h, other._h)
         else:
-            for p, m in other.bins():
-                self._py_insert(p, m)
-            self._min = min(self._min, other.min)
-            self._max = max(self._max, other.max)
+            # dst-first stable union by centroid — byte-identical to the
+            # native std::merge + coalesce + compress sequence
+            merged = sorted(self.bins() + other.bins(), key=lambda b: b[0])
+            out: List[Tuple[float, float]] = []
+            for p, m in merged:
+                if out and out[-1][0] == p:
+                    out[-1] = (p, out[-1][1] + m)
+                else:
+                    out.append((p, m))
+            bins = _compress_bins(out, self.max_bins)
+            self._load_state(bins, total, lo, hi)
+        self._check_invariants(total)
         return self
+
+    def _check_invariants(self, expected_total: Optional[float] = None) -> None:
+        """Merge/restore postconditions: bounded bins, conserved mass,
+        min/max bracket every centroid. A violated invariant means fold
+        order could change quantile outputs — fail loudly instead."""
+        nb = len(self.bins())
+        if nb > self.max_bins:
+            raise AssertionError(
+                f"histogram holds {nb} bins > max_bins={self.max_bins}")
+        if expected_total is not None and self.total != expected_total:
+            raise AssertionError(
+                f"merge lost mass: total={self.total!r} != "
+                f"expected {expected_total!r}")
+        if nb and (self.bins()[0][0] < self.min
+                   or self.bins()[-1][0] > self.max):
+            raise AssertionError("centroids escaped the [min, max] range")
+
+    def _load_state(self, bins: List[Tuple[float, float]], total: float,
+                    lo: float, hi: float) -> None:
+        """Replace this sketch's entire state (sorted bins expected)."""
+        if self._lib is not None:
+            centers = np.ascontiguousarray([p for p, _ in bins], dtype=np.float64)
+            masses = np.ascontiguousarray([m for _, m in bins], dtype=np.float64)
+            self._lib.sh_load(
+                self._h,
+                centers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                masses.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                centers.shape[0], float(total), float(lo), float(hi))
+        else:
+            self._bins = list(bins)
+            self._total = total
+            self._min = lo
+            self._max = hi
+
+    # -- serialization + canonical multiset merge (streaming folds) ----------
+    def to_state(self) -> dict:
+        """Checkpointable state: plain arrays, impl-independent. Restoring
+        via :meth:`from_state` is bit-exact on either backend."""
+        bins = self.bins()
+        return {
+            "max_bins": np.int64(self.max_bins),
+            "centers": np.asarray([p for p, _ in bins], dtype=np.float64),
+            "masses": np.asarray([m for _, m in bins], dtype=np.float64),
+            "total": np.float64(self.total),
+            "min": np.float64(self.min),
+            "max": np.float64(self.max),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "StreamingHistogram":
+        h = cls(int(state["max_bins"]))
+        bins = list(zip(np.asarray(state["centers"], dtype=np.float64).tolist(),
+                        np.asarray(state["masses"], dtype=np.float64).tolist()))
+        h._load_state(bins, float(state["total"]),
+                      float(state["min"]), float(state["max"]))
+        h._check_invariants(float(state["total"]))
+        return h
+
+    @classmethod
+    def merged(cls, hists: Sequence["StreamingHistogram"],
+               max_bins: Optional[int] = None) -> "StreamingHistogram":
+        """Canonical N-way merge: a pure function of the *multiset* of input
+        bins, so any permutation of ``hists`` produces a bit-identical
+        sketch (the associativity/commutativity contract chunk folds need —
+        pairwise :meth:`merge` compacts intermediates, so its result
+        depends on grouping). Bins sort by (centroid, mass), equal
+        centroids coalesce in that canonical order, and ONE compaction pass
+        runs at the end. Computed host-side in pure python for
+        impl-independence; the result loads into whichever backend is
+        available."""
+        hists = list(hists)
+        mb = max_bins if max_bins is not None else max(
+            [h.max_bins for h in hists], default=2)
+        centers: List[float] = []
+        masses: List[float] = []
+        for h in hists:
+            for p, m in h.bins():
+                centers.append(p)
+                masses.append(m)
+        ca = np.asarray(centers, dtype=np.float64)
+        ma = np.asarray(masses, dtype=np.float64)
+        order = np.lexsort((ma, ca))
+        out: List[Tuple[float, float]] = []
+        for i in order.tolist():
+            p, m = float(ca[i]), float(ma[i])
+            if out and out[-1][0] == p:
+                out[-1] = (p, out[-1][1] + m)
+            else:
+                out.append((p, m))
+        total = float(ma[order].sum()) if ma.size else 0.0
+        lo = min([h.min for h in hists], default=np.inf)
+        hi = max([h.max for h in hists], default=-np.inf)
+        result = cls(mb)
+        result._load_state(_compress_bins(out, mb), total, lo, hi)
+        result._check_invariants(total)
+        return result
 
     # -- queries -------------------------------------------------------------
     def bins(self) -> List[Tuple[float, float]]:

@@ -204,7 +204,7 @@ class OpWorkflow(_WorkflowCore):
     def stages(self) -> List[Any]:
         return [s for layer in (self._layers or []) for s, _ in layer]
 
-    def train(self, resume: bool = False) -> "OpWorkflowModel":
+    def train(self, resume: bool = False, stream=None) -> "OpWorkflowModel":
         """Materialize raw data, fit the DAG, return the fitted model
         (reference OpWorkflow.train:332-357). The whole fit runs under an
         activated FaultLog: retries, quarantines, skipped checkpoints and
@@ -218,14 +218,106 @@ class OpWorkflow(_WorkflowCore):
         model's ``summary()["resume"]`` records exactly what was restored
         vs refit. Checkpoints failing verification are reported and the
         stage refits — a resume never crashes on (or silently uses) state
-        it can deterministically rebuild."""
+        it can deterministically rebuild.
+
+        ``stream=<ChunkSource>`` — out-of-core training
+        (docs/streaming.md): the raw table is never materialized; every
+        estimator fits as chunked monoid folds over a double-buffered
+        host→device feed, per-chunk-checkpointed when a checkpoint dir is
+        set, so ``train(resume=True, stream=...)`` after a kill at any
+        ``stream.*`` site resumes to a bit-identical model. The fitted
+        model is a plain OpWorkflowModel (scoring, serving, persistence
+        all unchanged); ``summary()["streaming"]`` carries the feed
+        accounting (chunks, uploaded bytes, peak device residency,
+        overlap)."""
         from .observability.trace import span as _obs_span
         from .robustness.policy import FaultLog
         fault_log = FaultLog()
         with fault_log.activate(), \
-                _obs_span("workflow.train", cat="train", resume=resume):
-            model = self._train_logged(resume=resume)
+                _obs_span("workflow.train", cat="train", resume=resume,
+                          stream=stream is not None):
+            if stream is not None:
+                model = self._train_streaming(stream, resume=resume)
+            else:
+                model = self._train_logged(resume=resume)
         model._fault_log = fault_log
+        return model
+
+    def _train_streaming(self, source, resume: bool = False) -> "OpWorkflowModel":
+        """Streamed dual of ``_train_logged``: same checkpoint/resume
+        machinery, but the DAG fits via ``streaming.fit_dag_streaming``
+        (layer-wise chunk folds) instead of one in-memory table. A few
+        in-core-only workflow modes are rejected up front with the reason
+        rather than silently materializing the dataset."""
+        if not self.result_features:
+            raise ValueError("call set_result_features before train")
+        if self._raw_feature_filter is not None:
+            raise ValueError(
+                "RawFeatureFilter is not supported with train(stream=...): "
+                "its fill-rate/histogram stats are available as streaming "
+                "folds (streaming.folds.HistogramFold) but score-vs-train "
+                "comparison needs a second stream — train in-core or drop "
+                "the filter (ROADMAP item 5)")
+        if self._workflow_cv:
+            raise ValueError(
+                "with_workflow_cv() is not supported with train(stream=...):"
+                " per-fold DAG refits need fold-sliced tables")
+        if getattr(self, "_mesh", None) is not None:
+            raise ValueError(
+                "with_mesh() is not supported with train(stream=...) yet: "
+                "chunk folds are host monoids (ROADMAP item 3 will shard "
+                "chunks over hosts)")
+        from .streaming.checkpoint import StreamCheckpoint
+        from .streaming.trainer import fit_dag_streaming
+        layers = self._layers
+        source.bind(self.raw_features)
+        self._inject_stage_params([s for layer in layers for s, _ in layer])
+        ckpt_dir = getattr(self, "_checkpoint_dir", None)
+        if resume and ckpt_dir is None:
+            raise ValueError(
+                "train(resume=True) requires with_checkpoint_dir(...): "
+                "there is no checkpoint state to resume from")
+        checkpoint = None
+        preloaded = None
+        stream_ckpt = None
+        if ckpt_dir is not None:
+            from .persistence import (load_stage_checkpoints,
+                                      open_checkpoint_manifest,
+                                      save_stage_checkpoint)
+            preloaded = load_stage_checkpoints(ckpt_dir)
+            manifest = open_checkpoint_manifest(ckpt_dir)
+            checkpoint = lambda model: save_stage_checkpoint(
+                model, ckpt_dir, manifest)
+            stream_ckpt = StreamCheckpoint(ckpt_dir, manifest,
+                                           source.fingerprint())
+        fitted, transformers, stats = fit_dag_streaming(
+            source, layers,
+            checkpoint=checkpoint, stream_checkpoint=stream_ckpt,
+            preloaded=preloaded,
+            retry_policy=getattr(self, "_fault_policy", None))
+        new_results = tuple(
+            f.copy_with_new_stages(fitted) for f in self.result_features)
+        model = OpWorkflowModel()
+        model.reader = self.reader
+        model.parameters = self.parameters
+        model.result_features = new_results
+        model.raw_features = self.raw_features
+        model.blacklisted_features = ()
+        model.rff_results = None
+        # a small transformed head-of-stream probe stands in for the full
+        # train table: it carries the fitted schema (vector widths,
+        # metadata) that model persistence / serve warm-start fingerprint
+        # read — O(probe rows), never the dataset
+        probe = next(iter(source.chunks(0))).table
+        if probe.num_rows > 256:
+            probe = probe.take(np.arange(256))
+        for m in transformers:
+            probe = m.transform(probe)
+        model.train_table = probe
+        model._stream_stats = stats
+        model._fitted_stage_uids = sorted(fitted)
+        model._resume_requested = resume
+        model._layers = compute_dag(new_results)
         return model
 
     def _train_logged(self, resume: bool = False) -> "OpWorkflowModel":
@@ -549,6 +641,12 @@ class OpWorkflowModel(_WorkflowCore):
         # off.
         from .observability import summarize
         out["observability"] = summarize()
+        # out-of-core feed accounting for streamed trains (chunks, uploaded
+        # bytes, peak device residency, overlap — docs/streaming.md);
+        # absent for in-core/loaded models
+        stream_stats = getattr(self, "_stream_stats", None)
+        if stream_stats is not None:
+            out["streaming"] = stream_stats.to_json()
         return out
 
     def summary_json(self) -> str:
