@@ -151,6 +151,27 @@ def _no_serving_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_drift_leak():
+    """Drift refits run on background ``tg-drift-refit`` daemon threads
+    (serving/registry.py) that retrain + save + hot-swap a model. A refit
+    leaking out of a test would keep training (and writing model dirs +
+    metrics) underneath later tests. Mirrors the serving no-leak fixture:
+    assert none live on entry, join + fail on exit."""
+    from transmogrifai_tpu.serving import drift as _sdrift
+
+    assert not _sdrift.live_refits(), (
+        "drift refit thread(s) leaked from a previous test: "
+        f"{[t.name for t in _sdrift.live_refits()]}")
+    yield
+    leaked = _sdrift.live_refits()
+    for t in leaked:
+        t.join(timeout=30)
+    assert not _sdrift.live_refits(), (
+        "a test leaked running drift refit thread(s): "
+        f"{[t.name for t in _sdrift.live_refits()]}")
+
+
+@pytest.fixture(autouse=True)
 def _no_stream_leak():
     """The streaming device feed owns a producer thread and up to
     prefetch+1 host/device-resident chunk buffers. A leaked feed would

@@ -115,7 +115,7 @@ def test_fault_log_inactive_record_is_noop():
     assert log.to_json() == {"quarantined": [], "retries": [],
                              "checkpointsSkipped": [], "restored": [],
                              "planFallbacks": [], "breakerDegraded": [],
-                             "fatal": [], "droppedReports": 0}
+                             "drift": [], "fatal": [], "droppedReports": 0}
 
 
 # ---------------------------------------------------------------------------

@@ -454,8 +454,21 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
             report = run_open_loop(rt, rows, seconds, rps,
                                    deadline_ms=deadline_ms)
             health = reg.health()
+            # drift report (docs/serving.md): per-feature JS/fill vs the
+            # training baseline + the verdict history. The monitor folds
+            # on a row cadence; force a final verdict pass so a short run
+            # still reports fresh numbers (None when the model dir
+            # predates drift baselines or TG_DRIFT=0).
+            drift_report = None
+            if rt.drift_monitor is not None:
+                try:
+                    rt.drift_monitor.run_verdict()
+                except Exception:
+                    pass  # report whatever the last pass computed
+                drift_report = rt.drift_monitor.report()
         summary = {"model": model_path, "rpsOffered": round(rps, 1),
-                   "load": report, "health": health["models"][name]}
+                   "load": report, "health": health["models"][name],
+                   "drift": drift_report}
         print(_json.dumps(summary, indent=2, default=str))
         if output:
             os.makedirs(output, exist_ok=True)

@@ -24,6 +24,53 @@ from ..utils.streaming_histogram import StreamingHistogram
 _NUMERIC_KINDS = frozenset({"real", "binary", "integral", "date"})
 
 
+def js_divergence(p, q, bins: int = 100) -> float:
+    """Jensen-Shannon divergence in [0, 1] (log base 2) — THE shared
+    implementation (reference FeatureDistribution.jsDivergence). Accepts
+    either two dense mass arrays over identical bins, or two
+    :class:`StreamingHistogram` sketches directly (binned over shared
+    boundaries derived from their joint range — the serve-side drift
+    monitor's path, where no dense arrays exist). RawFeatureFilter and
+    the DriftMonitor both resolve here; there is deliberately no second
+    copy of this math anywhere in the tree."""
+    if isinstance(p, StreamingHistogram) or isinstance(q, StreamingHistogram):
+        if not (isinstance(p, StreamingHistogram)
+                and isinstance(q, StreamingHistogram)):
+            raise TypeError("js_divergence needs two sketches or two arrays, "
+                            f"got {type(p).__name__} vs {type(q).__name__}")
+        edges = sketch_bin_edges(p, q, bins)
+        if edges is None:
+            return 0.0
+        p, q = p.density(edges), q.density(edges)
+    p, q = np.asarray(p, float), np.asarray(q, float)
+    if p.size == 0 or q.size == 0 or p.size != q.size:
+        return 0.0
+    ps, qs = p.sum(), q.sum()
+    if ps == 0 or qs == 0:
+        return 0.0
+    p, q = p / ps, q / qs
+    m = (p + q) / 2.0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        kl_pm = np.where(p > 0, p * np.log2(p / m), 0.0).sum()
+        kl_qm = np.where(q > 0, q * np.log2(q / m), 0.0).sum()
+    return float((kl_pm + kl_qm) / 2.0)
+
+
+def sketch_bin_edges(a: StreamingHistogram, b: StreamingHistogram,
+                     bins: int) -> Optional[np.ndarray]:
+    """Shared open-ended bin boundaries over two sketches' joint [min, max]
+    (the sketch twin of :func:`numeric_bin_edges`, which works from
+    Summary records); None when neither sketch saw a finite value."""
+    lo = min(a.min, b.min)
+    hi = max(a.max, b.max)
+    if not np.isfinite(lo) or not np.isfinite(hi):
+        return None
+    if hi <= lo:
+        hi = lo + 1.0
+    edges = np.linspace(lo, hi, bins + 1)
+    return np.concatenate([[lo - 1.0], edges[1:-1], [hi + 1.0]])
+
+
 @dataclass
 class Summary:
     """Per-feature value summary (reference filters/Summary.scala)."""
@@ -86,20 +133,10 @@ class FeatureDistribution:
         return np.inf if lo == 0 else hi / lo
 
     def js_divergence(self, other: "FeatureDistribution") -> float:
-        """Jensen-Shannon divergence in [0, 1] (log base 2), reference
-        FeatureDistribution.jsDivergence."""
-        p, q = np.asarray(self.distribution, float), np.asarray(other.distribution, float)
-        if p.size == 0 or q.size == 0 or p.size != q.size:
-            return 0.0
-        ps, qs = p.sum(), q.sum()
-        if ps == 0 or qs == 0:
-            return 0.0
-        p, q = p / ps, q / qs
-        m = (p + q) / 2.0
-        with np.errstate(divide="ignore", invalid="ignore"):
-            kl_pm = np.where(p > 0, p * np.log2(p / m), 0.0).sum()
-            kl_qm = np.where(q > 0, q * np.log2(q / m), 0.0).sum()
-        return float((kl_pm + kl_qm) / 2.0)
+        """Jensen-Shannon divergence in [0, 1] — delegates to the shared
+        module-level :func:`js_divergence` (one implementation for dense
+        bins, sketches, train-time RFF, and serve-time drift)."""
+        return js_divergence(self.distribution, other.distribution)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -117,11 +154,37 @@ class FeatureDistribution:
 def numeric_distribution(name: str, values: np.ndarray, valid: np.ndarray,
                          max_bins: int, key: Optional[str] = None,
                          ) -> FeatureDistribution:
-    vals = np.asarray(values, dtype=np.float64)[valid]
-    sketch = StreamingHistogram(max_bins).update(vals)
+    """Sketch one numeric column through the streaming Histogram fold —
+    the SAME fill-rate/sketch monoid the out-of-core trainer and the
+    serve-side DriftMonitor fold (streaming/folds.py HistogramFold), so a
+    train-time RFF baseline and a serve-time accumulation are states of
+    one fold, not two reimplementations."""
+    from ..streaming.folds import HistogramFold
+    vals = np.asarray(values, dtype=np.float64)
+    fold = HistogramFold(1, max_bins=max_bins)
+    state = fold.accumulate(fold.zero(), vals.reshape(-1, 1),
+                            np.asarray(valid, bool).reshape(-1, 1))
+    return fold_distribution(fold, state, 0, name, key=key)
+
+
+def fold_distribution(fold, state, j: int, name: str,
+                      key: Optional[str] = None) -> FeatureDistribution:
+    """A :class:`FeatureDistribution` view of column ``j`` of a
+    ``HistogramFold`` state (sketch + fill rate + summary) — shared by
+    :func:`numeric_distribution` and the serving DriftMonitor."""
+    sketch = fold.column_histogram(state, j)
+    n = float(state["rows"])
+    filled = n - float(state["nulls"][j])
+    mn = sketch.min if filled else np.inf
+    mx = sketch.max if filled else -np.inf
+    # Summary.sum comes from bin centroids: SPDT merging preserves the
+    # mass-weighted mean, so it equals the true sum up to float rounding.
+    # Summary fields are used for bin edges + reporting, never for a
+    # filter decision.
+    val_sum = float(sum(p * m for p, m in sketch.bins())) if filled else 0.0
     return FeatureDistribution(
-        name=name, key=key, count=float(valid.size),
-        nulls=float(valid.size - vals.size), summary=Summary.of(vals),
+        name=name, key=key, count=n, nulls=float(state["nulls"][j]),
+        summary=Summary(mn, mx, val_sum, sketch.total),
         is_numeric=True, sketch=sketch)
 
 
@@ -185,6 +248,25 @@ def fill_numeric_bins(train: FeatureDistribution,
             dist.distribution = np.diff(cs)
         elif dist.sketch is not None:
             dist.distribution = dist.sketch.density(finite_edges)
+
+
+def compare_distributions(train: FeatureDistribution,
+                          score: FeatureDistribution,
+                          bins: int) -> Dict[str, float]:
+    """Train-vs-score comparison metrics — the ONE implementation both the
+    train-time RawFeatureFilter and the serve-time DriftMonitor call:
+    numeric sketches are binned over shared boundaries
+    (:func:`fill_numeric_bins`), then fill-rate delta/ratio and JS
+    divergence come from the shared :func:`js_divergence` math."""
+    if train.is_numeric:
+        fill_numeric_bins(train, score, bins)
+    return {
+        "trainFill": train.fill_fraction(),
+        "scoreFill": score.fill_fraction(),
+        "fillDelta": train.relative_fill_delta(score),
+        "fillRatio": float(train.relative_fill_ratio(score)),
+        "jsDivergence": train.js_divergence(score),
+    }
 
 
 def column_distributions(name: str, col: Column, max_bins: int, text_bins: int,

@@ -25,7 +25,8 @@ import numpy as np
 from ..features import Feature
 from ..table import Column, FeatureTable
 from .distribution import (
-    FeatureDistribution, column_distributions, fill_numeric_bins,
+    FeatureDistribution, column_distributions, compare_distributions,
+    fill_numeric_bins,
 )
 
 
@@ -344,19 +345,23 @@ class RawFeatureFilter:
                 if score_dists is not None:
                     sd = next((s for s in score_dists.get(f.name, [])
                                if s.key == d.key), None)
-                if d.is_numeric:
+                if d.is_numeric and sd is None:
                     fill_numeric_bins(d, sd, self.bins)
                 m = FeatureMetrics(
                     name=f.name, key=d.key,
                     train_fill_rate=d.fill_fraction(),
                     null_label_correlation=null_corr.get(d.full_name))
                 if sd is not None:
-                    m.score_fill_rate = sd.fill_fraction()
-                    m.fill_rate_delta = d.relative_fill_delta(sd)
-                    # inf (one side completely empty) must EXCEED the threshold,
-                    # matching the reference's Double.PositiveInfinity compare
-                    m.fill_ratio_diff = float(d.relative_fill_ratio(sd))
-                    m.js_divergence = d.js_divergence(sd)
+                    # the shared train-vs-score comparison (also the drift
+                    # monitor's math, serving/drift.py). fill_ratio inf
+                    # (one side completely empty) must EXCEED the
+                    # threshold, matching the reference's
+                    # Double.PositiveInfinity compare
+                    cmp = compare_distributions(d, sd, self.bins)
+                    m.score_fill_rate = cmp["scoreFill"]
+                    m.fill_rate_delta = cmp["fillDelta"]
+                    m.fill_ratio_diff = cmp["fillRatio"]
+                    m.js_divergence = cmp["jsDivergence"]
                 self._apply_exclusions(m, sd is not None)
                 f_metrics.append(m)
                 metrics.append(m)

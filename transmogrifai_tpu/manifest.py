@@ -114,6 +114,11 @@ class CheckpointManifest:
         #: (serving/warmup.py; absent/empty on stage-checkpoint dirs and
         #: pre-serving manifests — loaders must tolerate that)
         self.serving: Dict[str, Any] = {}
+        #: optional per-feature training-distribution baseline (streaming
+        #: histogram sketch states + fill rates) the serving registry
+        #: hands its DriftMonitor at load (serving/drift.py; absent on
+        #: pre-drift manifests — loaders must tolerate that)
+        self.drift: Dict[str, Any] = {}
 
     @property
     def path(self) -> str:
@@ -146,6 +151,7 @@ class CheckpointManifest:
         m.sweeps = dict(doc.get("sweeps", {}))
         m.serving = dict(doc.get("serving", {}))
         m.streams = dict(doc.get("streams", {}))
+        m.drift = dict(doc.get("drift", {}))
         return m, None
 
     def save(self) -> None:
@@ -161,6 +167,8 @@ class CheckpointManifest:
             doc["serving"] = self.serving
         if self.streams:
             doc["streams"] = self.streams
+        if self.drift:
+            doc["drift"] = self.drift
         atomic_write_json(self.path, doc, indent=1)
 
     # -- recording -----------------------------------------------------------

@@ -74,11 +74,14 @@ def summarize(tr: Optional[trace.Tracer] = None,
             fam["configs"] += int(s.attrs.get("configs", 0) or 0)
 
     snap = r.snapshot()
-    # serving series (tg_serve_* + the breaker gauge, labelled per model)
-    # get their own section — mirrored there from each runtime's
-    # serve-local registry when metrics are enabled (docs/serving.md)
+    # serving series (tg_serve_* + the breaker gauge + the drift gauges,
+    # labelled per model) get their own section — mirrored there from each
+    # runtime's serve-local registry when metrics are enabled
+    # (docs/serving.md); tg_drift_verdict mirrors each model's drift
+    # verdict (0=ok, 1=drifting, 2=degraded)
     serving = {name: series for name, series in snap.items()
-               if name.startswith("tg_serve_") or name == "tg_breaker_state"}
+               if name.startswith(("tg_serve_", "tg_drift_"))
+               or name == "tg_breaker_state"}
     counters = {name: series for name, series in snap.items()
                 if not name.startswith("tg_score_") and name not in serving}
     scoring: Dict[str, Any] = {}

@@ -20,6 +20,15 @@ helpers (:func:`inc_counter` / :func:`set_gauge` / :func:`observe`) are the
 hot-path entry points: one enabled check, zero writes when off — the
 overhead guard in tests/test_observability.py holds the registry to exactly
 zero writes with observability disabled.
+
+Label cardinality is bounded: a metric name may hold at most
+``TG_METRICS_MAX_LABELS`` distinct label sets (default 64). The first
+series past the bound collapses into one ``__other__`` overflow series per
+name (same label keys, every value ``__other__``) instead of growing the
+registry without bound — the guard the per-feature ``tg_drift_*{feature}``
+gauges need, and a safety net for any future labelled series (an
+unbounded user-supplied label value would otherwise leak one instrument
+per distinct value for the life of the process).
 """
 from __future__ import annotations
 
@@ -32,8 +41,21 @@ from ..utils.streaming_histogram import StreamingHistogram
 
 #: env switch; unset defers to TG_TRACE (tracing implies metrics)
 METRICS_ENV = "TG_METRICS"
+#: per-name label-set cardinality bound (docstring above)
+MAX_LABELS_ENV = "TG_METRICS_MAX_LABELS"
+DEFAULT_MAX_LABELS = 64
+#: the label value every over-bound series collapses to
+OVERFLOW_LABEL = "__other__"
 
 _FALSY = ("", "0", "false", "False", "no")
+
+
+def _max_labels() -> int:
+    try:
+        return max(1, int(os.environ.get(MAX_LABELS_ENV, "")
+                          or DEFAULT_MAX_LABELS))
+    except ValueError:
+        return DEFAULT_MAX_LABELS
 
 _enabled_override: Optional[bool] = None
 
@@ -137,11 +159,16 @@ class MetricsRegistry:
     instrument kind; re-requesting with another kind raises (the same
     collision Prometheus clients reject)."""
 
-    def __init__(self):
+    def __init__(self, max_labels: Optional[int] = None):
         self._lock = threading.Lock()
         self._metrics: Dict[Tuple[str, LabelKey], Any] = {}
         self._kinds: Dict[str, str] = {}
         self._help: Dict[str, str] = {}
+        self._max_labels = (max(1, int(max_labels))
+                            if max_labels is not None else _max_labels())
+        self._series_count: Dict[str, int] = {}
+        #: label sets collapsed into the __other__ series, per name
+        self.overflowed: Dict[str, int] = {}
 
     # -- get-or-create -------------------------------------------------------
     def _get(self, cls, kind: str, name: str, help: str,
@@ -158,8 +185,20 @@ class MetricsRegistry:
                 self._help.setdefault(name, help)
             m = self._metrics.get((name, lk))
             if m is None:
+                # cardinality bound: a NEW labelled series past the bound
+                # collapses into the name's single __other__ series instead
+                # of registering (last-write-wins for gauges there — an
+                # overflow series is a "something beyond the bound exists"
+                # signal, not a faithful per-label value)
+                if lk and self._series_count.get(name, 0) >= self._max_labels:
+                    self.overflowed[name] = self.overflowed.get(name, 0) + 1
+                    lk = tuple((k, OVERFLOW_LABEL) for k, _ in lk)
+                    m = self._metrics.get((name, lk))
+                    if m is not None:
+                        return m
                 m = self._metrics[(name, lk)] = cls(
                     name, dict(lk), **kw)
+                self._series_count[name] = self._series_count.get(name, 0) + 1
             return m
 
     def counter(self, name: str, help: str = "", **labels: str) -> Counter:

@@ -362,6 +362,17 @@ def save_model(model, path: str) -> None:
         manifest.serving = manifest_serving_entry(model)
     except Exception:
         pass
+    # drift baseline: per-feature training-distribution sketches + fill
+    # rates (serving/drift.py) — the serving registry hands them to a
+    # DriftMonitor at load so scoring traffic is compared online against
+    # what the model trained on. Same contract as the warm-start hint: a
+    # model without a usable train table simply ships no baseline — the
+    # entry must never fail a save.
+    try:
+        from .serving.drift import manifest_drift_entry
+        manifest.drift = manifest_drift_entry(model)
+    except Exception:
+        pass
     manifest.save()
 
 

@@ -93,16 +93,17 @@ def planning_applicable() -> bool:
     injection site the eager per-stage path runs so PR 1 retry/quarantine
     behavior is exactly preserved. Sites prefixed ``plan.`` target the
     planner itself and keep it active — they exercise the runtime
-    fallback; sites prefixed ``serve.`` target the serving runtime *above*
-    the planner (serving/runtime.py), whose chaos tests must exercise the
-    real planned dispatch path, not an eager stand-in."""
+    fallback; sites prefixed ``serve.`` / ``drift.`` target the serving
+    runtime and its drift monitor *above* the planner
+    (serving/runtime.py, serving/drift.py), whose chaos tests must
+    exercise the real planned dispatch path, not an eager stand-in."""
     if not plan_enabled():
         return False
     from .robustness import faults
     if os.environ.get(faults.CHAOS_ENV):
         return False
     armed = faults.active_sites()
-    if any(not s.startswith(("plan.", "serve.")) for s in armed):
+    if any(not s.startswith(("plan.", "serve.", "drift.")) for s in armed):
         return False
     return True
 

@@ -58,6 +58,21 @@ site                         fires in
                              ``mode: "preempt"`` here is the canonical
                              kill-mid-epoch test — resume continues from
                              the last committed chunk bit-exactly
+``drift.fold``               in the drift monitor, before a scored
+                             micro-batch folds into the per-feature
+                             scoring sketches (serving/drift.py; a raise
+                             is contained by the runtime's crash-isolation
+                             fence — typed ``drift_fold_failed``, zero
+                             request impact; ``drift.*`` sites keep the
+                             transform planner active like ``serve.*``)
+``drift.verdict``            before a drift verdict pass compares the
+                             scoring sketches against the training
+                             baseline (contained in the monitor — typed
+                             ``drift_verdict_failed``, fold state intact)
+``drift.refit``              in the background refit thread, before the
+                             refit hook runs (a raise means no new model:
+                             typed ``drift_refit_failed``, the old model
+                             keeps serving, breaker untouched)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,

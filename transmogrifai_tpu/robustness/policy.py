@@ -167,6 +167,11 @@ class FaultLog:
             # (breaker open / dispatch failure; docs/serving.md)
             "breakerDegraded": [r.to_json()
                                 for r in self.of_kind("breaker_degraded")],
+            # drift-monitor events: contained fold/verdict failures plus
+            # refit outcomes (drift_refit / drift_refit_failed;
+            # docs/serving.md "Drift monitoring & self-healing")
+            "drift": [r.to_json() for r in self.reports
+                      if r.kind.startswith("drift_")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
             # ring accounting: reports evicted under TG_FAULTS_MAX
             "droppedReports": self.dropped,

@@ -4,10 +4,16 @@ Continuous batching over the compiled micro-batch scorer with
 backpressure (bounded queue + typed :class:`OverloadError` shedding),
 per-request deadlines (shed before dispatch), a per-model circuit breaker
 that degrades to the bit-equal eager path instead of failing requests, a
-multi-model registry with warm plan caches, and per-model p50/p95/p99 SLO
-reporting from ``observability/metrics.py``.
+multi-model registry with warm plan caches, per-model p50/p95/p99 SLO
+reporting from ``observability/metrics.py``, and drift-aware self-healing
+(``drift.py``): online train-vs-score distribution monitoring with
+automatic background refit + hot swap.
 """
 from .breaker import BREAKER_GAUGE, CircuitBreaker  # noqa: F401
+from .drift import (  # noqa: F401
+    DEGRADED, DRIFTING, OK, DriftBaseline, DriftConfig, DriftMonitor,
+    drift_enabled, live_refits, manifest_drift_entry,
+)
 from .loadgen import run_open_loop, synthetic_rows  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import (  # noqa: F401

@@ -1,4 +1,5 @@
-"""Multi-model registry: named runtimes, warm plan caches, health/SLO.
+"""Multi-model registry: named runtimes, warm plan caches, health/SLO,
+drift-aware self-healing.
 
 The serving analog of the reference's one-model-per-MLeap-bundle local
 scorer, grown to the multi-model process ROADMAP item 1 asks for: each
@@ -9,39 +10,71 @@ one failing model degrades *itself* while its neighbors keep their SLOs.
 ``load()`` goes through ``persistence.load_model`` (manifest-verified)
 and, by default, warm-starts the plan cache from the ``serving`` section
 ``save_model`` recorded in ``MANIFEST.json`` (serving/warmup.py) — a
-fresh process serves its first request without retracing.
+fresh process serves its first request without retracing. When the
+manifest also carries a ``drift`` baseline (and ``TG_DRIFT`` is not
+``0``), a :class:`~.drift.DriftMonitor` is attached so the model's
+scoring distribution is compared online against its training
+distribution (docs/serving.md "Drift monitoring & self-healing").
+
+Self-healing: a configured ``refit_hook`` — ``(name, runtime, drift
+report) -> saved-model path or OpWorkflowModel`` — fires in a background
+thread the first time a model's drift verdict degrades. The refreshed
+model then hot-swaps through :meth:`ModelRegistry.swap`: built + warmed
+*before* the entry flips, old runtime drained *after*, so requests keep
+flowing (on the old model) throughout and not one is shed by the swap. A
+failed refit is typed ``drift_refit_failed`` in the runtime's FaultLog
+and the old model keeps serving — the breaker is untouched (the device
+path is healthy; the *data* is what drifted).
 
 ``health()`` is the readiness endpoint payload: per-model state
 (ready / degraded / stopped), breaker snapshot, queue depth, p50/p95/p99
-latency, shed + degraded + quarantine counts, and the warm report.
+latency, shed + degraded + quarantine counts, the warm report, and the
+drift verdict.
 """
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
+from ..observability.trace import add_event as _obs_event
+from ..robustness import faults
+from ..robustness.policy import FaultReport
+from . import drift as _drift
+from . import warmup as _warmup
 from .breaker import CircuitBreaker
 from .runtime import ServeConfig, ServingRuntime
-from . import warmup as _warmup
+
+#: refit hook signature: (model name, live runtime, drift report) → a
+#: saved-model directory path (manifest-verified load) or a fitted
+#: OpWorkflowModel. ``OpWorkflow.drift_refit_hook`` builds one.
+RefitHook = Callable[[str, ServingRuntime, Dict[str, Any]], Any]
 
 
 class ModelRegistry:
     """Name → :class:`ServingRuntime` map with lifecycle management."""
 
-    def __init__(self, config: Optional[ServeConfig] = None):
+    def __init__(self, config: Optional[ServeConfig] = None,
+                 refit_hook: Optional[RefitHook] = None):
         self._default_config = config
         self._lock = threading.Lock()
         self._runtimes: Dict[str, ServingRuntime] = {}
+        self._refit_hook = refit_hook
+        self._refit_lock = threading.Lock()
+        self._refits_inflight: set = set()
+        #: completed refit attempts, oldest first (success and failure)
+        self.refit_history: List[Dict[str, Any]] = []
 
     # -- registration --------------------------------------------------------
     def register(self, name: str, model,
                  config: Optional[ServeConfig] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  warm: bool = False,
-                 warm_entry: Optional[Dict[str, Any]] = None
+                 warm_entry: Optional[Dict[str, Any]] = None,
+                 drift_monitor: Optional["_drift.DriftMonitor"] = None,
                  ) -> ServingRuntime:
         """Start a runtime for ``model`` under ``name``. ``warm=True``
-        pre-traces the serve plans before the runtime takes traffic."""
+        pre-traces the serve plans before the runtime takes traffic;
+        ``drift_monitor`` attaches online distribution monitoring."""
         with self._lock:
             if name in self._runtimes:
                 raise ValueError(
@@ -50,8 +83,10 @@ class ModelRegistry:
             rt = ServingRuntime(
                 model, name=name,
                 config=config or self._default_config,
-                breaker=breaker, auto_start=False)
+                breaker=breaker, drift_monitor=drift_monitor,
+                auto_start=False)
             self._runtimes[name] = rt
+        self._wire_drift(name, rt)
         if warm:
             _warmup.warm_runtime(rt, warm_entry)
         rt.start()
@@ -62,14 +97,26 @@ class ModelRegistry:
              warm: bool = True) -> ServingRuntime:
         """Load a saved model (manifest-verified) and register it; by
         default pre-traces the plans recorded in its ``MANIFEST.json``
-        ``serving`` section so the first request is served warm."""
+        ``serving`` section so the first request is served warm, and
+        attaches a DriftMonitor when the manifest carries a ``drift``
+        baseline (``TG_DRIFT=0`` opts out)."""
+        model, entry, monitor = self._load_parts(path, workflow)
+        return self.register(name, model, config=config, warm=warm,
+                             warm_entry=entry or None,
+                             drift_monitor=monitor)
+
+    @staticmethod
+    def _load_parts(path: str, workflow=None):
         from ..manifest import CheckpointManifest
         from ..persistence import FORMAT_VERSION, load_model
         model = load_model(path, workflow=workflow)
         manifest, err = CheckpointManifest.load(path, FORMAT_VERSION)
         entry = dict(manifest.serving) if err is None else {}
-        return self.register(name, model, config=config, warm=warm,
-                             warm_entry=entry or None)
+        monitor = None
+        if err is None and manifest.drift and _drift.drift_enabled():
+            monitor = _drift.DriftMonitor(
+                _drift.DriftBaseline.from_json(manifest.drift))
+        return model, entry, monitor
 
     def unregister(self, name: str, drain: bool = True) -> None:
         with self._lock:
@@ -99,17 +146,129 @@ class ModelRegistry:
     def score(self, name: str, row: Dict[str, Any], **kw) -> Dict[str, Any]:
         return self.runtime(name).score(row, **kw)
 
+    # -- drift-triggered refit + hot swap ------------------------------------
+    def set_refit_hook(self, hook: Optional[RefitHook]) -> "ModelRegistry":
+        self._refit_hook = hook
+        return self
+
+    def _wire_drift(self, name: str, rt: ServingRuntime) -> None:
+        mon = rt.drift_monitor
+        if mon is not None:
+            mon.on_degraded = (
+                lambda report, _n=name: self._on_degraded(_n, report))
+
+    def _on_degraded(self, name: str, report: Dict[str, Any]) -> None:
+        """Fired (once per transition into ``degraded``) from the model's
+        batcher thread — must never block it: the refit runs in its own
+        daemon thread, at most one per model."""
+        _obs_event("drift.degraded", model=name)
+        if self._refit_hook is None:
+            return
+        with self._refit_lock:
+            if name in self._refits_inflight:
+                return
+            self._refits_inflight.add(name)
+        t = threading.Thread(target=self._run_refit, args=(name, report),
+                             name=f"tg-drift-refit[{name}]", daemon=True)
+        _drift.track_refit(t)
+        t.start()
+
+    def _run_refit(self, name: str, report: Dict[str, Any]) -> None:
+        entry: Dict[str, Any] = {"model": name, "ok": False}
+        try:
+            rt = self.runtime(name)
+        except KeyError:
+            with self._refit_lock:
+                self._refits_inflight.discard(name)
+            _drift.untrack_refit(threading.current_thread())
+            return
+        try:
+            # deterministic chaos entry: a fault anywhere in the refit
+            # path (hook crash, corrupt save, load failure) — the old
+            # model keeps serving, the breaker is untouched
+            faults.inject("drift.refit", key=name)
+            result = self._refit_hook(name, rt, report)
+            new_rt = self.swap(name, result)
+            entry.update(ok=True, swapped=True,
+                         path=result if isinstance(result, str) else None)
+            new_rt.fault_log.add(FaultReport(
+                site="drift.refit", kind="drift_refit",
+                detail={"model": name,
+                        "path": entry.get("path"),
+                        "triggerVerdict": report.get("verdict")}))
+            _obs_event("drift.refit", model=name, ok=True)
+        except Exception as e:
+            entry["error"] = f"{type(e).__name__}: {e}"[:300]
+            rt.fault_log.add(FaultReport(
+                site="drift.refit", kind="drift_refit_failed",
+                detail={"model": name, "error": entry["error"]}))
+            _obs_event("drift.refit", model=name, ok=False)
+        finally:
+            self.refit_history.append(entry)
+            with self._refit_lock:
+                self._refits_inflight.discard(name)
+            _drift.untrack_refit(threading.current_thread())
+
+    def swap(self, name: str, model_or_path, warm: bool = True,
+             workflow=None) -> ServingRuntime:
+        """Hot-swap ``name`` to a new model with zero request loss: the
+        replacement runtime is built, (optionally) warm pre-traced, and
+        *started* before the registry entry flips; the old runtime then
+        closes with ``drain=True``, scoring everything already queued on
+        the old model. ``model_or_path``: a saved-model directory
+        (manifest-verified load + warm fingerprint + drift baseline) or a
+        fitted ``OpWorkflowModel`` (baseline rebuilt from its train
+        table when possible)."""
+        old = self.runtime(name)
+        entry: Optional[Dict[str, Any]] = None
+        if isinstance(model_or_path, str):
+            model, entry, monitor = self._load_parts(model_or_path, workflow)
+        else:
+            model = model_or_path
+            monitor = None
+            if _drift.drift_enabled():
+                try:
+                    monitor = _drift.DriftMonitor(
+                        _drift.DriftBaseline.from_model(model))
+                except Exception:
+                    monitor = None  # no baseline → serve unmonitored
+        new_rt = ServingRuntime(model, name=name,
+                                config=old.config,
+                                drift_monitor=monitor, auto_start=False)
+        self._wire_drift(name, new_rt)
+        if warm:
+            _warmup.warm_runtime(new_rt, entry or None)
+        new_rt.start()
+        with self._lock:
+            if self._runtimes.get(name) is not old:
+                current = self._runtimes.get(name)
+                raise RuntimeError(
+                    f"model '{name}' changed during swap "
+                    f"({'unregistered' if current is None else 'replaced'})")
+            self._runtimes[name] = new_rt
+        old.close(drain=True)
+        _obs_event("serve.swap", model=name)
+        return new_rt
+
     # -- health --------------------------------------------------------------
     def health(self) -> Dict[str, Any]:
         """Readiness snapshot: ``ready`` is True only when every registered
-        model is serving with its device path live (breaker not open)."""
+        model is serving with its device path live (breaker not open).
+        Each model's entry carries its drift verdict (``summary()["drift"]``)
+        — a drift-degraded model still serves, so it does not flip
+        ``ready``; it flags that its *data* needs attention (or a refit is
+        already healing it)."""
         with self._lock:
             rts = dict(self._runtimes)
         models = {name: rt.summary() for name, rt in sorted(rts.items())}
+        with self._refit_lock:
+            inflight = sorted(self._refits_inflight)
         return {
             "ready": bool(models) and all(
                 m["state"] == "ready" for m in models.values()),
             "models": models,
+            "refitsInFlight": inflight,
+            "refits": list(self.refit_history),
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -119,6 +278,10 @@ class ModelRegistry:
             self._runtimes.clear()
         for rt in rts:
             rt.close(drain=drain)
+        # a refit racing close() targets an unregistered name and exits;
+        # wait briefly so no tg-drift-refit thread outlives the registry
+        for t in _drift.live_refits():
+            t.join(timeout=30)
 
     def __enter__(self) -> "ModelRegistry":
         return self

@@ -160,6 +160,7 @@ class ServingRuntime:
                  breaker: Optional[CircuitBreaker] = None,
                  fault_log: Optional[FaultLog] = None,
                  metrics_registry: Optional[_obs_metrics.MetricsRegistry] = None,
+                 drift_monitor=None,
                  auto_start: bool = True):
         self.model = model
         self.name = name
@@ -168,6 +169,12 @@ class ServingRuntime:
         self.metrics = metrics_registry or _obs_metrics.MetricsRegistry()
         #: serve-scoped fault accounting (ring-bounded; TG_FAULTS_MAX)
         self.fault_log = fault_log or FaultLog()
+        #: online distribution monitor (serving/drift.py); every scored
+        #: micro-batch folds into it on the batcher thread, behind a
+        #: crash-isolation fence — a drift failure can never fail a request
+        self.drift_monitor = drift_monitor
+        if drift_monitor is not None:
+            drift_monitor.bind(name, self.metrics, self.fault_log)
         self.warm_info: Optional[Dict[str, Any]] = None
         self._scorer = micro_batch_score_function(model)
         self._eager_row = score_function(model)
@@ -424,6 +431,35 @@ class ServingRuntime:
         if quarantined:
             self._count("tg_serve_quarantined_total", float(quarantined),
                         help="requests quarantined under __score_error__")
+        # drift fold AFTER every future resolved: still on the batcher
+        # thread (off the request hot path), post-quarantine, and fenced —
+        # nothing past this line can affect a response
+        self._drift_observe(reqs, recs)
+
+    def _drift_observe(self, reqs: Sequence[_Request],
+                       recs: Sequence[Dict[str, Any]]) -> None:
+        """The drift crash-isolation fence: fold the batch's clean rows
+        into the monitor; ANY exception (a ``drift.fold`` chaos raise, a
+        poisoned fold, a monitor bug) is typed ``drift_fold_failed`` in
+        the FaultLog + ``tg_drift_errors_total`` and swallowed."""
+        mon = self.drift_monitor
+        if mon is None:
+            return
+        rows = [r.row for r, rec in zip(reqs, recs)
+                if SCORE_ERROR_KEY not in rec]
+        if not rows:
+            return
+        try:
+            mon.observe(rows)
+        except Exception as e:
+            mon.fold_errors += 1
+            self._count("tg_drift_errors_total", reason="fold",
+                        help="drift-monitor failures contained by the "
+                        "crash-isolation fence (docs/serving.md)")
+            self.fault_log.add(FaultReport(
+                site="drift.fold", kind="drift_fold_failed",
+                detail={"model": self.name, "rows": len(rows),
+                        "error": f"{type(e).__name__}: {e}"[:300]}))
 
     # -- accounting ----------------------------------------------------------
     def _record_degraded(self, site: str, rows: int,
@@ -499,6 +535,10 @@ class ServingRuntime:
             "faults": {"reports": len(self.fault_log.reports),
                        "dropped": self.fault_log.dropped},
             "warm": self.warm_info,
+            # per-model drift verdict + per-feature JS/fill deltas
+            # (serving/drift.py); None when no monitor is attached
+            "drift": (self.drift_monitor.snapshot()
+                      if self.drift_monitor is not None else None),
         }
 
     def health_state(self) -> str:

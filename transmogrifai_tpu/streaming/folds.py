@@ -336,11 +336,25 @@ class HistogramFold(MonoidFold):
         return state
 
     def _hist_of(self, state, j) -> StreamingHistogram:
+        """The raw bin-multiset carrier for column ``j``. INTERNAL: the
+        state concatenates one sorted run per accumulated chunk, so the
+        bins are NOT globally sorted — only ``StreamingHistogram.merged``
+        (which lexsorts + coalesces) may consume this; ``sum``/``density``
+        on it would silently interpolate garbage."""
         return StreamingHistogram.from_state({
             "max_bins": max(self.max_bins, state[f"c{j}"].size),
             "centers": state[f"c{j}"], "masses": state[f"m{j}"],
             "total": state[f"m{j}"].sum(),
             "min": state[f"r{j}"][0], "max": state[f"r{j}"][1]})
+
+    def column_histogram(self, state, j: int) -> StreamingHistogram:
+        """Column ``j``'s canonical sketch (≤ max_bins bins, queryable) —
+        the public single-column accessor. RawFeatureFilter distributions
+        and the serving DriftMonitor both build their
+        ``FeatureDistribution`` views through it
+        (filters/distribution.py ``fold_distribution``)."""
+        return StreamingHistogram.merged([self._hist_of(state, j)],
+                                         max_bins=self.max_bins)
 
     def _compact(self, state, j) -> None:
         h = StreamingHistogram.merged([self._hist_of(state, j)],
@@ -359,9 +373,7 @@ class HistogramFold(MonoidFold):
 
     def finalize(self, state) -> List[StreamingHistogram]:
         """One canonical sketch per column (≤ max_bins bins each)."""
-        return [StreamingHistogram.merged([self._hist_of(state, j)],
-                                          max_bins=self.max_bins)
-                for j in range(self.d)]
+        return [self.column_histogram(state, j) for j in range(self.d)]
 
     def fill_rates(self, state) -> np.ndarray:
         """Per-column fill fraction — the RawFeatureFilter backing stat."""

@@ -401,6 +401,37 @@ class OpWorkflow(_WorkflowCore):
         model._layers = compute_dag(new_results)
         return model
 
+    def drift_refit_hook(self, save_dir: str, resume: Optional[bool] = None):
+        """A serving-registry refit hook bound to this workflow
+        (``ModelRegistry(refit_hook=...)`` / ``set_refit_hook``;
+        docs/serving.md "Drift monitoring & self-healing"): when a served
+        model's drift verdict degrades, the registry calls the hook on a
+        background thread; it retrains this workflow on whatever its
+        reader/input currently yields (point the reader at fresh data —
+        that is the whole point of a drift refit), saves the result under
+        ``save_dir`` (``refit_000001``, ``refit_000002``, ... so the
+        in-service model directory is never written over while being
+        read), and returns the saved path for the registry's
+        manifest-verified load + warm hot swap.
+
+        ``resume`` defaults to whether a checkpoint dir is attached —
+        ``with_checkpoint_dir`` makes the refit itself preemption-safe
+        (``train(resume=True)`` restores verified stages and replays
+        sweep state instead of starting over after a kill)."""
+        import os as _os
+        counter = {"n": 0}
+        if resume is None:
+            resume = getattr(self, "_checkpoint_dir", None) is not None
+
+        def hook(name: str, runtime, report) -> str:
+            counter["n"] += 1
+            model = self.train(resume=resume)
+            path = _os.path.join(save_dir, f"refit_{counter['n']:06d}")
+            model.save(path)
+            return path
+
+        return hook
+
     def _fit_with_workflow_cv(self, table: FeatureTable, layers):
         """The cutDAG path (reference FitStagesUtil.cutDAG:305-358 +
         OpWorkflow.fitStages:397-442): fit label-independent stages once,
