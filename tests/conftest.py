@@ -196,6 +196,34 @@ def _no_stream_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_watchdog_leak():
+    """Watchdog hearts drive a shared ``tg-watchdog`` scanner thread that
+    lives exactly as long as hearts are registered (robustness/watchdog.py)
+    — a heart leaked by a test (an unclosed runtime/feed, a wedged refit)
+    would keep the scanner alive and could fire stalls into later tests'
+    fault logs. Mirrors the serving/stream no-leak fixtures: assert no
+    hearts on entry, close leftovers + join the scanner + fail on exit."""
+    import threading
+
+    from transmogrifai_tpu.robustness import watchdog as _wd
+
+    assert not _wd.live_hearts(), (
+        "watchdog heart(s) leaked from a previous test: "
+        f"{[h.name for h in _wd.live_hearts()]}")
+    yield
+    leaked = _wd.live_hearts()
+    for h in leaked:
+        h.close()
+    _wd.idle_join()
+    assert not leaked, (
+        "a test leaked open watchdog heart(s): "
+        f"{[h.name for h in leaked]}")
+    stray = [t.name for t in threading.enumerate()
+             if t.name.startswith("tg-watchdog") and t.is_alive()]
+    assert not stray, f"watchdog thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_injection_leak(request):
     """Fault-injection sites must be inert outside chaos tests: an armed
     site leaking out of a ``chaos``-marked test (or in via a stray

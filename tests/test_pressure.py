@@ -1,0 +1,488 @@
+"""Resource-exhaustion resilience (robustness/resources.py +
+robustness/watchdog.py; docs/robustness.md "Resource exhaustion &
+watchdog"): forced ``oom.*`` chaos at every device-dispatch choke point
+must complete with results bit-equal to the unforced run (plan / serve),
+an identical sweep winner, and a finished streamed train; exhaustion is
+classified away from blind retry; the watchdog detects stalled threads
+deterministically via an injectable clock and aborts a wedged feed."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import plan as plan_mod
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+from transmogrifai_tpu.models.api import MODEL_REGISTRY
+from transmogrifai_tpu.robustness import faults, resources
+from transmogrifai_tpu.robustness import watchdog as wd_mod
+from transmogrifai_tpu.robustness.faults import TransientFaultError
+from transmogrifai_tpu.robustness.policy import (
+    FaultLog, RetryPolicy, is_transient_error,
+)
+from transmogrifai_tpu.robustness.resources import (
+    ResourceExhaustedError, classify_exhaustion,
+)
+from transmogrifai_tpu.robustness.watchdog import Watchdog, WatchdogStallError
+from transmogrifai_tpu.serving import ServeConfig, ServingRuntime
+from transmogrifai_tpu.streaming import DeviceFeed, TableChunkSource
+from transmogrifai_tpu.streaming import feed as feed_mod
+from transmogrifai_tpu.table import Column, FeatureTable
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.pressure
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _train_model(n=300, d=2, seed=7):
+    rng = np.random.RandomState(seed)
+    cols = {f"x{i}": rng.randn(n) for i in range(d)}
+    y = (sum(cols.values()) > 0).astype(float)
+    df = pd.DataFrame({**cols, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed,
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train_model()
+
+
+def _rows(n, d=2, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{f"x{i}": float(rng.randn()) for i in range(d)}
+            for _ in range(n)]
+
+
+class _FakeXlaRuntimeError(RuntimeError):
+    pass
+
+
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+# ---------------------------------------------------------------------------
+# classification + retry routing (the policy.py misclassification fix)
+# ---------------------------------------------------------------------------
+
+def test_classify_exhaustion_recognizes_device_and_host_oom():
+    assert classify_exhaustion(MemoryError("boom")) is not None
+    assert classify_exhaustion(_FakeXlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 8589934592 bytes"
+    )) is not None
+    assert classify_exhaustion(RuntimeError(
+        "Resource exhausted: failed to allocate request")) is not None
+    err = ResourceExhaustedError("injected", site="oom.plan")
+    assert classify_exhaustion(err) is err
+    # non-exhaustion stays unclassified
+    assert classify_exhaustion(ValueError("shape mismatch")) is None
+    assert classify_exhaustion(RuntimeError("UNAVAILABLE: link reset")) is None
+
+
+def test_exhaustion_is_never_transient():
+    """The 'resource temporarily'/OSError heuristics used to let genuine
+    exhaustion match as transient and be retried verbatim — a futile,
+    identical allocation. Exhaustion must classify fatal-for-retry."""
+    assert not is_transient_error(MemoryError("boom"))
+    assert not is_transient_error(ResourceExhaustedError("x"))
+    assert not is_transient_error(_FakeXlaRuntimeError(
+        "RESOURCE_EXHAUSTED: resource temporarily exhausted"))
+    # genuine transients keep retrying
+    assert is_transient_error(ConnectionResetError("reset"))
+    assert is_transient_error(TransientFaultError("injected"))
+    assert is_transient_error(RuntimeError("UNAVAILABLE: link reset"))
+
+
+def test_retry_policy_never_retries_exhaustion():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ResourceExhaustedError("RESOURCE_EXHAUSTED: out of memory")
+
+    log = FaultLog()
+    with log.activate():
+        with pytest.raises(ResourceExhaustedError):
+            RetryPolicy(max_retries=3, base_delay=0.001).execute(fn, "site")
+    assert len(calls) == 1          # no blind retry of the same allocation
+    assert len(log.of_kind("fatal")) == 1
+
+
+# ---------------------------------------------------------------------------
+# oom.plan: planned transform bisects to smaller padding buckets
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_oom_plan_bisects_bit_equal(model):
+    """A planned run whose segment exhausts must bisect the row batch and
+    produce byte-identical values/masks to the unforced planned run."""
+    n = 600
+    rows = _rows(n, seed=11)
+    mb_clean = tg.local.micro_batch_score_function(model)
+    clean = mb_clean(rows)
+    plan_mod.clear_plan_cache()
+    log = FaultLog()
+    with log.activate():
+        with faults.injected({"oom.plan": {"mode": "oom", "nth": 1}}):
+            mb = tg.local.micro_batch_score_function(model)
+            forced = mb(rows)
+    assert forced == clean
+    downshifts = log.of_kind("oom_downshift")
+    assert downshifts and downshifts[0].site == "oom.plan"
+    assert downshifts[0].detail["rows"] == 600
+    # and no eager plan_fallback was needed — the bisect recovered it
+    assert not log.of_kind("plan_fallback")
+
+
+@pytest.mark.chaos
+def test_oom_plan_exhausted_below_min_bucket_falls_back_eager(model):
+    """Persistent exhaustion (every bisect level fires) must land on the
+    pre-existing eager fallback — still bit-equal, recorded as
+    plan_fallback."""
+    rows = _rows(64, seed=12)
+    clean = tg.local.micro_batch_score_function(model)(rows)
+    plan_mod.clear_plan_cache()
+    log = FaultLog()
+    with log.activate():
+        with faults.injected({"oom.plan": {"mode": "oom", "nth": 1,
+                                           "count": 10_000}}):
+            forced = tg.local.micro_batch_score_function(model)(rows)
+    assert forced == clean
+    assert log.of_kind("plan_fallback")     # eager rescue, never silent
+
+
+# ---------------------------------------------------------------------------
+# oom.serve: flush splits to singletons, breaker untouched, zero failures
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_oom_serve_splits_flush_bit_equal(model):
+    rows = _rows(16, seed=13)
+    mb = tg.local.micro_batch_score_function(model)
+    expect = [mb([r])[0] for r in rows]
+    cfg = ServeConfig(max_batch=16, max_queue=64, max_wait_ms=20.0)
+    with faults.injected({"oom.serve": {"mode": "oom", "nth": 1}}):
+        # stage the queue BEFORE starting so the whole batch coalesces
+        # into one flush — the flush that exhausts and splits
+        rt = ServingRuntime(model, "oomserve", cfg, auto_start=False)
+        try:
+            futs = [rt.submit(r) for r in rows]
+            rt.start()
+            got = [f.result(timeout=30) for f in futs]
+            summary = rt.summary()
+        finally:
+            rt.close()
+    assert got == expect                      # zero failed, bit-equal
+    assert summary["faults"]["oomDownshifts"] >= 1
+    assert summary["breaker"]["state"] == "closed"
+    assert summary["breaker"]["opens"] == 0   # resource faults don't count
+    assert summary["degradedRows"] == 0       # served compiled, just split
+
+
+@pytest.mark.chaos
+def test_oom_serve_singleton_exhaustion_degrades_eager_zero_failures(model):
+    """Even when every compiled dispatch (down to singletons) exhausts,
+    requests are served through the eager per-row path — bit-equal,
+    breaker still closed."""
+    rows = _rows(6, seed=14)
+    eager = tg.local.score_function(model)
+    expect = [eager(r) for r in rows]
+    cfg = ServeConfig(max_batch=8, max_queue=64, max_wait_ms=20.0)
+    with faults.injected({"oom.serve": {"mode": "oom", "nth": 1,
+                                        "count": 10_000}}):
+        rt = ServingRuntime(model, "oomeager", cfg, auto_start=False)
+        try:
+            futs = [rt.submit(r) for r in rows]
+            rt.start()
+            got = [f.result(timeout=30) for f in futs]
+            summary = rt.summary()
+        finally:
+            rt.close()
+    assert got == expect
+    assert summary["breaker"]["opens"] == 0
+    assert summary["degradedRows"] == len(rows)
+    kinds = {r.kind for r in rt.fault_log.reports}
+    assert "oom_downshift" in kinds and "breaker_degraded" in kinds
+
+
+@pytest.mark.chaos
+def test_non_resource_dispatch_faults_still_feed_breaker(model):
+    """The breaker contract is unchanged for non-resource faults: enough
+    consecutive dispatch failures still open it."""
+    cfg = ServeConfig(max_batch=4, max_queue=64, max_wait_ms=2.0,
+                      breaker_failures=2, breaker_reset_ms=60_000.0)
+    with faults.injected({"serve.dispatch": {"mode": "raise", "nth": 1,
+                                             "count": 10}}):
+        with ServingRuntime(model, "nonoom", cfg) as rt:
+            for r in _rows(6, seed=15):
+                rt.score(r, timeout=30)
+            snap = rt.breaker.snapshot()
+    assert snap["opens"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# oom.stream: chunk budget halves, train completes, prep stats bit-equal
+# ---------------------------------------------------------------------------
+
+def _stream_table(n=2000, d=6, seed=21):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    mask = rng.rand(n, d) >= 0.05
+    y = (np.where(mask, X, 0.0)[:, 0] > 0.3).astype(np.float32)
+    cols = {f"x{i}": Column(Real, X[:, i], mask[:, i]) for i in range(d)}
+    cols["y"] = Column(RealNN, y, None)
+    return FeatureTable(cols, n)
+
+
+def _stream_pipeline(d=6):
+    from transmogrifai_tpu.streaming import StreamingGBT
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.transform_with(SanityChecker(seed=1),
+                                   tg.transmogrify(feats))
+    return (StreamingGBT(problem="binary", num_trees=1, max_depth=2,
+                         n_bins=8, learning_rate=1.0)
+            .set_input(label, checked).get_output())
+
+
+@pytest.mark.chaos
+def test_oom_stream_halves_chunk_budget_and_completes():
+    table = _stream_table()
+    clean = (OpWorkflow().set_result_features(_stream_pipeline())
+             .train(stream=TableChunkSource(table, chunk_rows=400)))
+    with faults.injected({"oom.stream": {"mode": "oom", "nth": 2}}):
+        forced = (OpWorkflow().set_result_features(_stream_pipeline())
+                  .train(stream=TableChunkSource(table, chunk_rows=400)))
+    # the monoid prep folds are schedule-invariant: bit-equal fills/stats
+    rv_c = [s for s in clean.stages
+            if type(s).__name__ == "RealVectorizerModel"][0]
+    rv_f = [s for s in forced.stages
+            if type(s).__name__ == "RealVectorizerModel"][0]
+    assert np.array_equal(np.asarray(rv_c.fills), np.asarray(rv_f.fills))
+    faultlog = forced.summary()["faults"]
+    assert faultlog["oomDownshifts"], faultlog
+    ds = faultlog["oomDownshifts"][0]
+    assert ds["site"] == "oom.stream" and ds["detail"]["chunkRows"] == 200
+    # scores agree to documented tree tolerance
+    sc_c = clean.score(table=table.drop(["y"]))
+    sc_f = forced.score(table=table.drop(["y"]))
+    pc = np.asarray(sc_c[clean.result_features[0].name].values,
+                    dtype=np.float64)
+    pf = np.asarray(sc_f[forced.result_features[0].name].values,
+                    dtype=np.float64)
+    assert np.allclose(pc, pf, atol=5e-2)
+
+
+@pytest.mark.chaos
+def test_oom_stream_at_floor_raises_typed():
+    """Exhaustion below the TG_OOM_MIN_CHUNK_ROWS floor (or an odd budget
+    that cannot halve chunk-aligned) must surface the typed error, not
+    loop or silently truncate the dataset."""
+    table = _stream_table(600, 4)
+    with faults.injected({"oom.stream": {"mode": "oom", "nth": 1,
+                                         "count": 10_000}}):
+        with pytest.raises(ResourceExhaustedError):
+            (OpWorkflow().set_result_features(_stream_pipeline(4))
+             .train(stream=TableChunkSource(table, chunk_rows=100)))
+    assert not feed_mod.live_feeds()
+
+
+# ---------------------------------------------------------------------------
+# oom.sweep: grid splits, metrics merge, winner identical, no quarantine
+# ---------------------------------------------------------------------------
+
+def _sweep_inputs(n=800, d=6, seed=31):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d).astype(np.float32) > 0).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y)
+
+
+def _sweep_models():
+    lr = [{"regParam": r, "elasticNetParam": e}
+          for r in (0.001, 0.01, 0.1, 0.3) for e in (0.0, 0.5)]
+    svc = [{"regParam": float(r)} for r in (0.001, 0.01, 0.1)]
+    return [(MODEL_REGISTRY["OpLogisticRegression"], lr),
+            (MODEL_REGISTRY["OpLinearSVC"], svc)]
+
+
+@pytest.mark.chaos
+def test_oom_sweep_splits_grid_winner_identical():
+    Xd, yd = _sweep_inputs()
+    cv = OpCrossValidation(num_folds=3, seed=0)
+    clean = cv.validate(_sweep_models(), Xd, yd, "binary", "AuROC", True, 2)
+    log = FaultLog()
+    with log.activate():
+        with faults.injected({"oom.sweep": {"mode": "oom", "nth": 1,
+                                            "count": 2,
+                                            "key": "OpLogisticRegression"}}):
+            forced = OpCrossValidation(num_folds=3, seed=0).validate(
+                _sweep_models(), Xd, yd, "binary", "AuROC", True, 2)
+    assert forced.family_name == clean.family_name
+    assert forced.hyper == clean.hyper
+    assert forced.metric_value == clean.metric_value
+    assert not forced.quarantined            # downshifted, NOT quarantined
+    for rc, rf in zip(clean.results, forced.results):
+        assert np.array_equal(rc.fold_metrics, rf.fold_metrics), rc.family
+    ds = log.of_kind("oom_downshift")
+    assert ds and ds[0].site == "oom.sweep"
+    assert ds[0].detail["family"] == "OpLogisticRegression"
+
+
+@pytest.mark.chaos
+def test_oom_sweep_single_config_exhaustion_quarantines_family():
+    """Exhaustion that survives down to a single config exhausts the
+    downshift ladder: the family quarantines (pre-existing semantics) and
+    the other families still race."""
+    Xd, yd = _sweep_inputs(400, 4, seed=32)
+    with faults.injected({"oom.sweep": {"mode": "oom", "nth": 1,
+                                        "count": 10_000,
+                                        "key": "OpLinearSVC"}}):
+        best = OpCrossValidation(num_folds=2, seed=0).validate(
+            _sweep_models(), Xd, yd, "binary", "AuROC", True, 2)
+    assert best.family_name == "OpLogisticRegression"
+    assert any(q["family"] == "OpLinearSVC" for q in best.quarantined)
+
+
+# ---------------------------------------------------------------------------
+# watchdog: stall detection (injectable clock), feed abort, breaker trip
+# ---------------------------------------------------------------------------
+
+def test_watchdog_detects_stall_once_per_episode():
+    now = [0.0]
+    wd = Watchdog(stall_after=10.0, clock=lambda: now[0],
+                  start_thread=False)
+    stalls = []
+    log = FaultLog()
+    h = wd.register("worker", kind="test",
+                    on_stall=lambda heart, waited: stalls.append(waited),
+                    fault_log=log)
+    assert wd.check_now() == []              # fresh heart: no stall
+    now[0] = 9.9
+    assert wd.check_now() == []
+    now[0] = 10.0
+    assert wd.check_now() == [h]             # budget reached: fires once
+    assert wd.check_now() == []              # same episode: no re-fire
+    assert h.stalls == 1 and stalls == [10.0]
+    reports = log.of_kind("thread_stalled")
+    assert len(reports) == 1
+    assert reports[0].site == "watchdog.test"
+    h.beat()                                  # beats resume: episode ends
+    assert wd.check_now() == []
+    now[0] = 25.0
+    assert wd.check_now() == [h]             # new episode fires again
+    assert h.stalls == 2
+    h.close()
+    assert wd.check_now() == []
+
+
+def test_watchdog_disabled_returns_inert_heart(monkeypatch):
+    monkeypatch.setenv("TG_WATCHDOG_S", "0")
+    h = wd_mod.register("nothing", kind="test")
+    assert h is wd_mod.NULL_HEART
+    h.beat()
+    h.close()
+    assert not wd_mod.live_hearts()
+
+
+def test_watchdog_aborts_wedged_feed(monkeypatch):
+    """A producer wedged inside its chunk source must not hang the
+    consumer: the watchdog aborts the feed with a typed error."""
+    monkeypatch.setenv("TG_WATCHDOG_S", "0.2")
+    release = threading.Event()
+
+    def chunks():
+        yield next(iter(TableChunkSource(_stream_table(100, 2),
+                                         chunk_rows=100).chunks(0)))
+        release.wait(30)        # the wedge: blocks until the test releases
+        return
+
+    feed = DeviceFeed(chunks(), prefetch=1)
+    try:
+        first = next(feed)
+        assert first.rows == 100
+        with pytest.raises(WatchdogStallError):
+            next(feed)          # producer never delivers: watchdog aborts
+    finally:
+        release.set()           # unwedge so close() joins cleanly
+        feed.close()
+    assert feed.closed and not feed_mod.live_feeds()
+
+
+def test_watchdog_stall_trips_serving_breaker(model):
+    """The runtime's stall response: breaker tripped open + serve-local
+    stall counter + thread_stalled on the serve-scoped FaultLog (driven
+    directly — wedging a real batcher deterministically would need a hung
+    XLA program)."""
+    with ServingRuntime(model, "stall", ServeConfig(max_batch=4,
+                                                    max_queue=16)) as rt:
+        heart = rt._heart
+        assert heart is not None and not heart.stalled
+        wd_mod.report_thread_stalled(
+            site="watchdog.serve.batcher", thread_name=heart.name,
+            waited_s=31.0, fault_log=rt.fault_log)
+        rt._on_watchdog_stall(heart, 31.0)
+        assert rt.breaker.state == "open"
+        assert rt.summary()["faults"]["threadStalls"] == 1
+        snap = rt.metrics.snapshot()
+        key = "model=stall,site=serve.batcher"
+        assert snap["tg_watchdog_stalls_total"][key] == 1.0
+        # breaker heals: a successful probe closes it again
+        rt.breaker.record_success()
+        assert rt.breaker.state == "closed"
+
+
+def test_join_leak_is_recorded_not_silent():
+    """The shared accounting behind the feed/runtime/registry close()
+    fixes: a thread alive past its join timeout lands in
+    summary()['faults']['threadStalls'], never discarded silently."""
+    log = FaultLog()
+    wd_mod.report_thread_stalled(site="stream.close",
+                                 thread_name="tg-stream-feed",
+                                 waited_s=5.0, fault_log=log)
+    out = log.to_json()
+    assert len(out["threadStalls"]) == 1
+    assert out["threadStalls"][0]["detail"]["thread"] == "tg-stream-feed"
+
+
+# ---------------------------------------------------------------------------
+# chaos hygiene
+# ---------------------------------------------------------------------------
+
+def test_oom_sites_inert_after_injected_context():
+    with faults.injected({"oom.plan": {"mode": "oom", "nth": 1},
+                          "oom.serve": {"mode": "oom", "nth": 1},
+                          "oom.stream": {"mode": "oom", "nth": 1},
+                          "oom.sweep": {"mode": "oom", "nth": 1}}):
+        assert len(faults.active_sites()) == 4
+    assert not faults.active_sites()
+    faults.inject("oom.plan")    # disarmed: must not raise
+
+
+def test_oom_sites_keep_planner_active():
+    with faults.injected({"oom.serve": {"mode": "oom", "nth": 1}}):
+        assert plan_mod.planning_applicable()
+    with faults.injected({"dag.stage_fit": {"mode": "raise", "nth": 1}}):
+        assert not plan_mod.planning_applicable()

@@ -115,7 +115,9 @@ def test_fault_log_inactive_record_is_noop():
     assert log.to_json() == {"quarantined": [], "retries": [],
                              "checkpointsSkipped": [], "restored": [],
                              "planFallbacks": [], "breakerDegraded": [],
-                             "drift": [], "fatal": [], "droppedReports": 0}
+                             "drift": [], "oomDownshifts": [],
+                             "threadStalls": [], "fatal": [],
+                             "droppedReports": 0}
 
 
 # ---------------------------------------------------------------------------

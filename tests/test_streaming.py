@@ -569,3 +569,58 @@ def test_corrupt_stream_checkpoint_detected_and_refolded():
         assert any(r["site"] == "stream.checkpoint" for r in skipped)
     finally:
         shutil.rmtree(ck, ignore_errors=True)
+
+
+@pytest.mark.chaos
+def test_kill_during_downshifted_stream_resumes_bit_equal():
+    """Memory pressure mid-pass halves the chunk row budget (oom.stream →
+    robustness/resources.py); a preemption while folding on the HALVED
+    grid must resume against the same downshifted schedule — the
+    checkpoint record carries its ``chunkRows`` — and reproduce the
+    uninterrupted downshifted run's model bit-exactly."""
+    table, _, _, _ = _table(2000, 5, seed=19)
+
+    def pipeline():
+        label = FeatureBuilder.RealNN("y").extract_field().as_response()
+        feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+                 for i in range(5)]
+        checked = label.transform_with(SanityChecker(seed=1),
+                                       tg.transmogrify(feats))
+        return (StreamingGBT(problem="binary", num_trees=1, max_depth=2,
+                             n_bins=8, learning_rate=1.0)
+                .set_input(label, checked).get_output())
+
+    # reference: the SAME downshift (oom at the 2nd chunk production →
+    # 400 → 200 rows/chunk), uninterrupted
+    with faults.injected({"oom.stream": {"mode": "oom", "nth": 2}}):
+        ref_model = (OpWorkflow().set_result_features(pipeline())
+                     .train(stream=TableChunkSource(table, chunk_rows=400)))
+    ref = _gbt_of(ref_model)
+    assert ref_model.summary()["faults"]["oomDownshifts"]
+
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(pipeline())
+              .with_checkpoint_dir(ck))
+        # same oom, then a kill while folding on the downshifted grid
+        # (fold call 5 = the 4th halved chunk of the first pass)
+        with pytest.raises(SimulatedPreemption):
+            with faults.injected({
+                    "oom.stream": {"mode": "oom", "nth": 2},
+                    "stream.fold": {"mode": "preempt", "nth": 5}}):
+                wf.train(stream=TableChunkSource(table, chunk_rows=400))
+        assert not feed_mod.live_feeds()
+        # the committed record must carry the downshifted chunking
+        import json
+        with open(os.path.join(ck, "MANIFEST.json")) as fh:
+            manifest = json.load(fh)
+        assert any(rec.get("chunkRows") == 200
+                   for rec in manifest.get("streams", {}).values())
+        resumed = wf.train(resume=True,
+                           stream=TableChunkSource(table, chunk_rows=400))
+        assert _trees_equal(ref, _gbt_of(resumed))
+        # the resumed pass restored the downshifted record, not a refold
+        restored = resumed.summary()["faults"]["restored"]
+        assert any(r["detail"].get("chunkRows") == 200 for r in restored)
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)

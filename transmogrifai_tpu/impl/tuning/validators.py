@@ -545,6 +545,48 @@ class OpValidator:
                                         site="sweep.table_upload")
 
         def _dispatch(family, grid):
+            """One family's sweep branch with adaptive degradation under
+            memory pressure: resource exhaustion (XLA RESOURCE_EXHAUSTED /
+            host MemoryError — or the ``oom.sweep`` chaos site) splits the
+            packed (F·G) config grid in half and dispatches the halves as
+            their own fused programs, recursively down to single configs;
+            the per-config fold metrics merge back by concatenation along
+            the config axis (each config's metric is independent of its
+            batch-mates, so the merged (F, G) matrix is identical to the
+            unsplit program's). The family is DOWNSHIFTED, not
+            quarantined; only a single config that still exhausts — or any
+            non-resource throw — propagates to the quarantine handler
+            below."""
+            try:
+                faults.inject("oom.sweep", key=family.name)
+                return _dispatch_once(family, grid)
+            except Exception as e:
+                from ...robustness import resources
+                if (resources.classify_exhaustion(e) is None
+                        or len(grid) < 2):
+                    raise
+                mid = len(grid) // 2
+                resources.record_downshift(
+                    "oom.sweep", family=family.name, configs=len(grid),
+                    splitConfigs=[mid, len(grid) - mid],
+                    error=f"{type(e).__name__}: {e}"[:200])
+                logger.warning(
+                    "sweep branch for %s exhausted memory at %d configs; "
+                    "splitting the grid into %d + %d",
+                    family.name, len(grid), mid, len(grid) - mid)
+                _, _, m1, _, G1 = _dispatch(family, grid[:mid])
+                _, _, m2, _, G2 = _dispatch(family, grid[mid:])
+                # metric monoid merge: un-pad each half to its (F, Gi)
+                # matrix and concatenate along the config axis — the
+                # merged flat vector is exactly the unsplit program's
+                # [:B_true] slice (finish() reshapes it to (F, G))
+                m = jnp.concatenate(
+                    [m1.reshape(-1)[:F * G1].reshape(F, G1),
+                     m2.reshape(-1)[:F * G2].reshape(F, G2)],
+                    axis=1).reshape(-1)
+                return (family.name, list(grid), m, F * (G1 + G2), G1 + G2)
+
+        def _dispatch_once(family, grid):
             """One family's sweep branch → a pending (name, grid, metric
             program output, B_true, G) entry. Runs under the quarantine
             try/except below: a throw here (trace error, diverging fused

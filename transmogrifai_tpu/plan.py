@@ -96,14 +96,18 @@ def planning_applicable() -> bool:
     fallback; sites prefixed ``serve.`` / ``drift.`` target the serving
     runtime and its drift monitor *above* the planner
     (serving/runtime.py, serving/drift.py), whose chaos tests must
-    exercise the real planned dispatch path, not an eager stand-in."""
+    exercise the real planned dispatch path, not an eager stand-in;
+    sites prefixed ``oom.`` inject resource exhaustion into the planned /
+    serve / stream / sweep dispatch paths themselves — disabling the
+    planner would disable exactly the path under test."""
     if not plan_enabled():
         return False
     from .robustness import faults
     if os.environ.get(faults.CHAOS_ENV):
         return False
     armed = faults.active_sites()
-    if any(not s.startswith(("plan.", "serve.", "drift.")) for s in armed):
+    if any(not s.startswith(("plan.", "serve.", "drift.", "oom."))
+           for s in armed):
         return False
     return True
 
@@ -251,6 +255,10 @@ class TransformPlan:
         # deterministic chaos entry: a fault here models an XLA runtime
         # error mid-plan; apply_planned catches it and falls back to eager
         faults.inject("plan.segment_execute", key=seg.stages[0].uid)
+        # chaos: a RESOURCE_EXHAUSTED here models the padded segment not
+        # fitting on the device; apply_planned bisects the row batch to
+        # smaller padding buckets before falling back to eager
+        faults.inject("oom.plan", key=seg.stages[0].uid)
         n = table.num_rows
         n_pad = bucket_for(n)
         t0 = (time.perf_counter()
@@ -539,6 +547,58 @@ def get_plan(stages: Sequence[Any], table: FeatureTable, *,
     return plan
 
 
+def _concat_columns(a: Column, b: Column) -> Column:
+    """Row-concatenate two halves of a bisected run. Device (jnp) values
+    stay on device; host/object arrays concat with numpy. A mask present
+    on either half materializes on both (None = all-valid)."""
+    va, vb = a.values, b.values
+    if isinstance(va, np.ndarray) and isinstance(vb, np.ndarray):
+        vals = np.concatenate([va, vb])
+    else:
+        import jax.numpy as jnp
+        vals = jnp.concatenate([jnp.asarray(va), jnp.asarray(vb)])
+    if a.mask is None and b.mask is None:
+        mask = None
+    else:
+        mask = np.concatenate([a.valid_mask(), b.valid_mask()])
+    return Column(a.feature_type, vals, mask, dict(a.metadata))
+
+
+def _concat_tables(a: FeatureTable, b: FeatureTable) -> FeatureTable:
+    cols = {nm: _concat_columns(a[nm], b[nm]) for nm in a.column_names}
+    key = (None if a.key is None or b.key is None
+           else np.concatenate([a.key, b.key]))
+    return FeatureTable(cols, a.num_rows + b.num_rows, key)
+
+
+def _execute_adaptive(plan: TransformPlan, table: FeatureTable) -> FeatureTable:
+    """Run the plan; on resource exhaustion bisect the row batch into
+    smaller padding buckets and concatenate the halves — bit-equal by
+    construction (every planned stage is a per-row map; padding rows carry
+    zero weight, so a half padded to a smaller bucket produces the exact
+    per-row values of the full batch). Below the minimum bucket a further
+    bisect cannot shrink the padded program, so the error propagates to
+    the existing eager fallback."""
+    from .robustness import resources
+    from .utils.padding import _MIN_BUCKET
+    try:
+        return plan.execute(table)
+    except Exception as e:
+        n = table.num_rows
+        if resources.classify_exhaustion(e) is None or n <= _MIN_BUCKET:
+            raise
+        mid = n // 2
+        resources.record_downshift(
+            "oom.plan", rows=n, splitRows=[mid, n - mid],
+            error=f"{type(e).__name__}: {e}"[:200])
+        logger.warning(
+            "planned transform run exhausted device memory at %d rows; "
+            "bisecting to %d + %d", n, mid, n - mid)
+        lo = _execute_adaptive(plan, table.take(np.arange(0, mid)))
+        hi = _execute_adaptive(plan, table.take(np.arange(mid, n)))
+        return _concat_tables(lo, hi)
+
+
 def apply_planned(stages: Sequence[Any], table: FeatureTable, *,
                   keep_intermediates: bool = True,
                   extra_keep: Sequence[str] = (),
@@ -551,14 +611,17 @@ def apply_planned(stages: Sequence[Any], table: FeatureTable, *,
     The fallback contract: a raised planned run records a FaultLog
     ``plan_fallback`` report (+ span event + tg_faults_total counter) and
     returns None; the caller's eager loop then produces identical results —
-    plans never transform the input table in place."""
+    plans never transform the input table in place. Resource exhaustion
+    gets one extra rung first: the run bisects its row batch into smaller
+    padding buckets (``oom_downshift``; docs/robustness.md) and only falls
+    back to eager when even the minimum bucket exhausts."""
     plan = get_plan(stages, table, keep_intermediates=keep_intermediates,
                     extra_keep=extra_keep, cat=cat,
                     min_device_stages=min_device_stages)
     if plan is None:
         return None
     try:
-        return plan.execute(table)
+        return _execute_adaptive(plan, table)
     except Exception as e:
         from .robustness.policy import FaultLog, FaultReport
         FaultLog.record(FaultReport(

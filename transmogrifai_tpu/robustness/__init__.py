@@ -13,7 +13,15 @@ programs, so resilience has to be rebuilt at the framework layer:
   params, producing quarantine records instead of crashes;
 * :mod:`.faults` — env/config-driven deterministic fault injection (named
   sites, fail-Nth-call, NaN poisoning) so every recovery path is testable
-  on CPU (``JAX_PLATFORMS=cpu``, ``TG_CHAOS=1``).
+  on CPU (``JAX_PLATFORMS=cpu``, ``TG_CHAOS=1``);
+* :mod:`.resources` — resource-exhaustion classification
+  (``classify_exhaustion``: XLA ``RESOURCE_EXHAUSTED`` / host
+  ``MemoryError`` → typed ``ResourceExhaustedError``) and the
+  ``oom_downshift`` accounting behind the adaptive-degradation paths;
+* :mod:`.watchdog` — heartbeat hang detection for worker threads
+  (``TG_WATCHDOG_S``): a stalled batcher / feed producer / refit thread
+  is recorded (``thread_stalled``), trips the serving breaker, or aborts
+  a wedged feed with a typed error instead of hanging forever.
 
 See docs/robustness.md for the fault-policy contract, the injection-site
 table, and the ``summary()["faults"]`` schema.
@@ -26,3 +34,7 @@ from .guards import (  # noqa: F401
 from .policy import (  # noqa: F401
     FaultLog, FaultReport, RetryPolicy, is_transient_error,
 )
+from .resources import (  # noqa: F401
+    ResourceExhaustedError, classify_exhaustion, is_resource_exhausted,
+)
+from .watchdog import Watchdog, WatchdogStallError  # noqa: F401

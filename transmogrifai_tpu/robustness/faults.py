@@ -73,6 +73,27 @@ site                         fires in
                              refit hook runs (a raise means no new model:
                              typed ``drift_refit_failed``, the old model
                              keeps serving, breaker untouched)
+``oom.plan``                 before each fused transform-plan segment runs
+                             (plan.py; ``mode: "oom"`` raises a typed
+                             :class:`~.resources.ResourceExhaustedError`
+                             — the planned run bisects the row batch to
+                             smaller padding buckets, bit-equal by
+                             construction; ``oom.*`` sites keep the
+                             planner active like ``plan.*``/``serve.*``)
+``oom.serve``                before the compiled micro-batch dispatch in
+                             the serve batcher (serving/runtime.py; an
+                             exhausted flush splits in half down to
+                             singletons — requests degrade in latency,
+                             never fail, and the breaker counts only
+                             non-resource faults)
+``oom.stream``               in the chunk-feed producer, before the packed
+                             host→device upload (streaming/feed.py; the
+                             trainer halves the chunk row budget and
+                             continues from the committed-row prefix)
+``oom.sweep``                before a family's fused sweep program
+                             dispatches (validators.py; the packed (F·G)
+                             grid splits in half and fold metrics merge —
+                             the family is downshifted, not quarantined)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
@@ -130,8 +151,11 @@ class FaultSpec:
     """One armed site.
 
     ``mode``: ``"raise"`` (throw from :func:`inject`), ``"nan"`` (poison
-    the array passed to :func:`poison`), or ``"preempt"`` (throw
-    :class:`SimulatedPreemption` — a simulated process kill).
+    the array passed to :func:`poison`), ``"preempt"`` (throw
+    :class:`SimulatedPreemption` — a simulated process kill), or
+    ``"oom"`` (throw :class:`~.resources.ResourceExhaustedError` — a
+    simulated device/host allocation failure the adaptive downshift
+    paths recover from).
     ``nth``/``count``: fire on matching calls nth..nth+count-1 (1-based).
     ``key``: only fire when the call's ``key`` matches (None = any).
     ``index``: nan mode — flat index to poison; None poisons the whole
@@ -222,12 +246,17 @@ def inject(site: str, key: Optional[str] = None) -> None:
         return
     _load_env()
     spec = _fires(site, key)
-    if spec is None or spec.mode not in ("raise", "preempt"):
+    if spec is None or spec.mode not in ("raise", "preempt", "oom"):
         return
     if spec.mode == "preempt":
         raise SimulatedPreemption(
             f"simulated preemption at site '{site}'"
             + (f" (key={key})" if key else ""))
+    if spec.mode == "oom":
+        from .resources import ResourceExhaustedError
+        raise ResourceExhaustedError(
+            f"injected resource exhaustion at site '{site}'"
+            + (f" (key={key})" if key else ""), site=site)
     exc = TransientFaultError if spec.transient else InjectedFaultError
     raise exc(f"injected fault at site '{site}'"
               + (f" (key={key})" if key else ""))

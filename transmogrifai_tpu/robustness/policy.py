@@ -23,6 +23,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 from ..observability import metrics as _obs_metrics
 from ..observability import trace as _obs_trace
 from .faults import InjectedFaultError, TransientFaultError
+from .resources import is_resource_exhausted
 
 #: substrings (lowercased) marking an error transient: the gRPC-style
 #: status codes surfaced by jax/PJRT transfer failures plus socket-level
@@ -39,7 +40,17 @@ def is_transient_error(exc: BaseException) -> bool:
     types, OS-level I/O interruptions, and runtime errors whose message
     carries a retryable transport status. Everything else — ValueError,
     shape/trace errors, injected fatal faults — is fatal: retrying a
-    deterministic program on the same inputs cannot fix those."""
+    deterministic program on the same inputs cannot fix those.
+
+    Resource exhaustion is checked FIRST and is never transient: an XLA
+    ``RESOURCE_EXHAUSTED`` / host ``MemoryError`` / ``ENOMEM`` is
+    deterministic at a given allocation size — re-running the identical
+    allocation re-exhausts identically, so blind retry only triples the
+    failure latency. (The "resource temporarily"/OSError heuristics below
+    used to classify genuine exhaustion as retryable.) The downshift
+    paths (robustness/resources.py) split the work instead."""
+    if is_resource_exhausted(exc):
+        return False
     if isinstance(exc, InjectedFaultError):
         return False
     if isinstance(exc, (TransientFaultError, ConnectionError, TimeoutError,
@@ -172,6 +183,15 @@ class FaultLog:
             # docs/serving.md "Drift monitoring & self-healing")
             "drift": [r.to_json() for r in self.reports
                       if r.kind.startswith("drift_")],
+            # adaptive degradation after resource exhaustion: row-batch
+            # bisects, flush splits, chunk-budget halvings, grid splits
+            # (docs/robustness.md "Resource exhaustion & watchdog")
+            "oomDownshifts": [r.to_json()
+                              for r in self.of_kind("oom_downshift")],
+            # threads caught wedged by the watchdog or left alive past a
+            # join(timeout=...) at close — never discarded silently
+            "threadStalls": [r.to_json()
+                             for r in self.of_kind("thread_stalled")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
             # ring accounting: reports evicted under TG_FAULTS_MAX
             "droppedReports": self.dropped,

@@ -1,0 +1,124 @@
+"""Resource-exhaustion classification + adaptive-degradation accounting.
+
+The reference's Spark substrate absorbed memory pressure for free —
+executors spill to disk, tasks retry elsewhere — but a jitted XLA program
+either fits on the device or dies with ``RESOURCE_EXHAUSTED``; host-side
+table/record assembly dies with ``MemoryError``. Neither failure is
+transient: retrying the identical allocation re-exhausts identically, so
+the only useful response is to *downshift* — run the same work in smaller
+pieces whose results compose back exactly. One classification helper lives
+here so every choke point agrees on what "out of memory" looks like, and
+one accounting helper so every downshift is observable the same way.
+
+The four adaptive responses (docs/robustness.md "Resource exhaustion &
+watchdog"):
+
+* ``plan.py`` — a planned transform segment that exhausts bisects the row
+  batch into smaller padding buckets (bit-equal by construction: the
+  stages are per-row maps) before its existing eager fallback;
+* ``serving/runtime.py`` — an exhausted flush splits in half down to
+  singleton requests: latency degrades, requests never fail, and the
+  circuit breaker counts only non-resource faults;
+* ``streaming/trainer.py`` — a chunk the device cannot hold halves the
+  chunk row budget and continues from the committed-row prefix
+  (checkpoint records carry the active ``chunkRows`` so a killed
+  downshifted train resumes bit-exactly);
+* ``impl/tuning/validators.py`` — an exhausted packed (F·G) sweep grid
+  splits in half and the per-config fold metrics merge back (metric
+  concatenation along the config axis is the monoid), instead of
+  quarantining the whole family.
+
+Every downshift is a FaultLog ``oom_downshift`` report (span event +
+``tg_faults_total{kind="oom_downshift"}`` via the FaultLog choke point)
+plus the ``tg_oom_total{site}`` / ``tg_oom_downshift_total`` counters.
+"""
+from __future__ import annotations
+
+import errno
+import os
+from typing import Any, Optional
+
+from ..observability import metrics as _obs_metrics
+
+#: message substrings (lowercased) marking a runtime error as device/host
+#: memory exhaustion — the PJRT status name plus the prose jaxlib variants
+EXHAUSTED_PATTERNS = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "failed to allocate", "allocation failure",
+)
+
+#: minimum chunk row budget the streaming downshift may halve to
+OOM_MIN_CHUNK_ROWS_ENV = "TG_OOM_MIN_CHUNK_ROWS"
+DEFAULT_MIN_CHUNK_ROWS = 64
+
+
+def min_chunk_rows() -> int:
+    try:
+        return max(1, int(os.environ.get(OOM_MIN_CHUNK_ROWS_ENV, "")
+                          or DEFAULT_MIN_CHUNK_ROWS))
+    except ValueError:
+        return DEFAULT_MIN_CHUNK_ROWS
+
+
+class ResourceExhaustedError(RuntimeError):
+    """Typed resource exhaustion: the device (XLA ``RESOURCE_EXHAUSTED``)
+    or the host (``MemoryError``, ``ENOMEM``) could not satisfy an
+    allocation. Deterministic at a given work size — never blindly
+    retried (robustness/policy.py routes it away from RetryPolicy); the
+    downshift paths split the work instead."""
+
+    def __init__(self, message: str, site: Optional[str] = None):
+        super().__init__(message)
+        self.site = site
+
+
+def classify_exhaustion(exc: BaseException) -> Optional[ResourceExhaustedError]:
+    """Return a typed :class:`ResourceExhaustedError` view of ``exc`` when
+    it is a resource-exhaustion failure, else None. Recognizes:
+
+    * :class:`ResourceExhaustedError` itself (injected or already wrapped);
+    * host ``MemoryError`` and ``OSError`` with ``errno == ENOMEM``;
+    * jaxlib ``XlaRuntimeError`` (and plain ``RuntimeError``) whose message
+      carries the PJRT ``RESOURCE_EXHAUSTED`` status or an out-of-memory
+      prose variant (:data:`EXHAUSTED_PATTERNS`).
+    """
+    if isinstance(exc, ResourceExhaustedError):
+        return exc
+    if isinstance(exc, MemoryError):
+        return ResourceExhaustedError(f"host MemoryError: {exc}")
+    if isinstance(exc, OSError) and getattr(exc, "errno", None) == errno.ENOMEM:
+        return ResourceExhaustedError(f"host ENOMEM: {exc}")
+    if type(exc).__name__ == "XlaRuntimeError" or isinstance(exc, RuntimeError):
+        msg = str(exc).lower()
+        if any(p in msg for p in EXHAUSTED_PATTERNS):
+            return ResourceExhaustedError(
+                f"{type(exc).__name__}: {exc}"[:500])
+    return None
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    return classify_exhaustion(exc) is not None
+
+
+def record_downshift(site: str, fault_log: Optional[Any] = None,
+                     **detail: Any) -> None:
+    """Account one adaptive downshift at ``site`` (``oom.plan`` /
+    ``oom.serve`` / ``oom.stream`` / ``oom.sweep``): a FaultLog
+    ``oom_downshift`` report (→ span event + ``tg_faults_total{kind}``
+    through the FaultLog choke point) on ``fault_log`` (or the ambient
+    train/serve log), plus the ``tg_oom_total{site}`` and
+    ``tg_oom_downshift_total`` counters."""
+    from .policy import FaultLog, FaultReport
+    report = FaultReport(site=site, kind="oom_downshift",
+                         detail=dict(detail))
+    if fault_log is not None:
+        fault_log.add(report)
+    else:
+        FaultLog.record(report)
+    _obs_metrics.inc_counter(
+        "tg_oom_total", help="resource-exhaustion events by site "
+        "(docs/robustness.md)", site=site)
+    _obs_metrics.inc_counter(
+        "tg_oom_downshift_total",
+        help="adaptive downshifts after resource exhaustion "
+        "(docs/robustness.md)")

@@ -114,6 +114,20 @@ class CircuitBreaker:
                 self._opens += 1
                 self._transition(OPEN)
 
+    def trip(self, error: Optional[BaseException] = None) -> None:
+        """Force the breaker open immediately, bypassing the consecutive-
+        failure count — the watchdog's stall response: a batcher that
+        stopped beating is wedged *now*, and new batches must route to the
+        degraded path instead of queueing behind it. Heals normally
+        (timed half-open probe → close on success)."""
+        with self._lock:
+            if error is not None:
+                self._last_error = f"{type(error).__name__}: {error}"[:300]
+            if self._state != OPEN:
+                self._opened_at = self.clock()
+                self._opens += 1
+                self._transition(OPEN)
+
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Health/summary view (docs/serving.md "Breaker semantics")."""

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..observability.trace import add_event as _obs_event
 from ..robustness import faults
+from ..robustness import watchdog as _watchdog
 from ..robustness.policy import FaultReport
 from . import drift as _drift
 from . import warmup as _warmup
@@ -182,6 +183,13 @@ class ModelRegistry:
                 self._refits_inflight.discard(name)
             _drift.untrack_refit(threading.current_thread())
             return
+        # hang watchdog: a refit is one long hook call with no heartbeat
+        # cadence, so a heart that never beats past TG_WATCHDOG_S records
+        # the wedge (thread_stalled + tg_watchdog_stalls_total) — the
+        # model keeps serving either way, but the hang is never silent
+        heart = _watchdog.register(
+            f"tg-drift-refit[{name}]", kind="drift.refit",
+            fault_log=rt.fault_log)
         try:
             # deterministic chaos entry: a fault anywhere in the refit
             # path (hook crash, corrupt save, load failure) — the old
@@ -204,6 +212,7 @@ class ModelRegistry:
                 detail={"model": name, "error": entry["error"]}))
             _obs_event("drift.refit", model=name, ok=False)
         finally:
+            heart.close()
             self.refit_history.append(entry)
             with self._refit_lock:
                 self._refits_inflight.discard(name)
@@ -279,9 +288,15 @@ class ModelRegistry:
         for rt in rts:
             rt.close(drain=drain)
         # a refit racing close() targets an unregistered name and exits;
-        # wait briefly so no tg-drift-refit thread outlives the registry
+        # wait briefly so no tg-drift-refit thread outlives the registry —
+        # and never discard one that does silently: the leak is recorded
+        # as thread_stalled + tg_watchdog_stalls_total (docs/robustness.md)
         for t in _drift.live_refits():
             t.join(timeout=30)
+            if t.is_alive():
+                _watchdog.report_thread_stalled(
+                    site="registry.close", thread_name=t.name,
+                    waited_s=30.0)
 
     def __enter__(self) -> "ModelRegistry":
         return self

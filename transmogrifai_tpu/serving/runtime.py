@@ -27,10 +27,27 @@ for free, rebuilt for the serving tier):
   failing requests, recorded via FaultLog (``breaker_degraded``) and the
   ``tg_breaker_state`` gauge. A half-open probe re-tries the device path
   and closes on success.
+* **adaptive degradation under memory pressure** — a flush whose compiled
+  dispatch exhausts device/host memory (XLA ``RESOURCE_EXHAUSTED``, host
+  ``MemoryError`` — robustness/resources.py) splits in half and retries,
+  recursively down to singleton requests: latency degrades, requests
+  never fail, and each split is an ``oom_downshift`` FaultLog report +
+  ``tg_oom_total{site="oom.serve"}``. Resource faults NEVER feed the
+  breaker — exhaustion says the *batch* was too big, not that the device
+  path is broken, and opening the breaker would needlessly route healthy
+  traffic to the slow eager path. Only if even singletons exhaust does
+  the batch degrade to the eager per-row scorer (still zero failures).
+* **hang watchdog** — the batcher thread beats a
+  :mod:`~..robustness.watchdog` heart every loop iteration
+  (``TG_WATCHDOG_S``); a wedged dispatch stops the beats, which records
+  ``thread_stalled`` + ``tg_watchdog_stalls_total`` and trips the
+  breaker so the *next* batches degrade instead of queueing behind the
+  wedge. ``close()`` likewise refuses to silently discard a batcher that
+  outlives its join timeout — the leak is recorded the same way.
 
 Failure injection: the ``serve.enqueue`` / ``serve.flush`` /
-``serve.dispatch`` chaos sites (robustness/faults.py) make every one of
-those paths deterministically testable.
+``serve.dispatch`` / ``oom.serve`` chaos sites (robustness/faults.py)
+make every one of those paths deterministically testable.
 
 Metrics: every instrument is kept in a **serve-local**
 ``MetricsRegistry`` (always on — health/SLO snapshots must work with
@@ -56,8 +73,10 @@ from ..local.scoring import (
 from ..observability import metrics as _obs_metrics
 from ..observability.trace import add_event as _obs_event
 from ..observability.trace import span as _obs_span
-from ..robustness import faults
+from ..robustness import faults, resources
+from ..robustness import watchdog as _watchdog
 from ..robustness.policy import FaultLog, FaultReport
+from ..robustness.watchdog import WatchdogStallError
 from .breaker import BREAKER_GAUGE, CLOSED, CircuitBreaker, OPEN
 
 
@@ -186,6 +205,7 @@ class ServingRuntime:
         #                          so tests can stage a queue deterministically)
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._heart = None  # watchdog heartbeat (set in start())
         self.breaker = breaker or CircuitBreaker(
             name=name,
             failure_threshold=self.config.breaker_failures,
@@ -206,6 +226,12 @@ class ServingRuntime:
             if self._running:
                 return self
             self._running = True
+        # hang watchdog: the batcher beats this heart every loop
+        # iteration; a wedged dispatch stops the beats → thread_stalled
+        # is recorded and the breaker trips (docs/robustness.md)
+        self._heart = _watchdog.register(
+            f"tg-serve[{self.name}]", kind="serve.batcher",
+            on_stall=self._on_watchdog_stall, fault_log=self.fault_log)
         self._thread = threading.Thread(
             target=self._loop, name=f"tg-serve[{self.name}]", daemon=True)
         self._thread.start()
@@ -232,6 +258,19 @@ class ServingRuntime:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # never discard a still-alive batcher silently: record the
+                # stall (serve-local counter + FaultLog + global series)
+                self.metrics.counter(
+                    "tg_watchdog_stalls_total",
+                    "thread stalls (docs/robustness.md)",
+                    model=self.name, site="serve.close").inc()
+                _watchdog.report_thread_stalled(
+                    site="serve.close", thread_name=self._thread.name,
+                    waited_s=30.0, fault_log=self.fault_log,
+                    model=self.name)
+        if self._heart is not None:
+            self._heart.close()
         with self._cond:
             self._closed = True
         with _LIVE_LOCK:
@@ -296,8 +335,28 @@ class ServingRuntime:
         return self._scorer([{} for _ in range(max(1, rows))])
 
     # -- batcher -------------------------------------------------------------
+    def _beat(self) -> None:
+        h = self._heart
+        if h is not None:
+            h.beat()
+
+    def _on_watchdog_stall(self, heart, waited: float) -> None:
+        """Watchdog stall response (scanner thread): the batcher stopped
+        beating — most likely a wedged dispatch. Trip the breaker so
+        batches after the wedge clears (and probes) prefer the degraded
+        path, and count the stall on the serve-local registry (the
+        FaultLog report + global counter come from the watchdog)."""
+        self.breaker.trip(error=WatchdogStallError(
+            f"serve batcher for model '{self.name}' stalled "
+            f"{waited:.1f}s (> TG_WATCHDOG_S)"))
+        self.metrics.counter(
+            "tg_watchdog_stalls_total",
+            "thread stalls (docs/robustness.md)",
+            model=self.name, site="serve.batcher").inc()
+
     def _loop(self) -> None:
         while True:
+            self._beat()
             batch = self._take_batch()
             if batch is None:
                 return
@@ -316,11 +375,13 @@ class ServingRuntime:
         cfg = self.config
         with self._cond:
             while not self._queue and self._running:
+                self._beat()
                 self._cond.wait(0.05)
             if not self._queue:
                 return None  # stopped and drained
             flush_at = self._queue[0].enqueued + cfg.max_wait_ms / 1000.0
             while (len(self._queue) < cfg.max_batch and self._running):
+                self._beat()
                 remaining = flush_at - time.monotonic()
                 if remaining <= 0:
                     break
@@ -366,6 +427,37 @@ class ServingRuntime:
                 alive.append(r)
         return alive
 
+    def _score_adaptive(self, rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Compiled micro-batch scoring with adaptive degradation: a flush
+        whose dispatch exhausts memory splits in half and retries, down to
+        singletons — per-row results are independent of the batching, so
+        the concatenated halves are bit-equal to the unsplit flush. Each
+        split is an ``oom_downshift`` report + ``tg_oom_total``; anything
+        non-resource (or a singleton that still exhausts) re-raises to
+        ``_dispatch``'s breaker/eager handling."""
+        try:
+            # chaos: a RESOURCE_EXHAUSTED here models the padded flush not
+            # fitting on the device (call-counted, so halves can succeed)
+            faults.inject("oom.serve", key=self.name)
+            return self._scorer(rows)
+        except Exception as e:
+            if resources.classify_exhaustion(e) is None or len(rows) <= 1:
+                raise
+            mid = len(rows) // 2
+            self.fault_log.add(FaultReport(
+                site="oom.serve", kind="oom_downshift",
+                detail={"model": self.name, "rows": len(rows),
+                        "splitRows": [mid, len(rows) - mid],
+                        "error": f"{type(e).__name__}: {e}"[:200]}))
+            self._count("tg_oom_total", site="oom.serve",
+                        help="resource-exhaustion events by site "
+                        "(docs/robustness.md)")
+            self._count("tg_oom_downshift_total",
+                        help="adaptive downshifts after resource "
+                        "exhaustion (docs/robustness.md)")
+            return (self._score_adaptive(rows[:mid])
+                    + self._score_adaptive(rows[mid:]))
+
     def _dispatch(self, alive: List[_Request]) -> None:
         rows = [r.row for r in alive]
         if self.breaker.allow_device():
@@ -375,8 +467,17 @@ class ServingRuntime:
                     # chaos: a fault here models the compiled micro-batch
                     # path failing (wedged XLA dispatch, poisoned plan)
                     faults.inject("serve.dispatch", key=self.name)
-                    recs = self._scorer(rows)
+                    recs = self._score_adaptive(rows)
             except Exception as e:
+                if resources.classify_exhaustion(e) is not None:
+                    # even singleton dispatches exhaust: final fallback is
+                    # the eager per-row path — requests still never fail.
+                    # The breaker counts only NON-resource faults: the
+                    # device path is healthy, the allocations were not.
+                    self._record_degraded("oom.serve", len(rows), error=e)
+                    self._finish(alive, self._eager_records(alive),
+                                 degraded=True)
+                    return
                 self.breaker.record_failure(error=e)
                 self._record_degraded("serve.dispatch", len(rows), error=e)
                 self._finish(alive, self._eager_records(alive),
@@ -533,7 +634,14 @@ class ServingRuntime:
                                          reason="deadline"),
             },
             "faults": {"reports": len(self.fault_log.reports),
-                       "dropped": self.fault_log.dropped},
+                       "dropped": self.fault_log.dropped,
+                       # adaptive flush splits under memory pressure and
+                       # watchdog/join-leak stall detections
+                       # (docs/robustness.md)
+                       "oomDownshifts": len(
+                           self.fault_log.of_kind("oom_downshift")),
+                       "threadStalls": len(
+                           self.fault_log.of_kind("thread_stalled"))},
             "warm": self.warm_info,
             # per-model drift verdict + per-feature JS/fill deltas
             # (serving/drift.py); None when no monitor is attached

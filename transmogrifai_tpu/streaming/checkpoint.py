@@ -62,9 +62,16 @@ class StreamCheckpoint:
 
     # -- commit ---------------------------------------------------------------
     def commit(self, key: str, arrays: Dict[str, np.ndarray],
-               next_chunk: int) -> None:
+               next_chunk: int, fingerprint: Optional[str] = None,
+               chunk_rows: Optional[int] = None) -> None:
         """Persist ``arrays`` as the fold state with chunks < ``next_chunk``
-        folded in (``PASS_COMPLETE`` = the pass finished)."""
+        folded in (``PASS_COMPLETE`` = the pass finished).
+
+        ``fingerprint``/``chunk_rows`` override the source identity the
+        record commits to — the memory-pressure downshift re-chunks the
+        source mid-pass (streaming/trainer.py), and the record must carry
+        the *active* chunking so a killed downshifted train resumes
+        against the same schedule, bit-exactly."""
         os.makedirs(self.dirpath, exist_ok=True)
         rec = self.manifest.streams.get(key)
         prev_file = rec.get("file") if rec else None
@@ -72,8 +79,11 @@ class StreamCheckpoint:
         data = _npz_bytes(arrays)
         sha = atomic_write_bytes(os.path.join(self.dirpath, fname), data)
         self.manifest.record_file(fname, sha, len(data))
-        self.manifest.complete_stream(key, fname, {
-            "fingerprint": self.fingerprint, "chunk": int(next_chunk)})
+        extra = {"fingerprint": fingerprint or self.fingerprint,
+                 "chunk": int(next_chunk)}
+        if chunk_rows is not None:
+            extra["chunkRows"] = int(chunk_rows)
+        self.manifest.complete_stream(key, fname, extra)
         if prev_file and prev_file != fname:
             self.manifest.files.pop(prev_file, None)
         self.manifest.save()          # ← the commit point
@@ -84,15 +94,18 @@ class StreamCheckpoint:
                 pass
 
     # -- restore --------------------------------------------------------------
-    def restore(self, key: str) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+    def restore(self, key: str, fingerprint: Optional[str] = None,
+                ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         """(state arrays, next chunk to fold) — ``(None, 0)`` when nothing
         committed/verifiable for this key+fingerprint. A verified complete
-        pass returns ``(state, PASS_COMPLETE)``."""
+        pass returns ``(state, PASS_COMPLETE)``. ``fingerprint`` overrides
+        the expected source identity (the trainer passes a downshifted
+        source's fingerprint when the record carries its ``chunkRows``)."""
         rec = self.manifest.streams.get(key)
         if rec is None:
             return None, 0
         reason = None
-        if rec.get("fingerprint") != self.fingerprint:
+        if rec.get("fingerprint") != (fingerprint or self.fingerprint):
             reason = ("source fingerprint mismatch — resumed against "
                       "different data or chunking")
         else:

@@ -18,7 +18,19 @@ Error contract: any exception in the producer — ``SimulatedPreemption``
 (a BaseException, modeling a kill mid-read/mid-upload) included — is
 forwarded through the queue and re-raised in the consumer thread, so a
 streamed ``train()`` dies exactly like an in-core one would, with the
-last committed chunk checkpoint intact.
+last committed chunk checkpoint intact. Resource exhaustion
+(``oom.stream`` chaos site, or a real ``RESOURCE_EXHAUSTED`` from the
+packed upload) forwards the same way; the trainer catches it and halves
+the chunk row budget (robustness/resources.py).
+
+Hang contract: the producer beats a watchdog heart
+(robustness/watchdog.py, ``TG_WATCHDOG_S``) every loop iteration. A
+producer wedged inside a dead reader or a hung upload stops beating; the
+stall is recorded (``thread_stalled`` + ``tg_watchdog_stalls_total``)
+and the feed ABORTS — the consumer's next ``__next__`` raises a typed
+``WatchdogStallError`` instead of waiting on the wedge forever.
+``close()`` likewise records (never silently discards) a producer that
+outlives its join timeout.
 """
 from __future__ import annotations
 
@@ -34,6 +46,8 @@ import numpy as np
 
 from ..observability import metrics as _obs_metrics
 from ..robustness import faults
+from ..robustness import watchdog as _watchdog
+from ..robustness.watchdog import WatchdogStallError
 from ..table import DEVICE_KINDS, FeatureTable
 from .source import Chunk
 
@@ -155,16 +169,38 @@ class DeviceFeed:
         self._lock = threading.Lock()
         self._prev_bytes = 0
         self.closed = False
+        self._stall_error: Optional[BaseException] = None
         self._t0 = time.perf_counter()
+        # hang watchdog: the producer beats this heart per loop iteration;
+        # a wedge (dead reader, hung upload) stops the beats → the feed
+        # aborts with a typed error instead of hanging the consumer
+        self._heart = _watchdog.register(
+            "tg-stream-feed", kind="stream.producer",
+            on_stall=self._on_watchdog_stall)
         self._thread = threading.Thread(
             target=self._produce, name="tg-stream-feed", daemon=True)
         _LIVE.add(self)
         self._thread.start()
 
+    def _on_watchdog_stall(self, heart, waited: float) -> None:
+        """Watchdog stall response (scanner thread): abort the feed. The
+        wedged producer cannot be killed, but the consumer must not wait
+        on it forever — it sees a typed error on its next take."""
+        err = WatchdogStallError(
+            f"stream feed producer stalled {waited:.1f}s "
+            f"(> TG_WATCHDOG_S); aborting the feed")
+        self._stall_error = err
+        self._stop.set()
+        try:  # wake a consumer blocked on an empty queue
+            self._q.put_nowait((self._SENTINEL, err))
+        except queue.Full:
+            pass
+
     # -- producer -------------------------------------------------------------
     def _produce(self) -> None:
         try:
             while not self._stop.is_set():
+                self._heart.beat()
                 if not self._slots.acquire(timeout=0.1):
                     continue
                 faults.inject("stream.read")
@@ -178,6 +214,10 @@ class DeviceFeed:
                     table = model.transform(table)
                 t0 = time.perf_counter()
                 faults.inject("stream.upload")
+                # chaos: a RESOURCE_EXHAUSTED here models the packed chunk
+                # upload not fitting on the device — it forwards through
+                # the queue and the trainer halves the chunk row budget
+                faults.inject("oom.stream")
                 if self._to_device:
                     table = table.to_device()
                 nbytes = device_bytes(table)
@@ -196,9 +236,14 @@ class DeviceFeed:
                 self._put((Chunk(chunk.index, chunk.chunk_id, table), nbytes))
         except BaseException as e:  # noqa: BLE001 — preemption must forward
             self._put((self._SENTINEL, e))
+        finally:
+            # a finished producer has nothing left to stall on; keeping
+            # the heart open would flag a slow CONSUMER as a feed stall
+            self._heart.close()
 
     def _put(self, item) -> None:
         while not self._stop.is_set():
+            self._heart.beat()
             try:
                 self._q.put(item, timeout=0.1)
                 return
@@ -217,6 +262,12 @@ class DeviceFeed:
                 item, extra = self._q.get(timeout=0.1)
                 break
             except queue.Empty:
+                if self._stall_error is not None:
+                    # watchdog abort: the producer is wedged — fail the
+                    # consumer with the typed error instead of waiting
+                    err = self._stall_error
+                    self.close()
+                    raise err
                 if not self._thread.is_alive() and self._q.empty():
                     raise RuntimeError(
                         "stream feed producer died without a sentinel")
@@ -253,6 +304,14 @@ class DeviceFeed:
         except queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # never discard a still-alive producer silently: record the
+            # stall (thread_stalled FaultLog + tg_watchdog_stalls_total)
+            # so it surfaces in summary()["faults"]["threadStalls"]
+            _watchdog.report_thread_stalled(
+                site="stream.close", thread_name=self._thread.name,
+                waited_s=5.0)
+        self._heart.close()
         if self.stats.wall_seconds == 0.0:
             self.stats.wall_seconds = time.perf_counter() - self._t0
         if _obs_metrics.metrics_enabled():

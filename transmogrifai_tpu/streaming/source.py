@@ -78,6 +78,16 @@ class ChunkSource(abc.ABC):
         """Called by the streaming trainer before the first pass; sources
         that build tables from records (Avro) need the raw feature set."""
 
+    def with_chunk_rows(self, chunk_rows: int) -> "ChunkSource":
+        """The same logical dataset re-chunked at ``chunk_rows`` rows per
+        chunk — what the trainer's memory-pressure downshift halves to
+        (robustness/resources.py; docs/robustness.md "Resource exhaustion
+        & watchdog"). Sources that cannot re-chunk deterministically leave
+        this unimplemented; exhaustion then propagates instead of
+        downshifting."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support re-chunking")
+
     def chunk_id(self, index: int) -> str:
         return f"{self.fingerprint()[:16]}:{index:06d}"
 
@@ -94,6 +104,9 @@ class TableChunkSource(ChunkSource):
         self.table = table
         self.chunk_rows = env_chunk_rows(chunk_rows)
         self._fp: Optional[str] = None
+
+    def with_chunk_rows(self, chunk_rows: int) -> "TableChunkSource":
+        return TableChunkSource(self.table, chunk_rows)
 
     def fingerprint(self) -> str:
         if self._fp is None:
@@ -139,6 +152,9 @@ class AvroChunkSource(ChunkSource):
     def bind(self, raw_features: Sequence) -> None:
         if self.raw_features is None:
             self.raw_features = tuple(raw_features)
+
+    def with_chunk_rows(self, chunk_rows: int) -> "AvroChunkSource":
+        return AvroChunkSource(self.path, chunk_rows, self.raw_features)
 
     def fingerprint(self) -> str:
         st = os.stat(self.path)
@@ -205,6 +221,11 @@ class SyntheticChunkSource(ChunkSource):
         self.missing_rate = float(missing_rate)
         self._w = np.random.RandomState(seed).randn(num_features).astype(
             np.float64)
+
+    # NOTE: no ``with_chunk_rows`` — chunk ``i``'s rows are a pure function
+    # of ``(seed, i, chunk_rows)``, so re-chunking would change the DATA,
+    # not just the schedule; the memory-pressure downshift must propagate
+    # instead of silently folding a different dataset.
 
     def fingerprint(self) -> str:
         ident = (f"synthetic:{self.num_rows}:{self.num_features}:"

@@ -19,12 +19,14 @@ materialize the dataset.
 """
 from __future__ import annotations
 
+import logging
+
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..observability.trace import span as _obs_span
-from ..robustness import faults
+from ..robustness import faults, resources
 from ..robustness.policy import FaultLog, FaultReport
 from ..stages.base import Estimator, Transformer
 from ..table import FeatureTable
@@ -32,6 +34,8 @@ from .checkpoint import PASS_COMPLETE, StreamCheckpoint
 from .feed import DeviceFeed, FeedStats
 from .folds import MonoidFold
 from .source import ChunkSource
+
+logger = logging.getLogger(__name__)
 
 
 class StreamingNotSupportedError(TypeError):
@@ -84,44 +88,131 @@ class StreamRun:
         from the next un-folded chunk; commits after every
         TG_STREAM_CKPT_EVERY chunks and marks the pass complete at the
         end — so a resumed train re-executes no completed pass and no
-        committed chunk, bit-exactly."""
+        committed chunk, bit-exactly.
+
+        Resource exhaustion (a chunk the device cannot hold — forwarded
+        from the feed producer, or raised by the fold itself) downshifts
+        instead of dying: the chunk row budget HALVES and the pass
+        continues from the committed-row prefix — the already-folded rows
+        align exactly with the new chunk grid (old budget = 2 × new), so
+        no row refolds and no row is skipped. Commits after a downshift
+        carry the re-chunked source's fingerprint + ``chunkRows``, and
+        restore recognizes them (``with_chunk_rows``), so a kill mid-
+        downshifted-pass resumes against the identical schedule,
+        bit-exactly. The downshift is pass-local: later passes start back
+        at the configured budget. Floor: ``TG_OOM_MIN_CHUNK_ROWS``
+        (docs/robustness.md "Resource exhaustion & watchdog")."""
         key = f"{self.stage_uid}/{pass_id}"
+        src = self.source
         state, start = None, 0
         if self.checkpoint is not None:
-            arrays, start = self.checkpoint.restore(key)
-            if arrays is not None:
-                state = fold.state_from_arrays(arrays)
-                if start == PASS_COMPLETE:
-                    FaultLog.record(FaultReport(
-                        site="stream.fold", kind="restored",
-                        detail={"key": key, "pass": pass_id}))
-                    return state
-                FaultLog.record(FaultReport(
-                    site="stream.fold", kind="restored",
-                    detail={"key": key, "pass": pass_id,
-                            "fromChunk": start}))
+            src, state, start = self._restore(key, pass_id, fold, src)
+            if start == PASS_COMPLETE:
+                return state
         if state is None:
             state, start = fold.zero(), 0
         every = self.checkpoint.every if self.checkpoint is not None else 0
-        with _obs_span("stream.pass", cat="train", uid=self.stage_uid,
-                       passId=pass_id, fromChunk=start), \
-                DeviceFeed(self.source.chunks(start),
-                           transforms=self.upstream,
-                           prefetch=self.prefetch) as feed:
-            for chunk in feed:
-                faults.inject("stream.fold", key=pass_id)
-                state = fold.accumulate(state, *extract(chunk.table))
-                done = chunk.index + 1
-                if (self.checkpoint is not None
-                        and done < self.num_chunks
-                        and (done - start) % every == 0):
-                    self.checkpoint.commit(
-                        key, fold.state_to_arrays(state), done)
-            self.stats.merge(feed.stats)
+        while True:
+            folded = start
+            try:
+                with _obs_span("stream.pass", cat="train",
+                               uid=self.stage_uid, passId=pass_id,
+                               fromChunk=start,
+                               chunkRows=src.chunk_rows), \
+                        DeviceFeed(src.chunks(start),
+                                   transforms=self.upstream,
+                                   prefetch=self.prefetch) as feed:
+                    try:
+                        for chunk in feed:
+                            faults.inject("stream.fold", key=pass_id)
+                            state = fold.accumulate(state,
+                                                    *extract(chunk.table))
+                            folded = chunk.index + 1
+                            if (self.checkpoint is not None
+                                    and folded < src.num_chunks
+                                    and (folded - start) % every == 0):
+                                self.checkpoint.commit(
+                                    key, fold.state_to_arrays(state),
+                                    folded,
+                                    fingerprint=src.fingerprint(),
+                                    chunk_rows=src.chunk_rows)
+                    finally:
+                        self.stats.merge(feed.stats)
+                break
+            except Exception as e:
+                src, start = self._downshift(e, src, folded, key, fold,
+                                             state)
         if self.checkpoint is not None:
             self.checkpoint.commit(key, fold.state_to_arrays(state),
-                                   PASS_COMPLETE)
+                                   PASS_COMPLETE,
+                                   fingerprint=src.fingerprint(),
+                                   chunk_rows=src.chunk_rows)
         return state
+
+    def _restore(self, key: str, pass_id: str, fold: MonoidFold, src):
+        """Committed-row-prefix-aware restore: a record committed by a
+        downshifted run carries its ``chunkRows``; when re-chunking the
+        run's source at that budget reproduces the record's fingerprint,
+        the pass resumes on the downshifted grid — the committed rows are
+        a prefix of both schedules."""
+        rec = self.checkpoint.manifest.streams.get(key)
+        if rec is not None and rec.get("fingerprint") != src.fingerprint():
+            cr = rec.get("chunkRows")
+            if cr and int(cr) != src.chunk_rows:
+                try:
+                    cand = src.with_chunk_rows(int(cr))
+                except NotImplementedError:
+                    cand = None
+                if (cand is not None
+                        and cand.fingerprint() == rec.get("fingerprint")):
+                    src = cand
+        arrays, start = self.checkpoint.restore(
+            key, fingerprint=src.fingerprint())
+        if arrays is None:
+            return src, None, 0
+        state = fold.state_from_arrays(arrays)
+        detail = {"key": key, "pass": pass_id}
+        if start != PASS_COMPLETE:
+            detail["fromChunk"] = start
+        if src is not self.source:
+            detail["chunkRows"] = src.chunk_rows  # downshifted record
+        FaultLog.record(FaultReport(site="stream.fold", kind="restored",
+                                    detail=detail))
+        return src, state, start
+
+    def _downshift(self, exc: Exception, src, folded: int, key: str,
+                   fold: MonoidFold, state):
+        """Halve the chunk row budget after resource exhaustion, or
+        re-raise anything that is not exhaustion / cannot halve. Returns
+        ``(re-chunked source, next chunk index on the new grid)`` —
+        ``folded`` full chunks at the old budget are exactly ``2·folded``
+        chunks at the new one, so the committed-row prefix is preserved
+        row-for-row."""
+        if resources.classify_exhaustion(exc) is None:
+            raise exc
+        new_rows = src.chunk_rows // 2
+        if src.chunk_rows % 2 or new_rows < resources.min_chunk_rows():
+            raise exc  # at (or below) the floor: exhaustion is fatal
+        try:
+            new_src = src.with_chunk_rows(new_rows)
+        except NotImplementedError:
+            raise exc  # source cannot re-chunk deterministically
+        start = folded * 2
+        resources.record_downshift(
+            "oom.stream", stage=self.stage_uid,
+            chunkRows=new_rows, fromChunk=start,
+            error=f"{type(exc).__name__}: {exc}"[:200])
+        logger.warning(
+            "stream pass for %s exhausted memory at chunk_rows=%d; "
+            "halving to %d and resuming at chunk %d",
+            self.stage_uid, src.chunk_rows, new_rows, start)
+        if self.checkpoint is not None:
+            # commit the prefix under the NEW chunking so a kill right
+            # after the downshift resumes on the same grid
+            self.checkpoint.commit(key, fold.state_to_arrays(state), start,
+                                   fingerprint=new_src.fingerprint(),
+                                   chunk_rows=new_rows)
+        return new_src, start
 
 
 def fit_dag_streaming(source: ChunkSource, layers, *,
