@@ -74,16 +74,14 @@ def _no_plan_cache_leak():
     not bleed plans — or a forced-enabled/disabled planner state — between
     tests: a stale plan keyed to dead stage objects would silently serve
     the wrong fitted constants if an id() were ever recycled. Assert clean
-    + bounded on entry, hard-reset on exit."""
+    + bounded on entry (the check itself is the shared plan-cache oracle —
+    robustness/oracles.py, also run by the chaos-campaign engine after
+    every schedule), hard-reset on exit."""
     from transmogrifai_tpu import plan as _plan
+    from transmogrifai_tpu.robustness import oracles
 
-    assert isinstance(_plan._PLAN_CACHE_MAX, int) and _plan._PLAN_CACHE_MAX > 0, (
-        f"plan cache bound must be a positive int, got {_plan._PLAN_CACHE_MAX!r}")
-    assert len(_plan._PLAN_CACHE) <= _plan._PLAN_CACHE_MAX, (
-        "plan cache exceeded its LRU bound: "
-        f"{len(_plan._PLAN_CACHE)} > {_plan._PLAN_CACHE_MAX}")
-    assert _plan._enabled_override is None, (
-        "a test leaked a forced planner enable/disable override")
+    problems = oracles.plan_cache_violations()
+    assert not problems, f"plan-cache state leaked into this test: {problems}"
     # module-scoped fixtures train models during setup (before this
     # function-scoped fixture runs), so the cache may hold their plans —
     # drop them so every TEST starts with an empty cache
@@ -131,22 +129,16 @@ def _no_serving_leak():
     Assert none are live on entry; on exit force-close leftovers and fail
     the test that leaked them (mirrors the observability/plan/mesh no-leak
     fixtures: assert clean entry, hard-reset exit)."""
-    import threading
+    from transmogrifai_tpu.robustness import oracles
 
-    from transmogrifai_tpu.serving import runtime as _srt
-
-    assert not _srt.live_runtimes(), (
+    assert not oracles.leaked_serving_runtimes(), (
         "serving runtime(s) leaked from a previous test: "
-        f"{[r.name for r in _srt.live_runtimes()]}")
+        f"{oracles.leaked_serving_runtimes()}")
     yield
-    leaked = _srt.live_runtimes()
-    for rt in leaked:
-        rt.close(drain=False)
+    leaked = oracles.close_leaked_serving()
     assert not leaked, (
-        "a test leaked running serving runtime(s): "
-        f"{[r.name for r in leaked]}")
-    stray = [t.name for t in threading.enumerate()
-             if t.name.startswith("tg-serve") and t.is_alive()]
+        f"a test leaked running serving runtime(s): {leaked}")
+    stray = oracles.leaked_threads(("tg-serve",))
     assert not stray, f"serving batcher thread(s) survived a test: {stray}"
 
 
@@ -157,18 +149,15 @@ def _no_drift_leak():
     leaking out of a test would keep training (and writing model dirs +
     metrics) underneath later tests. Mirrors the serving no-leak fixture:
     assert none live on entry, join + fail on exit."""
-    from transmogrifai_tpu.serving import drift as _sdrift
+    from transmogrifai_tpu.robustness import oracles
 
-    assert not _sdrift.live_refits(), (
+    assert not oracles.leaked_drift_refits(), (
         "drift refit thread(s) leaked from a previous test: "
-        f"{[t.name for t in _sdrift.live_refits()]}")
+        f"{oracles.leaked_drift_refits()}")
     yield
-    leaked = _sdrift.live_refits()
-    for t in leaked:
-        t.join(timeout=30)
-    assert not _sdrift.live_refits(), (
-        "a test leaked running drift refit thread(s): "
-        f"{[t.name for t in _sdrift.live_refits()]}")
+    still = oracles.join_drift_refits(timeout=30)
+    assert not still, (
+        f"a test leaked running drift refit thread(s): {still}")
 
 
 @pytest.fixture(autouse=True)
@@ -179,19 +168,14 @@ def _no_stream_leak():
     metrics registry) underneath later tests; a leaked tg-stream thread
     pins its chunk source alive for the session. Mirrors the serving
     no-leak fixture: assert clean entry, force-close + fail on exit."""
-    import threading
+    from transmogrifai_tpu.robustness import oracles
 
-    from transmogrifai_tpu.streaming import feed as _feed
-
-    assert not _feed.live_feeds(), (
+    assert not oracles.leaked_stream_feeds(), (
         "stream feed(s) leaked from a previous test")
     yield
-    leaked = _feed.live_feeds()
-    for f in leaked:
-        f.close()
+    leaked = oracles.close_leaked_feeds()
     assert not leaked, f"a test leaked {len(leaked)} open DeviceFeed(s)"
-    stray = [t.name for t in threading.enumerate()
-             if t.name.startswith("tg-stream") and t.is_alive()]
+    stray = oracles.leaked_threads(("tg-stream",))
     assert not stray, f"stream feed thread(s) survived a test: {stray}"
 
 
@@ -203,23 +187,16 @@ def _no_watchdog_leak():
     would keep the scanner alive and could fire stalls into later tests'
     fault logs. Mirrors the serving/stream no-leak fixtures: assert no
     hearts on entry, close leftovers + join the scanner + fail on exit."""
-    import threading
+    from transmogrifai_tpu.robustness import oracles
 
-    from transmogrifai_tpu.robustness import watchdog as _wd
-
-    assert not _wd.live_hearts(), (
+    assert not oracles.leaked_watchdog_hearts(), (
         "watchdog heart(s) leaked from a previous test: "
-        f"{[h.name for h in _wd.live_hearts()]}")
+        f"{oracles.leaked_watchdog_hearts()}")
     yield
-    leaked = _wd.live_hearts()
-    for h in leaked:
-        h.close()
-    _wd.idle_join()
+    leaked = oracles.close_leaked_hearts()
     assert not leaked, (
-        "a test leaked open watchdog heart(s): "
-        f"{[h.name for h in leaked]}")
-    stray = [t.name for t in threading.enumerate()
-             if t.name.startswith("tg-watchdog") and t.is_alive()]
+        f"a test leaked open watchdog heart(s): {leaked}")
+    stray = oracles.leaked_threads(("tg-watchdog",))
     assert not stray, f"watchdog thread(s) survived a test: {stray}"
 
 
@@ -246,6 +223,9 @@ def _no_fault_injection_leak(request):
         assert not faults._CALLS, (
             "fault-injection call counters leaked from a previous test: "
             f"{dict(faults._CALLS)}")
+        assert not faults._FIRED, (
+            "fired-injection counters leaked from a previous test: "
+            f"{dict(faults._FIRED)}")
     yield
     if not is_chaos:
         assert not faults.active_sites(), (
@@ -256,3 +236,18 @@ def _no_fault_injection_leak(request):
         # context exited — or died at an injected preemption — must not
         # poison the rest of the session
         faults.clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_campaign_leak(request):
+    """Campaign-marked tests drive MANY arm/run/disarm cycles through the
+    chaos-campaign engine (robustness/campaign.py) — hundreds of scenario
+    runs per test, each spawning runtimes, feeds, and hearts. The engine
+    checks the no-leak oracles after every schedule; this fixture is the
+    backstop asserting the TEST as a whole left the process clean, via
+    the same callable oracles the engine uses (robustness/oracles.py)."""
+    yield
+    if request.node.get_closest_marker("campaign") is not None:
+        from transmogrifai_tpu.robustness import oracles
+        leaks = oracles.campaign_violations()
+        assert not leaks, f"campaign test leaked process state: {leaks}"

@@ -116,8 +116,8 @@ def test_fault_log_inactive_record_is_noop():
                              "checkpointsSkipped": [], "restored": [],
                              "planFallbacks": [], "breakerDegraded": [],
                              "drift": [], "oomDownshifts": [],
-                             "threadStalls": [], "fatal": [],
-                             "droppedReports": 0}
+                             "threadStalls": [], "uncleanExits": [],
+                             "fatal": [], "droppedReports": 0}
 
 
 # ---------------------------------------------------------------------------

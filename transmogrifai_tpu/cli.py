@@ -486,6 +486,62 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
         obs_metrics.enable_metrics(None)
 
 
+def run_campaign(schedules: int = 0, seed: Optional[int] = None,
+                 scenario: Optional[str] = None,
+                 faults_json: Optional[str] = None,
+                 output: Optional[str] = None,
+                 no_minimize: bool = False) -> Dict[str, Any]:
+    """``op campaign`` (docs/robustness.md "Chaos campaigns"): run a
+    seeded chaos campaign — or re-run ONE schedule as a reproducer.
+
+    Repro mode (the one-command repro a failing campaign emits): pass
+    ``--faults '<json>'``, or set ``TG_CHAOS=1 TG_FAULTS='<json>'`` in the
+    environment, together with ``--scenario``; the single schedule runs
+    and the process exits non-zero when any invariant oracle fires.
+    Campaign mode otherwise: ``--schedules`` randomized schedules
+    (coverage singletons first), violations delta-debugged to minimal
+    reproducers, report JSON on stdout (and ``campaign_report.json``
+    under ``--output``)."""
+    import json as _json
+    import sys as _sys
+
+    from .robustness.campaign import ChaosCampaign
+
+    repro_blob = faults_json or (
+        os.environ.get("TG_FAULTS")
+        if os.environ.get("TG_CHAOS") and scenario else None)
+    if repro_blob and not scenario:
+        raise SystemExit(
+            "campaign repro mode needs --scenario naming the harness "
+            "the TG_FAULTS schedule runs against")
+    eng = ChaosCampaign(
+        seed=seed,
+        scenarios=None if (repro_blob or scenario is None) else [scenario])
+    try:
+        if repro_blob:
+            result = eng.run_schedule(
+                {"scenario": scenario, "faults": _json.loads(repro_blob)})
+            print(_json.dumps(result, indent=2, default=str))
+            if result["violations"]:
+                _sys.exit(1)
+            return result
+        report = eng.run(count=schedules or None,
+                         minimize=not no_minimize)
+        doc = report.to_json()
+        print(_json.dumps(doc, indent=2, default=str))
+        if output:
+            os.makedirs(output, exist_ok=True)
+            path = os.path.join(output, "campaign_report.json")
+            with open(path, "w") as fh:
+                _json.dump(doc, fh, indent=2, default=str)
+            print(f"wrote {path}")
+        if doc["violations"]:
+            _sys.exit(1)
+        return doc
+    finally:
+        eng.close()
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="op",
                                 description="transmogrifai_tpu CLI")
@@ -539,6 +595,34 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="directory for the telemetry bundle (trace.json / "
                          "spans.jsonl / metrics.prom / serve_summary.json)")
     sv.add_argument("--seed", type=int, default=42)
+    cp = sub.add_parser(
+        "campaign", help="run a seeded chaos campaign — randomized "
+                         "multi-fault schedules against real scenario "
+                         "harnesses with invariant oracles and automatic "
+                         "schedule minimization (docs/robustness.md)")
+    cp.add_argument("--schedules", type=int, default=0,
+                    help="schedule budget (0 = TG_CAMPAIGN_SCHEDULES or "
+                         "40; coverage singletons for every registered "
+                         "site come first)")
+    cp.add_argument("--seed", type=int, default=None,
+                    help="campaign seed (default TG_CAMPAIGN_SEED or 0); "
+                         "same seed => same schedules => same fault "
+                         "sequence")
+    cp.add_argument("--scenario", default=None,
+                    help="restrict to one scenario harness (train | sweep "
+                         "| serve | serve_heal | stream | transfer); "
+                         "required in repro mode")
+    cp.add_argument("--faults", default=None,
+                    help="repro mode: a TG_FAULTS-style JSON schedule to "
+                         "run ONCE against --scenario (also picked up "
+                         "from TG_CHAOS=1 TG_FAULTS=... env — the "
+                         "one-command repro a failing campaign emits); "
+                         "exits non-zero on any invariant violation")
+    cp.add_argument("--output", default=None,
+                    help="directory for campaign_report.json")
+    cp.add_argument("--no-minimize", action="store_true",
+                    help="skip delta-debug minimization of violating "
+                         "schedules")
     a = p.parse_args(argv)
     if a.command == "gen":
         generate(a.input, a.response, a.output, a.name, a.id_field,
@@ -552,6 +636,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                   deadline_ms=a.deadline_ms, max_batch=a.max_batch,
                   queue_max=a.queue_max, name=a.name, output=a.output,
                   seed=a.seed)
+    elif a.command == "campaign":
+        run_campaign(schedules=a.schedules, seed=a.seed,
+                     scenario=a.scenario, faults_json=a.faults,
+                     output=a.output, no_minimize=a.no_minimize)
 
 
 if __name__ == "__main__":

@@ -595,6 +595,10 @@ class OpValidator:
             fused jitted program (see _make_fused_program); the mesh
             variant carries explicit NamedSharding in/out specs and is
             cached under a mesh-inclusive key."""
+            from ...manifest import sentinel_phase
+            # crash evidence: a kill past this point happened inside a
+            # fused sweep dispatch (run sentinel, docs/robustness.md)
+            sentinel_phase("device_sweep")
             G = len(grid)
             sliced_f = fold_sliced and getattr(family, "fold_sliced_predict",
                                                True)

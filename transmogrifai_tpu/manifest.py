@@ -23,6 +23,7 @@ always describes a consistent prefix of the training run.
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -30,6 +31,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MANIFEST_FILE = "MANIFEST.json"
 MANIFEST_VERSION = 1
+#: run liveness sentinel (cross-process kill detection, docs/robustness.md
+#: "Cross-process kill detection"): pid + coarse phase of the training run
+#: that owns the checkpoint dir, written atomically, removed on clean exit
+SENTINEL_FILE = "RUN_SENTINEL.json"
 
 
 def sha256_bytes(data: bytes) -> str:
@@ -220,6 +225,27 @@ class CheckpointManifest:
                     f"file {actual[:12]}...")
         return None
 
+    def verify_recorded(self) -> List[str]:
+        """Verify every file reachable through a completion record
+        (stages + sweeps); → list of '<file>: <reason>' problems. The
+        campaign engine's checkpoint-integrity oracle — an empty list
+        means everything a resume would trust actually verifies."""
+        problems: List[str] = []
+        fnames: List[str] = []
+        for rec in self.stages.values():
+            fnames.extend(rec.get("files", ()))
+        for rec in self.sweeps.values():
+            if rec.get("file"):
+                fnames.append(rec["file"])
+        for rec in self.streams.values():
+            if rec.get("file"):
+                fnames.append(rec["file"])
+        for fname in sorted(set(fnames)):
+            reason = self.verify_file(fname)
+            if reason is not None:
+                problems.append(f"{fname}: {reason}")
+        return problems
+
     def unrecorded_files(self) -> List[str]:
         """Checkpoint payload files on disk with no completion record —
         debris from a write the process never committed."""
@@ -232,8 +258,125 @@ class CheckpointManifest:
             recorded.add(rec.get("file"))
         out = []
         for fname in sorted(os.listdir(self.dirpath)):
-            if fname == MANIFEST_FILE or fname.endswith(".tmp"):
+            # the run sentinel is liveness metadata, not checkpoint payload
+            if fname in (MANIFEST_FILE, SENTINEL_FILE) or fname.endswith(".tmp"):
                 continue
             if fname not in recorded:
                 out.append(fname)
         return out
+
+
+# -- run sentinel: cross-process kill detection ------------------------------
+
+class RunSentinel:
+    """Pid + coarse-phase liveness marker for one training run
+    (``RUN_SENTINEL.json`` in the checkpoint dir; docs/robustness.md
+    "Cross-process kill detection").
+
+    A preemption-safe resume can already survive a kill — but it could
+    never *say* the previous process died, or what it was doing. The
+    sentinel closes that gap for cross-process kills (the OOM killer,
+    SIGKILL, a node loss): the training run writes ``{pid, phase}``
+    atomically at start, updates ``phase`` only when it changes (one
+    rename per transition, never per call), and removes the file on clean
+    completion. A later ``train(resume=True)`` from a *different* process
+    finding the file knows the previous owner exited uncleanly and records
+    a FaultLog ``unclean_exit`` — with ``oomKillSuspected`` when the last
+    phase was device work (``device_*``: a dispatch/upload is exactly
+    where the OOM killer strikes). Same-pid re-runs (in-process simulated
+    preemption, a retried train in one process) are not flagged — those
+    recoveries are already accounted by the preemption machinery."""
+
+    #: phases with this prefix mean the process was inside a device
+    #: dispatch/upload when it last wrote — an OOM kill's favorite moment
+    DEVICE_PHASE_PREFIX = "device"
+
+    def __init__(self, dirpath: str):
+        self.dirpath = dirpath
+        self._phase: Optional[str] = None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dirpath, SENTINEL_FILE)
+
+    def start(self, phase: str = "start") -> None:
+        os.makedirs(self.dirpath, exist_ok=True)
+        self._phase = None
+        self.set_phase(phase)
+
+    def set_phase(self, phase: str) -> None:
+        """Record the run's coarse phase; writes only on transition so
+        hot paths can call this per dispatch at no recurring cost."""
+        if phase == self._phase:
+            return
+        self._phase = phase
+        atomic_write_json(self.path, {"pid": os.getpid(), "phase": phase})
+
+    def clear(self) -> None:
+        """Clean-exit commit: the run finished, no evidence to keep."""
+        self._phase = None
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def read(dirpath: str) -> Optional[Dict[str, Any]]:
+        path = os.path.join(dirpath, SENTINEL_FILE)
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            # atomic writes make a torn sentinel impossible; an unreadable
+            # one is still evidence of *something* — report it as such
+            return {"pid": None, "phase": "unreadable"}
+
+    def read_stale(self) -> Optional[Dict[str, Any]]:
+        """The previous owner's sentinel, when that owner was a different
+        process (None for own/absent sentinels)."""
+        doc = self.read(self.dirpath)
+        if doc is None or doc.get("pid") == os.getpid():
+            return None
+        return doc
+
+    @staticmethod
+    def suspects_oom_kill(doc: Dict[str, Any]) -> bool:
+        return str(doc.get("phase", "")).startswith(
+            RunSentinel.DEVICE_PHASE_PREFIX)
+
+
+#: the ambient sentinel a training run activates so deep code (plan
+#: segments, the stream feed's producer THREAD, sweep dispatch) can hint
+#: the current phase without threading the object through every signature.
+#: A plain module global, not a contextvar: the feed producer runs on its
+#: own thread and must see the trainer's sentinel; phase hints are
+#: advisory, and concurrent trains (rare: a background drift refit) just
+#: share the hint.
+_ACTIVE_SENTINEL: Optional[RunSentinel] = None
+
+
+@contextlib.contextmanager
+def active_sentinel(sentinel: Optional[RunSentinel]):
+    """Make ``sentinel`` the ambient phase-hint target for the block
+    (no-op context when None)."""
+    global _ACTIVE_SENTINEL
+    prev = _ACTIVE_SENTINEL
+    _ACTIVE_SENTINEL = sentinel
+    try:
+        yield sentinel
+    finally:
+        _ACTIVE_SENTINEL = prev
+
+
+def sentinel_phase(phase: str) -> None:
+    """Advisory phase hint onto the ambient run sentinel (inert when no
+    training run owns one). Never raises — crash evidence must not crash
+    the run it protects."""
+    s = _ACTIVE_SENTINEL
+    if s is not None:
+        try:
+            s.set_phase(phase)
+        except OSError:
+            pass

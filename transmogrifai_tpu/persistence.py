@@ -31,7 +31,8 @@ import numpy as np
 
 from .features import Feature
 from .manifest import (
-    CheckpointManifest, atomic_write_bytes, clean_tmp_debris,
+    MANIFEST_FILE, SENTINEL_FILE, CheckpointManifest, atomic_write_bytes,
+    clean_tmp_debris,
 )
 from .stages.base import STAGE_REGISTRY, FeatureGeneratorStage, OpPipelineStage
 from .types import feature_type_by_name
@@ -554,8 +555,12 @@ def save_stage_checkpoint(stage: OpPipelineStage, ckpt_dir: str,
     the manifest — rewritten atomically last — records its completion and
     checksums. A preemption anywhere mid-protocol leaves files the loader
     classifies as debris (reported, refit) rather than state it trusts."""
+    from .manifest import sentinel_phase
     from .robustness import faults
     os.makedirs(ckpt_dir, exist_ok=True)
+    # crash evidence: a kill in here died writing a checkpoint, not inside
+    # a device dispatch (run sentinel, docs/robustness.md)
+    sentinel_phase("checkpoint_write")
     if manifest is None:
         manifest = open_checkpoint_manifest(ckpt_dir)
     arrays = _Arrays()
@@ -619,7 +624,9 @@ def load_stage_checkpoints(ckpt_dir: str,
                             f"the directory can be verified")
             return out
         if merr == "missing" and any(
-                f.endswith(".json") for f in os.listdir(ckpt_dir)):
+                f.endswith(".json")
+                and f not in (MANIFEST_FILE, SENTINEL_FILE)
+                for f in os.listdir(ckpt_dir)):
             return _load_legacy_checkpoints(ckpt_dir)
     for fname in manifest.unrecorded_files():
         uid = fname.rsplit(".", 1)[0]
@@ -656,7 +663,8 @@ def _load_legacy_checkpoints(ckpt_dir: str) -> Dict[str, OpPipelineStage]:
     behavior), with skips reported through the same FaultLog path."""
     out: Dict[str, OpPipelineStage] = {}
     for fname in sorted(os.listdir(ckpt_dir)):
-        if not fname.endswith(".json") or fname.startswith("sweep_"):
+        if (not fname.endswith(".json") or fname.startswith("sweep_")
+                or fname in (MANIFEST_FILE, SENTINEL_FILE)):
             continue
         uid = fname[:-5]
         try:

@@ -250,8 +250,12 @@ class TransformPlan:
                      table: FeatureTable) -> FeatureTable:
         import jax.numpy as jnp
 
+        from .manifest import sentinel_phase
         from .robustness import faults
         from .utils.padding import bucket_for
+        # crash evidence: if the process dies past this point the run
+        # sentinel says it was inside a device dispatch (OOM-kill suspect)
+        sentinel_phase("device_dispatch")
         # deterministic chaos entry: a fault here models an XLA runtime
         # error mid-plan; apply_planned catches it and falls back to eager
         faults.inject("plan.segment_execute", key=seg.stages[0].uid)

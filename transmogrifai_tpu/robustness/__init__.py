@@ -21,13 +21,20 @@ programs, so resilience has to be rebuilt at the framework layer:
 * :mod:`.watchdog` — heartbeat hang detection for worker threads
   (``TG_WATCHDOG_S``): a stalled batcher / feed producer / refit thread
   is recorded (``thread_stalled``), trips the serving breaker, or aborts
-  a wedged feed with a typed error instead of hanging forever.
+  a wedged feed with a typed error instead of hanging forever;
+* :mod:`.oracles` — the no-leak / invariant checks as callable library
+  functions, shared by the conftest fixtures and the campaign engine;
+* :mod:`.campaign` — the chaos campaign engine: seeded randomized
+  multi-fault schedules over the :data:`~.faults.ALL_SITES` registry,
+  scenario harnesses, invariant oracles, and automatic delta-debug
+  minimization of failing schedules into one-command ``TG_FAULTS``
+  reproducers (docs/robustness.md "Chaos campaigns").
 
 See docs/robustness.md for the fault-policy contract, the injection-site
 table, and the ``summary()["faults"]`` schema.
 """
 from . import faults  # noqa: F401
-from .faults import SimulatedPreemption  # noqa: F401
+from .faults import ALL_SITES, SimulatedPreemption, SiteSpec  # noqa: F401
 from .guards import (  # noqa: F401
     AllCandidatesFailedError, params_finite, quarantine_non_finite,
 )

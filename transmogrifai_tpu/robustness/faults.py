@@ -116,9 +116,11 @@ import logging
 import os
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..observability import metrics as _obs_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -171,9 +173,125 @@ class FaultSpec:
     transient: bool = True
 
 
+@dataclass(frozen=True)
+class SiteSpec:
+    """One *registered* chaos site — the machine-readable row behind the
+    docstring table above and the docs/robustness.md site tables (a test
+    asserts all three agree, so the inventory can never silently rot).
+
+    ``modes``: injection modes the site supports. ``module``: the file
+    whose production code compiles the ``inject``/``poison`` call in.
+    ``scenarios``: campaign scenario names that exercise the site
+    (first entry is the canonical one the coverage pass uses —
+    robustness/campaign.py). ``recovery``: the promised recovery, prose.
+    ``bit_equal``: True when the promise is that a run recovering from
+    this fault produces results **bit-identical** to the fault-free run
+    (the campaign's strongest oracle); False when recovery legitimately
+    alters the result (e.g. a quarantined candidate changes selection) —
+    such divergence must then be visible in fault accounting, never
+    silent."""
+    name: str
+    modes: Tuple[str, ...]
+    module: str
+    scenarios: Tuple[str, ...]
+    recovery: str
+    bit_equal: bool = True
+
+
+def _site(name, modes, module, scenarios, recovery, bit_equal=True):
+    return SiteSpec(name, tuple(modes.split("|")), module,
+                    tuple(scenarios.split("|")), recovery, bit_equal)
+
+
+#: the machine-readable site inventory (docs/robustness.md carries the
+#: human tables; tests/test_campaign.py asserts they agree and that every
+#: site here is armed by at least one tier-1 test — no dead chaos sites)
+ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
+    _site("validator.family_fit", "raise", "impl/tuning/validators.py",
+          "sweep|train",
+          "family quarantined; the other families still race",
+          bit_equal=False),
+    _site("validator.fold_metrics", "nan", "impl/tuning/validators.py",
+          "sweep|train",
+          "poisoned candidates quarantined, sweep continues",
+          bit_equal=False),
+    _site("selector.refit", "raise", "impl/selector/model_selector.py",
+          "train",
+          "winner quarantined; next-ranked finite candidate refits",
+          bit_equal=False),
+    _site("dag.stage_fit", "raise", "dag.py", "train",
+          "stage fit retried under the fault policy (transient), else "
+          "typed failure"),
+    _site("distributed.to_host", "raise", "parallel/distributed.py",
+          "sweep|transfer|train",
+          "device->host transfer retried (transient); a fatal transfer "
+          "fault quarantines the consuming family", bit_equal=False),
+    _site("distributed.device_put", "raise", "parallel/distributed.py",
+          "transfer|mesh_sweep",
+          "host->device placement retried (transient); a fatal placement "
+          "fault quarantines the consuming family", bit_equal=False),
+    _site("plan.segment_execute", "raise", "plan.py", "train|serve",
+          "planned run falls back to eager per-stage dispatch, bit-equal"),
+    _site("serve.enqueue", "raise", "serving/runtime.py", "serve",
+          "typed error to the one caller; the runtime stays up"),
+    _site("serve.flush", "raise", "serving/runtime.py", "serve",
+          "batch degrades to the eager per-row path, bit-equal"),
+    _site("serve.dispatch", "raise", "serving/runtime.py", "serve",
+          "breaker counts the failure; batch degrades eager, bit-equal"),
+    _site("stream.read", "raise|preempt", "streaming/feed.py", "stream",
+          "error forwards through the queue; preemption resumes "
+          "bit-exactly from the last committed chunk"),
+    _site("stream.upload", "raise|preempt", "streaming/feed.py", "stream",
+          "error forwards through the queue; resume bit-exact"),
+    _site("stream.fold", "raise|preempt", "streaming/trainer.py", "stream",
+          "fold retried/resumed from the committed state, bit-exact"),
+    _site("drift.fold", "raise", "serving/drift.py", "serve|serve_heal",
+          "contained by the runtime fence; zero request impact"),
+    _site("drift.verdict", "raise", "serving/drift.py", "serve|serve_heal",
+          "contained in the monitor; fold state intact"),
+    _site("drift.refit", "raise", "serving/registry.py", "serve_heal",
+          "no swap; the old model keeps serving, breaker untouched"),
+    _site("oom.plan", "oom", "plan.py", "train|serve",
+          "row batch bisects to smaller padding buckets, bit-equal"),
+    _site("oom.serve", "oom", "serving/runtime.py", "serve|serve_heal",
+          "flush splits down to singletons; zero failed requests, "
+          "bit-equal records"),
+    _site("oom.stream", "oom", "streaming/feed.py", "stream",
+          "chunk row budget halves from the committed-row prefix; prep "
+          "folds bit-equal, tree edges within documented tolerance",
+          bit_equal=False),
+    _site("oom.sweep", "oom", "impl/tuning/validators.py", "sweep|train",
+          "packed grid splits and fold metrics merge (identical winner); "
+          "exhaustion persisting to a single config quarantines the "
+          "family", bit_equal=False),
+    _site("preempt.stage_fit", "preempt", "dag.py", "train|stream",
+          "train(resume=True) restores verified stages, bit-exact"),
+    _site("preempt.checkpoint_write", "preempt", "persistence.py",
+          "train|stream",
+          "torn checkpoint detected by manifest; resume refits it"),
+    _site("preempt.sweep", "preempt", "impl/tuning/validators.py", "train",
+          "persisted sweep state replays bit-exactly on resume"),
+    _site("preempt.refit", "preempt", "impl/selector/model_selector.py",
+          "train",
+          "resume replays the sweep from disk and goes straight to refit"),
+)}
+
+
+def sites_for_scenario(scenario: str) -> List[str]:
+    """Registered sites a campaign scenario can exercise (sorted)."""
+    return sorted(n for n, s in ALL_SITES.items()
+                  if scenario in s.scenarios)
+
+
 _LOCK = threading.Lock()
 _SPECS: Dict[str, FaultSpec] = {}
 _CALLS: Dict[str, int] = {}
+#: (site, mode) -> times an armed spec actually APPLIED its fault (raised /
+#: poisoned) — always-on process-local accounting the campaign engine reads
+#: for per-schedule coverage; mirrored into the gated
+#: ``tg_chaos_injections_total{site,mode}`` counter (zero writes when
+#: metrics are off). Reset by clear()/configure().
+_FIRED: Dict[Tuple[str, str], int] = {}
 _ENV_LOADED = False
 
 
@@ -199,6 +317,7 @@ def configure(specs: Dict[str, Dict[str, Any]]) -> None:
         for site, kv in specs.items():
             _SPECS[site] = FaultSpec(site=site, **kv)
         _CALLS.clear()
+        _FIRED.clear()
 
 
 def clear() -> None:
@@ -206,6 +325,27 @@ def clear() -> None:
     with _LOCK:
         _SPECS.clear()
         _CALLS.clear()
+        _FIRED.clear()
+
+
+def fired_counts() -> Dict[str, Dict[str, int]]:
+    """{site: {mode: n}} faults actually applied since the last
+    configure()/clear() — the campaign engine's per-schedule coverage
+    accounting (armed-but-never-fired sites are invisible here)."""
+    with _LOCK:
+        out: Dict[str, Dict[str, int]] = {}
+        for (site, mode), n in _FIRED.items():
+            out.setdefault(site, {})[mode] = n
+        return out
+
+
+def _record_fired(site: str, mode: str) -> None:
+    with _LOCK:
+        _FIRED[(site, mode)] = _FIRED.get((site, mode), 0) + 1
+    _obs_metrics.inc_counter(
+        "tg_chaos_injections_total",
+        help="chaos faults actually applied, by site and mode "
+        "(docs/robustness.md 'Chaos campaigns')", site=site, mode=mode)
 
 
 def active_sites() -> List[str]:
@@ -248,6 +388,7 @@ def inject(site: str, key: Optional[str] = None) -> None:
     spec = _fires(site, key)
     if spec is None or spec.mode not in ("raise", "preempt", "oom"):
         return
+    _record_fired(site, spec.mode)
     if spec.mode == "preempt":
         raise SimulatedPreemption(
             f"simulated preemption at site '{site}'"
@@ -271,6 +412,7 @@ def poison(site: str, arr: np.ndarray, key: Optional[str] = None) -> np.ndarray:
     spec = _fires(site, key)
     if spec is None or spec.mode != "nan":
         return arr
+    _record_fired(site, spec.mode)
     out = np.array(arr, dtype=np.float64 if arr.dtype.kind != "f"
                    else arr.dtype, copy=True)
     if spec.index is None:

@@ -1,0 +1,159 @@
+"""Callable invariant oracles: the no-leak checks as library functions.
+
+The conftest no-leak fixtures (tests/conftest.py) and the chaos-campaign
+engine (robustness/campaign.py) need the SAME checks — "no serving
+runtime survived", "no feed/watchdog/refit thread is alive", "no chaos
+site is still armed", "the plan cache is bounded" — but a fixture can
+only guard one test, while a campaign must run the checks after every one
+of hundreds of randomized schedules. So the checks live here once, as
+plain functions returning *violation strings* (empty list = clean), and
+both consumers call them:
+
+* each ``leaked_*`` probe reports what is live **without touching it**;
+* each ``close_leaked_*`` helper force-closes the leftovers and returns
+  what it closed — the fixtures use these on exit so one leaky test
+  cannot poison the rest of the session, and the campaign uses them so
+  one leaky schedule cannot poison the next;
+* :func:`campaign_violations` is the aggregate the engine runs after
+  every schedule (leaks are *violations*, then cleaned).
+
+Nothing here imports heavyweight modules at import time — each probe
+imports its subsystem lazily, so importing the oracles costs nothing.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List, Optional
+
+#: thread-name prefixes owned by framework worker threads; anything alive
+#: with one of these names after a close/teardown is a leak
+THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog")
+
+
+# -- probes (read-only) ------------------------------------------------------
+
+def leaked_serving_runtimes() -> List[str]:
+    """Names of live (started, unclosed) serving runtimes."""
+    from ..serving import runtime as _srt
+    return [rt.name for rt in _srt.live_runtimes()]
+
+
+def leaked_stream_feeds() -> List[str]:
+    """repr of open DeviceFeeds."""
+    from ..streaming import feed as _feed
+    return [f"DeviceFeed#{i}" for i, _ in enumerate(_feed.live_feeds())]
+
+
+def leaked_watchdog_hearts() -> List[str]:
+    """Names of registered (unclosed) watchdog hearts."""
+    from . import watchdog as _wd
+    return [h.name for h in _wd.live_hearts()]
+
+
+def leaked_drift_refits() -> List[str]:
+    """Names of live background drift-refit threads."""
+    from ..serving import drift as _sdrift
+    return [t.name for t in _sdrift.live_refits()]
+
+
+def leaked_threads(prefixes: Iterable[str] = THREAD_PREFIXES) -> List[str]:
+    """Live threads whose names carry a framework worker prefix."""
+    pfx = tuple(prefixes)
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(pfx) and t.is_alive()]
+
+
+def armed_fault_sites() -> List[str]:
+    """Chaos sites still armed (must be empty outside an injection
+    context)."""
+    from . import faults
+    return faults.active_sites()
+
+
+def plan_cache_violations() -> List[str]:
+    """The compiled-plan LRU must stay bounded and no forced
+    planner-enable override may linger."""
+    from .. import plan as _plan
+    out: List[str] = []
+    if not (isinstance(_plan._PLAN_CACHE_MAX, int)
+            and _plan._PLAN_CACHE_MAX > 0):
+        out.append(f"plan cache bound is {_plan._PLAN_CACHE_MAX!r}, "
+                   f"not a positive int")
+    elif len(_plan._PLAN_CACHE) > _plan._PLAN_CACHE_MAX:
+        out.append(f"plan cache exceeded its LRU bound: "
+                   f"{len(_plan._PLAN_CACHE)} > {_plan._PLAN_CACHE_MAX}")
+    if _plan._enabled_override is not None:
+        out.append("a forced planner enable/disable override is active")
+    return out
+
+
+# -- force-clean helpers (used on exit so one leak cannot cascade) ----------
+
+def close_leaked_serving() -> List[str]:
+    from ..serving import runtime as _srt
+    leaked = _srt.live_runtimes()
+    for rt in leaked:
+        rt.close(drain=False)
+    return [rt.name for rt in leaked]
+
+
+def close_leaked_feeds() -> List[str]:
+    from ..streaming import feed as _feed
+    leaked = _feed.live_feeds()
+    for f in leaked:
+        f.close()
+    return [f"DeviceFeed#{i}" for i, _ in enumerate(leaked)]
+
+
+def close_leaked_hearts() -> List[str]:
+    """Close leftover hearts and let the shared scanner thread retire."""
+    from . import watchdog as _wd
+    leaked = _wd.live_hearts()
+    for h in leaked:
+        h.close()
+    _wd.idle_join()
+    return [h.name for h in leaked]
+
+
+def join_drift_refits(timeout: float = 30.0) -> List[str]:
+    """Join outstanding refit threads; returns any still alive after."""
+    from ..serving import drift as _sdrift
+    for t in _sdrift.live_refits():
+        t.join(timeout=timeout)
+    return [t.name for t in _sdrift.live_refits()]
+
+
+# -- aggregates --------------------------------------------------------------
+
+def campaign_violations(clean: bool = True,
+                        refit_join_timeout: float = 30.0) -> List[str]:
+    """The engine's post-schedule invariant sweep: every leak is a
+    violation, and (with ``clean=True``, the default) the leftovers are
+    force-closed so the NEXT schedule starts from a clean process — a
+    campaign reports the first schedule that leaks instead of cascading
+    false failures."""
+    out: List[str] = []
+    still = join_drift_refits(timeout=refit_join_timeout)
+    if still:
+        out.append(f"drift refit thread(s) outlived the schedule: {still}")
+    rts = leaked_serving_runtimes()
+    if rts:
+        out.append(f"serving runtime(s) leaked: {rts}")
+    feeds = leaked_stream_feeds()
+    if feeds:
+        out.append(f"device feed(s) leaked: {feeds}")
+    hearts = leaked_watchdog_hearts()
+    if hearts:
+        out.append(f"watchdog heart(s) leaked: {hearts}")
+    if clean:
+        close_leaked_serving()
+        close_leaked_feeds()
+        close_leaked_hearts()
+    else:
+        from . import watchdog as _wd
+        _wd.idle_join()
+    threads = leaked_threads()
+    if threads:
+        out.append(f"worker thread(s) survived: {threads}")
+    out.extend(plan_cache_violations())
+    return out

@@ -192,6 +192,13 @@ class FaultLog:
             # join(timeout=...) at close — never discarded silently
             "threadStalls": [r.to_json()
                              for r in self.of_kind("thread_stalled")],
+            # stale run sentinels found on resume: a PREVIOUS process
+            # owning this checkpoint dir exited uncleanly (SIGKILL, node
+            # loss, the OOM killer — oomKillSuspected when its last phase
+            # was device work; docs/robustness.md "Cross-process kill
+            # detection")
+            "uncleanExits": [r.to_json()
+                             for r in self.of_kind("unclean_exit")],
             "fatal": [r.to_json() for r in self.of_kind("fatal")],
             # ring accounting: reports evicted under TG_FAULTS_MAX
             "droppedReports": self.dropped,

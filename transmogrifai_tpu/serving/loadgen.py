@@ -18,6 +18,7 @@ that dies on the first shed cannot measure shedding.
 from __future__ import annotations
 
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -86,8 +87,11 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
             i += 1
             next_at += interval
         time.sleep(min(0.001, max(0.0, next_at - time.monotonic())))
-    # drain: every accepted request must resolve (result or typed shed)
-    completed = quarantined = shed_deadline = failed = 0
+    # drain: every accepted request must resolve (result or typed shed).
+    # A future that never resolves inside the drain budget is LOST — the
+    # one outcome a serving tier may never produce; the campaign engine
+    # and BENCH_MODE=campaign assert lost == 0
+    completed = quarantined = shed_deadline = failed = lost = 0
     drain_deadline = time.monotonic() + drain_timeout
     for fut in futures:
         try:
@@ -98,6 +102,8 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
             completed += 1
         except DeadlineExceededError:
             shed_deadline += 1
+        except FuturesTimeoutError:
+            lost += 1
         except Exception:
             failed += 1
     wall = time.monotonic() - start
@@ -114,6 +120,12 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         "shedDeadline": shed_deadline,
         "submitErrors": submit_errors,
         "failed": failed,
+        "lost": lost,
+        # every offered arrival must land in exactly one bucket — the
+        # full-request-accounting invariant, precomputed so callers can
+        # assert it without re-deriving the sum
+        "accountingOk": (offered == completed + shed_submit + shed_deadline
+                         + submit_errors + failed + lost),
         "p50Ms": round(lat.get("p50", float("nan")) * 1e3, 3),
         "p95Ms": round(lat.get("p95", float("nan")) * 1e3, 3),
         "p99Ms": round(lat.get("p99", float("nan")) * 1e3, 3),

@@ -508,15 +508,18 @@ class ServingRuntime:
 
     def _finish(self, reqs: Sequence[_Request],
                 recs: Sequence[Dict[str, Any]], degraded: bool) -> None:
+        # account the flush BEFORE resolving futures: a caller that takes
+        # its result and immediately reads summary() must see this flush
+        # already counted — resolving first let the woken waiter race
+        # ahead of the batcher's counter writes (latencies use one `now`,
+        # so the ordering changes no measured value)
         now = time.monotonic()
         quarantined = 0
         for r, rec in zip(reqs, recs):
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
-            try:
-                r.future.set_result(rec)
-            except InvalidStateError:
-                continue  # cancelled while in flight
+            if r.future.cancelled():
+                continue
             self._observe("tg_serve_request_seconds", now - r.enqueued,
                           help="enqueue-to-result latency per request "
                           "(p50/p95/p99; docs/serving.md)")
@@ -532,6 +535,11 @@ class ServingRuntime:
         if quarantined:
             self._count("tg_serve_quarantined_total", float(quarantined),
                         help="requests quarantined under __score_error__")
+        for r, rec in zip(reqs, recs):
+            try:
+                r.future.set_result(rec)
+            except InvalidStateError:
+                continue  # cancelled while in flight
         # drift fold AFTER every future resolved: still on the batcher
         # thread (off the request hot path), post-quarantine, and fenced —
         # nothing past this line can affect a response

@@ -44,6 +44,7 @@ from typing import Any, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..manifest import sentinel_phase as _sentinel_phase
 from ..observability import metrics as _obs_metrics
 from ..robustness import faults
 from ..robustness import watchdog as _watchdog
@@ -213,6 +214,11 @@ class DeviceFeed:
                 for model in self._transforms:
                     table = model.transform(table)
                 t0 = time.perf_counter()
+                # crash evidence: an OOM-killed process dies right here —
+                # the run sentinel's phase names the packed upload
+                # (module-global ambient, so this producer thread sees the
+                # trainer's sentinel)
+                _sentinel_phase("device_upload")
                 faults.inject("stream.upload")
                 # chaos: a RESOURCE_EXHAUSTED here models the packed chunk
                 # upload not fitting on the device — it forwards through

@@ -23,6 +23,33 @@ from .stages.base import Estimator, FeatureGeneratorStage
 from .table import Column, FeatureTable
 
 
+def _open_run_sentinel(ckpt_dir: Optional[str], resume: bool):
+    """Cross-process kill detection (docs/robustness.md): open this run's
+    pid+phase sentinel in the checkpoint dir. On ``resume=True``, a stale
+    sentinel left by a *different* process is the previous owner's dying
+    breath — recorded as a FaultLog ``unclean_exit`` (``oomKillSuspected``
+    when its last phase was device work) before this run takes over.
+    Returns the started sentinel (cleared by the caller on clean exit),
+    or None without a checkpoint dir."""
+    if ckpt_dir is None:
+        return None
+    from .manifest import RunSentinel
+    from .robustness.policy import FaultLog, FaultReport
+    sentinel = RunSentinel(ckpt_dir)
+    if resume:
+        stale = sentinel.read_stale()
+        if stale is not None:
+            FaultLog.record(FaultReport(
+                site="manifest.sentinel", kind="unclean_exit",
+                detail={"pid": stale.get("pid"),
+                        "phase": stale.get("phase"),
+                        "dir": ckpt_dir,
+                        "oomKillSuspected":
+                            RunSentinel.suspects_oom_kill(stale)}))
+    sentinel.start("dag_fit")
+    return sentinel
+
+
 class _WorkflowCore:
     """Shared state between workflow and model (reference OpWorkflowCore.scala:60-84)."""
 
@@ -290,11 +317,16 @@ class OpWorkflow(_WorkflowCore):
                 model, ckpt_dir, manifest)
             stream_ckpt = StreamCheckpoint(ckpt_dir, manifest,
                                            source.fingerprint())
-        fitted, transformers, stats = fit_dag_streaming(
-            source, layers,
-            checkpoint=checkpoint, stream_checkpoint=stream_ckpt,
-            preloaded=preloaded,
-            retry_policy=getattr(self, "_fault_policy", None))
+        from .manifest import active_sentinel
+        sentinel = _open_run_sentinel(ckpt_dir, resume)
+        with active_sentinel(sentinel):
+            fitted, transformers, stats = fit_dag_streaming(
+                source, layers,
+                checkpoint=checkpoint, stream_checkpoint=stream_ckpt,
+                preloaded=preloaded,
+                retry_policy=getattr(self, "_fault_policy", None))
+        if sentinel is not None:
+            sentinel.clear()
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in self.result_features)
         model = OpWorkflowModel()
@@ -371,14 +403,20 @@ class OpWorkflow(_WorkflowCore):
                         s.set_sweep_checkpoint(
                             SweepCheckpoint(ckpt_dir, s.uid, manifest))
         retry_policy = getattr(self, "_fault_policy", None)
-        if self._workflow_cv:
-            table, fitted = self._fit_with_workflow_cv(table, layers)
-        else:
-            table, fitted = fit_and_transform_dag(table, layers,
-                                                  profiler=self.profiler,
-                                                  checkpoint=checkpoint,
-                                                  preloaded=preloaded,
-                                                  retry_policy=retry_policy)
+        from .manifest import active_sentinel
+        sentinel = _open_run_sentinel(ckpt_dir, resume)
+        with active_sentinel(sentinel):
+            if self._workflow_cv:
+                table, fitted = self._fit_with_workflow_cv(table, layers)
+            else:
+                table, fitted = fit_and_transform_dag(
+                    table, layers, profiler=self.profiler,
+                    checkpoint=checkpoint, preloaded=preloaded,
+                    retry_policy=retry_policy)
+        if sentinel is not None:
+            # clean-exit commit: a kill anywhere above leaves the sentinel
+            # for the next resume to report
+            sentinel.clear()
         new_results = tuple(
             f.copy_with_new_stages(fitted) for f in result_features)
         model = OpWorkflowModel()
