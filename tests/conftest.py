@@ -68,6 +68,36 @@ def _no_observability_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_blackbox_leak():
+    """The flight recorder is ALWAYS ON (TG_BLACKBOX; unlike TG_TRACE it
+    has no opt-in), so every test records events — that is the feature,
+    not a leak. What must not bleed between tests: recorder contents
+    (cross-test event bleed would make timeline assertions
+    order-dependent), a forced enable/disable override, the post-mortem
+    rate-limit counters, and bundle files in the default
+    TG_POSTMORTEM_DIR (trigger events fired by breaker/oom/drift tests
+    dump real bundles there). Probes + cleanup live in
+    robustness/oracles.py like the other leak checks; module-scoped
+    fixtures may record during setup, so the recorder is cleared (not
+    asserted empty) on entry."""
+    from transmogrifai_tpu.observability import blackbox as _bb
+    from transmogrifai_tpu.observability import postmortem as _pm
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.stray_postmortem_bundles(), (
+        "post-mortem bundle(s) leaked from a previous test: "
+        f"{oracles.stray_postmortem_bundles()}")
+    assert not oracles.blackbox_violations(), (
+        f"blackbox state leaked into this test: "
+        f"{oracles.blackbox_violations()}")
+    _bb.recorder().clear()
+    yield
+    oracles.clean_postmortem_bundles()
+    _bb.reset()
+    _pm.reset()
+
+
+@pytest.fixture(autouse=True)
 def _no_plan_cache_leak():
     """Compiled transform plans pin jitted executables (and the stage
     objects they closed over), so the LRU must be provably bounded and must

@@ -170,12 +170,19 @@ def test_prometheus_text_format_valid(traced):
     assert text.endswith("\n")
     for line in text.splitlines():
         assert _PROM_LINE.match(line), f"invalid prometheus line: {line!r}"
-    # summary exposition: quantile series + _sum + _count
-    assert 'tg_lat_seconds{quantile="0.5"}' in text
+    # histogram exposition (round 11): cumulative _bucket series from the
+    # streaming sketch + the exact +Inf/_sum/_count triple
+    assert "# TYPE tg_lat_seconds histogram" in text
+    assert 'tg_lat_seconds_bucket{le="+Inf"} 3' in text
     assert "tg_lat_seconds_sum" in text
     assert "tg_lat_seconds_count 3" in text
     assert "# TYPE tg_things_total counter" in text
     assert 'tg_things_total{kind="x"} 3.0' in text
+    # the pre-round-11 summary exposition survives behind the compat flag
+    compat = r.to_prometheus(compat=True)
+    assert 'tg_lat_seconds{quantile="0.5"}' in compat
+    assert "# TYPE tg_lat_seconds summary" in compat
+    assert "_bucket" not in compat
 
 
 # -- exporters ---------------------------------------------------------------

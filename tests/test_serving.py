@@ -435,8 +435,14 @@ def test_serve_metrics_mirrored_when_enabled(model):
         # serve series live in the serving section, not counters
         assert not any(k.startswith("tg_serve_") for k in obs["counters"])
         prom = om.registry().to_prometheus()
-        assert 'tg_serve_request_seconds{model="obs",quantile="0.99"}' in prom
+        # round-11 exposition: real cumulative buckets (+Inf is exact);
+        # the old quantile-summary lines live behind TG_PROM_SUMMARY_COMPAT
+        assert 'tg_serve_request_seconds_bucket{model="obs",le="+Inf"} 1' \
+            in prom
         assert 'tg_breaker_state{model="obs"}' in prom
+        compat = om.registry().to_prometheus(compat=True)
+        assert 'tg_serve_request_seconds{model="obs",quantile="0.99"}' \
+            in compat
     finally:
         om.enable_metrics(None)
 

@@ -542,6 +542,118 @@ def run_campaign(schedules: int = 0, seed: Optional[int] = None,
         eng.close()
 
 
+def _doctor_ms(ts_ns: Optional[float], anchor_ns: Optional[float]) -> str:
+    if ts_ns is None:
+        return "       ?"
+    if anchor_ns is None:
+        return f"{ts_ns / 1e6:12.1f}"
+    return f"{(ts_ns - anchor_ns) / 1e6:+12.1f}"
+
+
+def _doctor_event_line(e: Dict[str, Any], anchor_ns: Optional[float]) -> str:
+    attrs = e.get("attrs") or {}
+    body = " ".join(f"{k}={v}" for k, v in sorted(attrs.items())
+                    if v is not None)
+    corr = f" [{e['corr']}]" if e.get("corr") else ""
+    return (f"  {_doctor_ms(e.get('tsNs'), anchor_ns)} ms  "
+            f"{e.get('kind', '?'):<22}{corr}  {body}"[:200])
+
+
+def run_doctor(bundle: str, as_json: bool = False,
+               tail: int = 40) -> Dict[str, Any]:
+    """``op doctor <bundle>`` (docs/observability.md "Flight recorder &
+    post-mortems"): render a post-mortem bundle into a human-readable
+    incident report — trigger, environment, the trigger correlation id's
+    full timeline, the recent ring tail, top metrics, and the FaultLog
+    buckets. ``bundle`` may be a bundle file or a directory (the newest
+    bundle inside is used). Exits non-zero when the bundle fails schema
+    validation."""
+    import json as _json
+    import sys as _sys
+
+    from .observability import postmortem as _postmortem
+
+    path = bundle
+    if os.path.isdir(path):
+        bundles = _postmortem.list_bundles(path)
+        if not bundles:
+            raise SystemExit(f"no post-mortem bundles under {path}")
+        path = bundles[-1]
+    doc = _postmortem.read_bundle(path)
+    problems = _postmortem.validate_bundle(doc)
+    if as_json:
+        out = {"bundle": path, "problems": problems, "doc": doc}
+        print(_json.dumps(out, indent=2, default=str))
+        if problems:
+            _sys.exit(1)
+        return out
+
+    trig = doc.get("trigger", {}) or {}
+    anchor = trig.get("tsNs")
+    print(f"== post-mortem: {path}")
+    print(f"   trigger : {trig.get('kind')}  (pid {doc.get('pid')}, "
+          f"unix {trig.get('unixTime')})")
+    if trig.get("corr"):
+        print(f"   corr    : {trig['corr']}")
+    detail = trig.get("detail") or {}
+    for k, v in sorted(detail.items()):
+        print(f"   {k:<8}: {v}")
+    env = doc.get("environment", {}) or {}
+    devs = env.get("devices") or []
+    print(f"   env     : jax {env.get('jax')} / jaxlib {env.get('jaxlib')} "
+          f"/ {env.get('backend')} x{len(devs)} "
+          f"/ python {env.get('python')}")
+    if problems:
+        print("-- SCHEMA PROBLEMS --")
+        for p in problems:
+            print(f"   ! {p}")
+    corr_events = doc.get("correlated") or []
+    if corr_events:
+        print(f"-- correlated timeline ({trig.get('corr')}; "
+              f"{len(corr_events)} events; ms relative to trigger) --")
+        for e in corr_events:
+            print(_doctor_event_line(e, anchor))
+    ring = (doc.get("recorder") or {}).get("events") or []
+    shown = ring[-max(1, tail):]
+    print(f"-- ring tail ({len(shown)}/{len(ring)} events; dropped "
+          f"{(doc.get('recorder') or {}).get('dropped', 0)}) --")
+    for e in shown:
+        print(_doctor_event_line(e, anchor))
+    # top metrics: the biggest counter series from the trigger site's
+    # registry (serve-local when the trigger carried one, else global)
+    metrics = doc.get("metrics") or doc.get("globalMetrics") or {}
+    flat: List[Any] = []
+    for name, series in metrics.items():
+        for key, v in series.items():
+            if isinstance(v, dict):
+                lat = {q: v.get(q) for q in ("p50", "p95", "p99")
+                       if v.get(q) is not None}
+                flat.append((v.get("count", 0), name, key,
+                             f"count={v.get('count')} {lat}"))
+                for ex in (v.get("exemplars") or [])[:3]:
+                    flat.append((v.get("count", 0), name, key,
+                                 f"slowest {ex.get('value'):.4f}s -> "
+                                 f"{ex.get('exemplar')}"))
+            else:
+                flat.append((float(v), name, key, f"{v}"))
+    flat.sort(key=lambda t: -t[0])
+    if flat:
+        print("-- top metrics --")
+        for _rank, name, key, desc in flat[:12]:
+            print(f"   {name}{{{key}}}: {desc}")
+    faults_doc = doc.get("faults") or {}
+    buckets = {k: len(v) for k, v in faults_doc.items()
+               if isinstance(v, list) and v}
+    if buckets:
+        print(f"-- fault log: {buckets} "
+              f"(dropped {faults_doc.get('droppedReports', 0)})")
+    verdict = "INVALID" if problems else "ok"
+    print(f"== doctor verdict: {verdict} ==")
+    if problems:
+        _sys.exit(1)
+    return {"bundle": path, "problems": problems}
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="op",
                                 description="transmogrifai_tpu CLI")
@@ -623,6 +735,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     cp.add_argument("--no-minimize", action="store_true",
                     help="skip delta-debug minimization of violating "
                          "schedules")
+    dr = sub.add_parser(
+        "doctor", help="render a flight-recorder post-mortem bundle into "
+                       "a human-readable incident report "
+                       "(docs/observability.md)")
+    dr.add_argument("bundle",
+                    help="bundle file (postmortem_*.json) or a directory "
+                         "of bundles (the newest one is rendered)")
+    dr.add_argument("--json", action="store_true",
+                    help="machine-readable output (bundle + validation "
+                         "problems) instead of the rendered report")
+    dr.add_argument("--tail", type=int, default=40,
+                    help="ring events to show in the recent-timeline tail")
     a = p.parse_args(argv)
     if a.command == "gen":
         generate(a.input, a.response, a.output, a.name, a.id_field,
@@ -640,6 +764,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         run_campaign(schedules=a.schedules, seed=a.seed,
                      scenario=a.scenario, faults_json=a.faults,
                      output=a.output, no_minimize=a.no_minimize)
+    elif a.command == "doctor":
+        run_doctor(a.bundle, as_json=a.json, tail=a.tail)
 
 
 if __name__ == "__main__":

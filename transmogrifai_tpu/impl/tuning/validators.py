@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
+from ...observability import blackbox as _blackbox
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
 from ...observability.trace import span as _obs_span, tracing_enabled
@@ -703,6 +704,11 @@ class OpValidator:
             with _obs_span("sweep.family", cat="sweep", family=family.name,
                            configs=len(grid), folds=F,
                            metric=metric_name) as sweep_span:
+                # flight-recorder: each family dispatch, stamped with the
+                # owning run's correlation id (workflow.train) — a sweep
+                # post-mortem shows which family the incident interrupted
+                _blackbox.record("sweep.family", family=family.name,
+                                 configs=len(grid), folds=F)
                 cs0 = None
                 if tracing_enabled():
                     from ...utils.jax_cache import cache_stats

@@ -12,12 +12,23 @@ only); disabled, every instrumentation point is a single flag check.
 ``OpWorkflowModel.summary()["observability"]`` returns :func:`summarize` —
 the aggregated per-stage / per-family timings, fault counters and scoring
 latency quantiles of the current process.
+
+Independently of both switches, the **flight recorder** (``blackbox``)
+runs always-on (``TG_BLACKBOX=0`` opts out): a bounded ring of compact
+request-correlated events that ``postmortem`` snapshots into atomic
+incident bundles on trigger events (breaker open, watchdog stall, OOM
+downshift, drift degradation, unclean exit, campaign violations) —
+docs/observability.md "Flight recorder & post-mortems".
 """
 from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import export, metrics, trace  # noqa: F401
+from . import blackbox, export, metrics, postmortem, trace  # noqa: F401
+from .blackbox import (  # noqa: F401
+    FlightRecorder, blackbox_enabled, correlated, current_correlation,
+    enable_blackbox, new_correlation_id, recorder,
+)
 from .metrics import (  # noqa: F401
     MetricsRegistry, enable_metrics, inc_counter, metrics_enabled, observe,
     registry, set_gauge,
@@ -28,10 +39,13 @@ from .trace import (  # noqa: F401
 
 
 def reset() -> None:
-    """Fresh tracer + registry + env-driven enablement — the per-test
-    isolation hook (tests/conftest.py); production never needs it."""
+    """Fresh tracer + registry + flight recorder + env-driven enablement —
+    the per-test isolation hook (tests/conftest.py); production never
+    needs it."""
     trace.reset()
     metrics.reset()
+    blackbox.reset()
+    postmortem.reset()
 
 
 def summarize(tr: Optional[trace.Tracer] = None,

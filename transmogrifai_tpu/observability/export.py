@@ -10,14 +10,23 @@ the same discipline as every other artifact this framework writes.
   loadable in ``chrome://tracing`` or https://ui.perfetto.dev. Spans export
   as complete events (``ph: "X"``, microsecond ``ts``/``dur``); span events
   and free-standing instants as ``ph: "i"``.
-* :func:`write_prometheus` — the registry's text exposition format
-  (``metrics.prom``), scrape-able or pushable as-is.
+* :func:`prometheus_text` / :func:`write_prometheus` — the registry's
+  text exposition format (``metrics.prom``), scrape-able or pushable
+  as-is. Histograms export as REAL cumulative ``_bucket``/``_sum``/
+  ``_count`` series (the bucket boundaries are the streaming sketch's
+  bin centroids, the cumulative counts its ``Sum`` estimates — monotone
+  by construction, ``+Inf`` exact), so Prometheus can aggregate and
+  ``histogram_quantile`` across instances — the one thing the old
+  quantile-summary exposition could never do. ``TG_PROM_SUMMARY_COMPAT=1``
+  (or ``compat=True``) restores the pre-round-11 summary lines for
+  scrapers built against them.
 * :func:`write_jsonl` — one JSON object per finished span, for ad-hoc
   ``jq``/pandas analysis of long runs.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import Any, Dict, List, Optional
 
@@ -63,11 +72,70 @@ def write_chrome_trace(path: str,
     return path
 
 
-def write_prometheus(path: str,
-                     registry: Optional[_metrics.MetricsRegistry] = None
-                     ) -> str:
+#: compat switch: truthy restores the quantile-summary exposition
+PROM_COMPAT_ENV = "TG_PROM_SUMMARY_COMPAT"
+_FALSY = ("", "0", "false", "False", "no")
+
+
+def _prom_compat() -> bool:
+    return os.environ.get(PROM_COMPAT_ENV, "") not in _FALSY
+
+
+def prometheus_text(registry: Optional[_metrics.MetricsRegistry] = None,
+                    compat: Optional[bool] = None) -> str:
+    """Render a registry in the Prometheus text exposition format
+    (validated against the format grammar in tests/test_blackbox.py).
+
+    Histograms (default): ``# TYPE <name> histogram`` with cumulative
+    ``<name>_bucket{le="..."}`` series from
+    :meth:`~.metrics.Histogram.cumulative_buckets` plus the exact
+    ``le="+Inf"``/``_sum``/``_count`` triple. ``compat=True`` (or the
+    ``TG_PROM_SUMMARY_COMPAT`` env): the pre-round-11 summary exposition
+    — ``# TYPE <name> summary`` with p50/p95/p99 ``quantile`` series."""
     reg = registry or _metrics.registry()
-    atomic_write_bytes(path, reg.to_prometheus().encode("utf-8"))
+    if compat is None:
+        compat = _prom_compat()
+    labels_of = _metrics._labels
+    num = _metrics._num
+    lines: List[str] = []
+    for name, kind, help, ms in reg.collect():
+        if help:
+            lines.append(f"# HELP {name} {_metrics._escape_help(help)}")
+        is_hist = kind in ("histogram", "summary")
+        lines.append(f"# TYPE {name} "
+                     f"{('summary' if compat else 'histogram') if is_hist else kind}")
+        for m in ms:
+            if isinstance(m, _metrics.Histogram):
+                if compat:
+                    if m.count:
+                        for q in _metrics.QUANTILES:
+                            v = m.quantile(q)
+                            if math.isfinite(v):
+                                lines.append(
+                                    f"{name}{labels_of(m.labels, quantile=q)}"
+                                    f" {num(v)}")
+                else:
+                    for le, cum in m.cumulative_buckets():
+                        lines.append(
+                            f"{name}_bucket{labels_of(m.labels, le=num(le))}"
+                            f" {num(cum)}")
+                    lines.append(
+                        f"{name}_bucket{labels_of(m.labels, le='+Inf')} "
+                        f"{m.count}")
+                lines.append(f"{name}_sum{labels_of(m.labels)} "
+                             f"{num(m.sum)}")
+                lines.append(f"{name}_count{labels_of(m.labels)} "
+                             f"{m.count}")
+            else:
+                lines.append(f"{name}{labels_of(m.labels)} {num(m.value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus(path: str,
+                     registry: Optional[_metrics.MetricsRegistry] = None,
+                     compat: Optional[bool] = None) -> str:
+    atomic_write_bytes(
+        path, prometheus_text(registry, compat=compat).encode("utf-8"))
     return path
 
 

@@ -32,7 +32,6 @@ per distinct value for the life of the process).
 """
 from __future__ import annotations
 
-import math
 import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
@@ -117,14 +116,33 @@ class Gauge:
 #: quantiles exported for every histogram (Prometheus summary convention)
 QUANTILES = (0.5, 0.95, 0.99)
 
+#: how many slowest-observation exemplars a histogram keeps
+EXEMPLARS_ENV = "TG_EXEMPLARS_K"
+DEFAULT_EXEMPLARS_K = 5
+
+
+def _exemplars_k() -> int:
+    try:
+        return max(0, int(os.environ.get(EXEMPLARS_ENV, "")
+                          or DEFAULT_EXEMPLARS_K))
+    except ValueError:
+        return DEFAULT_EXEMPLARS_K
+
 
 class Histogram:
     """Streaming-quantile distribution: fixed-size SPDT sketch + exact
     count/sum. ``observe`` is O(1); quantiles are approximations whose
     error shrinks with bin count (64 bins ≈ sub-percent on unimodal
-    latency distributions — validated against numpy in the tests)."""
+    latency distributions — validated against numpy in the tests).
 
-    __slots__ = ("name", "labels", "count", "sum", "_sketch")
+    **Exemplars**: observations may carry an exemplar tag (the serving
+    runtime passes the request's flight-recorder correlation id —
+    observability/blackbox.py); the histogram keeps the tags of its K
+    largest observations (``TG_EXEMPLARS_K``, default 5), so a p99
+    latency outlier links directly to the recorder timeline of the
+    request that caused it."""
+
+    __slots__ = ("name", "labels", "count", "sum", "_sketch", "_exemplars")
 
     def __init__(self, name: str, labels: Dict[str, str], max_bins: int = 64):
         self.name = name
@@ -132,25 +150,57 @@ class Histogram:
         self.count = 0
         self.sum = 0.0
         self._sketch = StreamingHistogram(max_bins=max_bins)
+        #: (value, exemplar) of the K largest tagged observations, desc
+        self._exemplars: List[Tuple[float, Any]] = []
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Any = None) -> None:
         v = float(v)
         self.count += 1
         self.sum += v
         self._sketch.update([v])
+        if exemplar is not None:
+            xs = self._exemplars
+            k = _exemplars_k()
+            if k and (len(xs) < k or v > xs[-1][0]):
+                xs.append((v, exemplar))
+                xs.sort(key=lambda t: -t[0])
+                del xs[k:]
 
     def quantile(self, q: float) -> float:
         if self.count == 0:
             return float("nan")
         return float(self._sketch.quantile(q))
 
-    def snapshot(self) -> Dict[str, float]:
-        out: Dict[str, float] = {"count": self.count, "sum": self.sum}
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """The slowest-K tagged observations, largest first:
+        ``[{"value": seconds, "exemplar": corr-id}]``."""
+        return [{"value": v, "exemplar": e} for v, e in self._exemplars]
+
+    def cumulative_buckets(self) -> List[Tuple[float, float]]:
+        """``[(le, cumulative count)]`` derived from the streaming
+        sketch's bin centroids — monotone non-decreasing and capped at
+        ``count``, ready for Prometheus ``_bucket`` exposition (the
+        exporter appends the ``+Inf`` bucket; observability/export.py)."""
+        if not self.count:
+            return []
+        out: List[Tuple[float, float]] = []
+        prev = 0.0
+        for center, _mass in self._sketch.bins():
+            cum = min(float(self._sketch.sum(center)), float(self.count))
+            cum = max(cum, prev)
+            prev = cum
+            out.append((float(center), cum))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"count": self.count, "sum": self.sum}
         if self.count:
             out["min"] = float(self._sketch.min)
             out["max"] = float(self._sketch.max)
             for q in QUANTILES:
                 out[f"p{int(q * 100)}"] = self.quantile(q)
+        if self._exemplars:
+            out["exemplars"] = self.exemplars()
         return out
 
 
@@ -209,7 +259,7 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "", max_bins: int = 64,
                   **labels: str) -> Histogram:
-        return self._get(Histogram, "summary", name, help, labels,
+        return self._get(Histogram, "histogram", name, help, labels,
                          max_bins=max_bins)
 
     # -- introspection -------------------------------------------------------
@@ -236,36 +286,25 @@ class MetricsRegistry:
             out[name] = series
         return out
 
-    def to_prometheus(self) -> str:
-        """Text exposition format (counters/gauges as-is, histograms as
-        summaries with p50/p95/p99 quantile series)."""
-        lines: List[str] = []
-        for name, kind, help, ms in self.collect():
-            if help:
-                lines.append(f"# HELP {name} {_escape_help(help)}")
-            lines.append(f"# TYPE {name} {kind}")
-            for m in ms:
-                if isinstance(m, Histogram):
-                    if m.count:
-                        for q in QUANTILES:
-                            v = m.quantile(q)
-                            if math.isfinite(v):
-                                lines.append(
-                                    f"{name}{_labels(m.labels, quantile=q)} "
-                                    f"{_num(v)}")
-                    lines.append(f"{name}_sum{_labels(m.labels)} "
-                                 f"{_num(m.sum)}")
-                    lines.append(f"{name}_count{_labels(m.labels)} "
-                                 f"{m.count}")
-                else:
-                    lines.append(f"{name}{_labels(m.labels)} {_num(m.value)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+    def to_prometheus(self, compat: Optional[bool] = None) -> str:
+        """Text exposition format: counters/gauges as-is, histograms as
+        real cumulative ``_bucket``/``_sum``/``_count`` series derived
+        from the streaming sketch (observability/export.py owns the
+        grammar). ``compat=True`` — or ``TG_PROM_SUMMARY_COMPAT=1`` —
+        restores the pre-round-11 summary exposition (p50/p95/p99
+        quantile series) for scrapers built against it."""
+        from .export import prometheus_text
+        return prometheus_text(self, compat=compat)
 
 
-def _labels(labels: Dict[str, str], quantile: Optional[float] = None) -> str:
+def _labels(labels: Dict[str, str], quantile: Optional[float] = None,
+            le: Optional[str] = None) -> str:
     items = sorted(labels.items())
     if quantile is not None:
         items.append(("quantile", f"{quantile:g}"))
+    if le is not None:
+        # the bucket boundary label goes LAST (Prometheus convention)
+        items.append(("le", str(le)))
     if not items:
         return ""
     body = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in items)

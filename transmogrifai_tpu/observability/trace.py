@@ -171,6 +171,14 @@ class Tracer:
             if len(self.spans) == self.spans.maxlen:
                 self.dropped += 1
             self.spans.append(s)
+        # span close summary into the always-on flight recorder: when a
+        # run is traced, the black box sees the traced world too — a
+        # post-mortem bundle then carries the span names/durations of the
+        # seconds before the trigger (observability/blackbox.py)
+        from . import blackbox as _blackbox
+        if _blackbox.blackbox_enabled():
+            _blackbox.record("span", name=s.name, cat=s.cat,
+                             durNs=s.dur_ns)
 
     # -- queries -------------------------------------------------------------
     def current(self) -> Optional[Span]:

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..observability import postmortem as _postmortem
 from . import faults, oracles
 from .faults import ALL_SITES, SimulatedPreemption, sites_for_scenario
 from .policy import FaultLog, RetryPolicy
@@ -833,6 +834,16 @@ class ChaosCampaign:
                     violations.append(
                         f"{scn.name}: untyped {type(e).__name__} escaped "
                         f"a fenced region: {e}")
+                # trigger event: an error — typed or not — escaped a
+                # campaign scenario; freeze the fault sequence that led
+                # to it (rate-limited; observability/postmortem.py)
+                _postmortem.trigger(
+                    "campaign_escape", fault_log=log,
+                    detail={"scenario": scn.name,
+                            "error": f"{type(e).__name__}: {e}"[:300],
+                            "typed": isinstance(e, self.typed_escapes()),
+                            "faults": {k: dict(v) for k, v
+                                       in schedule["faults"].items()}})
             finally:
                 fired_raw = faults.fired_counts()
         if faults.active_sites():
@@ -883,6 +894,22 @@ class ChaosCampaign:
                     entry["minimized"] = mini
                     entry["repro"] = repro
                     report.minimized.append(repro)
+                # trigger event: an invariant oracle fired — dump the
+                # post-mortem bundle AFTER minimization (the probe re-runs
+                # would shuffle the ring) and attach its path to the
+                # one-command reproducer, so the repro ships with the
+                # black-box context of the schedule that found it
+                bundle = _postmortem.trigger(
+                    "campaign_violation",
+                    detail={"scenario": res["scenario"], "index": idx,
+                            "violations": res["violations"],
+                            "faults": res["faults"],
+                            "minimized": entry.get("minimized"),
+                            "cmd": (entry.get("repro") or {}).get("cmd")})
+                if bundle is not None:
+                    entry["postmortem"] = bundle
+                    if "repro" in entry:
+                        entry["repro"]["postmortem"] = bundle
                 report.violations.append(entry)
             res.pop("accounting", None)
             report.results.append(res)

@@ -342,6 +342,11 @@ def fired_counts() -> Dict[str, Dict[str, int]]:
 def _record_fired(site: str, mode: str) -> None:
     with _LOCK:
         _FIRED[(site, mode)] = _FIRED.get((site, mode), 0) + 1
+    # an applied chaos fault is part of the incident narrative — the
+    # flight recorder must show the injection next to the recovery it
+    # provoked (observability/blackbox.py)
+    from ..observability import blackbox as _blackbox
+    _blackbox.record("chaos.injection", site=site, mode=mode)
     _obs_metrics.inc_counter(
         "tg_chaos_injections_total",
         help="chaos faults actually applied, by site and mode "

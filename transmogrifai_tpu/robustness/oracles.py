@@ -22,6 +22,7 @@ imports its subsystem lazily, so importing the oracles costs nothing.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Iterable, List, Optional
 
@@ -68,6 +69,46 @@ def armed_fault_sites() -> List[str]:
     context)."""
     from . import faults
     return faults.active_sites()
+
+
+def stray_postmortem_bundles() -> List[str]:
+    """Post-mortem bundle files sitting in the *default* (env-less)
+    ``TG_POSTMORTEM_DIR`` — between tests that directory must be empty
+    (a test that expects bundles points the env at its own tmp dir, or
+    its leftovers are swept by ``clean_postmortem_bundles``). The
+    conftest ``_no_blackbox_leak`` fixture's probe."""
+    from ..observability import postmortem as _postmortem
+    return _postmortem.list_bundles(_postmortem.default_dir())
+
+
+def clean_postmortem_bundles() -> List[str]:
+    """Remove (and return) bundles from the default post-mortem dir —
+    trigger events fired by a test are *expected* behavior, but their
+    bundles must not accumulate across the session."""
+    from ..observability import postmortem as _postmortem
+    removed: List[str] = []
+    for path in _postmortem.list_bundles(_postmortem.default_dir()):
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
+
+
+def blackbox_violations() -> List[str]:
+    """The flight recorder must stay bounded and no forced enable/disable
+    override may linger (mirrors ``plan_cache_violations``)."""
+    from ..observability import blackbox as _blackbox
+    out: List[str] = []
+    rec = _blackbox.recorder()
+    snap = rec.snapshot()
+    if snap["events"] > snap["maxEvents"]:
+        out.append(f"flight recorder exceeded its ring bound: "
+                   f"{snap['events']} > {snap['maxEvents']}")
+    if _blackbox._enabled_override is not None:
+        out.append("a forced blackbox enable/disable override is active")
+    return out
 
 
 def plan_cache_violations() -> List[str]:
@@ -156,4 +197,5 @@ def campaign_violations(clean: bool = True,
     if threads:
         out.append(f"worker thread(s) survived: {threads}")
     out.extend(plan_cache_violations())
+    out.extend(blackbox_violations())
     return out

@@ -20,6 +20,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional
 
+from ..observability import blackbox as _blackbox
 from ..observability import metrics as _obs_metrics
 from ..observability import trace as _obs_trace
 from .faults import InjectedFaultError, TransientFaultError
@@ -210,7 +211,14 @@ def _emit_fault_observability(report: FaultReport) -> None:
     # becomes a span event on whatever span is open (a trace shows the
     # quarantine in line with the sweep it interrupted) and a counter
     # keyed by kind (bounded cardinality; the site goes on the event
-    # only). Both are no-ops when observability is off.
+    # only). Both are no-ops when observability is off. The ALWAYS-ON
+    # flight recorder (observability/blackbox.py) gets the same record —
+    # one hook here puts every FaultLog event (retries, quarantines,
+    # breaker degradations, downshifts, stalls, unclean exits, drift
+    # events) into the black box, stamped with the ambient correlation
+    # id when a run owns one.
+    _blackbox.record("fault." + report.kind, site=report.site,
+                     attempts=report.attempts)
     _obs_trace.add_event("fault." + report.kind, site=report.site,
                          attempts=report.attempts)
     _obs_metrics.inc_counter(

@@ -122,3 +122,10 @@ def record_downshift(site: str, fault_log: Optional[Any] = None,
         "tg_oom_downshift_total",
         help="adaptive downshifts after resource exhaustion "
         "(docs/robustness.md)")
+    # trigger event: exhaustion downshifts are recoveries, but the next
+    # one might not be — dump the context while it exists (rate-limited;
+    # observability/postmortem.py)
+    from ..observability import postmortem as _postmortem
+    _postmortem.trigger("oom_downshift", fault_log=fault_log,
+                        detail={"site": site, **{k: v for k, v in
+                                                 detail.items()}})

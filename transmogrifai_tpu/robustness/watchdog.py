@@ -279,3 +279,11 @@ def report_thread_stalled(site: str, thread_name: str, waited_s: float,
         "leak checks (docs/robustness.md)", site=site)
     logger.warning("thread '%s' stalled for %.1fs (site %s)",
                    thread_name, waited_s, site)
+    # trigger event: a wedged thread is exactly the incident the black
+    # box exists for — freeze its context into a post-mortem bundle
+    # (rate-limited; observability/postmortem.py)
+    from ..observability import postmortem as _postmortem
+    _postmortem.trigger(
+        "thread_stalled", fault_log=fault_log,
+        detail={"site": site, "thread": thread_name,
+                "waitedS": round(waited_s, 3), **detail})

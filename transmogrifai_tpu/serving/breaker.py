@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..observability import blackbox as _blackbox
+
 #: state → ``tg_breaker_state`` gauge value (0 is the healthy steady state
 #: so dashboards can alert on anything non-zero)
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
@@ -67,7 +69,15 @@ class CircuitBreaker:
         # lock held by caller
         if state == self._state:
             return
-        self._state = state
+        prev, self._state = self._state, state
+        # every breaker transition lands in the always-on flight recorder
+        # (observability/blackbox.py) — the open→half_open→close dance is
+        # the heart of any serving post-mortem. NOTE: the breaker lock is
+        # held; on_transition callbacks must not call back into snapshot().
+        _blackbox.record("breaker", name=self.name, state=state,
+                         previous=prev,
+                         consecutiveFailures=self._consecutive_failures,
+                         error=self._last_error)
         cb = self.on_transition
         if cb is not None:
             cb(state)

@@ -70,7 +70,9 @@ from ..filters.distribution import (
     FeatureDistribution, Summary, _hash_bin, column_distributions,
     compare_distributions, fold_distribution,
 )
+from ..observability import blackbox as _blackbox
 from ..observability import metrics as _obs_metrics
+from ..observability import postmortem as _postmortem
 from ..observability.trace import add_event as _obs_event
 from ..robustness import faults
 from ..robustness.policy import FaultLog, FaultReport
@@ -458,12 +460,32 @@ class DriftMonitor:
         if worst != prev:
             _obs_event("drift.verdict", model=self.model_name,
                        verdict=worst, previous=prev)
-        if (worst == DEGRADED and prev != DEGRADED
-                and self.on_degraded is not None):
-            try:
-                self.on_degraded(self.report())
-            except Exception as e:
-                self._record_fault("drift.refit", "drift_refit_failed", e)
+            # verdict transitions are flight-recorder events (always on):
+            # the drift story must be readable next to the serve events
+            # it interleaves with (observability/blackbox.py)
+            _blackbox.record("drift.verdict", model=self.model_name,
+                             verdict=worst, previous=prev,
+                             worstFeature=worst_feature,
+                             rows=self._rows)
+        if worst == DEGRADED and prev != DEGRADED:
+            # trigger event: the model's data went bad — freeze the
+            # recorder context + the per-feature comparison while the
+            # offending traffic is still in the ring (rate-limited;
+            # observability/postmortem.py)
+            _postmortem.trigger(
+                "drift_degraded", fault_log=self._fault_log,
+                metrics=self._metrics,
+                detail={"model": self.model_name,
+                        "worstFeature": worst_feature, "rows": self._rows},
+                state={"drift": {"verdict": worst, "previous": prev,
+                                 "features": {n: dict(m) for n, m
+                                              in per_feature.items()}}})
+            if self.on_degraded is not None:
+                try:
+                    self.on_degraded(self.report())
+                except Exception as e:
+                    self._record_fault("drift.refit",
+                                       "drift_refit_failed", e)
         return worst
 
     def _compare(self, name: str, score: FeatureDistribution

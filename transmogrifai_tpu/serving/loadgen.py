@@ -26,6 +26,10 @@ import numpy as np
 from ..local.scoring import SCORE_ERROR_KEY
 from .runtime import DeadlineExceededError, OverloadError, ServingRuntime
 
+#: how many tail outliers the load report names (per-request correlation
+#: ids from the flight recorder; docs/observability.md "Exemplars")
+SLOWEST_K = 5
+
 
 def synthetic_rows(model, n: int, seed: int = 0) -> List[Dict[str, Any]]:
     """``n`` synthetic request rows shaped by the model's raw-feature
@@ -65,6 +69,7 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     t_end = start + seconds
     next_at = start
     futures = []
+    _done_at: Dict[Any, float] = {}
     offered = shed_submit = submit_errors = 0
     i = 0
     while True:
@@ -75,8 +80,19 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         # the process fell behind — open-loop arrivals do not wait)
         while next_at <= now and next_at < t_end:
             try:
-                futures.append(runtime.submit(rows[i % len(rows)],
-                                              deadline_ms=deadline_ms))
+                fut = runtime.submit(rows[i % len(rows)],
+                                     deadline_ms=deadline_ms)
+                # the runtime stamps each accepted request's
+                # flight-recorder correlation id on its future
+                # (observability/blackbox.py) — remember it with the
+                # submit time, and stamp the RESOLVE time from the
+                # future's done callback (drain-side clocks would read
+                # the drain walk, not the request), so the tail report
+                # can NAME its outliers with honest latencies
+                fut.add_done_callback(
+                    lambda f: _done_at.setdefault(f, time.monotonic()))
+                futures.append((fut, getattr(fut, "tg_corr", None),
+                                time.monotonic()))
             except OverloadError:
                 shed_submit += 1
             except Exception:
@@ -92,20 +108,31 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     # one outcome a serving tier may never produce; the campaign engine
     # and BENCH_MODE=campaign assert lost == 0
     completed = quarantined = shed_deadline = failed = lost = 0
+    slowest: List[Dict[str, Any]] = []
     drain_deadline = time.monotonic() + drain_timeout
-    for fut in futures:
+    for fut, corr, submitted_at in futures:
         try:
             rec = fut.result(timeout=max(0.1, drain_deadline
                                          - time.monotonic()))
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
             completed += 1
+            slowest.append({"corr": corr, "ms": round(
+                (_done_at.get(fut, time.monotonic())
+                 - submitted_at) * 1e3, 3)})
         except DeadlineExceededError:
             shed_deadline += 1
         except FuturesTimeoutError:
             lost += 1
         except Exception:
             failed += 1
+    # the slowest-K completed requests BY ID: drain-side wall times are
+    # an upper bound on the serve latency (the drain loop walks futures in
+    # submit order), but the ids are exact — each links to its recorder
+    # timeline (blackbox.slice_for) and to the runtime histogram's
+    # exemplars, so a bench/chaos soak can name its tail outliers
+    slowest.sort(key=lambda d: -d["ms"])
+    del slowest[SLOWEST_K:]
     wall = time.monotonic() - start
     summary = runtime.summary()
     lat = summary.get("latency", {}) or {}
@@ -129,6 +156,10 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         "p50Ms": round(lat.get("p50", float("nan")) * 1e3, 3),
         "p95Ms": round(lat.get("p95", float("nan")) * 1e3, 3),
         "p99Ms": round(lat.get("p99", float("nan")) * 1e3, 3),
+        # the slowest-K completed requests, named by correlation id —
+        # feed one to blackbox.recorder().slice_for() (or `op doctor`)
+        # to replay that request's enqueue→resolve timeline
+        "slowestRequests": slowest,
         "degradedRows": summary.get("degradedRows", 0.0),
         "breaker": summary.get("breaker", {}),
     }

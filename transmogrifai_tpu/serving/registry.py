@@ -36,6 +36,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..observability import blackbox as _blackbox
 from ..observability.trace import add_event as _obs_event
 from ..robustness import faults
 from ..robustness import watchdog as _watchdog
@@ -163,6 +164,8 @@ class ModelRegistry:
         batcher thread — must never block it: the refit runs in its own
         daemon thread, at most one per model."""
         _obs_event("drift.degraded", model=name)
+        _blackbox.record("drift.degraded", model=name,
+                         refitHook=self._refit_hook is not None)
         if self._refit_hook is None:
             return
         with self._refit_lock:
@@ -257,6 +260,7 @@ class ModelRegistry:
             self._runtimes[name] = new_rt
         old.close(drain=True)
         _obs_event("serve.swap", model=name)
+        _blackbox.record("serve.swap", model=name)
         return new_rt
 
     # -- health --------------------------------------------------------------

@@ -70,7 +70,9 @@ from typing import Any, Deque, Dict, List, Optional, Sequence
 from ..local.scoring import (
     SCORE_ERROR_KEY, micro_batch_score_function, score_function,
 )
+from ..observability import blackbox as _blackbox
 from ..observability import metrics as _obs_metrics
+from ..observability import postmortem as _postmortem
 from ..observability.trace import add_event as _obs_event
 from ..observability.trace import span as _obs_span
 from ..robustness import faults, resources
@@ -150,6 +152,11 @@ class _Request:
     future: Future
     enqueued: float
     deadline: Optional[float]  # absolute monotonic, None = no deadline
+    #: flight-recorder correlation id, minted at enqueue and carried
+    #: through flush → dispatch → resolve (None when TG_BLACKBOX=0);
+    #: also exposed on the Future as ``tg_corr`` so callers (loadgen,
+    #: the exemplar reports) can name their requests
+    corr: Optional[str] = None
 
 
 #: live (started, not yet closed) runtimes — the conftest no-leak fixture
@@ -307,6 +314,12 @@ class ServingRuntime:
         now = time.monotonic()
         deadline = now + dl_ms / 1000.0 if dl_ms else None
         fut: Future = Future()
+        # flight-recorder correlation: one id per request, minted here,
+        # resolved in _finish — the black box can replay any request's
+        # enqueue→resolve timeline (observability/blackbox.py)
+        boxed = _blackbox.blackbox_enabled()
+        corr = _blackbox.new_correlation_id() if boxed else None
+        fut.tg_corr = corr
         with self._cond:
             if not self._accepting:
                 raise RuntimeStoppedError(
@@ -314,13 +327,21 @@ class ServingRuntime:
             if len(self._queue) >= self.config.max_queue:
                 self._count("tg_serve_shed_total", reason="overload",
                             help="requests shed (docs/serving.md)")
+                if boxed:
+                    _blackbox.record("serve.shed", corr=corr,
+                                     model=self.name, reason="overload",
+                                     queueDepth=len(self._queue))
                 raise OverloadError(
                     f"serve queue for model '{self.name}' is full "
                     f"({self.config.max_queue} pending); request shed")
-            self._queue.append(_Request(row, fut, now, deadline))
-            self._set_gauge("tg_serve_queue_depth", float(len(self._queue)),
+            self._queue.append(_Request(row, fut, now, deadline, corr))
+            depth = len(self._queue)
+            self._set_gauge("tg_serve_queue_depth", float(depth),
                             help="requests waiting for a flush")
             self._cond.notify()
+        if boxed:
+            _blackbox.record("serve.enqueue", corr=corr, model=self.name,
+                             queueDepth=depth)
         return fut
 
     def score(self, row: Dict[str, Any], timeout: Optional[float] = None,
@@ -394,6 +415,9 @@ class ServingRuntime:
     def _flush(self, batch: List[_Request]) -> None:
         with _obs_span("serve.flush", cat="serve", model=self.name,
                        rows=len(batch)):
+            _blackbox.record("serve.flush", model=self.name,
+                             rows=len(batch),
+                             queueDepth=self.queue_depth())
             alive = self._shed_expired(batch)
             if not alive:
                 return
@@ -417,6 +441,8 @@ class ServingRuntime:
             if r.deadline is not None and now >= r.deadline:
                 self._count("tg_serve_shed_total", reason="deadline",
                             help="requests shed (docs/serving.md)")
+                _blackbox.record("serve.shed", corr=r.corr,
+                                 model=self.name, reason="deadline")
                 self._fail_future(r.future, DeadlineExceededError(
                     f"deadline expired after "
                     f"{(now - r.enqueued) * 1000:.1f}ms in queue "
@@ -455,6 +481,14 @@ class ServingRuntime:
             self._count("tg_oom_downshift_total",
                         help="adaptive downshifts after resource "
                         "exhaustion (docs/robustness.md)")
+            # trigger event: freeze the flight-recorder context for the
+            # exhaustion (rate-limited; observability/postmortem.py)
+            _postmortem.trigger(
+                "oom_downshift", fault_log=self.fault_log,
+                metrics=self.metrics,
+                detail={"site": "oom.serve", "model": self.name,
+                        "rows": len(rows),
+                        "error": f"{type(e).__name__}: {e}"[:200]})
             return (self._score_adaptive(rows[:mid])
                     + self._score_adaptive(rows[mid:]))
 
@@ -464,6 +498,8 @@ class ServingRuntime:
             try:
                 with _obs_span("serve.dispatch", cat="serve",
                                model=self.name, rows=len(rows)):
+                    _blackbox.record("serve.dispatch", model=self.name,
+                                     rows=len(rows))
                     # chaos: a fault here models the compiled micro-batch
                     # path failing (wedged XLA dispatch, poisoned plan)
                     faults.inject("serve.dispatch", key=self.name)
@@ -514,15 +550,26 @@ class ServingRuntime:
         # ahead of the batcher's counter writes (latencies use one `now`,
         # so the ordering changes no measured value)
         now = time.monotonic()
+        boxed = _blackbox.blackbox_enabled()
         quarantined = 0
         for r, rec in zip(reqs, recs):
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
             if r.future.cancelled():
                 continue
-            self._observe("tg_serve_request_seconds", now - r.enqueued,
+            seconds = now - r.enqueued
+            # the request's latency histogram keeps the correlation ids
+            # of its slowest observations as exemplars — a p99 outlier
+            # links straight to its recorder timeline
+            self._observe("tg_serve_request_seconds", seconds,
                           help="enqueue-to-result latency per request "
-                          "(p50/p95/p99; docs/serving.md)")
+                          "(p50/p95/p99; docs/serving.md)",
+                          exemplar=r.corr)
+            if boxed:
+                _blackbox.record("serve.resolve", corr=r.corr,
+                                 model=self.name,
+                                 seconds=round(seconds, 6),
+                                 degraded=degraded)
         n = len(reqs)
         self._count("tg_serve_rows_total", float(n),
                     help="requests scored by the serving runtime")
@@ -585,14 +632,27 @@ class ServingRuntime:
                         help="per-model circuit breaker state "
                         "(0=closed, 1=half_open, 2=open; docs/serving.md)")
         _obs_event("serve.breaker", model=self.name, state=state)
+        if state == OPEN:
+            # trigger event: the breaker opening is the canonical serving
+            # incident — dump the post-mortem while the recorder still
+            # holds the dispatches that opened it. NOTE: this runs under
+            # the breaker's lock (on_transition contract), so the detail
+            # must not call back into breaker.snapshot().
+            _postmortem.trigger(
+                "breaker_open", fault_log=self.fault_log,
+                metrics=self.metrics,
+                detail={"model": self.name, "state": state,
+                        "queueDepth": self.queue_depth()})
 
     def _count(self, name: str, n: float = 1.0, help: str = "",
                **labels: str) -> None:
         self.metrics.counter(name, help, model=self.name, **labels).inc(n)
         _obs_metrics.inc_counter(name, n, help, model=self.name, **labels)
 
-    def _observe(self, name: str, v: float, help: str = "") -> None:
-        self.metrics.histogram(name, help, model=self.name).observe(v)
+    def _observe(self, name: str, v: float, help: str = "",
+                 exemplar: Any = None) -> None:
+        self.metrics.histogram(name, help, model=self.name).observe(
+            v, exemplar=exemplar)
         _obs_metrics.observe(name, v, help, model=self.name)
 
     def _set_gauge(self, name: str, v: float, help: str = "") -> None:

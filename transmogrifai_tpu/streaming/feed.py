@@ -45,6 +45,7 @@ from typing import Any, Iterable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..manifest import sentinel_phase as _sentinel_phase
+from ..observability import blackbox as _blackbox
 from ..observability import metrics as _obs_metrics
 from ..robustness import faults
 from ..robustness import watchdog as _watchdog
@@ -172,6 +173,11 @@ class DeviceFeed:
         self.closed = False
         self._stall_error: Optional[BaseException] = None
         self._t0 = time.perf_counter()
+        # flight-recorder correlation: captured HERE on the constructing
+        # (consumer/train) thread — contextvars do not cross into the
+        # producer thread, so the producer stamps its upload events with
+        # the owning run's id explicitly (observability/blackbox.py)
+        self._corr = _blackbox.current_correlation()
         # hang watchdog: the producer beats this heart per loop iteration;
         # a wedge (dead reader, hung upload) stops the beats → the feed
         # aborts with a typed error instead of hanging the consumer
@@ -239,6 +245,8 @@ class DeviceFeed:
                     self.stats.peak_resident_chunks = max(
                         self.stats.peak_resident_chunks,
                         self._resident_chunks)
+                _blackbox.record("stream.upload", corr=self._corr,
+                                 chunk=chunk.index, bytes=nbytes)
                 self._put((Chunk(chunk.index, chunk.chunk_id, table), nbytes))
         except BaseException as e:  # noqa: BLE001 — preemption must forward
             self._put((self._SENTINEL, e))

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability import blackbox as _blackbox
 from ..observability.trace import span as _obs_span
 from ..robustness import faults, resources
 from ..robustness.policy import FaultLog, FaultReport
@@ -114,6 +115,12 @@ class StreamRun:
         every = self.checkpoint.every if self.checkpoint is not None else 0
         while True:
             folded = start
+            # flight-recorder: pass boundaries carry the run's ambient
+            # correlation id (workflow.train), so a post-mortem slice for
+            # one run shows which pass/chunk it died in
+            _blackbox.record("stream.pass", uid=self.stage_uid,
+                             passId=pass_id, fromChunk=start,
+                             chunkRows=src.chunk_rows)
             try:
                 with _obs_span("stream.pass", cat="train",
                                uid=self.stage_uid, passId=pass_id,
@@ -147,6 +154,8 @@ class StreamRun:
                                    PASS_COMPLETE,
                                    fingerprint=src.fingerprint(),
                                    chunk_rows=src.chunk_rows)
+        _blackbox.record("stream.pass_done", uid=self.stage_uid,
+                         passId=pass_id, chunks=folded)
         return state
 
     def _restore(self, key: str, pass_id: str, fold: MonoidFold, src):

@@ -39,13 +39,21 @@ def _open_run_sentinel(ckpt_dir: Optional[str], resume: bool):
     if resume:
         stale = sentinel.read_stale()
         if stale is not None:
+            detail = {"pid": stale.get("pid"),
+                      "phase": stale.get("phase"),
+                      "dir": ckpt_dir,
+                      "oomKillSuspected":
+                          RunSentinel.suspects_oom_kill(stale)}
             FaultLog.record(FaultReport(
                 site="manifest.sentinel", kind="unclean_exit",
-                detail={"pid": stale.get("pid"),
-                        "phase": stale.get("phase"),
-                        "dir": ckpt_dir,
-                        "oomKillSuspected":
-                            RunSentinel.suspects_oom_kill(stale)}))
+                detail=dict(detail)))
+            # trigger event: the previous owner of this checkpoint dir
+            # died mid-run — dump what this process knows (the sentinel's
+            # last phase is the dying breath; the resume that follows is
+            # the recovery) before training over the evidence
+            # (observability/postmortem.py)
+            from .observability import postmortem as _postmortem
+            _postmortem.trigger("unclean_exit", detail=detail)
     sentinel.start("dag_fit")
     return sentinel
 
@@ -257,17 +265,29 @@ class OpWorkflow(_WorkflowCore):
         all unchanged); ``summary()["streaming"]`` carries the feed
         accounting (chunks, uploaded bytes, peak device residency,
         overlap)."""
+        from .observability import blackbox as _blackbox
         from .observability.trace import span as _obs_span
         from .robustness.policy import FaultLog
         fault_log = FaultLog()
-        with fault_log.activate(), \
+        # one flight-recorder correlation id per run: every black-box
+        # event recorded inside this train (stream passes, sweep
+        # dispatches, fault recoveries) is stamped with it, so a
+        # recorder slice replays this run's full timeline
+        # (observability/blackbox.py)
+        corr = (_blackbox.new_correlation_id("run")
+                if _blackbox.blackbox_enabled() else None)
+        with fault_log.activate(), _blackbox.correlated(corr), \
                 _obs_span("workflow.train", cat="train", resume=resume,
                           stream=stream is not None):
+            _blackbox.record("workflow.train", resume=resume,
+                             stream=stream is not None)
             if stream is not None:
                 model = self._train_streaming(stream, resume=resume)
             else:
                 model = self._train_logged(resume=resume)
+            _blackbox.record("workflow.train_done")
         model._fault_log = fault_log
+        model._correlation = corr
         return model
 
     def _train_streaming(self, source, resume: bool = False) -> "OpWorkflowModel":
