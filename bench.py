@@ -105,6 +105,32 @@ def _models(mode, registry):
             (registry["OpLinearSVC"], svc)]
 
 
+def _ledger_mark():
+    from transmogrifai_tpu.observability import ledger as obs_ledger
+    return obs_ledger.ledger().mark()
+
+
+def _ledger_phases(mark=0):
+    """The uniform compile & memory block every BENCH_MODE line carries
+    (docs/observability.md "Compile & memory ledger"): program builds
+    since ``mark`` by classified cause, plus the peak shape-predicted and
+    measured device bytes — so every bench number names what it compiled
+    and what it would have allocated."""
+    from transmogrifai_tpu.observability import devicemem as obs_devicemem
+    from transmogrifai_tpu.observability import ledger as obs_ledger
+    led = obs_ledger.ledger()
+    causes = {}
+    for r in led.since(mark):
+        causes[r.cause] = causes.get(r.cause, 0) + 1
+    peaks = obs_devicemem.observatory().peaks()
+    return {
+        "compiles": causes,
+        "compilesTotal": max(0, led.total - mark),
+        "peakPredictedBytes": peaks["predicted"],
+        "peakMeasuredBytes": peaks["measured"],
+    }
+
+
 def _sweep_transfer_sum():
     """Total seconds the sweeps spent fetching metrics device→host so far
     (validators observe tg_sweep_transfer_seconds per resolve)."""
@@ -140,6 +166,7 @@ def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
     # warmup paid; persistent-cache hit/miss counts tag whether that
     # compile was served from disk (TPU/GPU only — zero on CPU)
     obs_metrics.enable_metrics(True)
+    lmark = _ledger_mark()
     try:
         cs0 = cache_stats()
         t0 = time.perf_counter()
@@ -173,6 +200,7 @@ def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
             "transferSecs": round(transfer, 4),
             "cacheHits": cs1["hits"] - cs0["hits"],
             "cacheMisses": cs1["misses"] - cs0["misses"],
+            **_ledger_phases(lmark),
         },
     }), flush=True)
 
@@ -236,6 +264,7 @@ def _run_transform_ab(n, d, platform, reps):
         for arm in ("eager", "planned"):
             plan_mod.clear_plan_cache()
             plan_mod.enable_planning(arm == "planned")
+            lmark = _ledger_mark()
             try:
                 t0 = time.perf_counter()
                 model.score(table=score_table)   # compile warmup
@@ -264,6 +293,7 @@ def _run_transform_ab(n, d, platform, reps):
                     "compileSecs": round(max(0.0, cold - dt), 3),
                     "executeSecs": round(max(0.0, dt - transfer), 4),
                     "transferSecs": round(transfer, 4),
+                    **_ledger_phases(lmark),
                 },
             }), flush=True)
     finally:
@@ -339,6 +369,35 @@ def _run_serve(platform):
         cal = run_open_loop(rt, rows, min(1.5, seconds), capacity)
     runtime_capacity = max(cal["rowsPerSec"], 1.0)
 
+    # warm-serve tripwire (PR 6's zero-retrace claim, ledger-enforced):
+    # save → registry.load pre-trace → a real request must record ZERO
+    # compiles; a violation prints each build with its classified cause
+    # before failing the bench (docs/observability.md)
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from transmogrifai_tpu import plan as _plan_mod
+    from transmogrifai_tpu.observability import ledger as _obs_ledger
+    from transmogrifai_tpu.serving import ModelRegistry
+    wdir = _tempfile.mkdtemp(prefix="tg_bench_warm_model_")
+    try:
+        model.save(wdir)
+        _plan_mod.clear_plan_cache()
+        with ModelRegistry(cfg) as reg:
+            reg.load("warmgate", wdir)
+            wmark = _obs_ledger.ledger().mark()
+            reg.score("warmgate", rows[0], timeout=30)
+            retraced = _obs_ledger.ledger().since(wmark)
+            for r in retraced:
+                print(json.dumps({"warmServeViolation": r.to_json()}),
+                      flush=True)
+            assert not retraced, (
+                f"warm serve path retraced {len(retraced)} program(s) "
+                f"after registry.load pre-trace — causes: "
+                f"{[r.cause for r in retraced]}")
+    finally:
+        _shutil.rmtree(wdir, ignore_errors=True)
+
     deadline_ms = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", 250.0))
     # clean fraction 0.35: the saturated calibration number rides full-256
     # batches; at partial fill every flush still pays the full padded
@@ -362,12 +421,18 @@ def _run_serve(platform):
     # post-mortem bundle — asserted; docs/benchmarks.md round 11)
     clean_rows_per_sec = None
     lines = {}
-    for arm in ("noblackbox", "clean", "drift", "chaos2x"):
+    for arm in ("noblackbox", "noledger", "clean", "drift", "chaos2x"):
         faulted = arm == "chaos2x"
         rps = runtime_capacity * (2.0 if faulted else clean_frac)
         monitor = None
+        amark = _obs_ledger.ledger().mark()
         if arm == "noblackbox":
             _blackbox.enable_blackbox(False)
+        if arm == "noledger":
+            # TG_LEDGER=0 reference arm: the clean line below must stay
+            # within 2% of this (completion-ratio normalized — the same
+            # gate shape as the round-11 recorder arm)
+            _obs_ledger.enable_ledger(False)
         if arm == "drift":
             from transmogrifai_tpu.serving.drift import (
                 DriftBaseline, DriftMonitor)
@@ -395,6 +460,8 @@ def _run_serve(platform):
             faults.clear()
             if arm == "noblackbox":
                 _blackbox.enable_blackbox(None)
+            if arm == "noledger":
+                _obs_ledger.enable_ledger(None)
         lines[arm] = rep
         suffix = "" if arm == "clean" else f"_{arm}"
         phases = {
@@ -411,6 +478,7 @@ def _run_serve(platform):
             "quarantined": rep["quarantined"],
             "breakerOpens": summary["breaker"]["opens"],
             "breakerState": summary["breaker"]["state"],
+            **_ledger_phases(amark),
         }
         if arm == "clean":
             clean_rows_per_sec = rep["rowsPerSec"]
@@ -428,6 +496,16 @@ def _run_serve(platform):
                 f"flight-recorder overhead {overhead:.1%} exceeds the "
                 f"2% budget (clean {rep['completed']}/{rep['offered']} "
                 f"vs off {off['completed']}/{off['offered']})")
+            # the ≤2% compile-ledger gate: same load as the TG_LEDGER=0
+            # arm, same completion-ratio normalization
+            offl = lines["noledger"]
+            offl_ratio = offl["completed"] / max(offl["offered"], 1)
+            l_overhead = 1.0 - ratio / max(offl_ratio, 1e-9)
+            phases["ledgerOverheadVsOff"] = round(l_overhead, 4)
+            assert ratio >= 0.98 * offl_ratio, (
+                f"compile-ledger overhead {l_overhead:.1%} exceeds the "
+                f"2% budget (clean {rep['completed']}/{rep['offered']} "
+                f"vs TG_LEDGER=0 {offl['completed']}/{offl['offered']})")
         elif arm == "drift":
             # the ≤5% monitor-overhead acceptance gate: same offered
             # load as the clean line, every batch folded + verdicts on
@@ -497,6 +575,7 @@ def _run_stream(platform):
                          n_bins=32, learning_rate=1.0)
             .set_input(label, checked).get_output())
     wf = OpWorkflow().set_result_features(pred)
+    smark = _ledger_mark()
     t0 = time.perf_counter()
     model = wf.train(stream=source)
     wall = time.perf_counter() - t0
@@ -520,6 +599,7 @@ def _run_stream(platform):
             "chunks": stats["chunks"],
             "chunkRows": chunk_rows,
             "uploadBytes": stats["uploadBytes"],
+            **_ledger_phases(smark),
             "maxChunkBytes": stats["maxChunkBytes"],
             "peakDeviceBytes": stats["peakDeviceBytes"],
             "peakResidentChunks": stats["peakResidentChunks"],
@@ -604,6 +684,7 @@ def _run_pressure(platform):
     prev_wd = os.environ.get("TG_WATCHDOG_S")
     serve_lines = {}
     for arm in ("watchdog_off", "clean", "oom"):
+        amark = _ledger_mark()
         if arm == "watchdog_off":
             os.environ["TG_WATCHDOG_S"] = "0"
         elif prev_wd is None:
@@ -633,6 +714,7 @@ def _run_pressure(platform):
             "oomDownshifts": summary["faults"]["oomDownshifts"],
             "threadStalls": summary["faults"]["threadStalls"],
             "breakerOpens": summary["breaker"]["opens"],
+            **_ledger_phases(amark),
         }
         if arm == "clean":
             # normalize by the offered rate: the open-loop generator's
@@ -700,6 +782,7 @@ def _run_pressure(platform):
     overhead = 1.0 - walls["watchdog_off"] / max(walls["clean"], 1e-9)
     assert walls["clean"] <= 1.02 * walls["watchdog_off"], (
         f"stream watchdog overhead {overhead:.1%} exceeds the 2% budget")
+    pstream_mark = _ledger_mark()
     with faults.injected({"oom.stream": {"mode": "oom", "nth": 2}}):
         oom_wall, oom_model = stream_train()
     downshifts = oom_model.summary()["faults"]["oomDownshifts"]
@@ -712,11 +795,14 @@ def _run_pressure(platform):
             "value": round(n / wall, 1),
             "unit": "rows/sec",
             "vs_baseline": round(walls["watchdog_off"] / wall, 3),
+            # the oom line's ledger block shows the downshifted pass as a
+            # bucket-change rebuild (chunk-budget halving re-chunks it)
             "phases": ({"wallSecs": round(wall, 3)} if arm != "oom" else
                        {"wallSecs": round(wall, 3),
                         "oomDownshifts": len(downshifts),
                         "downshiftChunkRows": downshifts[0]["detail"]
-                        .get("chunkRows")}),
+                        .get("chunkRows"),
+                        **_ledger_phases(pstream_mark)}),
         }), flush=True)
     if prev_wd is None:
         os.environ.pop("TG_WATCHDOG_S", None)
@@ -740,6 +826,7 @@ def _run_campaign(platform):
     n = int(os.environ.get("BENCH_CAMPAIGN_SCHEDULES", 200))
     seed = int(os.environ.get("BENCH_CAMPAIGN_SEED", 0))
     eng = ChaosCampaign(seed=seed)
+    cmark = _ledger_mark()
     try:
         t0 = time.perf_counter()
         report = eng.run(count=n)
@@ -781,6 +868,7 @@ def _run_campaign(platform):
             "outcomes": outcomes,
             "firedTotal": sum(doc["firedBySite"].values()),
             "accounting": acct,
+            **_ledger_phases(cmark),
         },
     }), flush=True)
 
@@ -864,12 +952,17 @@ for _ in range(3):
     ts.append(time.perf_counter() - t0)
 transfer = (transfer_sum() - tr0) / 3
 tbytes = (counter_sum("tg_transfer_bytes_total") - b0) / 3
+from transmogrifai_tpu.observability import devicemem as obs_devicemem
+from transmogrifai_tpu.observability import ledger as obs_ledger
 print(json.dumps({"fits_per_sec": round(fits / min(ts), 2),
                   "single_fits_per_sec": round(single_fps, 2),
                   "compile_secs": round(max(0.0, cold - min(ts)), 3),
                   "execute_secs": round(max(0.0, min(ts) - transfer), 3),
                   "transfer_secs": round(transfer, 4),
                   "transfer_bytes": int(tbytes),
+                  "compiles": obs_ledger.ledger().counts_by_cause(),
+                  "peak_predicted_bytes":
+                      obs_devicemem.observatory().peaks()["predicted"],
                   "downgrades": int(counter_sum("tg_mesh_downgrade_total"))}))
 """ % os.path.dirname(os.path.abspath(__file__))
     for forced in (False, True):
@@ -909,6 +1002,10 @@ print(json.dumps({"fits_per_sec": round(fits / min(ts), 2),
                 "transferSecs": doc.get("transfer_secs"),
                 "transferBytes": doc.get("transfer_bytes"),
                 "meshDowngrades": doc.get("downgrades"),
+                # from the subprocess's own ledger/observatory (this
+                # process is platform-bound and runs no mesh programs)
+                "compiles": doc.get("compiles"),
+                "peakPredictedBytes": doc.get("peak_predicted_bytes"),
             },
         }), flush=True)
 

@@ -98,6 +98,37 @@ def _no_blackbox_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_ledger_leak():
+    """The compile ledger and device-memory observatory are process-global
+    (one ledger per process, like the flight recorder) and record on every
+    program build — that is the feature, not a leak. What must not bleed
+    between tests: ledger records and per-identity classification memory
+    (cross-test cause assertions would become order-dependent — a plan
+    built by an earlier test would turn this test's cold build into a
+    spurious cache-eviction), a forced TG_LEDGER override, observatory
+    peaks, and cost-table rows (a stray row would leak into the next
+    test's saved MANIFEST `costs` section). Module-scoped fixtures may
+    build programs during setup, so the ledger is cleared (not asserted
+    empty) on entry; the bound/override oracle runs both ways
+    (robustness/oracles.py ``ledger_violations``)."""
+    from transmogrifai_tpu.observability import devicemem as _dm
+    from transmogrifai_tpu.observability import ledger as _lg
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.ledger_violations(), (
+        f"compile-ledger state leaked into this test: "
+        f"{oracles.ledger_violations()}")
+    _lg.ledger().clear()
+    _dm.observatory().clear()
+    yield
+    violations = oracles.ledger_violations()
+    _lg.reset()
+    _dm.reset()
+    assert not violations, (
+        f"a test leaked compile-ledger state: {violations}")
+
+
+@pytest.fixture(autouse=True)
 def _no_plan_cache_leak():
     """Compiled transform plans pin jitted executables (and the stage
     objects they closed over), so the LRU must be provably bounded and must

@@ -564,8 +564,10 @@ def run_doctor(bundle: str, as_json: bool = False,
     """``op doctor <bundle>`` (docs/observability.md "Flight recorder &
     post-mortems"): render a post-mortem bundle into a human-readable
     incident report — trigger, environment, the trigger correlation id's
-    full timeline, the recent ring tail, top metrics, and the FaultLog
-    buckets. ``bundle`` may be a bundle file or a directory (the newest
+    full timeline, the recent ring tail, top metrics, the compiles &
+    memory block (cause-classified program builds + predicted/measured
+    device-byte peaks, schema v2), and the FaultLog buckets. ``bundle``
+    may be a bundle file or a directory (the newest
     bundle inside is used). Exits non-zero when the bundle fails schema
     validation."""
     import json as _json
@@ -641,6 +643,31 @@ def run_doctor(bundle: str, as_json: bool = False,
         print("-- top metrics --")
         for _rank, name, key, desc in flat[:12]:
             print(f"   {name}{{{key}}}: {desc}")
+    # compiles & memory (bundle schema v2; docs/observability.md
+    # "Compile & memory ledger") — which requests/runs paid a program
+    # build, why, and what the device allocations looked like
+    led = doc.get("ledger") or {}
+    mem = doc.get("deviceMemory") or {}
+    if led or mem:
+        print(f"-- compiles & memory ({led.get('builds', 0)} builds) --")
+        for sub, causes in sorted((led.get("counts") or {}).items()):
+            body = " ".join(f"{c}={n}" for c, n in sorted(causes.items()))
+            print(f"   compiles[{sub}]: {body}")
+        for rec in (led.get("tail") or [])[-8:]:
+            corr = f" [{rec['corr']}]" if rec.get("corr") else ""
+            diff = rec.get("diff") or []
+            why = f"  ({'; '.join(diff)})" if diff else ""
+            print(f"   {rec.get('subsystem', '?'):<7} "
+                  f"{rec.get('cause', '?'):<16}{corr}  "
+                  f"{rec.get('identity', '?')} "
+                  f"{rec.get('seconds', 0.0):.3f}s{why}"[:200])
+        for sub, s in sorted((mem.get("subsystems") or {}).items()):
+            meas = s.get("measuredPeakBytes")
+            measured = (f"measuredPeak={meas}B" if meas is not None
+                        else "measured n/a")
+            print(f"   mem[{sub}]: dispatches={s.get('dispatches')} "
+                  f"predictedPeak={s.get('predictedPeakBytes')}B "
+                  f"{measured}")
     faults_doc = doc.get("faults") or {}
     buckets = {k: len(v) for k, v in faults_doc.items()
                if isinstance(v, list) and v}

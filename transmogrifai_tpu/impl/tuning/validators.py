@@ -26,6 +26,8 @@ import numpy as np
 
 from ...models.api import FittedParams, ModelFamily
 from ...observability import blackbox as _blackbox
+from ...observability import devicemem as _devicemem
+from ...observability import ledger as _obs_ledger
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
 from ...observability.trace import span as _obs_span, tracing_enabled
@@ -145,6 +147,15 @@ _FUSED_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
 _FUSED_CACHE_MAX = int(os.environ.get("TG_FUSED_CACHE_MAX", "32"))
 
 
+def _arg_nbytes(a) -> int:
+    """Device bytes of one dispatch argument (shape × itemsize)."""
+    try:
+        itemsize = int(np.dtype(getattr(a, "dtype", np.float32)).itemsize)
+    except TypeError:
+        itemsize = 4
+    return int(np.prod(np.shape(a))) * itemsize
+
+
 def _fused_cache_get(key):
     prog = _FUSED_CACHE.get(key)
     if prog is not None:
@@ -156,7 +167,10 @@ def _fused_cache_put(key, prog) -> None:
     _FUSED_CACHE[key] = prog
     _FUSED_CACHE.move_to_end(key)
     while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
-        _FUSED_CACHE.popitem(last=False)
+        evicted_key, _ = _FUSED_CACHE.popitem(last=False)
+        # the compile ledger classifies the eventual rebuild of this key
+        # as cache-eviction instead of an unexplained cold build
+        _obs_ledger.record_eviction(_obs_ledger.cache_key_hash(evicted_key))
 
 
 def clear_mesh_programs() -> None:
@@ -610,13 +624,41 @@ class OpValidator:
                    X.ndim)
             entry = _fused_cache_get(key)
             if entry is None:
+                import time as _time
                 garr_np = {k: np.asarray(v)
                            for k, v in family.grid_to_arrays(grid).items()}
+                t0_build = _time.perf_counter()
                 entry = _make_fused_program(
                     family, garr_np, G, F, problem, metric_name,
                     num_classes, self.exact_sweep_fits, sliced_f,
                     binned_f, mesh=mesh, x_ndim=X.ndim)
                 _fused_cache_put(key, entry)
+                # compile ledger: one fused program per family branch —
+                # the fingerprint carries every traced dimension, so a
+                # near-miss rebuild names exactly which one changed
+                # (docs/observability.md "Compile & memory ledger")
+                import hashlib as _hl
+                _obs_ledger.record_build(
+                    "sweep",
+                    identity=(f"sweep/{family.name}"
+                              + ("/mesh" if mesh is not None else "")),
+                    key=_obs_ledger.cache_key_hash(key),
+                    fingerprint={
+                        "F": int(F), "G": int(G), "problem": problem,
+                        "metric": metric_name,
+                        "numClasses": int(num_classes),
+                        "exact": bool(self.exact_sweep_fits),
+                        "sliced": bool(sliced_f), "binned": binned_f,
+                        "xNdim": int(X.ndim),
+                        "mesh": mesh is not None,
+                        "grid": _hl.sha256(
+                            repr([sorted(g.items()) for g in grid])
+                            .encode()).hexdigest()[:12],
+                    },
+                    bucket=int(X.shape[0]),
+                    donation=entry[1],
+                    seconds=_time.perf_counter() - t0_build,
+                    configs=G, folds=F)
             prog, grid_keys = entry
             args = [X, y, ids_d]
             if sliced_f:
@@ -644,6 +686,13 @@ class OpValidator:
                 args.append(retrying_device_put(
                     jnp.asarray(gb), NamedSharding(mesh, P(None, "model")),
                     site="sweep.grid_upload"))
+            # device-memory observatory: argument bytes plus the (F·G, n)
+            # fold-weight tensor the trace builds on device — the branch's
+            # dominant allocations, predicted before dispatch
+            predicted = (sum(_arg_nbytes(a) for a in args)
+                         + F * G * int(X.shape[0]) * 4)
+            _devicemem.record_dispatch("sweep", predicted,
+                                       bucket=int(X.shape[0]))
             # defer host materialization: every family's full program queues
             # on the device back-to-back, then ONE sync reads all metrics
             # (a per-family sync costs a link round-trip each)
@@ -654,6 +703,7 @@ class OpValidator:
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
                 m = prog(*args)
+            _devicemem.sample_measured("sweep")
             return (family.name, list(grid), m, F * G, G)
 
         # per-candidate quarantine at family granularity: a family's whole

@@ -124,6 +124,13 @@ class CheckpointManifest:
         #: hands its DriftMonitor at load (serving/drift.py; absent on
         #: pre-drift manifests — loaders must tolerate that)
         self.drift: Dict[str, Any] = {}
+        #: optional measured dispatch cost table: (segment fingerprint ×
+        #: padding bucket) → {bytes, compileSeconds, executeSeconds},
+        #: written at save/warmup time (observability/devicemem.py) —
+        #: the artifact pre-flight admission control and the AOT compile
+        #: store consume (ROADMAP items 1/2). Absent or corrupt sections
+        #: load as {} — costs are advisory, never load-blocking.
+        self.costs: Dict[str, Any] = {}
 
     @property
     def path(self) -> str:
@@ -157,6 +164,10 @@ class CheckpointManifest:
         m.serving = dict(doc.get("serving", {}))
         m.streams = dict(doc.get("streams", {}))
         m.drift = dict(doc.get("drift", {}))
+        # advisory section: tolerate a corrupt/foreign costs value (a
+        # garbled cost table must never block loading a good model)
+        costs = doc.get("costs", {})
+        m.costs = dict(costs) if isinstance(costs, dict) else {}
         return m, None
 
     def save(self) -> None:
@@ -174,6 +185,8 @@ class CheckpointManifest:
             doc["streams"] = self.streams
         if self.drift:
             doc["drift"] = self.drift
+        if self.costs:
+            doc["costs"] = self.costs
         atomic_write_json(self.path, doc, indent=1)
 
     # -- recording -----------------------------------------------------------

@@ -24,11 +24,17 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import blackbox, export, metrics, postmortem, trace  # noqa: F401
+from . import blackbox, devicemem, export, ledger, metrics  # noqa: F401
+from . import postmortem, trace  # noqa: F401
 from .blackbox import (  # noqa: F401
     FlightRecorder, blackbox_enabled, correlated, current_correlation,
     enable_blackbox, new_correlation_id, recorder,
 )
+from .ledger import (  # noqa: F401
+    CompileLedger, enable_ledger, fingerprint_diff, ledger_enabled,
+    subsystem_scope,
+)
+from .ledger import ledger as compile_ledger  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry, enable_metrics, inc_counter, metrics_enabled, observe,
     registry, set_gauge,
@@ -46,6 +52,8 @@ def reset() -> None:
     metrics.reset()
     blackbox.reset()
     postmortem.reset()
+    ledger.reset()
+    devicemem.reset()
 
 
 def summarize(tr: Optional[trace.Tracer] = None,
@@ -129,4 +137,8 @@ def summarize(tr: Optional[trace.Tracer] = None,
         "serving": serving,
         "compileCache": cache_stats(),
         "planCache": plan_cache_stats(),
+        # cause-classified program builds + predicted/measured device
+        # bytes (docs/observability.md "Compile & memory ledger")
+        "compileLedger": ledger.ledger().snapshot(),
+        "deviceMemory": devicemem.observatory().snapshot(),
     }

@@ -13,11 +13,12 @@ torn bundle) written to ``TG_POSTMORTEM_DIR`` and rate-limited to
 land in the ring as ``postmortem.suppressed`` events — a storm of
 triggers cannot turn the incident into a disk-filling incident).
 
-Bundle schema (``schemaVersion`` 1; validated by :func:`validate_bundle`
-and rendered by ``cli.py doctor``)::
+Bundle schema (``schemaVersion`` 2; validated by :func:`validate_bundle`
+— which still accepts version-1 bundles from pre-ledger processes — and
+rendered by ``cli.py doctor``)::
 
     {
-      "schemaVersion": 1,
+      "schemaVersion": 2,
       "trigger":     {"kind", "tsNs", "unixTime", "corr", "detail"},
       "pid":         <int>,
       "recorder":    {"events": [...], "dropped", "maxEvents",
@@ -27,6 +28,9 @@ and rendered by ``cli.py doctor``)::
       "globalMetrics": {...}, // process registry snapshot (TG_METRICS)
       "faults":      {...},   // FaultLog.to_json() when a log was given
       "state":       {...},   // trigger-site state (breaker, drift, ...)
+      "ledger":      {"counts", "tail"},  // compile-ledger tail (v2;
+                                          // observability/ledger.py)
+      "deviceMemory": {...},  // devicemem observatory snapshot (v2)
       "environment": {"jax", "jaxlib", "backend", "devices", "python"}
     }
 
@@ -48,7 +52,13 @@ from typing import Any, Dict, List, Optional
 
 from . import blackbox as _blackbox
 
-SCHEMA_VERSION = 1
+#: current bundle schema. v2 (PR 12) added the compile-ledger tail and
+#: the device-memory snapshot; v1 bundles (no such sections) must stay
+#: readable — validate_bundle accepts every SUPPORTED_SCHEMA_VERSIONS
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMA_VERSIONS = (1, 2)
+#: how many ledger records a bundle carries (most recent builds)
+LEDGER_TAIL = 32
 
 #: where bundles land; default is a per-process tempdir subdirectory so
 #: concurrent processes (and test sessions) never interleave bundles
@@ -204,6 +214,19 @@ def trigger(kind: str, corr: Optional[str] = None,
             doc["faults"] = fault_log.to_json()
         if state:
             doc["state"] = dict(state)
+        # compiles & memory (schema v2): the recent build tail with
+        # classified causes, and the predicted/measured byte peaks — the
+        # "was a retrace storm / allocation spike part of this incident?"
+        # context (observability/ledger.py, observability/devicemem.py)
+        from . import devicemem as _devicemem
+        from . import ledger as _ledger
+        led = _ledger.ledger()
+        doc["ledger"] = {
+            "counts": led.counts(),
+            "builds": led.total,
+            "tail": [r.to_json() for r in led.tail(LEDGER_TAIL)],
+        }
+        doc["deviceMemory"] = _devicemem.observatory().snapshot()
     except Exception as e:  # context gathering must not kill the dump
         doc["contextError"] = f"{type(e).__name__}: {e}"[:300]
     path = os.path.join(postmortem_dir(),
@@ -241,9 +264,10 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
     gate every trigger-class test and the serve bench run bundles
     through."""
     problems: List[str] = []
-    if doc.get("schemaVersion") != SCHEMA_VERSION:
+    version = doc.get("schemaVersion")
+    if version not in SUPPORTED_SCHEMA_VERSIONS:
         problems.append(
-            f"schemaVersion {doc.get('schemaVersion')!r} != {SCHEMA_VERSION}")
+            f"schemaVersion {version!r} not in {SUPPORTED_SCHEMA_VERSIONS}")
     trig = doc.get("trigger")
     if not isinstance(trig, dict):
         problems.append("missing trigger section")
@@ -273,4 +297,12 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
         problems.append("missing environment section")
     if not isinstance(doc.get("pid"), int):
         problems.append("missing pid")
+    if version == 2:
+        # v2-only sections; v1 bundles predate the ledger and stay valid
+        led = doc.get("ledger")
+        if not isinstance(led, dict) or not isinstance(
+                led.get("tail"), list):
+            problems.append("missing ledger section (schema v2)")
+        if not isinstance(doc.get("deviceMemory"), dict):
+            problems.append("missing deviceMemory section (schema v2)")
     return problems

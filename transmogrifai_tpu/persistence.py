@@ -374,6 +374,18 @@ def save_model(model, path: str) -> None:
         manifest.drift = manifest_drift_entry(model)
     except Exception:
         pass
+    # dispatch cost table: the training process's measured (segment
+    # fingerprint × padding bucket) → {bytes, compileSeconds,
+    # executeSeconds} rows (observability/devicemem.py) — what pre-flight
+    # admission control and the AOT store read at load. Advisory like the
+    # two entries above: never fails a save.
+    try:
+        from .observability import devicemem as _devicemem
+        costs = _devicemem.costs_manifest_entry()
+        if costs.get("table"):
+            manifest.costs = costs
+    except Exception:
+        pass
     manifest.save()
 
 

@@ -37,7 +37,12 @@ Observability: ``plan.compile`` / ``plan.execute`` / ``plan.segment`` spans,
 the ``tg_dispatch_total`` counter (top-level device executable launches:
 one per device-capable stage in eager mode, one per fused segment planned)
 and ``tg_device_transfer_total`` (host→device uploads). All zero-write when
-observability is off.
+observability is off. Every plan build and every per-bucket first dispatch
+is additionally reported to the compile ledger with a classified cause
+(cold / schema-change / bucket-change / cache-eviction), and every segment
+dispatch reports its shape-predicted device bytes to the memory
+observatory (observability/ledger.py, observability/devicemem.py —
+docs/observability.md "Compile & memory ledger").
 """
 from __future__ import annotations
 
@@ -49,6 +54,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .observability import devicemem as _devicemem
+from .observability import ledger as _ledger
 from .observability import metrics as _obs_metrics
 from .observability.trace import span as _obs_span
 from .table import Column, FeatureTable
@@ -173,7 +180,8 @@ class _DeviceSegment:
     program. ``in_names`` are the columns the program reads (external to the
     segment), ``out_names`` the columns it materializes."""
 
-    __slots__ = ("stages", "in_names", "out_names", "chain", "out_meta")
+    __slots__ = ("stages", "in_names", "out_names", "chain", "out_meta",
+                 "out_shape", "seen_buckets", "fp_key", "pred_cache")
 
     def __init__(self, stages: List[Any], in_names: List[str],
                  out_names: List[str]):
@@ -181,6 +189,19 @@ class _DeviceSegment:
         self.in_names = in_names
         self.out_names = out_names
         self.out_meta: Dict[str, Tuple[Any, Dict[str, Any]]] = {}
+        #: output column (itemsize, trailing shape) from the zero-row
+        #: probe — what the byte prediction needs (devicemem)
+        self.out_shape: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        #: padding buckets this segment's jitted chain has already been
+        #: dispatched at: the first dispatch of a NEW bucket is an XLA
+        #: compile, recorded in the compile ledger
+        self.seen_buckets: set = set()
+        #: lazily-computed segment fingerprint hash (the cost-table key;
+        #: cached — the serving hot path dispatches this per flush)
+        self.fp_key: Optional[str] = None
+        #: bucket → predicted bytes (schema-fixed per plan, so one
+        #: computation per bucket serves every later dispatch)
+        self.pred_cache: Dict[int, int] = {}
         import jax
         fused = list(stages)
         outs = list(out_names)
@@ -203,6 +224,11 @@ class TransformPlan:
     def __init__(self, steps: List[Tuple[str, Any]], cat: str):
         self.steps = steps
         self.cat = cat
+        #: stable program identity (stage-uid sequence) + JSON schema
+        #: fingerprint, set by get_plan — the compile ledger's
+        #: classification baseline (observability/ledger.py)
+        self.ident: str = "plan"
+        self.fp_json: Any = None
 
     @property
     def num_segments(self) -> int:
@@ -232,6 +258,7 @@ class TransformPlan:
         with _obs_span("plan.execute", cat=self.cat, rows=table.num_rows,
                        segments=self.num_segments,
                        hostStages=self.num_host_stages):
+            seg_idx = 0
             for kind, payload in self.steps:
                 if kind == "host":
                     for s in payload:
@@ -243,11 +270,29 @@ class TransformPlan:
                                        stage=type(s).__name__, planned=True):
                             table = s.transform(table)
                 else:
-                    table = self._run_segment(payload, table)
+                    table = self._run_segment(payload, table, seg_idx)
+                    seg_idx += 1
         return table
 
+    def _predicted_bytes(self, seg: _DeviceSegment, table: FeatureTable,
+                         n_pad: int) -> int:
+        """Shape-predicted device bytes of one padded segment dispatch:
+        every input column staged at the bucket (f32 + bool mask) plus
+        every materialized output at its probe-captured shape — the
+        number admission control can subtract from the device budget
+        before dispatch (observability/devicemem.py)."""
+        from .utils.padding import padded_bytes
+        total = 0
+        for nm in seg.in_names:
+            v = table[nm].values
+            total += padded_bytes(n_pad, tuple(np.shape(v)[1:]), 4)
+        for nm in seg.out_names:
+            itemsize, trailing = seg.out_shape.get(nm, (4, ()))
+            total += padded_bytes(n_pad, trailing, itemsize)
+        return total
+
     def _run_segment(self, seg: _DeviceSegment,
-                     table: FeatureTable) -> FeatureTable:
+                     table: FeatureTable, seg_idx: int = 0) -> FeatureTable:
         import jax.numpy as jnp
 
         from .manifest import sentinel_phase
@@ -306,10 +351,57 @@ class TransformPlan:
             "tg_dispatch_total", kind="plan_segment",
             help="top-level device executable launches on the transform "
             "path (docs/plan.md)")
+        # compile & memory observatory: shape-predicted bytes before the
+        # dispatch, per-bucket first-call compiles into the ledger, the
+        # (segment fingerprint x bucket) cost row after
+        subsystem = _ledger.current_subsystem("plan")
+        predicted = seg.pred_cache.get(n_pad)
+        if predicted is None:
+            # one shape computation per (plan, bucket): the plan's schema
+            # is fixed by its cache key, so later dispatches reuse it
+            predicted = self._predicted_bytes(seg, table, n_pad)
+            seg.pred_cache[n_pad] = predicted
+        _devicemem.record_dispatch(subsystem, predicted, bucket=n_pad,
+                                   rows=n)
+        first_bucket = n_pad not in seg.seen_buckets
+        pre_stats = _devicemem.memory_stats()
+        t_disp = time.perf_counter()
         with _obs_span("plan.segment", cat=self.cat,
                        stages=len(seg.stages), rows=n,
                        inputs=len(seg.in_names), outputs=len(seg.out_names)):
             outs = seg.chain(tuple(vals_list), tuple(mask_list))
+        disp_secs = time.perf_counter() - t_disp
+        post_stats = _devicemem.sample_measured(subsystem)
+        # cost bytes: measured allocation delta where the backend reports
+        # live-buffer stats, shape-predicted otherwise (CPU)
+        cost_bytes = predicted
+        if pre_stats is not None and post_stats is not None:
+            delta = (post_stats.get("bytes_in_use", 0)
+                     - pre_stats.get("bytes_in_use", 0))
+            if delta > 0:
+                cost_bytes = delta
+        if seg.fp_key is None:
+            seg.fp_key = _ledger.cache_key_hash(
+                (self.ident, seg_idx, tuple(seg.in_names),
+                 tuple(seg.out_names), self.fp_json))
+        seg_fp = seg.fp_key
+        if first_bucket:
+            seg_ident = f"{self.ident}/seg{seg_idx}"
+            seg.seen_buckets.add(n_pad)
+            # the first dispatch at a NEW padding bucket traces+compiles
+            # a fresh XLA executable inside the jitted chain — that IS a
+            # program build (cold for the first bucket, bucket-change
+            # when row growth crossed a bucket boundary)
+            _ledger.record_build(
+                subsystem, identity=seg_ident,
+                key=f"{seg_fp}@{n_pad}", fingerprint=self.fp_json,
+                bucket=n_pad, seconds=disp_secs, rows=n,
+                stages=len(seg.stages), cat=self.cat)
+            _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
+                                   compile_s=disp_secs)
+        else:
+            _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
+                                   execute_s=disp_secs)
         new_cols: Dict[str, Column] = {}
         for nm, (arr, msk) in zip(seg.out_names, outs):
             # slice padding back off; keep values device-resident (exactly
@@ -468,6 +560,13 @@ def _build_plan(stages: List[Any], table: FeatureTable,
         for nm in payload.out_names:
             col = probe[nm]
             payload.out_meta[nm] = (col.feature_type, dict(col.metadata))
+            try:
+                itemsize = int(np.dtype(
+                    getattr(col.values, "dtype", np.float32)).itemsize)
+            except TypeError:
+                itemsize = 4
+            payload.out_shape[nm] = (
+                itemsize, tuple(int(x) for x in np.shape(col.values)[1:]))
     return plan
 
 
@@ -525,12 +624,13 @@ def get_plan(stages: Sequence[Any], table: FeatureTable, *,
     stages = list(stages)
     if sum(1 for s in stages if is_device_capable(s)) < min_device_stages:
         return None
+    fp = _schema_fingerprint(stages, table)
     key = (tuple((s.uid, id(s)) for s in stages),
-           _schema_fingerprint(stages, table),
-           keep_intermediates, tuple(sorted(extra_keep)))
+           fp, keep_intermediates, tuple(sorted(extra_keep)))
     if key in _PLAN_CACHE:
         _PLAN_CACHE.move_to_end(key)
         return _PLAN_CACHE[key]
+    t0 = time.perf_counter()
     with _obs_span("plan.compile", cat=cat, stages=len(stages)) as sp:
         try:
             plan = _build_plan(stages, table, keep_intermediates,
@@ -544,10 +644,28 @@ def get_plan(stages: Sequence[Any], table: FeatureTable, *,
         if plan is not None:
             sp.set_attr(segments=plan.num_segments,
                         hostStages=plan.num_host_stages)
+    if plan is not None:
+        # compile ledger: plan (re)builds are classified against the
+        # stage sequence's previous build — a cache miss alone says
+        # "rebuilt", the ledger says WHY (schema-change with the changed
+        # column named, eviction, cold) — docs/observability.md
+        plan.ident = "plan/" + ",".join(
+            str(getattr(s, "uid", "?")) for s in stages)
+        plan.fp_json = [[nm, dt, list(shape), bool(maskless)]
+                        for nm, dt, shape, maskless in (fp or ())]
+        _ledger.record_build(
+            _ledger.current_subsystem("plan"),
+            identity=(plan.ident
+                      + f"/ki={int(keep_intermediates)}"
+                      + f"/ek={','.join(sorted(extra_keep))}"),
+            key=_ledger.cache_key_hash(key), fingerprint=plan.fp_json,
+            seconds=time.perf_counter() - t0,
+            segments=plan.num_segments, cat=cat)
     _PLAN_CACHE[key] = plan
     _PLAN_CACHE.move_to_end(key)
     while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
-        _PLAN_CACHE.popitem(last=False)
+        evicted_key, _ = _PLAN_CACHE.popitem(last=False)
+        _ledger.record_eviction(_ledger.cache_key_hash(evicted_key))
     return plan
 
 

@@ -111,6 +111,21 @@ def blackbox_violations() -> List[str]:
     return out
 
 
+def ledger_violations() -> List[str]:
+    """The compile ledger must stay bounded and no forced enable/disable
+    override may linger (mirrors ``blackbox_violations``)."""
+    from ..observability import ledger as _ledger
+    out: List[str] = []
+    led = _ledger.ledger()
+    snap = led.snapshot()
+    if snap["records"] > snap["maxRecords"]:
+        out.append(f"compile ledger exceeded its ring bound: "
+                   f"{snap['records']} > {snap['maxRecords']}")
+    if _ledger._enabled_override is not None:
+        out.append("a forced ledger enable/disable override is active")
+    return out
+
+
 def plan_cache_violations() -> List[str]:
     """The compiled-plan LRU must stay bounded and no forced
     planner-enable override may linger."""
@@ -198,4 +213,5 @@ def campaign_violations(clean: bool = True,
         out.append(f"worker thread(s) survived: {threads}")
     out.extend(plan_cache_violations())
     out.extend(blackbox_violations())
+    out.extend(ledger_violations())
     return out

@@ -103,9 +103,20 @@ class ModelRegistry:
         attaches a DriftMonitor when the manifest carries a ``drift``
         baseline (``TG_DRIFT=0`` opts out)."""
         model, entry, monitor = self._load_parts(path, workflow)
-        return self.register(name, model, config=config, warm=warm,
-                             warm_entry=entry or None,
-                             drift_monitor=monitor)
+        rt = self.register(name, model, config=config, warm=warm,
+                           warm_entry=entry or None,
+                           drift_monitor=monitor)
+        if warm:
+            # warmup-time cost persistence: the warm pre-trace just
+            # measured this process's (segment fingerprint × bucket)
+            # bytes/compile/execute costs — merge them into the model's
+            # MANIFEST `costs` section so admission control (ROADMAP
+            # item 2) and the AOT store (item 1) can read them next load.
+            # Best-effort by contract: a read-only model dir must not
+            # fail the load.
+            from ..observability import devicemem as _devicemem
+            _devicemem.persist_costs(path)
+        return rt
 
     @staticmethod
     def _load_parts(path: str, workflow=None):

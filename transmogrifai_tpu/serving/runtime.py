@@ -71,6 +71,7 @@ from ..local.scoring import (
     SCORE_ERROR_KEY, micro_batch_score_function, score_function,
 )
 from ..observability import blackbox as _blackbox
+from ..observability import ledger as _obs_ledger
 from ..observability import metrics as _obs_metrics
 from ..observability import postmortem as _postmortem
 from ..observability.trace import add_event as _obs_event
@@ -352,8 +353,10 @@ class ServingRuntime:
     def warm(self, rows: int = 8) -> List[Dict[str, Any]]:
         """Drive the compiled serve path once with synthetic all-missing
         rows — compiles the plan + jitted programs for the padding bucket
-        the first real flush will land in (serving/warmup.py)."""
-        return self._scorer([{} for _ in range(max(1, rows))])
+        the first real flush will land in (serving/warmup.py). Builds are
+        ledger-attributed to subsystem ``serve`` (cause ``cold``)."""
+        with _obs_ledger.subsystem_scope("serve"):
+            return self._scorer([{} for _ in range(max(1, rows))])
 
     # -- batcher -------------------------------------------------------------
     def _beat(self) -> None:
@@ -496,8 +499,15 @@ class ServingRuntime:
         rows = [r.row for r in alive]
         if self.breaker.allow_device():
             try:
+                # ledger attribution: any program build this flush pays
+                # (a retrace after a schema-shifted request, a new
+                # padding bucket) lands as subsystem "serve", correlated
+                # to the flush's oldest request — so `cli doctor`
+                # timelines show which request paid the retrace
                 with _obs_span("serve.dispatch", cat="serve",
-                               model=self.name, rows=len(rows)):
+                               model=self.name, rows=len(rows)), \
+                        _obs_ledger.subsystem_scope("serve"), \
+                        _blackbox.correlated(alive[0].corr):
                     _blackbox.record("serve.dispatch", model=self.name,
                                      rows=len(rows))
                     # chaos: a fault here models the compiled micro-batch

@@ -70,9 +70,12 @@ def warm_runtime(runtime, entry: Optional[Dict[str, Any]] = None,
     Never raises — a model whose raw extracts cannot handle an all-missing
     probe row simply serves its first request cold (reported)."""
     from .. import plan as _plan
+    from ..observability import ledger as _ledger
     n = _warm_rows(rows if rows is not None
                    else (entry or {}).get("warmRows"))
     before = _plan.cache_stats()["entries"]
+    led = _ledger.ledger()
+    mark = led.mark()
     info: Dict[str, Any] = {"rows": n, "plansWarmed": 0, "ok": True,
                             "fingerprintMatch": None, "error": None}
     try:
@@ -81,6 +84,15 @@ def warm_runtime(runtime, entry: Optional[Dict[str, Any]] = None,
         info["ok"] = False
         info["error"] = f"{type(e).__name__}: {e}"[:300]
     info["plansWarmed"] = max(0, _plan.cache_stats()["entries"] - before)
+    # compile-ledger accounting: the builds warmup pre-paid (subsystem
+    # "serve") — what the warm-path zero-retrace gate subtracts before
+    # asserting the first real request compiles NOTHING
+    warm_builds = led.since(mark)
+    causes: Dict[str, int] = {}
+    for rec in warm_builds:
+        causes[rec.cause] = causes.get(rec.cause, 0) + 1
+    info["compiles"] = led.mark() - mark
+    info["compileCauses"] = causes
     recorded = (entry or {}).get("planFingerprint")
     if recorded is not None:
         try:

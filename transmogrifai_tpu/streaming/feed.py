@@ -46,6 +46,7 @@ import numpy as np
 
 from ..manifest import sentinel_phase as _sentinel_phase
 from ..observability import blackbox as _blackbox
+from ..observability import devicemem as _devicemem
 from ..observability import metrics as _obs_metrics
 from ..robustness import faults
 from ..robustness import watchdog as _watchdog
@@ -247,6 +248,12 @@ class DeviceFeed:
                         self._resident_chunks)
                 _blackbox.record("stream.upload", corr=self._corr,
                                  chunk=chunk.index, bytes=nbytes)
+                # device-memory observatory: the packed upload's shape-
+                # derived bytes (the chunk-residency prediction) +
+                # measured live-buffer peak where the backend reports it
+                _devicemem.record_dispatch("stream", nbytes,
+                                           rows=chunk.rows)
+                _devicemem.sample_measured("stream")
                 self._put((Chunk(chunk.index, chunk.chunk_id, table), nbytes))
         except BaseException as e:  # noqa: BLE001 — preemption must forward
             self._put((self._SENTINEL, e))

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..observability import blackbox as _blackbox
+from ..observability import ledger as _obs_ledger
 from ..observability.trace import span as _obs_span
 from ..robustness import faults, resources
 from ..robustness.policy import FaultLog, FaultReport
@@ -121,6 +122,16 @@ class StreamRun:
             _blackbox.record("stream.pass", uid=self.stage_uid,
                              passId=pass_id, fromChunk=start,
                              chunkRows=src.chunk_rows)
+            # compile ledger: each fold pass is one streaming program
+            # over the chunk grid — first attempt is cold; an OOM
+            # downshift re-enters at a halved row budget and the ledger
+            # classifies the rebuild as bucket-change (the stream analog
+            # of a padding-bucket crossing; docs/observability.md)
+            _obs_ledger.record_build(
+                "stream", identity=f"stream/{key}",
+                key=f"{key}@{src.chunk_rows}",
+                bucket=src.chunk_rows, fromChunk=start,
+                chunks=src.num_chunks)
             try:
                 with _obs_span("stream.pass", cat="train",
                                uid=self.stage_uid, passId=pass_id,

@@ -58,6 +58,24 @@ def pad_rows(values, n_pad: int):
     return np.concatenate([v, filler])
 
 
+def padded_bytes(n_pad: int, trailing=(), itemsize: int = 4,
+                 with_mask: bool = True) -> int:
+    """Device bytes one padded column stages: ``n_pad`` rows of
+    ``trailing``-shaped ``itemsize`` cells, plus the 1-byte-per-row bool
+    validity mask the traced programs always materialize. The shared
+    prediction primitive of the device-memory observatory
+    (observability/devicemem.py) — prediction must use the exact same
+    bucket arithmetic the dispatch sites pad with, or the predicted
+    bytes drift from what XLA actually allocates."""
+    cells = 1
+    for x in trailing:
+        cells *= int(x)
+    total = int(n_pad) * cells * int(itemsize)
+    if with_mask:
+        total += int(n_pad)
+    return total
+
+
 def padded_valid_mask(mask, n: int, n_pad: int):
     """(n_pad,) bool validity mask: the original mask (or all-valid when
     ``mask`` is None) over the first ``n`` rows, False over the pad."""

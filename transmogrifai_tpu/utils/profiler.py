@@ -79,7 +79,10 @@ class StageProfiler:
         # accumulated in track() (NOT derived from the bounded records ring,
         # which would undercount runs past its maxlen)
         by_layer = self._by_layer
+        from ..observability import devicemem as _devicemem
+        from ..observability import ledger as _ledger
         from .jax_cache import cache_stats
+        led = _ledger.ledger()
         out = {
             "appDurationSecs": time.time() - self.app_start,
             "stageSecondsTotal": self._total,
@@ -88,22 +91,28 @@ class StageProfiler:
             "byLayer": dict(sorted(by_layer.items())),
             "numRecords": self._count,
             # span-compatible view of the (bounded) record ring + the
-            # process compile-cache outcomes — the two blind spots of the
-            # original wall-clock-sums-only report
+            # process compile accounting — the two blind spots of the
+            # original wall-clock-sums-only report. Program-build counts
+            # come from the compile ledger (backend-independent: the
+            # dispatch sites report their own builds); the persistent-
+            # cache listener's hits/misses ride along as a cross-check
+            # where its monitoring events fire (TPU/GPU — they read 0 on
+            # CPU, the pre-ledger gap; observability/ledger.py)
             "spans": self.spans(),
-            "compileCache": cache_stats(),
+            "compileCache": {
+                **cache_stats(),
+                "builds": led.total,
+                "byCause": led.counts_by_cause(),
+                "bySubsystem": led.counts(),
+            },
         }
-        # device-side memory stats, best effort (the reference's analog is
-        # the listener's executor GC/spill metrics)
-        try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            out["deviceMemory"] = {
-                k: int(v) for k, v in stats.items()
-                if k in ("bytes_in_use", "peak_bytes_in_use",
-                         "bytes_limit", "num_allocs")}
-        except Exception:
-            pass
+        # device-side memory: measured live-buffer stats where the
+        # backend reports them, plus the observatory's shape-predicted
+        # per-subsystem peaks (works on every backend, CPU included)
+        stats = _devicemem.memory_stats()
+        if stats:
+            out["deviceMemory"] = stats
+        out["deviceMemoryPredicted"] = _devicemem.observatory().snapshot()
         return out
 
     def pretty(self, top_k: int = 15) -> str:
