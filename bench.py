@@ -27,13 +27,18 @@ Modes (BENCH_MODE env):
   sweeps at this density are what the 8-thread reference pool grinds
   through in minutes.
 - ``serve``: the resilient serving runtime under open-loop synthetic load
-  (docs/serving.md). Three lines: a clean line at 0.35× of measured
-  runtime capacity (sustained rows/sec + p50/p99 tail), the same load
-  with the drift monitor folding every batch (overhead asserted ≤5% of
-  the clean line), then a chaos soak at 2× capacity with faults armed at
-  all three ``serve.*`` sites — the soak must complete with overflow
-  shed as typed errors and the breaker/shed/degraded counts visible
-  (zero process crashes).
+  (docs/serving.md). Six lines: recorder-off / ledger-off / sampler-off
+  reference arms, a clean line at 0.35× of measured runtime capacity
+  (sustained rows/sec + p50/p99 tail; the flight-recorder, compile-ledger
+  and sampler+SLO overheads each asserted ≤2% of their off arms, and
+  ZERO page-severity SLO alerts — burn-rate false positives fail the
+  bench), the same load with the drift monitor folding every batch
+  (overhead asserted ≤5% of the clean line), then a chaos soak at 2×
+  capacity with faults armed at all three ``serve.*`` sites — the soak
+  must complete with overflow shed as typed errors, the breaker/shed/
+  degraded counts visible (zero process crashes), ≥1 page-severity SLO
+  burn-rate alert fired, and a ``slo_budget_exhausted`` post-mortem
+  bundle on disk (docs/observability.md "SLOs, budgets & burn rates").
 - ``stream``: the out-of-core line — a 10M×64 synthetic chunk stream
   trained end-to-end via ``OpWorkflow.train(stream=...)`` (vectorize →
   sanity-check → streaming GBT), reporting rows/sec, peak device-resident
@@ -330,6 +335,15 @@ def _serve_model(n, d, seed=0):
             .set_result_features(pred).train())
 
 
+def _slo_page_fires(summary):
+    """Cumulative page-severity SLO alert activations across a runtime
+    summary's per-spec tracker snapshots (fired-then-cleared counts)."""
+    total = 0
+    for snap in (summary.get("slo") or {}).values():
+        total += int((snap.get("fired") or {}).get("page", 0))
+    return total
+
+
 def _run_serve(platform):
     """BENCH_MODE=serve: sustained rows/sec + tail latency + shed rate
     from the open-loop generator, clean and under chaos at 2× capacity
@@ -411,17 +425,35 @@ def _run_serve(platform):
 
     from transmogrifai_tpu.observability import blackbox as _blackbox
     from transmogrifai_tpu.observability import postmortem as _postmortem
+    from transmogrifai_tpu.observability import timeseries as _timeseries
     pm_dir = _tempfile.mkdtemp(prefix="tg_bench_postmortems_")
     os.environ["TG_POSTMORTEM_DIR"] = pm_dir
-    # four lines: recorder-off baseline (TG_BLACKBOX=0) → clean (the
-    # always-on flight recorder, overhead must stay ≤2% of the off line —
-    # asserted, completion-ratio normalized like the round-9 watchdog
-    # gate) → same load with the drift monitor folding every batch (≤5%
-    # of clean — asserted) → chaos soak at 2× (must dump ≥1 schema-valid
-    # post-mortem bundle — asserted; docs/benchmarks.md round 11)
+    # SLO plane for the serve lines (docs/observability.md "SLOs,
+    # budgets & burn rates"): fast sampling so the scaled alert windows
+    # (page long = window/720 = 5s) hold several samples, a compressed
+    # budget window, and a 0.99 availability target — the page alert
+    # needs a sustained ≥14.4% bad fraction, which the clean line (zero
+    # sheds expected) can never produce and the 2× chaos line (massive
+    # overload shedding) always does: the zero-false-positive /
+    # must-fire pair is asserted below
+    slo_env = {"TG_SAMPLE_EVERY_S": "0.2", "TG_SLO_WINDOW_S": "3600",
+               "TG_SLO_AVAILABILITY": "0.99"}
+    saved_slo_env = {k: os.environ.get(k) for k in slo_env}
+    os.environ.update(slo_env)
+    # six lines: recorder-off baseline (TG_BLACKBOX=0) → ledger-off →
+    # sampler-off (TG_SAMPLER=0: no windowed telemetry, no SLO trackers)
+    # → clean (always-on flight recorder + ledger + sampler + SLO
+    # engine; each overhead must stay ≤2% of its off line — asserted,
+    # completion-ratio normalized like the round-9 watchdog gate) →
+    # same load with the drift monitor folding every batch (≤5% of
+    # clean — asserted) → chaos soak at 2× (must dump ≥1 schema-valid
+    # post-mortem bundle, fire ≥1 page-severity SLO alert, and dump a
+    # matching slo_budget_exhausted bundle — asserted;
+    # docs/benchmarks.md rounds 11/13)
     clean_rows_per_sec = None
     lines = {}
-    for arm in ("noblackbox", "noledger", "clean", "drift", "chaos2x"):
+    for arm in ("noblackbox", "noledger", "nosampler", "clean", "drift",
+                "chaos2x"):
         faulted = arm == "chaos2x"
         rps = runtime_capacity * (2.0 if faulted else clean_frac)
         monitor = None
@@ -433,6 +465,10 @@ def _run_serve(platform):
             # within 2% of this (completion-ratio normalized — the same
             # gate shape as the round-11 recorder arm)
             _obs_ledger.enable_ledger(False)
+        if arm == "nosampler":
+            # TG_SAMPLER=0 reference arm: the clean line's sampler+SLO
+            # overhead gate (≤2%, same normalization) reads this
+            _timeseries.enable_sampler(False)
         if arm == "drift":
             from transmogrifai_tpu.serving.drift import (
                 DriftBaseline, DriftMonitor)
@@ -462,6 +498,8 @@ def _run_serve(platform):
                 _blackbox.enable_blackbox(None)
             if arm == "noledger":
                 _obs_ledger.enable_ledger(None)
+            if arm == "nosampler":
+                _timeseries.enable_sampler(None)
         lines[arm] = rep
         suffix = "" if arm == "clean" else f"_{arm}"
         phases = {
@@ -506,6 +544,24 @@ def _run_serve(platform):
                 f"compile-ledger overhead {l_overhead:.1%} exceeds the "
                 f"2% budget (clean {rep['completed']}/{rep['offered']} "
                 f"vs TG_LEDGER=0 {offl['completed']}/{offl['offered']})")
+            # the ≤2% sampler+SLO gate: same load as the TG_SAMPLER=0
+            # arm, same completion-ratio normalization (round 13)
+            offs = lines["nosampler"]
+            offs_ratio = offs["completed"] / max(offs["offered"], 1)
+            s_overhead = 1.0 - ratio / max(offs_ratio, 1e-9)
+            phases["samplerOverheadVsOff"] = round(s_overhead, 4)
+            assert ratio >= 0.98 * offs_ratio, (
+                f"sampler+SLO overhead {s_overhead:.1%} exceeds the "
+                f"2% budget (clean {rep['completed']}/{rep['offered']} "
+                f"vs TG_SAMPLER=0 {offs['completed']}/{offs['offered']})")
+            # zero false positives: the clean line must not fire a
+            # single page-severity burn-rate alert (the chaos line's
+            # must-fire twin is asserted below)
+            clean_page = _slo_page_fires(summary)
+            phases["sloPageAlerts"] = clean_page
+            assert clean_page == 0, (
+                f"clean serve line fired {clean_page} page-severity SLO "
+                f"alert(s) — burn-rate false positive")
         elif arm == "drift":
             # the ≤5% monitor-overhead acceptance gate: same offered
             # load as the clean line, every batch folded + verdicts on
@@ -532,8 +588,25 @@ def _run_serve(platform):
             phases["postmortemBundles"] = len(bundles)
             phases["postmortemTriggers"] = sorted(
                 {d["trigger"]["kind"] for d in docs})
+            # the must-fire twin of the clean line's zero-false-positive
+            # gate: 2× overload sheds ~half the offered load, which must
+            # page AND fully burn the availability budget — with the
+            # matching slo_budget_exhausted bundle on disk (round 13)
+            chaos_page = _slo_page_fires(summary)
+            phases["sloPageAlerts"] = chaos_page
+            assert chaos_page >= 1, (
+                "chaos serve line fired no page-severity SLO alert "
+                "despite 2x overload shedding")
+            assert "slo_budget_exhausted" in phases["postmortemTriggers"], (
+                f"chaos soak dumped no slo_budget_exhausted bundle "
+                f"(triggers: {phases['postmortemTriggers']})")
             _shutil.rmtree(pm_dir, ignore_errors=True)
             os.environ.pop("TG_POSTMORTEM_DIR", None)
+            for k, v in saved_slo_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         print(json.dumps({
             "metric": f"serve_rows_per_sec{suffix}_{d}feat_{platform}",
             "value": rep["rowsPerSec"],

@@ -129,6 +129,33 @@ def _no_ledger_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_slo_leak():
+    """The windowed time-series sampler and the SLO engine are
+    process-global: attached sampler sources keep the shared
+    ``tg-sampler`` thread alive and snapshot their registry forever, and
+    a registered SLOSpec silently changes every later runtime's budgets
+    and alert thresholds. Assert clean on entry; on exit force-detach
+    sources, drop specs, retire the thread, and fail the test that
+    leaked them. Probes + cleanup live in robustness/oracles.py (also
+    run by the campaign engine after every schedule). Defined BEFORE the
+    serving no-leak fixture so this teardown runs AFTER runtimes (which
+    attach sources on start and detach on close) are force-closed."""
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.slo_violations(), (
+        f"sampler/SLO state leaked into this test: "
+        f"{oracles.slo_violations()}")
+    yield
+    leaks = oracles.slo_violations()
+    oracles.clean_slo_state()
+    from transmogrifai_tpu.observability import timeseries as _ts
+    _ts.idle_join()
+    assert not leaks, f"a test leaked sampler/SLO state: {leaks}"
+    stray = oracles.leaked_threads(("tg-sampler",))
+    assert not stray, f"sampler thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_plan_cache_leak():
     """Compiled transform plans pin jitted executables (and the stage
     objects they closed over), so the LRU must be provably bounded and must

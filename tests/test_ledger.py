@@ -399,7 +399,9 @@ def test_postmortem_bundle_carries_ledger_and_memory(model, tmp_path,
     assert path is not None
     doc = pm.read_bundle(path)
     assert pm.validate_bundle(doc) == []
-    assert doc["schemaVersion"] == 2
+    # current schema (v3 since the SLO engine; the ledger sections below
+    # are the v2 payload and ride along unchanged)
+    assert doc["schemaVersion"] == pm.SCHEMA_VERSION
     assert doc["ledger"]["builds"] >= 1 and doc["ledger"]["tail"]
     assert all(r["cause"] in lg.CAUSES for r in doc["ledger"]["tail"])
     assert "subsystems" in doc["deviceMemory"]

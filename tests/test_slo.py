@@ -1,0 +1,762 @@
+"""SLO engine (transmogrifai_tpu/observability/timeseries.py + slo.py;
+docs/observability.md "SLOs, budgets & burn rates"): windowed
+rate/quantile correctness vs numpy, SPDT sketch-window subtraction
+within documented tolerance, multi-window burn-rate alerts firing iff
+the budget actually burned (both directions, injectable clock), alert
+hysteresis, per-tenant budget isolation, the scale_hint ladder,
+sampler-disabled zero-writes, post-mortem bundle schema v3, and the
+``op slo`` / ``op doctor`` surfaces."""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.observability import blackbox as obs_blackbox
+from transmogrifai_tpu.observability import export as obs_export
+from transmogrifai_tpu.observability import metrics as obs_metrics
+from transmogrifai_tpu.observability import postmortem as obs_postmortem
+from transmogrifai_tpu.observability import slo as obs_slo
+from transmogrifai_tpu.observability import timeseries as obs_ts
+from transmogrifai_tpu.serving import ModelRegistry, ServeConfig, ServingRuntime
+from transmogrifai_tpu.serving.loadgen import run_open_loop
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo_state():
+    """Specs registered / samplers force-enabled by a test must not leak
+    into the conftest ``_no_slo_leak`` oracle (which would fail the
+    test); reset the module state after every test here."""
+    yield
+    obs_slo.reset()
+    obs_ts.reset()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _sampler(reg, every=1.0, max_samples=500):
+    clock = _Clock()
+    s = obs_ts.MetricsSampler(reg, name="unit", clock=clock,
+                              every_s=every, max_samples_=max_samples)
+    return s, clock
+
+
+def _train_model(n=300, seed=7):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2) > 0).astype(float)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(c).extract_field().as_predictor()
+             for c in ("x1", "x2")]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed,
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train_model()
+
+
+def _rows(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"x1": float(rng.randn()), "x2": float(rng.randn())}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Windowed time series: rates, gauges, quantiles
+# ---------------------------------------------------------------------------
+
+def test_windowed_rate_and_increase_vs_numpy():
+    """Counter rate over a window must equal the numpy-computed delta of
+    the cumulative series divided by elapsed, for several windows over a
+    synthetic increment schedule."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    c = reg.counter("reqs_total", model="m")
+    # cumulative[i] at t=i: increments drawn from a fixed schedule
+    incs = [0, 5, 9, 0, 20, 1, 1, 30, 2, 7]
+    cum = np.cumsum(incs)
+    for i, inc in enumerate(incs):
+        clock.t = float(i)
+        c.inc(inc) if inc else None
+        s.tick()
+    now = 9.0
+    for w in (1.0, 3.0, 5.0, 9.0):
+        got = s.increase("reqs_total", w, model="m")
+        exp = float(cum[-1] - cum[int(now - w)])
+        assert got == exp, (w, got, exp)
+        assert s.rate("reqs_total", w, model="m") == pytest.approx(exp / w)
+    # a window longer than history clips to it (value before the first
+    # sample is the born-at-zero convention)
+    assert s.increase("reqs_total", 1000.0) == float(cum[-1])
+    assert s.rate("reqs_total", 1000.0) == pytest.approx(cum[-1] / 9.0)
+
+
+def test_windowed_increase_aggregates_label_partitions():
+    """A query naming a label subset sums across the remaining labels —
+    shed_total{model} aggregates every reason, the SLO engine's shape."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    reg.counter("shed_total", model="m", reason="overload").inc(3)
+    reg.counter("shed_total", model="m", reason="deadline").inc(2)
+    reg.counter("shed_total", model="other", reason="overload").inc(100)
+    clock.advance(1.0)
+    s.tick()
+    assert s.increase("shed_total", 10.0, model="m") == 5.0
+    assert s.increase("shed_total", 10.0, model="other") == 100.0
+    assert s.increase("shed_total", 10.0) == 105.0
+
+
+def test_gauge_window_last_min_max():
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    g = reg.gauge("depth", model="m")
+    for i, v in enumerate((4.0, 9.0, 2.0, 7.0)):
+        clock.t = float(i)
+        g.set(v)
+        s.tick()
+    w = s.gauge_window("depth", 2.5, model="m")
+    # window (0.5, 3]: carried points 9, 2, 7 + inherited 4 at start
+    assert w["last"] == 7.0
+    assert w["max"] == 9.0
+    assert w["min"] == 2.0
+    full = s.gauge_window("depth", 100.0, model="m")
+    assert (full["min"], full["max"], full["last"]) == (2.0, 9.0, 7.0)
+
+
+def test_windowed_quantile_isolates_recent_phase():
+    """The sketch-subtraction quantile must reflect ONLY the window's
+    observations: after a distribution shift, the windowed p50/p99 track
+    the new phase while the lifetime sketch stays blended. Tolerance is
+    the documented sketch error (both phases well-separated here, so the
+    assertion bounds are generous multiples of the exact values)."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    h = reg.histogram("lat_seconds", model="m")
+    rng = np.random.RandomState(0)
+    phase1 = np.abs(rng.randn(3000))          # ~|N(0,1)|
+    phase2 = np.abs(rng.randn(3000)) + 10.0   # shifted by 10
+    s.tick()
+    for v in phase1:
+        h.observe(float(v))
+    clock.t = 10.0
+    s.tick()
+    for v in phase2:
+        h.observe(float(v))
+    clock.t = 20.0
+    s.tick()
+    p50_w = s.quantile("lat_seconds", 0.5, 10.0, model="m")
+    p99_w = s.quantile("lat_seconds", 0.99, 10.0, model="m")
+    exact50 = float(np.quantile(phase2, 0.5))
+    exact99 = float(np.quantile(phase2, 0.99))
+    assert abs(p50_w - exact50) < 0.15 * exact50
+    assert abs(p99_w - exact99) < 0.15 * exact99
+    # the lifetime p50 is blended across both phases — far from phase 2
+    p50_all = s.quantile("lat_seconds", 0.5, 1000.0, model="m")
+    assert p50_all < 0.6 * exact50
+    # cdf_increase: ~none of the window's observations sit below 5.0
+    below = s.cdf_increase("lat_seconds", 5.0, 10.0, model="m")
+    assert below < 0.02 * len(phase2)
+    cnt = s.window_count("lat_seconds", 10.0, model="m")
+    assert cnt == len(phase2)
+
+
+def test_sketch_delta_conserves_mass():
+    a = obs_ts.StreamingHistogram(max_bins=64)
+    rng = np.random.RandomState(1)
+    a.update(rng.randn(500))
+    import copy
+    start = obs_ts.StreamingHistogram.from_state(a.to_state())
+    a.update(rng.randn(700) + 3.0)
+    delta = obs_ts.sketch_delta(a, start)
+    assert delta.total == pytest.approx(700.0)
+    # empty delta when nothing new
+    empty = obs_ts.sketch_delta(start, start)
+    assert empty.total == 0.0
+    # no start snapshot → the delta IS the full sketch
+    full = obs_ts.sketch_delta(a, None)
+    assert full.total == a.total
+    assert copy is not None  # silence the unused-import linter
+
+
+def test_delta_encoding_skips_unchanged_series():
+    """An idle tick stores nothing (compact deltas), and queries still
+    inherit the last carried value across skipped samples."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    c = reg.counter("reqs_total")
+    c.inc(5)
+    clock.t = 1.0
+    assert s.tick() == 1
+    clock.t = 2.0
+    assert s.tick() == 0  # nothing changed: empty sample
+    clock.t = 3.0
+    assert s.tick() == 0
+    assert s.increase("reqs_total", 1.5) == 0.0  # flat across the window
+    assert s.rate("reqs_total", 10.0) > 0
+
+
+def test_ring_bound_drops_oldest():
+    reg = obs_metrics.MetricsRegistry()
+    clock = _Clock()
+    s = obs_ts.MetricsSampler(reg, clock=clock, every_s=1.0,
+                              max_samples_=5)
+    c = reg.counter("reqs_total")
+    for i in range(20):
+        clock.t = float(i)
+        c.inc(1)
+        s.tick()
+    snap = s.snapshot()
+    assert snap["samples"] == 5
+    assert snap["ticks"] == 20
+    # windows inside the retained ring resolve against real baselines
+    assert s.increase("reqs_total", 3.0) == 3.0
+    # a window past the oldest retained sample has no baseline →
+    # born-at-zero: the full cumulative value (the ring bounds window
+    # RESOLUTION, not counter correctness), with rate's elapsed clipped
+    # to the history actually observed
+    assert s.increase("reqs_total", 1000.0) == 20.0
+    # elapsed clips to the RETAINED ring (oldest kept sample at t=15)
+    assert s.rate("reqs_total", 1000.0) == pytest.approx(20.0 / 4.0)
+
+
+def test_sampler_disabled_zero_writes(model):
+    """TG_SAMPLER=0 (forced off here): attach returns None, runtimes get
+    no sampler/trackers, no tg-sampler thread exists, and the serve-local
+    registry gains no tg_slo_* series — the whole plane is inert."""
+    obs_ts.enable_sampler(False)
+    try:
+        assert obs_ts.attach(obs_metrics.MetricsRegistry()) is None
+        with ServingRuntime(model, "off", ServeConfig(max_batch=8)) as rt:
+            assert rt.sampler is None
+            assert rt.slo_trackers == []
+            rt.score(_rows(1)[0], timeout=30)
+            assert rt.slo_snapshot() is None
+            summary = rt.summary()
+        assert summary["slo"] is None
+        # scale_hint still works from the sampler-free signal families
+        assert summary["scaleHint"]["hint"] in ("up", "hold", "down")
+        assert not [k for k in rt.metrics.snapshot()
+                    if k.startswith("tg_slo_")]
+        import threading
+        assert not [t.name for t in threading.enumerate()
+                    if t.name.startswith("tg-sampler")]
+    finally:
+        obs_ts.enable_sampler(None)
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate alerts + budgets (injectable clock, synthetic serve series)
+# ---------------------------------------------------------------------------
+
+def _serve_series(reg, m="m"):
+    return (reg.counter("tg_serve_rows_total", model=m),
+            reg.counter("tg_serve_shed_total", model=m, reason="overload"),
+            reg.histogram("tg_serve_request_seconds", model=m))
+
+
+def _tracker(reg, s, **spec_kw):
+    spec_kw.setdefault("model", "m")
+    spec_kw.setdefault("availability", 0.99)
+    spec_kw.setdefault("window_s", 1000.0)
+    spec = obs_slo.SLOSpec(**spec_kw)
+    return obs_slo.SLOTracker(spec, s, reg, clock=s.clock)
+
+
+def test_burn_alert_fires_iff_budget_burned():
+    """Both directions: clean traffic never alerts (burn 0, budget
+    intact); sustained bad traffic above every threshold fires page AND
+    ticket, burns the budget, and flips the verdict."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    rows, shed, _h = _serve_series(reg)
+    tr = _tracker(reg, s)
+    # clean: 1000 good requests over 10s
+    for i in range(10):
+        clock.t = float(i)
+        rows.inc(100)
+        s.tick()
+    snap = tr.evaluate()
+    a = snap["objectives"]["availability"]
+    assert a["verdict"] == "ok"
+    assert a["budgetRemaining"] == pytest.approx(1.0)
+    assert not any(a["alerts"].values())
+    assert tr.fired == {"page": 0, "ticket": 0}
+    # bad: 50% sheds (bad fraction 0.5 ≫ 14.4 × 0.01 allowance)
+    for i in range(10, 20):
+        clock.t = float(i)
+        rows.inc(50)
+        shed.inc(50)
+        s.tick()
+    snap = tr.evaluate()
+    a = snap["objectives"]["availability"]
+    assert a["alerts"]["page"] and a["alerts"]["ticket"]
+    assert a["burn"]["page"]["long"] > 14.4
+    assert a["budgetRemaining"] < 1.0
+    assert a["verdict"] in ("breach", "exhausted")
+    assert tr.fired["page"] == 1 and tr.fired["ticket"] == 1
+    # the firing landed in the flight recorder
+    kinds = [e for e in obs_blackbox.recorder().events()
+             if e.kind == "slo.alert"]
+    assert any(e.attrs.get("state") == "firing"
+               and e.attrs.get("severity") == "page" for e in kinds)
+
+
+def test_burn_alert_needs_both_windows():
+    """Multi-window semantics: an old burst still inside the long window
+    but outside the short one must NOT page — the short window gates the
+    alert on the problem being current."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    rows, shed, _h = _serve_series(reg)
+    tr = _tracker(reg, s, window_s=7200.0)  # page long 10s, short 0.83s
+    clock.t = 0.0
+    s.tick()
+    rows.inc(50)
+    shed.inc(50)  # the burst: 50% bad
+    clock.t = 1.0
+    s.tick()
+    # 5s of light clean traffic — the long window still averages ≥14.4×
+    # burn (50 bad / 200 submitted = 25%), but the short window (0.83s)
+    # holds only the latest clean tick
+    for i in range(2, 7):
+        clock.t = float(i)
+        rows.inc(20)
+        s.tick()
+    snap = tr.evaluate()
+    a = snap["objectives"]["availability"]
+    assert a["burn"]["page"]["long"] > 14.4   # burst still in long window
+    assert a["burn"]["page"]["short"] < 14.4  # but not in the short one
+    assert not a["alerts"]["page"]
+    assert tr.fired["page"] == 0
+
+
+def test_alert_hysteresis_no_flap_on_boundary_traffic():
+    """Once fired, an alert survives burn oscillating inside the
+    [0.8×thr, thr) band and clears only when both windows cool below it
+    — boundary traffic cannot flap the pager."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    rows, shed, _h = _serve_series(reg)
+    tr = _tracker(reg, s, window_s=100.0)  # page long 0.14s → "since
+    #                                        last sample" at this cadence
+    t = 0.0
+
+    def step(good, bad, dt=1.0):
+        nonlocal t
+        t += dt
+        clock.t = t
+        if good:
+            rows.inc(good)
+        if bad:
+            shed.inc(bad)
+        s.tick()
+        return tr.evaluate()["objectives"]["availability"]
+
+    # fire: 50% bad
+    a = step(50, 50)
+    assert a["alerts"]["page"]
+    # boundary: ~13% bad → burn ≈ 13 ∈ [0.8×14.4=11.5, 14.4) — active
+    a = step(87, 13)
+    assert a["alerts"]["page"], "alert flapped inside the hysteresis band"
+    a = step(86, 14)  # ≈14: still in band
+    assert a["alerts"]["page"]
+    # cool: 5% bad → burn 5 < 11.5 on every window → clears
+    a = step(95, 5)
+    assert not a["alerts"]["page"]
+    assert tr.fired["page"] == 1  # one episode, not three
+
+
+def test_budget_exhaustion_dumps_one_bundle_per_episode(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("TG_POSTMORTEM_DIR", str(tmp_path))
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    rows, shed, _h = _serve_series(reg)
+    tr = _tracker(reg, s)
+    clock.t = 0.0
+    s.tick()
+    rows.inc(50)
+    shed.inc(50)
+    clock.t = 1.0
+    s.tick()
+    snap = tr.evaluate()
+    assert snap["objectives"]["availability"]["verdict"] == "exhausted"
+    bundles = obs_postmortem.list_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    doc = obs_postmortem.read_bundle(bundles[0])
+    assert doc["trigger"]["kind"] == "slo_budget_exhausted"
+    assert doc["trigger"]["detail"]["objective"] == "availability"
+    assert obs_postmortem.validate_bundle(doc) == []
+    assert doc["schemaVersion"] == 3
+    # still exhausted on the next evaluation: same episode, no new dump
+    clock.t = 2.0
+    shed.inc(10)
+    s.tick()
+    tr.evaluate()
+    assert len(obs_postmortem.list_bundles(str(tmp_path))) == 1
+
+
+def test_latency_objective_burns_on_slow_tail():
+    """Latency SLO: >1% of windowed requests over the p99 target burns
+    (ticket at ≥6×, page at ≥14.4×); a tail within budget stays ok."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    _rows_c, _shed, h = _serve_series(reg)
+    tr = _tracker(reg, s, latency_p99_ms=100.0)
+    s.tick()
+    # 30% of observations well over the 100ms target (smooth
+    # distributions on both sides — the sketch's trapezoid CDF
+    # interpolation needs spread mass, not two spikes)
+    rng = np.random.RandomState(2)
+    for i in range(2000):
+        slow = i % 10 < 3
+        h.observe(float(rng.uniform(0.3, 1.0) if slow
+                        else rng.uniform(0.001, 0.05)))
+    clock.t = 1.0
+    s.tick()
+    snap = tr.evaluate()
+    lat = snap["objectives"]["latency"]
+    assert lat["alerts"]["page"] and lat["alerts"]["ticket"]
+    assert lat["badFraction"] == pytest.approx(0.3, abs=0.07)
+    # fast traffic cools it back down (hysteresis respected)
+    for i in range(2, 30):
+        clock.t = float(i)
+        for _ in range(200):
+            h.observe(0.01)
+        s.tick()
+    lat = tr.evaluate()["objectives"]["latency"]
+    assert not lat["alerts"]["page"]
+
+
+def test_per_tenant_budget_isolation():
+    """Two tenant specs over the twin series: tenant a's sheds burn only
+    a's budget; tenant b stays pristine."""
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    ra = reg.counter("tg_serve_tenant_rows_total", model="m", tenant="a")
+    rb = reg.counter("tg_serve_tenant_rows_total", model="m", tenant="b")
+    sa = reg.counter("tg_serve_tenant_shed_total", model="m", tenant="a")
+    tra = _tracker(reg, s, tenant="a")
+    trb = _tracker(reg, s, tenant="b")
+    s.tick()
+    ra.inc(50)
+    sa.inc(50)   # tenant a: 50% shed
+    rb.inc(100)  # tenant b: clean
+    clock.t = 1.0
+    s.tick()
+    a = tra.evaluate()["objectives"]["availability"]
+    b = trb.evaluate()["objectives"]["availability"]
+    assert a["alerts"]["page"] and a["budgetRemaining"] < 0
+    assert not b["alerts"]["page"]
+    assert b["budgetRemaining"] == pytest.approx(1.0)
+    assert tra.key == "m/a" and trb.key == "m/b"
+
+
+def test_freshness_objective_tracks_drift_verdict():
+    class _Mon:
+        def __init__(self, v):
+            self._v = v
+
+        def verdict(self):
+            return self._v
+
+    class _Rt:
+        drift_monitor = _Mon("degraded")
+        fault_log = None
+
+    reg = obs_metrics.MetricsRegistry()
+    s, _clock = _sampler(reg)
+    spec = obs_slo.SLOSpec(model="m", window_s=1000.0)
+    tr = obs_slo.SLOTracker(spec, s, reg, runtime=_Rt())
+    snap = tr.evaluate()
+    assert snap["objectives"]["freshness"]["verdict"] == "breach"
+    assert snap["objectives"]["freshness"]["drift"] == "degraded"
+    assert snap["worst"] == "breach"
+    _Rt.drift_monitor = _Mon("ok")
+    snap = tr.evaluate()
+    assert snap["objectives"]["freshness"]["verdict"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# scale_hint ladder + runtime/registry wiring
+# ---------------------------------------------------------------------------
+
+def test_scale_hint_ladder(model):
+    cfg = ServeConfig(max_batch=8, max_queue=10)
+    # idle: started runtime, no traffic → down
+    with ServingRuntime(model, "hint", cfg) as rt:
+        hint = obs_slo.scale_hint(rt, rt.slo_snapshot())
+        assert hint["hint"] == "down"
+        assert "idle" in hint["reasons"][0]
+        # breaker open → hold, with the breaker named in the reason
+        rt.breaker.trip(error=RuntimeError("forced"))
+        hint = obs_slo.scale_hint(rt, rt.slo_snapshot())
+        assert hint["hint"] == "hold"
+        assert "breaker" in hint["reasons"][0]
+    # overload: a staged queue past 50% occupancy → up
+    rt2 = ServingRuntime(model, "hint2", cfg, auto_start=False)
+    try:
+        for r in _rows(6):
+            rt2.submit(r)
+        hint = obs_slo.scale_hint(rt2, None)
+        assert hint["hint"] == "up"
+        assert any("queue-depth" in r for r in hint["reasons"])
+    finally:
+        rt2.close(drain=False)
+    # shed rate (windowed, via the sampler) → up even with an empty queue
+    with ServingRuntime(model, "hint3", cfg) as rt3:
+        if rt3.sampler is not None:
+            rt3.metrics.counter("tg_serve_shed_total", model="hint3",
+                                reason="overload").inc(20)
+            rt3.sampler.tick()
+            hint = obs_slo.scale_hint(rt3, rt3.slo_snapshot())
+            assert hint["hint"] == "up"
+            assert any("shed-rate" in r for r in hint["reasons"])
+
+
+def test_scale_hint_drift_degraded_holds(model):
+    class _Mon:
+        @staticmethod
+        def verdict():
+            return "degraded"
+
+    cfg = ServeConfig(max_batch=8, max_queue=64)
+    with ServingRuntime(model, "hintd", cfg) as rt:
+        # traffic so the runtime is not idle, no overload signals
+        for r in _rows(4):
+            rt.score(r, timeout=30)
+        rt.drift_monitor = _Mon()
+        if rt.sampler is not None:
+            rt.sampler.tick()
+        hint = obs_slo.scale_hint(rt, rt.slo_snapshot())
+        assert hint["hint"] == "hold"
+        assert "drift-degraded" in hint["reasons"][0]
+        rt.drift_monitor = None
+
+
+def test_runtime_and_registry_expose_slo_and_scale_hint(model):
+    """The acceptance wiring: health() carries per-model slo verdicts +
+    a scale_hint derived from the live signal families, and the summary
+    mirrors land in summary()["observability"]["slo"]."""
+    obs_slo.register(obs_slo.SLOSpec(model="wired", availability=0.99,
+                                     latency_p99_ms=5000.0,
+                                     window_s=1000.0))
+    reg = ModelRegistry(ServeConfig(max_batch=8, max_queue=64))
+    with reg:
+        rt = reg.register("wired", model)
+        assert rt.sampler is not None
+        for r in _rows(8):
+            rt.score(r, timeout=30)
+        rt.sampler.tick()
+        rt._evaluate_slo(rt.sampler, None)
+        health = reg.health()
+        entry = health["models"]["wired"]
+        assert health["scaleHints"]["wired"] in ("up", "hold", "down")
+        assert entry["scaleHint"]["reasons"]
+        snap = entry["slo"]["wired"]
+        objs = snap["objectives"]
+        assert objs["availability"]["verdict"] == "ok"
+        assert objs["latency"]["verdict"] == "ok"
+        assert "freshness" in objs
+        assert snap["spec"]["availability"] == 0.99
+        # the summary()-side mirror
+        from transmogrifai_tpu import observability
+        slo_sec = observability.summarize()["slo"]
+        assert slo_sec["enabled"] is True
+        assert any(sp["model"] == "wired" for sp in slo_sec["specs"])
+        assert "wired" in slo_sec["models"]
+        assert slo_sec["models"]["wired"]["scaleHint"]["hint"] in (
+            "up", "hold", "down")
+
+
+def test_loadgen_multi_tenant_breakdown(model):
+    cfg = ServeConfig(max_batch=16, max_queue=256)
+    with ServingRuntime(model, "mt", cfg) as rt:
+        rep = run_open_loop(rt, _rows(64), seconds=0.6, rps=150.0,
+                            tenants=[("gold", 3.0), ("bronze", 1.0)],
+                            tenant_seed=5)
+        summary = rt.summary()
+    assert rep["accountingOk"]
+    tb = rep["tenants"]
+    assert set(tb) <= {"gold", "bronze"} and "gold" in tb
+    # per-tenant buckets sum to the totals
+    assert sum(t["offered"] for t in tb.values()) == rep["offered"]
+    assert sum(t["completed"] for t in tb.values()) == rep["completed"]
+    # the weighted mix skews ~3:1
+    if "bronze" in tb:
+        assert tb["gold"]["offered"] > tb["bronze"]["offered"]
+    # the runtime counted the twin series → summary tenant breakdown
+    st = summary["tenants"]
+    assert st and st["gold"]["rows"] == tb["gold"]["completed"]
+    assert "latency" in st["gold"]
+
+
+# ---------------------------------------------------------------------------
+# Export + bundles + summary
+# ---------------------------------------------------------------------------
+
+def test_windowed_prometheus_export():
+    reg = obs_metrics.MetricsRegistry()
+    s, clock = _sampler(reg)
+    c = reg.counter("tg_serve_rows_total", "scored rows", model="m")
+    h = reg.histogram("tg_serve_request_seconds", "latency", model="m")
+    s.tick()
+    c.inc(120)
+    h.observe(0.05)
+    h.observe(0.2)
+    clock.t = 60.0
+    s.tick()
+    text = obs_export.prometheus_text(reg, sampler=s)
+    assert 'tg_serve_rows_total_rate{model="m",window="60"} ' in text
+    assert "# TYPE tg_serve_rows_total_rate gauge" in text
+    assert 'tg_serve_request_seconds_p99{model="m",window="60"}' in text
+    # the windowed rate value is right there in the exposition
+    line = [ln for ln in text.splitlines()
+            if ln.startswith('tg_serve_rows_total_rate{model="m",'
+                             'window="60"}')][0]
+    assert float(line.split()[-1]) == pytest.approx(2.0)
+    # a sampler with <2 samples emits no windowed block
+    assert obs_export.windowed_prometheus_lines(None) == []
+
+
+def test_bundle_v3_sections_and_backcompat(model, tmp_path, monkeypatch):
+    """A live trigger writes schema v3 with slo + samples sections; v1/v2
+    documents (no such sections) must still validate."""
+    monkeypatch.setenv("TG_POSTMORTEM_DIR", str(tmp_path))
+    with ServingRuntime(model, "v3", ServeConfig(max_batch=8)) as rt:
+        rt.score(_rows(1)[0], timeout=30)
+        if rt.sampler is not None:
+            rt.sampler.tick()
+            rt._evaluate_slo(rt.sampler, None)
+        path = obs_postmortem.trigger("breaker_open", metrics=rt.metrics,
+                                      detail={"model": "v3"})
+    assert path is not None
+    doc = obs_postmortem.read_bundle(path)
+    assert obs_postmortem.validate_bundle(doc) == []
+    assert doc["schemaVersion"] == 3
+    assert "v3" in doc["slo"]
+    assert isinstance(doc["samples"], list) and doc["samples"]
+    assert doc["samples"][0]["source"] == "v3"
+    # v2 (pre-SLO) and v1 (pre-ledger) bundles stay valid
+    v2 = dict(doc, schemaVersion=2)
+    v2.pop("slo")
+    v2.pop("samples")
+    assert obs_postmortem.validate_bundle(v2) == []
+    v1 = dict(v2, schemaVersion=1)
+    v1.pop("ledger")
+    v1.pop("deviceMemory")
+    assert obs_postmortem.validate_bundle(v1) == []
+    # a v3 doc MISSING the new sections is flagged
+    broken = dict(doc)
+    broken.pop("slo")
+    assert any("slo" in p for p in obs_postmortem.validate_bundle(broken))
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_slo_smoke(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_slo
+    model = _train_model(n=200)
+    mdir = tmp_path / "model"
+    model.save(str(mdir))
+    out = tmp_path / "out"
+    summary = run_slo(str(mdir), seconds=1.2, rps=40.0, intervals=2,
+                      availability=0.9, window_s=3600.0,
+                      tenants="a:3,b:1", name="climodel",
+                      output=str(out))
+    assert summary["alertsFired"]["page"] == 0
+    assert len(summary["timeline"]) == 2
+    assert all(t["scaleHint"] in ("up", "hold", "down")
+               for t in summary["timeline"])
+    assert summary["scaleHints"]["climodel"] in ("up", "hold", "down")
+    assert (out / "slo_summary.json").exists()
+    prom = (out / "metrics.prom").read_text()
+    assert "tg_slo_budget_remaining" in prom
+    captured = capsys.readouterr().out
+    assert '"slice"' in captured
+
+
+def test_cli_doctor_renders_slo_block(model, tmp_path, monkeypatch,
+                                      capsys):
+    from transmogrifai_tpu.cli import run_doctor
+    monkeypatch.setenv("TG_POSTMORTEM_DIR", str(tmp_path))
+    with ServingRuntime(model, "doc", ServeConfig(max_batch=8)) as rt:
+        rt.score(_rows(1)[0], timeout=30)
+        if rt.sampler is not None:
+            rt.sampler.tick()
+            rt._evaluate_slo(rt.sampler, None)
+        path = obs_postmortem.trigger(
+            "slo_budget_exhausted", metrics=rt.metrics,
+            detail={"model": "doc", "objective": "availability"})
+    assert path is not None
+    result = run_doctor(path)
+    assert result["problems"] == []
+    out = capsys.readouterr().out
+    assert "SLO & budgets" in out
+    assert "slo_budget_exhausted" in out
+    assert "sampler[doc]" in out
+    # --json carries the raw doc through
+    doc = run_doctor(path, as_json=True)
+    assert doc["doc"]["trigger"]["kind"] == "slo_budget_exhausted"
+    capsys.readouterr()
+
+
+def test_specs_register_and_default():
+    obs_slo.register(obs_slo.SLOSpec(model="m", availability=0.95))
+    obs_slo.register(obs_slo.SLOSpec(model="m", tenant="t"))
+    assert [s.key for s in obs_slo.specs_for("m")] == ["m", "m/t"]
+    # re-register replaces, not duplicates
+    obs_slo.register(obs_slo.SLOSpec(model="m", availability=0.9))
+    assert len([s for s in obs_slo.registered_specs()
+                if s.key == "m"]) == 1
+    # unknown model → one default env-driven spec
+    default = obs_slo.specs_for("other")
+    assert len(default) == 1 and default[0].availability == pytest.approx(
+        obs_slo.DEFAULT_AVAILABILITY)
+    obs_slo.unregister("m/t")
+    assert [s.key for s in obs_slo.registered_specs()] == ["m"]
+
+
+def test_serve_summary_json_roundtrips(model):
+    """The new summary sections must stay JSON-serializable (the cli
+    serve/slo bundles dump them)."""
+    with ServingRuntime(model, "js", ServeConfig(max_batch=8)) as rt:
+        rt.submit(_rows(1)[0], tenant="a").result(timeout=30)
+        if rt.sampler is not None:
+            rt.sampler.tick()
+            rt._evaluate_slo(rt.sampler, None)
+        doc = json.loads(json.dumps(rt.summary(), default=str))
+    assert doc["scaleHint"]["hint"] in ("up", "hold", "down")
+    assert doc["tenants"]["a"]["rows"] == 1.0
+    assert os.path.sep  # keep the os import honest

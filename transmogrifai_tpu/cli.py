@@ -486,6 +486,138 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
         obs_metrics.enable_metrics(None)
 
 
+def run_slo(model_path: str, seconds: float = 5.0, rps: float = 0.0,
+            availability: Optional[float] = None,
+            p99_ms: Optional[float] = None,
+            window_s: Optional[float] = None,
+            tenants: Optional[str] = None,
+            intervals: int = 5, deadline_ms: Optional[float] = None,
+            name: str = "model", output: Optional[str] = None,
+            seed: int = 42) -> Dict[str, Any]:
+    """``op slo`` (docs/observability.md "SLOs, budgets & burn rates"):
+    load a saved model, register an SLO spec for it, drive the open-loop
+    load generator for ``seconds`` in ``intervals`` slices, and print a
+    scale-hint/budget-burn timeline plus the final per-objective
+    verdicts. Exits non-zero when a page-severity burn-rate alert fired
+    during the run — the CI-able "this model cannot hold its SLO at this
+    load" check.
+
+    ``--window-s`` scales the whole 30-day budget window down so a
+    seconds-long run exercises the full alert ladder (default 3600);
+    ``--tenants "a:3,b:1"`` adds a weighted multi-tenant traffic mix
+    with per-tenant budgets."""
+    import json as _json
+    import sys as _sys
+
+    from .observability import export as obs_export
+    from .observability import slo as _slo
+    from .observability import timeseries as _timeseries
+    from .serving import ModelRegistry, ServeConfig
+    from .serving.loadgen import run_open_loop, synthetic_rows
+
+    window = float(window_s) if window_s else 3600.0
+    # sample fast enough that the scaled alert windows (page long =
+    # window/720) hold several samples during a seconds-long run
+    every = max(min(seconds / max(intervals * 2, 1), 1.0), 0.05)
+    saved_env = {k: os.environ.get(k)
+                 for k in ("TG_SAMPLE_EVERY_S", "TG_SLO_WINDOW_S")}
+    os.environ["TG_SAMPLE_EVERY_S"] = str(every)
+    os.environ["TG_SLO_WINDOW_S"] = str(window)
+    tenant_mix = None
+    if tenants:
+        tenant_mix = []
+        for part in tenants.split(","):
+            t, _, w = part.strip().partition(":")
+            tenant_mix.append((t, float(w) if w else 1.0))
+    spec_kw: Dict[str, Any] = {"window_s": window}
+    if availability is not None:
+        spec_kw["availability"] = availability
+    if p99_ms is not None:
+        spec_kw["latency_p99_ms"] = p99_ms
+    _slo.register(_slo.SLOSpec(model=name, **spec_kw))
+    if tenant_mix:
+        for t, _w in tenant_mix:
+            _slo.register(_slo.SLOSpec(model=name, tenant=t, **spec_kw))
+    timeline: List[Dict[str, Any]] = []
+    try:
+        with ModelRegistry(ServeConfig.from_env()) as reg:
+            rt = reg.load(name, model_path)
+            rows = synthetic_rows(rt.model, 512, seed=seed)
+            if rps <= 0:
+                cal = run_open_loop(rt, rows, min(1.0, seconds),
+                                    200.0, tenants=tenant_mix)
+                rps = max(10.0, 0.5 * max(cal["rowsPerSec"], 20.0))
+            slice_s = seconds / max(intervals, 1)
+            agg = {"offered": 0, "completed": 0, "shedOverload": 0,
+                   "shedDeadline": 0, "failed": 0, "lost": 0}
+            for i in range(max(intervals, 1)):
+                rep = run_open_loop(rt, rows, slice_s, rps,
+                                    deadline_ms=deadline_ms,
+                                    tenants=tenant_mix, tenant_seed=i)
+                for k in agg:
+                    agg[k] += rep.get(k, 0)
+                if rt.sampler is not None:
+                    rt.sampler.tick()
+                rt._evaluate_slo(rt.sampler, None)
+                snap = rt.slo_snapshot() or {}
+                model_snap = snap.get(name, {})
+                avail = (model_snap.get("objectives", {})
+                         .get("availability", {}))
+                hint = _slo.scale_hint(rt, snap)
+                timeline.append({
+                    "t": round((i + 1) * slice_s, 2),
+                    "rowsPerSec": rep["rowsPerSec"],
+                    "p99Ms": rep["p99Ms"],
+                    "burnPageLong": round((avail.get("burn", {})
+                                           .get("page", {})
+                                           .get("long", 0.0)), 3),
+                    "budgetRemaining": round(
+                        avail.get("budgetRemaining", 1.0), 4),
+                    "verdict": model_snap.get("worst", "n/a"),
+                    "activeAlerts": model_snap.get("activeAlerts", []),
+                    "scaleHint": hint["hint"],
+                })
+                print(_json.dumps({"slice": timeline[-1]}, default=str),
+                      flush=True)
+            final = rt.slo_snapshot()
+            health = reg.health()
+            fired = {sev: sum(t.fired.get(sev, 0)
+                              for t in rt.slo_trackers)
+                     for sev in _slo.SEVERITIES}
+            summary = {
+                "model": model_path, "rpsOffered": round(rps, 1),
+                "windowS": window, "load": agg, "timeline": timeline,
+                "slo": final,
+                "scaleHint": health["models"][name]["scaleHint"],
+                "scaleHints": health["scaleHints"],
+                "tenants": health["models"][name].get("tenants"),
+                "alertsFired": fired,
+            }
+            if output:
+                os.makedirs(output, exist_ok=True)
+                obs_export.write_prometheus(
+                    os.path.join(output, "metrics.prom"),
+                    rt.metrics)
+                with open(os.path.join(output, "slo_summary.json"),
+                          "w") as fh:
+                    _json.dump(summary, fh, indent=2, default=str)
+        print(_json.dumps(summary, indent=2, default=str))
+        if fired.get("page", 0) > 0:
+            print(f"SLO: page-severity burn-rate alert fired "
+                  f"{fired['page']}x — budget cannot hold at this load",
+                  flush=True)
+            _sys.exit(1)
+        return summary
+    finally:
+        _slo.reset()
+        _timeseries.idle_join()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def run_campaign(schedules: int = 0, seed: Optional[int] = None,
                  scenario: Optional[str] = None,
                  faults_json: Optional[str] = None,
@@ -668,6 +800,37 @@ def run_doctor(bundle: str, as_json: bool = False,
             print(f"   mem[{sub}]: dispatches={s.get('dispatches')} "
                   f"predictedPeak={s.get('predictedPeakBytes')}B "
                   f"{measured}")
+    # SLO & budgets (bundle schema v3; docs/observability.md "SLOs,
+    # budgets & burn rates") — was the budget already burning before
+    # this incident, and what would the autoscaler have done?
+    slo_doc = doc.get("slo") or {}
+    if slo_doc:
+        print("-- SLO & budgets --")
+        for model, specs in sorted(slo_doc.items()):
+            for key, snap in sorted((specs or {}).items()):
+                objs = snap.get("objectives") or {}
+                parts = []
+                for obj, o in sorted(objs.items()):
+                    v = o.get("verdict", "?")
+                    rem = o.get("budgetRemaining")
+                    rem_s = (f" budget={rem:.3f}"
+                             if isinstance(rem, (int, float)) else "")
+                    parts.append(f"{obj}={v}{rem_s}")
+                active = snap.get("activeAlerts") or []
+                alert_s = ("  ALERTS: " + ", ".join(
+                    f"{a.get('severity')}:{a.get('objective')}"
+                    for a in active)) if active else ""
+                fired = snap.get("fired") or {}
+                fired_s = (f"  fired={fired}"
+                           if any(fired.values()) else "")
+                print(f"   {key:<20} {' '.join(parts)}"
+                      f"{alert_s}{fired_s}"[:200])
+    samples = doc.get("samples") or []
+    for src in samples[:4]:
+        print(f"   sampler[{src.get('source', '?')}]: "
+              f"{src.get('samples', 0)} samples / "
+              f"{src.get('series', 0)} series @ "
+              f"{src.get('everyS', '?')}s")
     faults_doc = doc.get("faults") or {}
     buckets = {k: len(v) for k, v in faults_doc.items()
                if isinstance(v, list) and v}
@@ -734,6 +897,39 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="directory for the telemetry bundle (trace.json / "
                          "spans.jsonl / metrics.prom / serve_summary.json)")
     sv.add_argument("--seed", type=int, default=42)
+    so = sub.add_parser(
+        "slo", help="load a saved model, drive open-loop load, and "
+                    "report SLO verdicts, budget burn and scale-hint "
+                    "timeline; exits non-zero when a page-severity "
+                    "burn-rate alert fires (docs/observability.md)")
+    so.add_argument("--model", required=True,
+                    help="saved model directory (OpWorkflowModel.save)")
+    so.add_argument("--seconds", type=float, default=5.0,
+                    help="total load duration")
+    so.add_argument("--rps", type=float, default=0.0,
+                    help="offered requests/sec (0 = auto-calibrate)")
+    so.add_argument("--availability", type=float, default=None,
+                    help="availability target (default "
+                         "TG_SLO_AVAILABILITY or 0.999)")
+    so.add_argument("--p99-ms", type=float, default=None,
+                    help="latency objective: windowed p99 target in ms "
+                         "(unset = availability/freshness only)")
+    so.add_argument("--window-s", type=float, default=None,
+                    help="scaled SLO budget window in seconds (default "
+                         "3600 — the 30-day methodology compressed so "
+                         "a seconds-long run exercises the alert "
+                         "ladder)")
+    so.add_argument("--tenants", default=None,
+                    help='weighted tenant mix, e.g. "a:3,b:1" — adds '
+                         "per-tenant budgets and a per-tenant report")
+    so.add_argument("--intervals", type=int, default=5,
+                    help="timeline resolution (load slices)")
+    so.add_argument("--deadline-ms", type=float, default=None)
+    so.add_argument("--name", default="model", help="registry model name")
+    so.add_argument("--output", default=None,
+                    help="directory for slo_summary.json + metrics.prom "
+                         "(windowed series included)")
+    so.add_argument("--seed", type=int, default=42)
     cp = sub.add_parser(
         "campaign", help="run a seeded chaos campaign — randomized "
                          "multi-fault schedules against real scenario "
@@ -787,6 +983,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                   deadline_ms=a.deadline_ms, max_batch=a.max_batch,
                   queue_max=a.queue_max, name=a.name, output=a.output,
                   seed=a.seed)
+    elif a.command == "slo":
+        run_slo(a.model, seconds=a.seconds, rps=a.rps,
+                availability=a.availability, p99_ms=a.p99_ms,
+                window_s=a.window_s, tenants=a.tenants,
+                intervals=a.intervals, deadline_ms=a.deadline_ms,
+                name=a.name, output=a.output, seed=a.seed)
     elif a.command == "campaign":
         run_campaign(schedules=a.schedules, seed=a.seed,
                      scenario=a.scenario, faults_json=a.faults,

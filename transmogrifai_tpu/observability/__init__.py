@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from . import blackbox, devicemem, export, ledger, metrics  # noqa: F401
-from . import postmortem, trace  # noqa: F401
+from . import postmortem, slo, timeseries, trace  # noqa: F401
 from .blackbox import (  # noqa: F401
     FlightRecorder, blackbox_enabled, correlated, current_correlation,
     enable_blackbox, new_correlation_id, recorder,
@@ -38,6 +38,10 @@ from .ledger import ledger as compile_ledger  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry, enable_metrics, inc_counter, metrics_enabled, observe,
     registry, set_gauge,
+)
+from .slo import SLOSpec, SLOTracker, scale_hint  # noqa: F401
+from .timeseries import (  # noqa: F401
+    MetricsSampler, enable_sampler, sampler_enabled, sketch_delta,
 )
 from .trace import (  # noqa: F401
     Span, Tracer, add_event, enable_tracing, span, tracer, tracing_enabled,
@@ -54,6 +58,8 @@ def reset() -> None:
     postmortem.reset()
     ledger.reset()
     devicemem.reset()
+    timeseries.reset()
+    slo.reset()
 
 
 def summarize(tr: Optional[trace.Tracer] = None,
@@ -141,4 +147,8 @@ def summarize(tr: Optional[trace.Tracer] = None,
         # bytes (docs/observability.md "Compile & memory ledger")
         "compileLedger": ledger.ledger().snapshot(),
         "deviceMemory": devicemem.observatory().snapshot(),
+        # windowed-sampler + SLO-budget state: registered specs, attached
+        # sampler accounting, per-model verdicts + scale hints
+        # (docs/observability.md "SLOs, budgets & burn rates")
+        "slo": slo.summarize(),
     }

@@ -22,6 +22,15 @@ the same discipline as every other artifact this framework writes.
   scrapers built against them.
 * :func:`write_jsonl` — one JSON object per finished span, for ad-hoc
   ``jq``/pandas analysis of long runs.
+
+When the rendered registry is attached to the windowed time-series
+sampler (``observability/timeseries.py``), the exposition additionally
+carries the **windowed series as gauges with a ``window`` label**
+(``TG_SAMPLE_WINDOWS``, default ``60,300`` seconds): every counter gets
+a ``<name>_rate{...,window="60"}`` per-second rate and every histogram
+gets ``<name>_p50/_p95/_p99{...,window="60"}`` windowed quantiles (SPDT
+sketch subtraction) — the scrape-side view of the same numbers the SLO
+engine (``observability/slo.py``) burns budgets on.
 """
 from __future__ import annotations
 
@@ -76,13 +85,76 @@ def write_chrome_trace(path: str,
 PROM_COMPAT_ENV = "TG_PROM_SUMMARY_COMPAT"
 _FALSY = ("", "0", "false", "False", "no")
 
+#: comma-separated window lengths (seconds) for the windowed exposition
+SAMPLE_WINDOWS_ENV = "TG_SAMPLE_WINDOWS"
+DEFAULT_SAMPLE_WINDOWS = (60.0, 300.0)
+
 
 def _prom_compat() -> bool:
     return os.environ.get(PROM_COMPAT_ENV, "") not in _FALSY
 
 
+def export_windows() -> List[float]:
+    raw = os.environ.get(SAMPLE_WINDOWS_ENV, "")
+    if not raw:
+        return list(DEFAULT_SAMPLE_WINDOWS)
+    out: List[float] = []
+    for part in raw.split(","):
+        try:
+            v = float(part.strip())
+            if v > 0:
+                out.append(v)
+        except ValueError:
+            continue
+    return out or list(DEFAULT_SAMPLE_WINDOWS)
+
+
+def windowed_prometheus_lines(sampler, windows: Optional[List[float]] = None
+                              ) -> List[str]:
+    """The windowed exposition block (module docstring): counter rates
+    and histogram quantiles over each window as gauges carrying a
+    ``window`` label. Empty when the sampler holds fewer than two
+    samples (no window to subtract yet)."""
+    if sampler is None or sampler.snapshot()["samples"] < 2:
+        return []
+    labels_of = _metrics._labels
+    num = _metrics._num
+    windows = windows if windows is not None else export_windows()
+    lines: List[str] = []
+    for name in sampler.counter_names():
+        series_name = f"{name}_rate"
+        emitted_type = False
+        for lbls in sampler.series_labels(name):
+            for w in windows:
+                v = sampler.rate(name, w, **lbls)
+                if not emitted_type:
+                    lines.append(f"# TYPE {series_name} gauge")
+                    emitted_type = True
+                lines.append(
+                    f"{series_name}"
+                    f"{labels_of({**lbls, 'window': f'{w:g}'})} {num(v)}")
+    for name in sampler.histogram_names():
+        for q in _metrics.QUANTILES:
+            series_name = f"{name}_p{int(q * 100)}"
+            emitted_type = False
+            for lbls in sampler.series_labels(name):
+                for w in windows:
+                    v = sampler.quantile(name, q, w, **lbls)
+                    if not math.isfinite(v):
+                        continue
+                    if not emitted_type:
+                        lines.append(f"# TYPE {series_name} gauge")
+                        emitted_type = True
+                    lines.append(
+                        f"{series_name}"
+                        f"{labels_of({**lbls, 'window': f'{w:g}'})} "
+                        f"{num(v)}")
+    return lines
+
+
 def prometheus_text(registry: Optional[_metrics.MetricsRegistry] = None,
-                    compat: Optional[bool] = None) -> str:
+                    compat: Optional[bool] = None,
+                    sampler: Optional[Any] = None) -> str:
     """Render a registry in the Prometheus text exposition format
     (validated against the format grammar in tests/test_blackbox.py).
 
@@ -128,6 +200,12 @@ def prometheus_text(registry: Optional[_metrics.MetricsRegistry] = None,
                              f"{m.count}")
             else:
                 lines.append(f"{name}{labels_of(m.labels)} {num(m.value)}")
+    # windowed exposition: when the registry is sampled, append its
+    # counter rates + histogram quantiles as window-labelled gauges
+    if sampler is None:
+        from . import timeseries as _timeseries
+        sampler = _timeseries.sampler_for(reg)
+    lines.extend(windowed_prometheus_lines(sampler))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

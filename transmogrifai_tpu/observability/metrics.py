@@ -171,6 +171,13 @@ class Histogram:
             return float("nan")
         return float(self._sketch.quantile(q))
 
+    def sketch_state(self) -> Dict[str, Any]:
+        """Checkpointable sketch state (plain arrays) — the windowed
+        time-series sampler (observability/timeseries.py) snapshots this
+        each tick so window-start-vs-now sketch subtraction can compute
+        windowed quantiles."""
+        return self._sketch.to_state()
+
     def exemplars(self) -> List[Dict[str, Any]]:
         """The slowest-K tagged observations, largest first:
         ``[{"value": seconds, "exemplar": corr-id}]``."""

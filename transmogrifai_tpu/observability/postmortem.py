@@ -13,12 +13,13 @@ torn bundle) written to ``TG_POSTMORTEM_DIR`` and rate-limited to
 land in the ring as ``postmortem.suppressed`` events — a storm of
 triggers cannot turn the incident into a disk-filling incident).
 
-Bundle schema (``schemaVersion`` 2; validated by :func:`validate_bundle`
-— which still accepts version-1 bundles from pre-ledger processes — and
-rendered by ``cli.py doctor``)::
+Bundle schema (``schemaVersion`` 3; validated by :func:`validate_bundle`
+— which still accepts version-1 bundles from pre-ledger processes and
+version-2 bundles from pre-SLO processes — and rendered by ``cli.py
+doctor``)::
 
     {
-      "schemaVersion": 2,
+      "schemaVersion": 3,
       "trigger":     {"kind", "tsNs", "unixTime", "corr", "detail"},
       "pid":         <int>,
       "recorder":    {"events": [...], "dropped", "maxEvents",
@@ -31,13 +32,17 @@ rendered by ``cli.py doctor``)::
       "ledger":      {"counts", "tail"},  // compile-ledger tail (v2;
                                           // observability/ledger.py)
       "deviceMemory": {...},  // devicemem observatory snapshot (v2)
+      "slo":         {...},   // per-model SLO tracker snapshots (v3;
+                              // observability/slo.py)
+      "samples":     [...],   // recent windowed-sampler samples (v3;
+                              // observability/timeseries.py)
       "environment": {"jax", "jaxlib", "backend", "devices", "python"}
     }
 
 Trigger kinds (docs/observability.md "Flight recorder & post-mortems"
 carries the full table): ``breaker_open``, ``thread_stalled``,
 ``oom_downshift``, ``drift_degraded``, ``unclean_exit``,
-``campaign_violation``, ``campaign_escape``.
+``campaign_violation``, ``campaign_escape``, ``slo_budget_exhausted``.
 """
 from __future__ import annotations
 
@@ -53,10 +58,12 @@ from typing import Any, Dict, List, Optional
 from . import blackbox as _blackbox
 
 #: current bundle schema. v2 (PR 12) added the compile-ledger tail and
-#: the device-memory snapshot; v1 bundles (no such sections) must stay
-#: readable — validate_bundle accepts every SUPPORTED_SCHEMA_VERSIONS
-SCHEMA_VERSION = 2
-SUPPORTED_SCHEMA_VERSIONS = (1, 2)
+#: the device-memory snapshot; v3 (PR 13) added the SLO tracker
+#: snapshots and the recent windowed-sampler samples; older bundles (no
+#: such sections) must stay readable — validate_bundle accepts every
+#: SUPPORTED_SCHEMA_VERSIONS
+SCHEMA_VERSION = 3
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
 #: how many ledger records a bundle carries (most recent builds)
 LEDGER_TAIL = 32
 
@@ -82,6 +89,7 @@ TRIGGER_KINDS = (
     "unclean_exit",        # resume found a different-pid run sentinel
     "campaign_violation",  # a chaos schedule violated an invariant oracle
     "campaign_escape",     # a typed error escaped a campaign scenario
+    "slo_budget_exhausted",  # an SLO error budget fully burned (slo.py)
 )
 
 _LOCK = threading.Lock()
@@ -227,6 +235,24 @@ def trigger(kind: str, corr: Optional[str] = None,
             "tail": [r.to_json() for r in led.tail(LEDGER_TAIL)],
         }
         doc["deviceMemory"] = _devicemem.observatory().snapshot()
+        # SLO & sampler context (schema v3): per-model budget/alert
+        # snapshots and the recent windowed samples — the "was the SLO
+        # already burning before this incident?" context. The serving
+        # module is only consulted when already loaded (a train-side
+        # trigger must not drag the serving stack in).
+        import sys as _sys
+        slo_doc: Dict[str, Any] = {}
+        rt_mod = _sys.modules.get("transmogrifai_tpu.serving.runtime")
+        if rt_mod is not None:
+            for rt in rt_mod.live_runtimes():
+                snap = rt.slo_snapshot()
+                if snap is not None:
+                    slo_doc[rt.name] = snap
+        doc["slo"] = slo_doc
+        from . import timeseries as _timeseries
+        doc["samples"] = [{"source": s.name, **s.snapshot(),
+                           "recent": s.recent(8)}
+                          for s in _timeseries.attached()]
     except Exception as e:  # context gathering must not kill the dump
         doc["contextError"] = f"{type(e).__name__}: {e}"[:300]
     path = os.path.join(postmortem_dir(),
@@ -297,12 +323,18 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
         problems.append("missing environment section")
     if not isinstance(doc.get("pid"), int):
         problems.append("missing pid")
-    if version == 2:
-        # v2-only sections; v1 bundles predate the ledger and stay valid
+    if isinstance(version, int) and version >= 2:
+        # v2+ sections; v1 bundles predate the ledger and stay valid
         led = doc.get("ledger")
         if not isinstance(led, dict) or not isinstance(
                 led.get("tail"), list):
             problems.append("missing ledger section (schema v2)")
         if not isinstance(doc.get("deviceMemory"), dict):
             problems.append("missing deviceMemory section (schema v2)")
+    if isinstance(version, int) and version >= 3:
+        # v3 sections; v2 bundles predate the SLO engine and stay valid
+        if not isinstance(doc.get("slo"), dict):
+            problems.append("missing slo section (schema v3)")
+        if not isinstance(doc.get("samples"), list):
+            problems.append("missing samples section (schema v3)")
     return problems

@@ -28,7 +28,8 @@ from typing import Iterable, List, Optional
 
 #: thread-name prefixes owned by framework worker threads; anything alive
 #: with one of these names after a close/teardown is a leak
-THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog")
+THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog",
+                   "tg-sampler")
 
 
 # -- probes (read-only) ------------------------------------------------------
@@ -126,6 +127,49 @@ def ledger_violations() -> List[str]:
     return out
 
 
+def leaked_sampler_sources() -> List[str]:
+    """Names of registries still attached to the shared windowed-sampler
+    thread (observability/timeseries.py) — every attached source keeps
+    the ``tg-sampler`` thread alive and snapshots its registry forever."""
+    from ..observability import timeseries as _ts
+    return [s.name for s in _ts.attached()]
+
+
+def registered_slo_specs() -> List[str]:
+    """Keys of SLO specs still registered (observability/slo.py) — a spec
+    leaked by a test silently changes every later runtime's budgets."""
+    from ..observability import slo as _slo
+    return [s.key for s in _slo.registered_specs()]
+
+
+def slo_violations() -> List[str]:
+    """Sampler/SLO state that must not outlive a test or a campaign
+    schedule: attached sampler sources, registered specs, and a lingering
+    forced TG_SAMPLER override (mirrors ``blackbox_violations``)."""
+    from ..observability import timeseries as _ts
+    out: List[str] = []
+    srcs = leaked_sampler_sources()
+    if srcs:
+        out.append(f"sampler source(s) still attached: {srcs}")
+    specs = registered_slo_specs()
+    if specs:
+        out.append(f"SLO spec(s) still registered: {specs}")
+    if _ts._enabled_override is not None:
+        out.append("a forced sampler enable/disable override is active")
+    return out
+
+
+def clean_slo_state() -> List[str]:
+    """Force-detach sampler sources, drop registered specs, retire the
+    tg-sampler thread; returns what was cleaned."""
+    from ..observability import slo as _slo
+    from ..observability import timeseries as _ts
+    cleaned = leaked_sampler_sources() + registered_slo_specs()
+    _ts.reset()
+    _slo.reset()
+    return cleaned
+
+
 def plan_cache_violations() -> List[str]:
     """The compiled-plan LRU must stay bounded and no forced
     planner-enable override may linger."""
@@ -201,13 +245,17 @@ def campaign_violations(clean: bool = True,
     hearts = leaked_watchdog_hearts()
     if hearts:
         out.append(f"watchdog heart(s) leaked: {hearts}")
+    out.extend(slo_violations())
     if clean:
         close_leaked_serving()
         close_leaked_feeds()
         close_leaked_hearts()
+        clean_slo_state()
     else:
         from . import watchdog as _wd
+        from ..observability import timeseries as _ts
         _wd.idle_join()
+        _ts.idle_join()
     threads = leaked_threads()
     if threads:
         out.append(f"worker thread(s) survived: {threads}")

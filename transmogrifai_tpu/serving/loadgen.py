@@ -59,11 +59,39 @@ def synthetic_rows(model, n: int, seed: int = 0) -> List[Dict[str, Any]]:
 def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
                   seconds: float, rps: float,
                   deadline_ms: Optional[float] = None,
-                  drain_timeout: float = 30.0) -> Dict[str, Any]:
+                  drain_timeout: float = 30.0,
+                  tenants: Optional[List[Any]] = None,
+                  tenant_seed: int = 0) -> Dict[str, Any]:
     """Offer ``rps`` requests/sec for ``seconds`` (cycling through
-    ``rows``), drain, and return the load report."""
+    ``rows``), drain, and return the load report.
+
+    ``tenants`` turns on the multi-tenant traffic mix: a weighted list
+    of ``(tenant name, weight)`` pairs (or bare names, equal weights).
+    Each arrival draws its tenant from the mix (deterministic under
+    ``tenant_seed``), submits with ``tenant=...`` so the runtime counts
+    the per-tenant twin series the SLO budgets read
+    (observability/slo.py), and the report grows a per-tenant
+    ``tenants`` breakdown with the same accounting buckets."""
     if rps <= 0:
         raise ValueError(f"rps must be > 0, got {rps}")
+    tenant_names: List[str] = []
+    tenant_probs = None
+    tenant_rng = None
+    if tenants:
+        pairs = [(t, 1.0) if isinstance(t, str) else (str(t[0]), float(t[1]))
+                 for t in tenants]
+        total_w = sum(w for _, w in pairs) or 1.0
+        tenant_names = [t for t, _ in pairs]
+        tenant_probs = np.asarray([w / total_w for _, w in pairs])
+        tenant_rng = np.random.RandomState(tenant_seed)
+
+    def _tenant_bucket(t):
+        return per_tenant.setdefault(t, {
+            "offered": 0, "completed": 0, "quarantined": 0,
+            "shedOverload": 0, "shedDeadline": 0, "submitErrors": 0,
+            "failed": 0, "lost": 0})
+
+    per_tenant: Dict[str, Dict[str, int]] = {}
     interval = 1.0 / rps
     start = time.monotonic()
     t_end = start + seconds
@@ -79,9 +107,15 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         # submit every arrival whose schedule time has passed (bursts when
         # the process fell behind — open-loop arrivals do not wait)
         while next_at <= now and next_at < t_end:
+            tenant = None
+            if tenant_names:
+                tenant = tenant_names[int(tenant_rng.choice(
+                    len(tenant_names), p=tenant_probs))]
+                _tenant_bucket(tenant)["offered"] += 1
             try:
                 fut = runtime.submit(rows[i % len(rows)],
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     tenant=tenant)
                 # the runtime stamps each accepted request's
                 # flight-recorder correlation id on its future
                 # (observability/blackbox.py) — remember it with the
@@ -92,13 +126,17 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
                 fut.add_done_callback(
                     lambda f: _done_at.setdefault(f, time.monotonic()))
                 futures.append((fut, getattr(fut, "tg_corr", None),
-                                time.monotonic()))
+                                time.monotonic(), tenant))
             except OverloadError:
                 shed_submit += 1
+                if tenant is not None:
+                    _tenant_bucket(tenant)["shedOverload"] += 1
             except Exception:
                 # injected serve.enqueue chaos / runtime stopping: counted,
                 # the generator keeps offering load
                 submit_errors += 1
+                if tenant is not None:
+                    _tenant_bucket(tenant)["submitErrors"] += 1
             offered += 1
             i += 1
             next_at += interval
@@ -110,22 +148,33 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     completed = quarantined = shed_deadline = failed = lost = 0
     slowest: List[Dict[str, Any]] = []
     drain_deadline = time.monotonic() + drain_timeout
-    for fut, corr, submitted_at in futures:
+    for fut, corr, submitted_at, tenant in futures:
+        tb = _tenant_bucket(tenant) if tenant is not None else None
         try:
             rec = fut.result(timeout=max(0.1, drain_deadline
                                          - time.monotonic()))
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
+                if tb:
+                    tb["quarantined"] += 1
             completed += 1
+            if tb:
+                tb["completed"] += 1
             slowest.append({"corr": corr, "ms": round(
                 (_done_at.get(fut, time.monotonic())
                  - submitted_at) * 1e3, 3)})
         except DeadlineExceededError:
             shed_deadline += 1
+            if tb:
+                tb["shedDeadline"] += 1
         except FuturesTimeoutError:
             lost += 1
+            if tb:
+                tb["lost"] += 1
         except Exception:
             failed += 1
+            if tb:
+                tb["failed"] += 1
     # the slowest-K completed requests BY ID: drain-side wall times are
     # an upper bound on the serve latency (the drain loop walks futures in
     # submit order), but the ids are exact — each links to its recorder
@@ -162,4 +211,8 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         "slowestRequests": slowest,
         "degradedRows": summary.get("degradedRows", 0.0),
         "breaker": summary.get("breaker", {}),
+        # per-tenant accounting (same buckets as the totals; None
+        # without a tenant mix) — the per-tenant-budget tests and the
+        # BENCH_MODE=serve tenant line read this
+        "tenants": per_tenant or None,
     }

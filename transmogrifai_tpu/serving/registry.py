@@ -291,6 +291,13 @@ class ModelRegistry:
             "ready": bool(models) and all(
                 m["state"] == "ready" for m in models.values()),
             "models": models,
+            # the fleet's autoscaling view in one map: each model's
+            # up/hold/down hint (derived from queue depth, shed rate,
+            # breaker state, SLO burn and drift verdict — each entry's
+            # full reasons live in models[name]["scaleHint"]); the
+            # artifact ROADMAP item 2's replica controller consumes
+            "scaleHints": {name: m["scaleHint"]["hint"]
+                           for name, m in models.items()},
             "refitsInFlight": inflight,
             "refits": list(self.refit_history),
         }

@@ -74,6 +74,8 @@ from ..observability import blackbox as _blackbox
 from ..observability import ledger as _obs_ledger
 from ..observability import metrics as _obs_metrics
 from ..observability import postmortem as _postmortem
+from ..observability import slo as _slo
+from ..observability import timeseries as _timeseries
 from ..observability.trace import add_event as _obs_event
 from ..observability.trace import span as _obs_span
 from ..robustness import faults, resources
@@ -158,6 +160,10 @@ class _Request:
     #: also exposed on the Future as ``tg_corr`` so callers (loadgen,
     #: the exemplar reports) can name their requests
     corr: Optional[str] = None
+    #: optional tenant label: per-tenant twin series (tg_serve_tenant_*)
+    #: feed per-tenant SLO budgets (observability/slo.py); flows through
+    #: the TG_METRICS_MAX_LABELS cardinality bound like any label
+    tenant: Optional[str] = None
 
 
 #: live (started, not yet closed) runtimes — the conftest no-leak fixture
@@ -214,6 +220,12 @@ class ServingRuntime:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._heart = None  # watchdog heartbeat (set in start())
+        #: windowed time-series source over the serve-local registry
+        #: (None when TG_SAMPLER=0; set in start(), detached in close())
+        self.sampler: Optional[_timeseries.MetricsSampler] = None
+        #: one SLO tracker per registered spec for this model (default
+        #: env-driven spec when none registered; observability/slo.py)
+        self.slo_trackers: List[_slo.SLOTracker] = []
         self.breaker = breaker or CircuitBreaker(
             name=name,
             failure_threshold=self.config.breaker_failures,
@@ -240,6 +252,18 @@ class ServingRuntime:
         self._heart = _watchdog.register(
             f"tg-serve[{self.name}]", kind="serve.batcher",
             on_stall=self._on_watchdog_stall, fault_log=self.fault_log)
+        # windowed telemetry + SLO budgets: attach the serve-local
+        # registry to the shared tg-sampler thread and evaluate every
+        # registered SLO spec on its tick cadence (TG_SAMPLER=0 opts the
+        # whole plane out — no thread, no trackers, zero writes)
+        if self.sampler is None:
+            self.sampler = _timeseries.attach(self.metrics, name=self.name)
+        if self.sampler is not None and not self.slo_trackers:
+            self.slo_trackers = [
+                _slo.SLOTracker(spec, self.sampler, self.metrics,
+                                runtime=self)
+                for spec in _slo.specs_for(self.name)]
+            self.sampler.on_sample.append(self._evaluate_slo)
         self._thread = threading.Thread(
             target=self._loop, name=f"tg-serve[{self.name}]", daemon=True)
         self._thread.start()
@@ -279,6 +303,8 @@ class ServingRuntime:
                     model=self.name)
         if self._heart is not None:
             self._heart.close()
+        _timeseries.detach(self.sampler)
+        self.sampler = None
         with self._cond:
             self._closed = True
         with _LIVE_LOCK:
@@ -302,11 +328,17 @@ class ServingRuntime:
 
     # -- request API ---------------------------------------------------------
     def submit(self, row: Dict[str, Any],
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the result
         record (``{feature name: value}``; quarantined rows carry
         ``__score_error__``). Raises :class:`OverloadError` when the queue
-        is full and :class:`RuntimeStoppedError` when not running."""
+        is full and :class:`RuntimeStoppedError` when not running.
+
+        ``tenant`` labels the request for per-tenant SLO budgets: its
+        outcome is additionally counted on the ``tg_serve_tenant_*``
+        twin series (rows / shed / quarantined / latency), bounded by
+        the registry's TG_METRICS_MAX_LABELS cardinality guard."""
         # deterministic chaos entry: an injected fault here models an
         # admission-layer failure (e.g. the listener thread dying)
         faults.inject("serve.enqueue", key=self.name)
@@ -328,6 +360,8 @@ class ServingRuntime:
             if len(self._queue) >= self.config.max_queue:
                 self._count("tg_serve_shed_total", reason="overload",
                             help="requests shed (docs/serving.md)")
+                if tenant is not None:
+                    self._count_tenant("tg_serve_tenant_shed_total", tenant)
                 if boxed:
                     _blackbox.record("serve.shed", corr=corr,
                                      model=self.name, reason="overload",
@@ -335,7 +369,8 @@ class ServingRuntime:
                 raise OverloadError(
                     f"serve queue for model '{self.name}' is full "
                     f"({self.config.max_queue} pending); request shed")
-            self._queue.append(_Request(row, fut, now, deadline, corr))
+            self._queue.append(_Request(row, fut, now, deadline, corr,
+                                        tenant))
             depth = len(self._queue)
             self._set_gauge("tg_serve_queue_depth", float(depth),
                             help="requests waiting for a flush")
@@ -444,6 +479,9 @@ class ServingRuntime:
             if r.deadline is not None and now >= r.deadline:
                 self._count("tg_serve_shed_total", reason="deadline",
                             help="requests shed (docs/serving.md)")
+                if r.tenant is not None:
+                    self._count_tenant("tg_serve_tenant_shed_total",
+                                       r.tenant)
                 _blackbox.record("serve.shed", corr=r.corr,
                                  model=self.name, reason="deadline")
                 self._fail_future(r.future, DeadlineExceededError(
@@ -565,9 +603,20 @@ class ServingRuntime:
         for r, rec in zip(reqs, recs):
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
+                if r.tenant is not None:
+                    self._count_tenant("tg_serve_tenant_quarantined_total",
+                                       r.tenant)
             if r.future.cancelled():
                 continue
             seconds = now - r.enqueued
+            if r.tenant is not None:
+                # per-tenant twin series: the tenant-budget SLO trackers'
+                # SLI inputs (observability/slo.py)
+                self._count_tenant("tg_serve_tenant_rows_total", r.tenant)
+                self.metrics.histogram(
+                    "tg_serve_tenant_request_seconds",
+                    "per-tenant enqueue-to-result latency",
+                    model=self.name, tenant=r.tenant).observe(seconds)
             # the request's latency histogram keeps the correlation ids
             # of its slowest observations as exemplars — a p99 outlier
             # links straight to its recorder timeline
@@ -659,6 +708,52 @@ class ServingRuntime:
         self.metrics.counter(name, help, model=self.name, **labels).inc(n)
         _obs_metrics.inc_counter(name, n, help, model=self.name, **labels)
 
+    def _count_tenant(self, name: str, tenant: str, n: float = 1.0) -> None:
+        """Per-tenant twin counter (serve-local + gated global mirror);
+        the label flows through TG_METRICS_MAX_LABELS like any other."""
+        self._count(name, n, help="per-tenant serve accounting "
+                    "(docs/serving.md)", tenant=tenant)
+
+    def _evaluate_slo(self, _sampler, now: float) -> None:
+        """Sampler tick hook: run every tracker's evaluation pass. Fenced
+        per tracker — a broken SLO evaluation must never stop the others
+        (the hook runner in timeseries.py fences the whole call too)."""
+        for t in self.slo_trackers:
+            try:
+                t.evaluate(now)
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+    def slo_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Per-spec SLO snapshots keyed by spec key (``model`` or
+        ``model/tenant``); None when the sampler is disabled (no windowed
+        telemetry → no budgets)."""
+        if not self.slo_trackers:
+            return None
+        return {t.key: t.snapshot() for t in self.slo_trackers}
+
+    def _tenant_breakdown(self, snap: Dict[str, Dict[str, Any]]
+                          ) -> Optional[Dict[str, Dict[str, Any]]]:
+        """Per-tenant accounting from the twin series; None when no
+        request ever carried a tenant label."""
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for name, field in (("tg_serve_tenant_rows_total", "rows"),
+                            ("tg_serve_tenant_shed_total", "shed"),
+                            ("tg_serve_tenant_quarantined_total",
+                             "quarantined")):
+            for key, v in snap.get(name, {}).items():
+                kv = dict(p.split("=", 1) for p in key.split(",")
+                          if "=" in p)
+                if kv.get("model") != self.name or "tenant" not in kv:
+                    continue
+                tenants.setdefault(kv["tenant"], {})[field] = v
+        for key, v in snap.get("tg_serve_tenant_request_seconds",
+                               {}).items():
+            kv = dict(p.split("=", 1) for p in key.split(",") if "=" in p)
+            if kv.get("model") == self.name and "tenant" in kv:
+                tenants.setdefault(kv["tenant"], {})["latency"] = v
+        return tenants or None
+
     def _observe(self, name: str, v: float, help: str = "",
                  exemplar: Any = None) -> None:
         self.metrics.histogram(name, help, model=self.name).observe(
@@ -725,6 +820,13 @@ class ServingRuntime:
             # (serving/drift.py); None when no monitor is attached
             "drift": (self.drift_monitor.snapshot()
                       if self.drift_monitor is not None else None),
+            # per-spec SLO verdicts/budgets (None when TG_SAMPLER=0) and
+            # the derived autoscaling signal — the readiness artifact
+            # ROADMAP item 2 consumes (observability/slo.py)
+            "slo": self.slo_snapshot(),
+            "scaleHint": _slo.scale_hint(self, self.slo_snapshot()),
+            # per-tenant accounting breakdown (None without tenants)
+            "tenants": self._tenant_breakdown(snap),
         }
 
     def health_state(self) -> str:
