@@ -618,6 +618,158 @@ def _run_serve(platform):
             "phases": phases,
         }), flush=True)
 
+    # ---- replica fleet lines (round 14; docs/serving.md "Replica fleet
+    # & front door"): saturated rows/sec + p99 across 1→2→4 local
+    # replicas behind the front door, then a kill-chaos soak asserting
+    # the fleet invariant — zero lost requests + a replica_lost
+    # post-mortem — with the warm-path zero-compile tripwire green on
+    # EVERY replica before any line runs.
+    import threading as _threading
+
+    from transmogrifai_tpu.serving import FleetConfig, FrontDoor
+    fleet_counts = [int(x) for x in os.environ.get(
+        "BENCH_FLEET_REPLICAS", "1,2,4").split(",") if x.strip()]
+    fleet_seconds = float(os.environ.get("BENCH_FLEET_SECONDS", seconds))
+    fdir = _tempfile.mkdtemp(prefix="tg_bench_fleet_model_")
+    fleet_pm = _tempfile.mkdtemp(prefix="tg_bench_fleet_pm_")
+    os.environ["TG_POSTMORTEM_DIR"] = fleet_pm
+    try:
+        model.save(fdir)
+        fleet_lines = {}
+        for nrep in fleet_counts:
+            fc = FleetConfig(min_replicas=1, max_replicas=max(nrep, 1),
+                             probe_interval_ms=200.0, autoscale=False)
+            amark = _obs_ledger.ledger().mark()
+            with FrontDoor({"m": fdir}, replicas=nrep, config=cfg,
+                           fleet_config=fc, warm=True) as fd:
+                # warm tripwire, per replica: after every replica's
+                # manifest-warm pre-trace, a real request through EACH
+                # replica must record ZERO ledger compiles
+                wmark = _obs_ledger.ledger().mark()
+                for _rid, _rep in sorted(fd._replicas.items()):
+                    _rep.submit("m", rows[0]).result(timeout=30)
+                retraced = _obs_ledger.ledger().since(wmark)
+                for r in retraced:
+                    print(json.dumps(
+                        {"fleetWarmViolation": r.to_json()}), flush=True)
+                assert not retraced, (
+                    f"fleet warm path retraced {len(retraced)} "
+                    f"program(s) across {nrep} replica(s) — causes: "
+                    f"{[r.cause for r in retraced]}")
+                frep = run_open_loop(
+                    fd, rows, fleet_seconds,
+                    runtime_capacity * 1.2 * nrep,
+                    deadline_ms=deadline_ms)
+                assert frep["lost"] == 0 and frep["failed"] == 0, frep
+                assert frep["accountingOk"], frep
+            fleet_lines[nrep] = frep
+            print(json.dumps({
+                "metric": f"serve_fleet{nrep}_rows_per_sec_{d}feat_"
+                          f"{platform}",
+                "value": frep["rowsPerSec"],
+                "unit": "rows/sec",
+                "vs_baseline": round(
+                    frep["rowsPerSec"] / runtime_capacity, 3),
+                "phases": {
+                    "replicas": nrep,
+                    "offeredRps": frep["offeredRps"],
+                    "p50Ms": frep["p50Ms"], "p99Ms": frep["p99Ms"],
+                    "shedOverload": frep["shedOverload"],
+                    "shedDeadline": frep["shedDeadline"],
+                    "routing": frep["replicas"],
+                    "failovers": frep["fleet"]["failovers"],
+                    **_ledger_phases(amark),
+                },
+            }), flush=True)
+        if 1 in fleet_lines and 2 in fleet_lines:
+            factor = (fleet_lines[2]["rowsPerSec"]
+                      / max(fleet_lines[1]["rowsPerSec"], 1e-9))
+            cores = (len(os.sched_getaffinity(0))
+                     if hasattr(os, "sched_getaffinity")
+                     else (os.cpu_count() or 1))
+            # the ≥1.5× 2-replica scaling gate needs real parallel
+            # hardware: in-process replicas on a single-core host can
+            # only win on queueing, never on compute — the gate is
+            # capability-skipped there (same policy as the two-process
+            # CPU cluster test), with the measured factor still printed
+            gated = cores >= 2
+            print(json.dumps({
+                "metric": f"serve_fleet_scaling_2v1_{platform}",
+                "value": round(factor, 3),
+                "unit": "x",
+                "vs_baseline": round(factor, 3),
+                "phases": {"cores": cores,
+                           "scalingGate": ("enforced" if gated else
+                                           "skipped: single-core host")},
+            }), flush=True)
+            if gated:
+                assert factor >= 1.5, (
+                    f"2-replica fleet line sustained only {factor:.2f}x "
+                    f"the single-replica line (gate: >= 1.5x on "
+                    f"{cores} cores)")
+
+        # kill-chaos fleet line: one replica murdered mid-soak; the run
+        # must still account every request (zero lost, zero failed) and
+        # leave >= 1 schema-valid replica_lost post-mortem bundle
+        fc = FleetConfig(min_replicas=1, max_replicas=2,
+                         probe_interval_ms=100.0, max_failovers=3,
+                         autoscale=False)
+        with FrontDoor({"m": fdir}, replicas=2, config=cfg,
+                       fleet_config=fc, warm=True) as fd:
+            def _mid_soak_kill():
+                active = [rid for rid, r in sorted(fd._replicas.items())
+                          if r.state == "active"]
+                if active:
+                    fd.kill_replica(active[0])
+            killer = _threading.Timer(fleet_seconds / 2.0,
+                                      _mid_soak_kill)
+            killer.daemon = True
+            killer.start()
+            try:
+                krep = run_open_loop(fd, rows, fleet_seconds,
+                                     runtime_capacity * 0.8,
+                                     deadline_ms=deadline_ms)
+            finally:
+                killer.cancel()
+            ksnap = fd.fleet_snapshot()
+        assert krep["lost"] == 0 and krep["failed"] == 0, krep
+        assert krep["accountingOk"], krep
+        assert ksnap["kills"] >= 1, "kill timer never fired"
+        kbundles = _postmortem.list_bundles(fleet_pm)
+        kdocs = [_postmortem.read_bundle(p) for p in kbundles]
+        lost_docs = [d for d in kdocs
+                     if d["trigger"]["kind"] == "replica_lost"]
+        assert lost_docs, (
+            f"fleet kill soak dumped no replica_lost bundle "
+            f"(triggers: {[d['trigger']['kind'] for d in kdocs]})")
+        bad = [p for p, d in zip(kbundles, kdocs)
+               if _postmortem.validate_bundle(d)]
+        assert not bad, f"invalid post-mortem bundle(s): {bad}"
+        print(json.dumps({
+            "metric": f"serve_fleet_kill_rows_per_sec_{d}feat_"
+                      f"{platform}",
+            "value": krep["rowsPerSec"],
+            "unit": "rows/sec",
+            "vs_baseline": round(
+                krep["rowsPerSec"] / runtime_capacity, 3),
+            "phases": {
+                "replicas": 2, "kills": ksnap["kills"],
+                "failovers": ksnap["failovers"],
+                "lost": krep["lost"], "failed": krep["failed"],
+                "shedNoReplica": krep["shedNoReplica"],
+                "shedOverload": krep["shedOverload"],
+                "shedDeadline": krep["shedDeadline"],
+                "routing": krep["replicas"],
+                "postmortemBundles": len(kbundles),
+                "postmortemTriggers": sorted(
+                    {d["trigger"]["kind"] for d in kdocs}),
+            },
+        }), flush=True)
+    finally:
+        _shutil.rmtree(fdir, ignore_errors=True)
+        _shutil.rmtree(fleet_pm, ignore_errors=True)
+        os.environ.pop("TG_POSTMORTEM_DIR", None)
+
 
 def _run_stream(platform):
     """BENCH_MODE=stream: the out-of-core line (docs/streaming.md). Trains
@@ -887,7 +1039,9 @@ def _run_campaign(platform):
     """BENCH_MODE=campaign: the seeded fixed-budget chaos soak
     (docs/robustness.md "Chaos campaigns"). Runs BENCH_CAMPAIGN_SCHEDULES
     randomized multi-fault schedules (default 200; coverage singletons
-    for every registered site first) across all six scenario harnesses
+    for every registered site first — the fleet.* sites included, so the
+    site-coverage guard extends to the replica front door automatically)
+    across all seven scenario harnesses
     and asserts the campaign contract: 100% site coverage, ZERO invariant
     violations, and full serve request accounting (zero lost / zero
     failed futures). A violation prints the minimized one-command

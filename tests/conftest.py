@@ -231,6 +231,29 @@ def _no_serving_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_fleet_leak():
+    """A fleet front door owns a probe thread plus N replica registries'
+    worth of batcher threads — a leaked fleet keeps routing (and
+    spawning/retiring replicas under autoscale) underneath every later
+    test. Defined AFTER the serving fixture so this teardown runs
+    FIRST: closing a leaked fleet closes its replicas' runtimes too,
+    and the serving fixture then verifies nothing survived. Probes +
+    cleanup live in robustness/oracles.py (also run by the campaign
+    engine after every schedule)."""
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.leaked_fleets(), (
+        "fleet front door(s) leaked from a previous test: "
+        f"{oracles.leaked_fleets()}")
+    yield
+    leaked = oracles.close_leaked_fleets()
+    assert not leaked, (
+        f"a test leaked running fleet front door(s): {leaked}")
+    stray = oracles.leaked_threads(("tg-fleet",))
+    assert not stray, f"fleet thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_drift_leak():
     """Drift refits run on background ``tg-drift-refit`` daemon threads
     (serving/registry.py) that retrain + save + hot-swap a model. A refit

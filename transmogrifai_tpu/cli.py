@@ -674,6 +674,101 @@ def run_campaign(schedules: int = 0, seed: Optional[int] = None,
         eng.close()
 
 
+def run_fleet(model_path: str, replicas: int = 2, seconds: float = 5.0,
+              rps: float = 0.0, deadline_ms: Optional[float] = None,
+              max_batch: int = 256, queue_max: int = 1024,
+              kill: bool = False, use_subprocess: bool = False,
+              name: str = "model", output: Optional[str] = None,
+              seed: int = 42) -> Dict[str, Any]:
+    """``op fleet`` (docs/serving.md "Replica fleet & front door"): start
+    ``replicas`` worker replicas of a saved model behind a front door,
+    drive the open-loop load generator for ``seconds``, and print the
+    fleet report — per-replica routing distribution, failovers,
+    ejections, scale events, sheds, and the SLO tail. ``--kill`` murders
+    one replica mid-soak (the zero-lost-requests drill: the run must
+    still account every request). Exits non-zero on ANY lost request or
+    broken accounting."""
+    import json as _json
+    import threading as _threading
+    import time as _time
+
+    from .observability import export as obs_export
+    from .observability import metrics as obs_metrics
+    from .observability import trace as obs_trace
+    from .persistence import load_model
+    from .serving import FleetConfig, FrontDoor, ServeConfig
+    from .serving.loadgen import run_open_loop, synthetic_rows
+
+    obs_trace.enable_tracing(True)
+    obs_metrics.enable_metrics(True)
+    try:
+        cfg = ServeConfig.from_env()
+        cfg.max_batch = max_batch
+        cfg.max_queue = queue_max
+        fc = FleetConfig.from_env()
+        if use_subprocess:
+            fc.subprocess = True
+        fc.max_replicas = max(fc.max_replicas, replicas)
+        model = load_model(model_path)
+        rows = synthetic_rows(model, 512, seed=seed)
+        with FrontDoor({name: model_path}, replicas=replicas, config=cfg,
+                       fleet_config=fc, warm=True) as fd:
+            if rps <= 0:
+                from .local import micro_batch_score_function
+                mb = micro_batch_score_function(model)
+                batch = rows[:max_batch]
+                mb(batch)  # compile warmup beyond the replica warms
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    mb(batch)
+                cap = 3 * len(batch) / (_time.perf_counter() - t0)
+                cal = run_open_loop(fd, rows, min(1.0, seconds), cap)
+                rps = max(10.0, 0.5 * cal["rowsPerSec"])
+            killer = None
+            if kill:
+                def _mid_soak_kill():
+                    active = [rid for rid, r in sorted(
+                        fd._replicas.items()) if r.state == "active"]
+                    if active:
+                        fd.kill_replica(active[0])
+                killer = _threading.Timer(seconds / 2.0, _mid_soak_kill)
+                killer.daemon = True
+                killer.start()
+            try:
+                report = run_open_loop(fd, rows, seconds, rps,
+                                       deadline_ms=deadline_ms)
+            finally:
+                if killer is not None:
+                    killer.cancel()
+            health = fd.health()
+        summary = {"model": model_path, "replicas": replicas,
+                   "rpsOffered": round(rps, 1), "load": report,
+                   "fleet": report.get("fleet"),
+                   "routing": report.get("replicas"),
+                   "ready": health["ready"],
+                   "replicaStates": {rid: r.get("state")
+                                     for rid, r in
+                                     health["replicas"].items()}}
+        print(_json.dumps(summary, indent=2, default=str))
+        if output:
+            os.makedirs(output, exist_ok=True)
+            obs_export.write_prometheus(
+                os.path.join(output, "metrics.prom"))
+            with open(os.path.join(output, "fleet_summary.json"),
+                      "w") as fh:
+                _json.dump(summary, fh, indent=2, default=str)
+            print(f"wrote metrics.prom, fleet_summary.json to {output}/")
+        if report["lost"] or report["failed"] or not report["accountingOk"]:
+            print(f"FLEET SOAK FAILED: lost={report['lost']} "
+                  f"failed={report['failed']} "
+                  f"accountingOk={report['accountingOk']}")
+            raise SystemExit(1)
+        return summary
+    finally:
+        obs_trace.enable_tracing(None)
+        obs_metrics.enable_metrics(None)
+
+
 def _doctor_ms(ts_ns: Optional[float], anchor_ns: Optional[float]) -> str:
     if ts_ns is None:
         return "       ?"
@@ -800,6 +895,18 @@ def run_doctor(bundle: str, as_json: bool = False,
             print(f"   mem[{sub}]: dispatches={s.get('dispatches')} "
                   f"predictedPeak={s.get('predictedPeakBytes')}B "
                   f"{measured}")
+    # fleet (replica front door; docs/serving.md "Replica fleet & front
+    # door") — replica states, routing distribution, failover/ejection
+    # accounting from the tg_fleet_* series the bundle snapshotted
+    fleet_series = {n: s for n, s in metrics.items()
+                    if n.startswith("tg_fleet_")}
+    if fleet_series or trig.get("kind") == "replica_lost":
+        print("-- fleet --")
+        for fname, series in sorted(fleet_series.items()):
+            for key, v in sorted(series.items()):
+                if isinstance(v, dict):
+                    v = f"count={v.get('count')}"
+                print(f"   {fname}{{{key}}}: {v}")
     # SLO & budgets (bundle schema v3; docs/observability.md "SLOs,
     # budgets & burn rates") — was the budget already burning before
     # this incident, and what would the autoscaler have done?
@@ -897,6 +1004,35 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="directory for the telemetry bundle (trace.json / "
                          "spans.jsonl / metrics.prom / serve_summary.json)")
     sv.add_argument("--seed", type=int, default=42)
+    fl = sub.add_parser(
+        "fleet", help="start N worker replicas of a saved model behind "
+                      "a load-aware front door, drive the open-loop "
+                      "soak, and print the per-replica + fleet report; "
+                      "exits non-zero on any lost request "
+                      "(docs/serving.md)")
+    fl.add_argument("--model", required=True,
+                    help="saved model directory (OpWorkflowModel.save)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="worker replica count")
+    fl.add_argument("--seconds", type=float, default=5.0,
+                    help="load duration")
+    fl.add_argument("--rps", type=float, default=0.0,
+                    help="offered requests/sec (0 = auto-calibrate)")
+    fl.add_argument("--deadline-ms", type=float, default=None)
+    fl.add_argument("--max-batch", type=int, default=256)
+    fl.add_argument("--queue-max", type=int, default=1024)
+    fl.add_argument("--kill", action="store_true",
+                    help="kill one replica mid-soak (zero-lost-requests "
+                         "drill: the run must still account every "
+                         "request)")
+    fl.add_argument("--subprocess", action="store_true",
+                    help="subprocess replicas (one OS process each; "
+                         "TG_FLEET_SUBPROCESS)")
+    fl.add_argument("--name", default="model", help="registry model name")
+    fl.add_argument("--output", default=None,
+                    help="directory for metrics.prom + "
+                         "fleet_summary.json")
+    fl.add_argument("--seed", type=int, default=42)
     so = sub.add_parser(
         "slo", help="load a saved model, drive open-loop load, and "
                     "report SLO verdicts, budget burn and scale-hint "
@@ -945,8 +1081,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "sequence")
     cp.add_argument("--scenario", default=None,
                     help="restrict to one scenario harness (train | sweep "
-                         "| serve | serve_heal | stream | transfer); "
-                         "required in repro mode")
+                         "| serve | serve_heal | stream | fleet | "
+                         "transfer); required in repro mode")
     cp.add_argument("--faults", default=None,
                     help="repro mode: a TG_FAULTS-style JSON schedule to "
                          "run ONCE against --scenario (also picked up "
@@ -983,6 +1119,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                   deadline_ms=a.deadline_ms, max_batch=a.max_batch,
                   queue_max=a.queue_max, name=a.name, output=a.output,
                   seed=a.seed)
+    elif a.command == "fleet":
+        run_fleet(a.model, replicas=a.replicas, seconds=a.seconds,
+                  rps=a.rps, deadline_ms=a.deadline_ms,
+                  max_batch=a.max_batch, queue_max=a.queue_max,
+                  kill=a.kill, use_subprocess=a.subprocess,
+                  name=a.name, output=a.output, seed=a.seed)
     elif a.command == "slo":
         run_slo(a.model, seconds=a.seconds, rps=a.rps,
                 availability=a.availability, p99_ms=a.p99_ms,

@@ -90,6 +90,7 @@ TRIGGER_KINDS = (
     "campaign_violation",  # a chaos schedule violated an invariant oracle
     "campaign_escape",     # a typed error escaped a campaign scenario
     "slo_budget_exhausted",  # an SLO error budget fully burned (slo.py)
+    "replica_lost",        # a fleet replica died mid-flight (frontdoor.py)
 )
 
 _LOCK = threading.Lock()

@@ -106,14 +106,18 @@ def planning_applicable() -> bool:
     exercise the real planned dispatch path, not an eager stand-in;
     sites prefixed ``oom.`` inject resource exhaustion into the planned /
     serve / stream / sweep dispatch paths themselves — disabling the
-    planner would disable exactly the path under test."""
+    planner would disable exactly the path under test; sites prefixed
+    ``fleet.`` target the replica front door a further layer up
+    (serving/frontdoor.py) and keep the planner active for the same
+    reason as ``serve.*``."""
     if not plan_enabled():
         return False
     from .robustness import faults
     if os.environ.get(faults.CHAOS_ENV):
         return False
     armed = faults.active_sites()
-    if any(not s.startswith(("plan.", "serve.", "drift.", "oom."))
+    if any(not s.startswith(("plan.", "serve.", "drift.", "oom.",
+                             "fleet."))
            for s in armed):
         return False
     return True

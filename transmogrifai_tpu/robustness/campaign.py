@@ -18,7 +18,9 @@ closes that gap *compositionally*:
   ``train`` (checkpointed train + resume-on-preemption), ``sweep`` (the
   CV validator), ``serve`` (a staged serving flush, deterministic),
   ``serve_heal`` (registry + drift monitor + background refit under
-  shifted traffic), ``stream`` (out-of-core train + resume), and
+  shifted traffic), ``stream`` (out-of-core train + resume), ``fleet``
+  (a two-replica front door with routing/failover/probe faults — the
+  zero-lost-futures accounting identity under replica kills), and
   ``transfer`` (the guarded host<->device helpers).
 * **oracles** — after every run a library of invariants is checked:
   bit-equality of recovered results against the fault-free baseline
@@ -78,6 +80,9 @@ ACCOUNT_KINDS = {
     "drift.fold": "drift_fold_failed",
     "drift.verdict": "drift_verdict_failed",
     "drift.refit": "drift_refit_failed",
+    "fleet.route": "fleet_failover",
+    "fleet.replica_kill": "replica_lost",
+    "fleet.probe": "fleet_probe_failed",
 }
 
 
@@ -579,6 +584,109 @@ class _StreamScenario(_Scenario):
         return out
 
 
+class _FleetScenario(_Scenario):
+    """Two-replica front door over one model: every request submitted
+    through the fleet, one probe pass (so ``fleet.probe`` can fire), then
+    collect. Oracles: the fleet accounting identity — submitted =
+    completed + *typed* sheds, zero failed, zero lost futures — holds
+    even when ``fleet.replica_kill`` murders a replica mid-schedule; every
+    completed record is bit-equal to the fault-free single-process run;
+    fired fleet sites leave their recovery kinds on the front door's
+    FaultLog (replica_lost / fleet_failover / fleet_probe_failed)."""
+
+    name = "fleet"
+
+    def setup(self) -> None:
+        from ..local import micro_batch_score_function
+        from ..serving.loadgen import synthetic_rows
+        self.model = self.engine.small_model()
+        self.rows = synthetic_rows(self.model, 24, seed=57)
+        self.baseline = micro_batch_score_function(self.model)(
+            list(self.rows))
+
+    def run(self, log: FaultLog) -> Dict[str, Any]:
+        from ..serving.fleet import FleetConfig
+        from ..serving.frontdoor import FrontDoor
+        from ..serving.runtime import ServeConfig
+        cfg = ServeConfig(max_batch=16, max_queue=64, max_wait_ms=10.0)
+        fc = FleetConfig(min_replicas=2, max_replicas=2,
+                         probe_interval_ms=0.0, probe_failures=1,
+                         readmit_probes=1, max_failovers=2,
+                         autoscale=False)
+        completed: Dict[int, Dict[str, Any]] = {}
+        shed: Dict[int, str] = {}
+        failed: Dict[int, str] = {}
+        lost: List[int] = []
+        fd = FrontDoor({"m": self.model}, replicas=2, config=cfg,
+                       fleet_config=fc, fault_log=log)
+        try:
+            pending = []
+            for i, row in enumerate(self.rows):
+                try:
+                    pending.append((i, fd.submit(row)))
+                except Exception as e:
+                    if isinstance(e, self.engine.typed_escapes()):
+                        shed[i] = type(e).__name__
+                    else:
+                        raise  # untyped submit failure = discipline breach
+            # one deterministic probe pass: the ejection ladder (and the
+            # fleet.probe site) run exactly once per schedule
+            fd.probe_now()
+            deadline = time.monotonic() + self.engine.collect_timeout
+            for i, fut in pending:
+                try:
+                    completed[i] = fut.result(
+                        timeout=max(0.05, deadline - time.monotonic()))
+                except _FutureTimeout:
+                    lost.append(i)
+                except Exception as e:
+                    if isinstance(e, self.engine.typed_escapes()):
+                        shed[i] = type(e).__name__
+                    else:
+                        failed[i] = f"{type(e).__name__}: {e}"
+            snapshot = fd.fleet_snapshot()
+        finally:
+            fd.close(drain=False)
+        return {"completed": completed, "shed": shed, "failed": failed,
+                "lost": lost, "fleet": snapshot,
+                "accounting": {"submitted": len(self.rows),
+                               "completed": len(completed),
+                               "shed": len(shed), "failed": len(failed),
+                               "lost": len(lost)}}
+
+    def violations(self, result, fired, log) -> List[str]:
+        out: List[str] = []
+        n = len(self.rows)
+        if result["lost"]:
+            out.append(f"fleet: {len(result['lost'])} request future(s) "
+                       f"never resolved (lost): {result['lost']}")
+        if result["failed"]:
+            out.append(f"fleet: request future(s) failed untyped "
+                       f"(requests must complete or shed typed): "
+                       f"{result['failed']}")
+        total = (len(result["completed"]) + len(result["shed"])
+                 + len(result["failed"]) + len(result["lost"]))
+        if total != n:
+            out.append(f"fleet: request accounting broken: "
+                       f"{total} accounted of {n} submitted")
+        mismatched = [i for i, rec in result["completed"].items()
+                      if rec != self.baseline[i]]
+        if mismatched:
+            out.append(f"fleet: completed record(s) not bit-equal to the "
+                       f"fault-free run: rows {sorted(mismatched)[:8]}")
+        kinds = {r.kind for r in log.reports}
+        for site in fired:
+            want = ACCOUNT_KINDS.get(site)
+            if want and want not in kinds:
+                out.append(f"fleet: site {site} fired but recovery kind "
+                           f"'{want}' was never recorded")
+        if ("fleet.replica_kill" in fired
+                and not result["fleet"]["kills"]):
+            out.append("fleet: fleet.replica_kill fired but the fleet "
+                       "snapshot shows no kill")
+        return out
+
+
 class _TransferScenario(_Scenario):
     """The guarded host<->device transfer helpers alone: a placement and
     a readback through the always-on retry policies must round-trip
@@ -651,11 +759,12 @@ class ChaosCampaign:
     """
 
     #: scenario draw weights for the randomized (post-coverage) schedules
-    SCENARIO_WEIGHTS = (("serve", 0.30), ("train", 0.25), ("sweep", 0.20),
-                        ("stream", 0.15), ("serve_heal", 0.05),
-                        ("transfer", 0.05))
+    SCENARIO_WEIGHTS = (("serve", 0.28), ("train", 0.23), ("sweep", 0.18),
+                        ("stream", 0.13), ("fleet", 0.08),
+                        ("serve_heal", 0.05), ("transfer", 0.05))
     _SCENARIOS = (_TrainScenario, _SweepScenario, _ServeScenario,
-                  _ServeHealScenario, _StreamScenario, _TransferScenario)
+                  _ServeHealScenario, _StreamScenario, _FleetScenario,
+                  _TransferScenario)
 
     def __init__(self, seed: Optional[int] = None,
                  workdir: Optional[str] = None,
@@ -797,9 +906,10 @@ class ChaosCampaign:
             k = 1 + int(rng.randint(0, min(3, len(pool))))
             sites = [str(s) for s in rng.choice(pool, size=k,
                                                 replace=False)]
-            # serve-side flushes coalesce, so only first-call triggers
-            # are schedule-deterministic there
-            force = scn in ("serve", "serve_heal")
+            # serve-side flushes coalesce (and fleet routing reacts to
+            # live queue depths), so only first-call triggers are
+            # schedule-deterministic there
+            force = scn in ("serve", "serve_heal", "fleet")
             fault_specs = {}
             for s in sorted(sites):
                 mode = str(rng.choice(ALL_SITES[s].modes))

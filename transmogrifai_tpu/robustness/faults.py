@@ -94,6 +94,22 @@ site                         fires in
                              dispatches (validators.py; the packed (F·G)
                              grid splits in half and fold metrics merge —
                              the family is downshifted, not quarantined)
+``fleet.route``              in the front door, on the routing hop to the
+                             selected replica (serving/frontdoor.py; a
+                             raise fails the request over to another
+                             replica within the bounded failover budget
+                             — typed shed when exhausted; ``fleet.*``
+                             sites keep the planner active like
+                             ``serve.*``)
+``fleet.replica_kill``       in the front door, as a request routes to
+                             the selected replica (a raise kills that
+                             replica — queued requests fail over to
+                             survivors with zero lost futures, and a
+                             ``replica_lost`` post-mortem bundle dumps)
+``fleet.probe``              in the fleet health-probe pass, before a
+                             replica's ``health()`` read (consecutive
+                             failures walk the ejection ladder; healthy
+                             probes readmit)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
@@ -264,6 +280,15 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
           "packed grid splits and fold metrics merge (identical winner); "
           "exhaustion persisting to a single config quarantines the "
           "family", bit_equal=False),
+    _site("fleet.route", "raise", "serving/frontdoor.py", "fleet",
+          "request fails over to another replica (bounded budget); "
+          "typed shed when exhausted — never a lost future"),
+    _site("fleet.replica_kill", "raise", "serving/frontdoor.py", "fleet",
+          "replica killed mid-flight; queued requests fail over to "
+          "survivors, replica_lost post-mortem dumped, zero lost"),
+    _site("fleet.probe", "raise", "serving/frontdoor.py", "fleet",
+          "probe failure counted; consecutive failures eject the "
+          "replica, healthy probes readmit it — requests unaffected"),
     _site("preempt.stage_fit", "preempt", "dag.py", "train|stream",
           "train(resume=True) restores verified stages, bit-exact"),
     _site("preempt.checkpoint_write", "preempt", "persistence.py",

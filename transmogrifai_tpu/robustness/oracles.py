@@ -29,7 +29,7 @@ from typing import Iterable, List, Optional
 #: thread-name prefixes owned by framework worker threads; anything alive
 #: with one of these names after a close/teardown is a leak
 THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog",
-                   "tg-sampler")
+                   "tg-sampler", "tg-fleet")
 
 
 # -- probes (read-only) ------------------------------------------------------
@@ -38,6 +38,13 @@ def leaked_serving_runtimes() -> List[str]:
     """Names of live (started, unclosed) serving runtimes."""
     from ..serving import runtime as _srt
     return [rt.name for rt in _srt.live_runtimes()]
+
+
+def leaked_fleets() -> List[str]:
+    """Names of live (started, unclosed) fleet front doors — each owns a
+    probe thread plus N replica registries' worth of batcher threads."""
+    from ..serving import frontdoor as _fd
+    return [fd.name for fd in _fd.live_fleets()]
 
 
 def leaked_stream_feeds() -> List[str]:
@@ -197,6 +204,17 @@ def close_leaked_serving() -> List[str]:
     return [rt.name for rt in leaked]
 
 
+def close_leaked_fleets() -> List[str]:
+    """Force-close leftover front doors (replicas included) — closed
+    BEFORE the runtime sweep so a fleet's runtimes are not reported
+    twice."""
+    from ..serving import frontdoor as _fd
+    leaked = _fd.live_fleets()
+    for fd in leaked:
+        fd.close(drain=False)
+    return [fd.name for fd in leaked]
+
+
 def close_leaked_feeds() -> List[str]:
     from ..streaming import feed as _feed
     leaked = _feed.live_feeds()
@@ -236,6 +254,9 @@ def campaign_violations(clean: bool = True,
     still = join_drift_refits(timeout=refit_join_timeout)
     if still:
         out.append(f"drift refit thread(s) outlived the schedule: {still}")
+    fds = leaked_fleets()
+    if fds:
+        out.append(f"fleet front door(s) leaked: {fds}")
     rts = leaked_serving_runtimes()
     if rts:
         out.append(f"serving runtime(s) leaked: {rts}")
@@ -247,6 +268,7 @@ def campaign_violations(clean: bool = True,
         out.append(f"watchdog heart(s) leaked: {hearts}")
     out.extend(slo_violations())
     if clean:
+        close_leaked_fleets()
         close_leaked_serving()
         close_leaked_feeds()
         close_leaked_hearts()

@@ -8,12 +8,26 @@ multi-model registry with warm plan caches, per-model p50/p95/p99 SLO
 reporting from ``observability/metrics.py``, and drift-aware self-healing
 (``drift.py``): online train-vs-score distribution monitoring with
 automatic background refit + hot swap.
+
+The horizontal layer (ROADMAP item 2, docs/serving.md "Replica fleet &
+front door"): a shared-nothing replica fleet (``fleet.py`` — in-process
+replicas tier-1, subprocess replicas behind ``TG_FLEET_SUBPROCESS``)
+behind a :class:`~.frontdoor.FrontDoor` that routes load-aware, ejects
+sick replicas, fails requests over on replica loss with zero lost
+futures, refuses-or-splits flushes against ``TG_DEVICE_BUDGET`` before
+dispatch, rolls deploys replica-by-replica, and autoscales on
+``scale_hint``.
 """
 from .breaker import BREAKER_GAUGE, CircuitBreaker  # noqa: F401
 from .drift import (  # noqa: F401
     DEGRADED, DRIFTING, OK, DriftBaseline, DriftConfig, DriftMonitor,
     drift_enabled, live_refits, manifest_drift_entry,
 )
+from .fleet import (  # noqa: F401
+    AdmissionRefusedError, FleetConfig, Replica, ReplicaLostError,
+    SubprocessReplica,
+)
+from .frontdoor import FrontDoor, live_fleets  # noqa: F401
 from .loadgen import run_open_loop, synthetic_rows  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import (  # noqa: F401

@@ -14,6 +14,16 @@ delay is included), shed/degraded/quarantine counts, and the breaker
 snapshot. Submit-side failures (``OverloadError``, injected
 ``serve.enqueue`` chaos) are counted, never raised — a load generator
 that dies on the first shed cannot measure shedding.
+
+The same loop drives a fleet :class:`~.frontdoor.FrontDoor` unchanged
+(duck-typed ``submit``/``summary``): failover-induced retries happen
+*inside* the front door and resolve the same future exactly once, so a
+retried request can never double-count as completed. Two fleet-only
+report fields appear when the target exposes them: ``shedNoReplica``
+(a future that resolved with a typed ``OverloadError`` *after* accept —
+failover budget exhausted / no healthy replica; part of the accounting
+identity) and ``fleet`` (per-replica routing distribution, failovers,
+ejections, kills, scale events).
 """
 from __future__ import annotations
 
@@ -146,6 +156,7 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     # one outcome a serving tier may never produce; the campaign engine
     # and BENCH_MODE=campaign assert lost == 0
     completed = quarantined = shed_deadline = failed = lost = 0
+    shed_noreplica = 0
     slowest: List[Dict[str, Any]] = []
     drain_deadline = time.monotonic() + drain_timeout
     for fut, corr, submitted_at, tenant in futures:
@@ -167,6 +178,13 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
             shed_deadline += 1
             if tb:
                 tb["shedDeadline"] += 1
+        except OverloadError:
+            # a fleet front door sheds typed AFTER accept when the
+            # failover budget exhausts (replica loss with no survivor)
+            # — an accounted shed, distinct from a lost future
+            shed_noreplica += 1
+            if tb:
+                tb["shedOverload"] += 1
         except FuturesTimeoutError:
             lost += 1
             if tb:
@@ -185,7 +203,7 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     wall = time.monotonic() - start
     summary = runtime.summary()
     lat = summary.get("latency", {}) or {}
-    return {
+    report = {
         "seconds": round(wall, 3),
         "offered": offered,
         "offeredRps": round(offered / wall, 1) if wall else 0.0,
@@ -194,14 +212,17 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         "quarantined": quarantined,
         "shedOverload": shed_submit,
         "shedDeadline": shed_deadline,
+        "shedNoReplica": shed_noreplica,
         "submitErrors": submit_errors,
         "failed": failed,
         "lost": lost,
         # every offered arrival must land in exactly one bucket — the
         # full-request-accounting invariant, precomputed so callers can
-        # assert it without re-deriving the sum
+        # assert it without re-deriving the sum (failover retries inside
+        # a front door resolve ONE future once, so they cannot inflate
+        # `completed`; a post-accept typed shed lands in shedNoReplica)
         "accountingOk": (offered == completed + shed_submit + shed_deadline
-                         + submit_errors + failed + lost),
+                         + shed_noreplica + submit_errors + failed + lost),
         "p50Ms": round(lat.get("p50", float("nan")) * 1e3, 3),
         "p95Ms": round(lat.get("p95", float("nan")) * 1e3, 3),
         "p99Ms": round(lat.get("p99", float("nan")) * 1e3, 3),
@@ -216,3 +237,11 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         # BENCH_MODE=serve tenant line read this
         "tenants": per_tenant or None,
     }
+    # fleet targets: per-replica routing distribution + failover /
+    # ejection / kill / scale accounting (docs/serving.md "Replica
+    # fleet & front door")
+    if hasattr(runtime, "replica_distribution"):
+        report["replicas"] = runtime.replica_distribution()
+    if hasattr(runtime, "fleet_snapshot"):
+        report["fleet"] = runtime.fleet_snapshot()
+    return report

@@ -1,0 +1,151 @@
+"""Subprocess replica worker: one ModelRegistry behind a JSON-lines
+stdio protocol (docs/serving.md "Replica fleet & front door").
+
+Spawned by :class:`~.fleet.SubprocessReplica` as::
+
+    python -m transmogrifai_tpu.serving.replica_worker \
+        --model churn=/path/to/saved_model [--model other=...]
+
+Protocol (one JSON object per line, both directions):
+
+parent → child
+    ``{"op": "submit", "id": n, "model": m, "row": {...},
+    "deadlineMs": x|null, "tenant": t|null}``,
+    ``{"op": "health", "id": n}``,
+    ``{"op": "swap", "id": n, "model": m, "path": dir}``,
+    ``{"op": "close"}``
+
+child → parent
+    ``{"ready": true, "models": [...]}`` once, after every model is
+    loaded + warm; then per request ``{"id": n, "record": {...}}`` or
+    ``{"id": n, "error": {"type": <typed class name>, "msg": ...}}``
+    (typed serving errors survive the process boundary by name —
+    fleet.py maps them back), ``{"id": n, "health": {...}}``.
+
+Results are written from Future done-callbacks (the replica's batcher
+thread) under one write lock — the protocol needs no ordering beyond
+line atomicity. stdout is reserved for the protocol; anything the model
+stack prints goes to stderr.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+
+def _json_default(o: Any):
+    """Records carry numpy scalars off the serve path; JSON them as
+    their Python values so bit-equality survives the pipe (binary64
+    round-trips exactly through repr)."""
+    item = getattr(o, "item", None)
+    if callable(item):
+        return item()
+    return str(o)
+
+
+class _Writer:
+    def __init__(self, stream):
+        self._stream = stream
+        self._lock = threading.Lock()
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        line = json.dumps(msg, separators=(",", ":"),
+                          default=_json_default)
+        with self._lock:
+            self._stream.write(line + "\n")
+            self._stream.flush()
+
+
+def _typed_name(e: BaseException) -> str:
+    from .runtime import ServingError
+    return (type(e).__name__ if isinstance(e, ServingError)
+            else "ReplicaError")
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(prog="replica_worker")
+    p.add_argument("--model", action="append", required=True,
+                   help="name=saved_model_dir (repeatable)")
+    p.add_argument("--max-batch", type=int, default=256)
+    p.add_argument("--queue-max", type=int, default=1024)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    a = p.parse_args(argv)
+
+    # stdout is the protocol channel: route any stray prints (jax
+    # warnings, model-stack chatter) to stderr before importing them
+    proto = sys.stdout
+    sys.stdout = sys.stderr
+
+    from .registry import ModelRegistry
+    from .runtime import ServeConfig
+
+    cfg = ServeConfig.from_env()
+    cfg.max_batch = a.max_batch
+    cfg.max_queue = a.queue_max
+    cfg.max_wait_ms = a.max_wait_ms
+    writer = _Writer(proto)
+    reg = ModelRegistry(cfg)
+    try:
+        names = []
+        for spec in a.model:
+            name, _, path = spec.partition("=")
+            reg.load(name, path)
+            names.append(name)
+        writer.send({"ready": True, "models": names})
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            op = msg.get("op")
+            if op == "close":
+                break
+            rid = msg.get("id")
+            if op == "health":
+                try:
+                    writer.send({"id": rid, "health": reg.health()})
+                except Exception as e:  # noqa: BLE001 - protocol fence
+                    writer.send({"id": rid, "error": {
+                        "type": _typed_name(e),
+                        "msg": f"{type(e).__name__}: {e}"[:300]}})
+            elif op == "swap":
+                try:
+                    reg.swap(msg["model"], msg["path"])
+                    writer.send({"id": rid, "record": {"swapped": True}})
+                except Exception as e:  # noqa: BLE001 - protocol fence
+                    writer.send({"id": rid, "error": {
+                        "type": _typed_name(e),
+                        "msg": f"{type(e).__name__}: {e}"[:300]}})
+            elif op == "submit":
+                try:
+                    fut = reg.submit(msg["model"], msg.get("row") or {},
+                                     deadline_ms=msg.get("deadlineMs"),
+                                     tenant=msg.get("tenant"))
+                except Exception as e:  # typed shed (overload/stopped)
+                    writer.send({"id": rid, "error": {
+                        "type": _typed_name(e),
+                        "msg": f"{type(e).__name__}: {e}"[:300]}})
+                    continue
+
+                def _emit(f, _rid=rid):
+                    e = f.exception()
+                    if e is not None:
+                        writer.send({"id": _rid, "error": {
+                            "type": _typed_name(e),
+                            "msg": f"{type(e).__name__}: {e}"[:300]}})
+                    else:
+                        writer.send({"id": _rid, "record": f.result()})
+                fut.add_done_callback(_emit)
+    finally:
+        reg.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
