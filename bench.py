@@ -393,9 +393,10 @@ def _run_serve(platform):
     from transmogrifai_tpu import plan as _plan_mod
     from transmogrifai_tpu.observability import ledger as _obs_ledger
     from transmogrifai_tpu.serving import ModelRegistry
+    from transmogrifai_tpu.programstore import store as _pstore
     wdir = _tempfile.mkdtemp(prefix="tg_bench_warm_model_")
     try:
-        model.save(wdir)
+        model.save(wdir)  # populates <wdir>/programs at save (TG_AOT)
         _plan_mod.clear_plan_cache()
         with ModelRegistry(cfg) as reg:
             reg.load("warmgate", wdir)
@@ -409,6 +410,66 @@ def _run_serve(platform):
                 f"warm serve path retraced {len(retraced)} program(s) "
                 f"after registry.load pre-trace — causes: "
                 f"{[r.cause for r in retraced]}")
+
+        # ---- cold-start lines (round 15; docs/serving.md "AOT cold
+        # start & the program store"): registry.load() -> first-request
+        # latency, measured three ways against the SAME saved model —
+        # cold (no pre-trace: the first request pays plan build + trace
+        # + compile), warm (the PR 6 pre-trace: load pays it), AOT (the
+        # program store: nothing traces anywhere — the zero-compile
+        # gate marks BEFORE the load and must see an empty ledger after
+        # the first real request).
+        def _cold_start(arm):
+            _plan_mod.clear_plan_cache()
+            _pstore.close_sessions()
+            if arm != "aot":
+                _pstore.enable_aot(False)
+            try:
+                mark = _obs_ledger.ledger().mark()
+                t0 = time.perf_counter()
+                with ModelRegistry(cfg) as reg2:
+                    rt = reg2.load("coldstart", wdir,
+                                   warm=(arm != "cold"))
+                    t_load = time.perf_counter() - t0
+                    t1 = time.perf_counter()
+                    reg2.score("coldstart", rows[0], timeout=30)
+                    t_first = time.perf_counter() - t1
+                    builds = _obs_ledger.ledger().since(mark)
+                    warm_info = dict(rt.warm_info or {})
+            finally:
+                _pstore.enable_aot(None)
+                _pstore.close_sessions()
+            return {"loadS": round(t_load, 4),
+                    "firstRequestS": round(t_first, 4),
+                    "totalS": round(t_load + t_first, 4),
+                    "compiles": len(builds),
+                    "aotHits": warm_info.get("aotHits", 0)}, builds
+
+        cold, _ = _cold_start("cold")
+        warm_line, _ = _cold_start("warm")
+        aot_line, aot_builds = _cold_start("aot")
+        for r in aot_builds:
+            print(json.dumps({"aotColdStartViolation": r.to_json()}),
+                  flush=True)
+        assert not aot_builds, (
+            f"AOT cold start recorded {len(aot_builds)} ledger "
+            f"build(s) across load + first request — causes: "
+            f"{[r.cause for r in aot_builds]}")
+        assert aot_line["aotHits"] > 0, (
+            "AOT cold start deserialized nothing — the save-time "
+            "populate did not ship programs")
+        print(json.dumps({
+            "metric": f"serve_cold_start_aot_speedup_{d}feat_{platform}",
+            "value": round(cold["totalS"] / max(aot_line["totalS"],
+                                                1e-9), 3),
+            "unit": "x",
+            "vs_baseline": round(cold["totalS"]
+                                 / max(aot_line["totalS"], 1e-9), 3),
+            "phases": {"cold": cold, "warm": warm_line, "aot": aot_line,
+                       "warmVsAot": round(
+                           warm_line["totalS"]
+                           / max(aot_line["totalS"], 1e-9), 3)},
+        }), flush=True)
     finally:
         _shutil.rmtree(wdir, ignore_errors=True)
 
@@ -633,17 +694,21 @@ def _run_serve(platform):
     fdir = _tempfile.mkdtemp(prefix="tg_bench_fleet_model_")
     fleet_pm = _tempfile.mkdtemp(prefix="tg_bench_fleet_pm_")
     os.environ["TG_POSTMORTEM_DIR"] = fleet_pm
+    fleet_subproc = bool(int(os.environ.get("TG_FLEET_SUBPROCESS", "0")
+                             or 0))
     try:
-        model.save(fdir)
+        model.save(fdir)  # populates <fdir>/programs at save (TG_AOT)
         fleet_lines = {}
         for nrep in fleet_counts:
             fc = FleetConfig(min_replicas=1, max_replicas=max(nrep, 1),
-                             probe_interval_ms=200.0, autoscale=False)
+                             probe_interval_ms=200.0, autoscale=False,
+                             subprocess=fleet_subproc)
+            _pstore.close_sessions()
             amark = _obs_ledger.ledger().mark()
             with FrontDoor({"m": fdir}, replicas=nrep, config=cfg,
                            fleet_config=fc, warm=True) as fd:
                 # warm tripwire, per replica: after every replica's
-                # manifest-warm pre-trace, a real request through EACH
+                # manifest-warm pre-pass, a real request through EACH
                 # replica must record ZERO ledger compiles
                 wmark = _obs_ledger.ledger().mark()
                 for _rid, _rep in sorted(fd._replicas.items()):
@@ -656,6 +721,34 @@ def _run_serve(platform):
                     f"fleet warm path retraced {len(retraced)} "
                     f"program(s) across {nrep} replica(s) — causes: "
                     f"{[r.cause for r in retraced]}")
+                # AOT populate-once tripwire (round 15): with the store
+                # populated at save, replicas 2..N must pay ZERO warm
+                # compiles — at most ONE replica (none, when save
+                # populated) compiles for the whole fleet. warm_info
+                # crosses the subprocess protocol via health(), so the
+                # same gate holds under TG_FLEET_SUBPROCESS.
+                warm_reports = {
+                    rid: (rep.warm_reports() or {}).get("m") or {}
+                    for rid, rep in sorted(fd._replicas.items())}
+                tail = list(sorted(warm_reports.items()))[1:]
+                tail_compiles = sum(int(w.get("compiles", 0) or 0)
+                                    for _rid, w in tail)
+                assert tail_compiles == 0, (
+                    f"replicas 2..{nrep} paid {tail_compiles} warm "
+                    f"compile(s) — the program store did not share the "
+                    f"first replica's programs: {warm_reports}")
+                fleet_aot_hits = sum(int(w.get("aotHits", 0) or 0)
+                                     for w in warm_reports.values())
+                if not fleet_subproc:
+                    # in-process replicas share this ledger: the WHOLE
+                    # fleet bring-up (all N loads) must record zero
+                    # builds when the store was populated at save
+                    bringup = _obs_ledger.ledger().since(amark)
+                    bringup = [r for r in bringup if r.seq <= wmark]
+                    assert not bringup, (
+                        f"fleet bring-up compiled {len(bringup)} "
+                        f"program(s) despite a populated store — "
+                        f"causes: {[r.cause for r in bringup]}")
                 frep = run_open_loop(
                     fd, rows, fleet_seconds,
                     runtime_capacity * 1.2 * nrep,
@@ -678,6 +771,8 @@ def _run_serve(platform):
                     "shedDeadline": frep["shedDeadline"],
                     "routing": frep["replicas"],
                     "failovers": frep["fleet"]["failovers"],
+                    "aotWarmHits": fleet_aot_hits,
+                    "subprocess": fleet_subproc,
                     **_ledger_phases(amark),
                 },
             }), flush=True)
@@ -713,7 +808,7 @@ def _run_serve(platform):
         # leave >= 1 schema-valid replica_lost post-mortem bundle
         fc = FleetConfig(min_replicas=1, max_replicas=2,
                          probe_interval_ms=100.0, max_failovers=3,
-                         autoscale=False)
+                         autoscale=False, subprocess=fleet_subproc)
         with FrontDoor({"m": fdir}, replicas=2, config=cfg,
                        fleet_config=fc, warm=True) as fd:
             def _mid_soak_kill():

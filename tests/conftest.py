@@ -129,6 +129,33 @@ def _no_ledger_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_programstore_leak():
+    """The AOT program store keeps process-global state: open read
+    sessions (whose mere presence flips later ledger builds from `cold`
+    to `aot-miss`), capture scopes, hit/miss accounting, and a possible
+    forced TG_AOT override. A session opened by one test's
+    ``registry.load`` bleeding into the next would make cause-
+    classification assertions order-dependent, and a leaked capture
+    scope would keep exporting every later test's traced programs into
+    a dead tmp dir. Mirrors the ledger fixture: assert no
+    capture/override on entry, hard-reset (sessions + stats included)
+    on exit, and fail the test that leaked (robustness/oracles.py
+    ``programstore_violations`` — also run by the campaign engine after
+    every schedule)."""
+    from transmogrifai_tpu.programstore import store as _ps
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.programstore_violations(), (
+        f"AOT program-store state leaked into this test: "
+        f"{oracles.programstore_violations()}")
+    _ps.reset()
+    yield
+    leaks = oracles.programstore_violations()
+    _ps.reset()
+    assert not leaks, f"a test leaked AOT program-store state: {leaks}"
+
+
+@pytest.fixture(autouse=True)
 def _no_slo_leak():
     """The windowed time-series sampler and the SLO engine are
     process-global: attached sampler sources keep the shared

@@ -256,10 +256,15 @@ def test_stream_passes_recorded_under_stream_subsystem():
 # Warm serving path: zero compiles after registry.load pre-trace
 # ---------------------------------------------------------------------------
 
-def test_warm_load_then_first_request_zero_compiles(model, tmp_path):
+def test_warm_load_then_first_request_zero_compiles(model, tmp_path,
+                                                    monkeypatch):
     """The acceptance gate: ``registry.load`` pre-traces (builds recorded,
     subsystem ``serve``); the first real request then records ZERO
-    compiles in the ledger."""
+    compiles in the ledger. Pinned to the TRACE path (TG_AOT=0) — with
+    the program store on, warmup deserializes instead of tracing and
+    records no builds at all (that stronger gate lives in
+    tests/test_programstore.py)."""
+    monkeypatch.setenv("TG_AOT", "0")
     path = str(tmp_path / "model")
     model.save(path)
     plan_mod.clear_plan_cache()

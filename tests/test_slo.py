@@ -415,7 +415,7 @@ def test_budget_exhaustion_dumps_one_bundle_per_episode(tmp_path,
     assert doc["trigger"]["kind"] == "slo_budget_exhausted"
     assert doc["trigger"]["detail"]["objective"] == "availability"
     assert obs_postmortem.validate_bundle(doc) == []
-    assert doc["schemaVersion"] == 3
+    assert doc["schemaVersion"] == obs_postmortem.SCHEMA_VERSION
     # still exhausted on the next evaluation: same episode, no new dump
     clock.t = 2.0
     shed.inc(10)
@@ -661,12 +661,15 @@ def test_bundle_v3_sections_and_backcompat(model, tmp_path, monkeypatch):
     assert path is not None
     doc = obs_postmortem.read_bundle(path)
     assert obs_postmortem.validate_bundle(doc) == []
-    assert doc["schemaVersion"] == 3
+    assert doc["schemaVersion"] == obs_postmortem.SCHEMA_VERSION
     assert "v3" in doc["slo"]
     assert isinstance(doc["samples"], list) and doc["samples"]
     assert doc["samples"][0]["source"] == "v3"
-    # v2 (pre-SLO) and v1 (pre-ledger) bundles stay valid
-    v2 = dict(doc, schemaVersion=2)
+    # v3 (pre-AOT), v2 (pre-SLO) and v1 (pre-ledger) bundles stay valid
+    v3 = dict(doc, schemaVersion=3)
+    v3.pop("aot")
+    assert obs_postmortem.validate_bundle(v3) == []
+    v2 = dict(v3, schemaVersion=2)
     v2.pop("slo")
     v2.pop("samples")
     assert obs_postmortem.validate_bundle(v2) == []

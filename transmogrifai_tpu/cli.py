@@ -769,6 +769,95 @@ def run_fleet(model_path: str, replicas: int = 2, seconds: float = 5.0,
         obs_metrics.enable_metrics(None)
 
 
+def run_programs(path: str, gc: bool = False,
+                 as_json: bool = False) -> Dict[str, Any]:
+    """``op programs <model-dir | store-dir>`` (docs/serving.md "AOT
+    cold start & the program store"): list the AOT program store's
+    entries (key, component, size, age, hit count), verify every blob
+    against its recorded sha256/size, and optionally GC past the
+    ``TG_AOT_STORE_MAX``/``TG_AOT_STORE_MAX_BYTES`` bounds. A model dir
+    (has MANIFEST.json) is resolved to its ``programs/`` subdirectory
+    and cross-checked against the manifest ``programs`` section. Exits
+    non-zero when any entry is corrupt."""
+    import json as _json
+    import sys as _sys
+    import time as _time
+
+    from .manifest import MANIFEST_FILE, CheckpointManifest
+    from .programstore import PROGRAMS_DIR, ProgramStore
+
+    store_dir = path
+    manifest_entries: Optional[Dict[str, Any]] = None
+    plan_idents: List[str] = []
+    if os.path.isfile(os.path.join(path, MANIFEST_FILE)):
+        store_dir = os.path.join(path, PROGRAMS_DIR)
+        from .persistence import FORMAT_VERSION
+        m, err = CheckpointManifest.load(path, FORMAT_VERSION)
+        if err is None and isinstance(m.programs.get("entries"), dict):
+            manifest_entries = dict(m.programs["entries"])
+            plan_idents = [str(x) for x in m.programs.get("planIdents", ())]
+    store = ProgramStore(store_dir)
+    entries = store.entries()
+    problems = store.verify()
+    removed = store.gc() if gc else []
+    if gc:
+        entries = store.entries()
+    now = _time.time()
+    rows = []
+    for kid, meta in sorted(entries.items()):
+        rows.append({
+            "key": kid,
+            "component": meta.get("component"),
+            "bucket": meta.get("bucket"),
+            "jaxlib": meta.get("jaxlib"),
+            "deviceKind": meta.get("deviceKind"),
+            "sizeBytes": meta.get("size"),
+            "ageS": round(now - float(meta.get("createdUnix", now)), 1),
+            "hits": meta.get("hits", 0),
+            "identity": meta.get("identity"),
+        })
+    report = {
+        "dir": store_dir,
+        "entries": rows,
+        "totalBytes": store.total_bytes(),
+        "planIdents": plan_idents,
+        "manifestEntries": (len(manifest_entries)
+                            if manifest_entries is not None else None),
+        "corrupt": problems,
+        "removedByGc": removed,
+    }
+    if manifest_entries is not None:
+        # entries the manifest records but the store no longer holds —
+        # a lookup for these will miss (absent) and re-trace
+        report["manifestOnly"] = sorted(set(manifest_entries) -
+                                        set(entries))
+    if as_json:
+        print(_json.dumps(report, indent=2, default=str))
+    else:
+        print(f"== AOT program store: {store_dir}")
+        print(f"   entries: {len(rows)}  total "
+              f"{report['totalBytes']} bytes"
+              + (f"  (manifest records {report['manifestEntries']})"
+                 if report["manifestEntries"] is not None else ""))
+        for r in rows:
+            print(f"   {r['key']:<24} {r['component']:<13} "
+                  f"bucket={r['bucket']:<6} {r['sizeBytes']:>8}B  "
+                  f"age={r['ageS']:>8.1f}s  hits={r['hits']:<4} "
+                  f"[{r['jaxlib']} {r['deviceKind']}]")
+        for kid in report.get("manifestOnly", []):
+            print(f"   ! manifest-only (blob gone): {kid}")
+        if removed:
+            print(f"   gc removed: {removed}")
+        if problems:
+            print("-- CORRUPT ENTRIES --")
+            for p in problems:
+                print(f"   ! {p}")
+        print(f"== verdict: {'CORRUPT' if problems else 'ok'} ==")
+    if problems:
+        _sys.exit(1)
+    return report
+
+
 def _doctor_ms(ts_ns: Optional[float], anchor_ns: Optional[float]) -> str:
     if ts_ns is None:
         return "       ?"
@@ -938,6 +1027,29 @@ def run_doctor(bundle: str, as_json: bool = False,
               f"{src.get('samples', 0)} samples / "
               f"{src.get('series', 0)} series @ "
               f"{src.get('everyS', '?')}s")
+    # programs (bundle schema v4; docs/serving.md "AOT cold start & the
+    # program store") — was this process serving deserialized AOT
+    # programs, and had the store been hitting or falling back?
+    aot = doc.get("aot") or {}
+    if aot:
+        st = aot.get("stats") or {}
+        print(f"-- programs (AOT store: "
+              f"{'on' if aot.get('enabled') else 'off'}) --")
+        print(f"   hits={st.get('hitsTotal', 0)} "
+              f"{st.get('hits') or {}}  "
+              f"misses={st.get('missesTotal', 0)} "
+              f"{st.get('misses') or {}}  "
+              f"exports={st.get('exports', 0)}")
+        for sess in (aot.get("sessions") or [])[:6]:
+            print(f"   session[{sess.get('origin', '?')}]: "
+                  f"{sess.get('entries', 0)} entries, "
+                  f"{sess.get('loaded', 0)} loaded, "
+                  f"{sess.get('planIdents', 0)} plan idents")
+        aot_builds = {sub: causes.get("aot-miss", 0)
+                      for sub, causes in (led.get("counts") or {}).items()
+                      if causes.get("aot-miss")}
+        if aot_builds:
+            print(f"   aot-miss builds by subsystem: {aot_builds}")
     faults_doc = doc.get("faults") or {}
     buckets = {k: len(v) for k, v in faults_doc.items()
                if isinstance(v, list) and v}
@@ -1094,6 +1206,19 @@ def main(argv: Optional[List[str]] = None) -> None:
     cp.add_argument("--no-minimize", action="store_true",
                     help="skip delta-debug minimization of violating "
                          "schedules")
+    pg = sub.add_parser(
+        "programs", help="list/verify/gc an AOT program store — a model "
+                         "dir's programs/ + MANIFEST `programs` section "
+                         "or a raw TG_AOT_STORE dir; exits non-zero on "
+                         "corrupt entries (docs/serving.md)")
+    pg.add_argument("path",
+                    help="model directory (MANIFEST.json present) or a "
+                         "program-store directory")
+    pg.add_argument("--gc", action="store_true",
+                    help="evict oldest entries past TG_AOT_STORE_MAX / "
+                         "TG_AOT_STORE_MAX_BYTES")
+    pg.add_argument("--json", action="store_true",
+                    help="machine-readable report")
     dr = sub.add_parser(
         "doctor", help="render a flight-recorder post-mortem bundle into "
                        "a human-readable incident report "
@@ -1135,6 +1260,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         run_campaign(schedules=a.schedules, seed=a.seed,
                      scenario=a.scenario, faults_json=a.faults,
                      output=a.output, no_minimize=a.no_minimize)
+    elif a.command == "programs":
+        run_programs(a.path, gc=a.gc, as_json=a.json)
     elif a.command == "doctor":
         run_doctor(a.bundle, as_json=a.json, tail=a.tail)
 

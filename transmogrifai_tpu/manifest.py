@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
+import itertools
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
@@ -52,16 +53,37 @@ def sha256_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+#: per-process tmp-name disambiguator: concurrent writers targeting the
+#: SAME destination (two replicas populating one AOT program store, two
+#: trainers sharing a checkpoint dir) must not share a tmp path — with a
+#: fixed ``<path>.tmp`` one writer's rename deletes the other's staging
+#: file mid-write (found by the programstore two-process race test)
+_TMP_SEQ = itertools.count(1)
+
+
 def atomic_write_bytes(path: str, data: bytes) -> str:
     """Write ``data`` to ``path`` via tmp + fsync + rename; returns the
-    sha256 of what was written. A kill mid-write leaves only ``<path>.tmp``
-    debris — the destination is either absent or complete."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    sha256 of what was written. The staging file is
+    ``<path>.<pid>.<seq>.tmp`` (unique per writer, so concurrent
+    processes targeting one destination race benignly — last rename
+    wins, both files were complete); a kill mid-write leaves only
+    ``*.tmp`` debris — the destination is either absent or complete."""
+    tmp = f"{path}.{os.getpid()}.{next(_TMP_SEQ)}.tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # best-effort: do not strand the staging file on a failed write
+        # (a hard kill still can — that is the debris clean_tmp_debris
+        # sweeps)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     return sha256_bytes(data)
 
 
@@ -131,6 +153,15 @@ class CheckpointManifest:
         #: store consume (ROADMAP items 1/2). Absent or corrupt sections
         #: load as {} — costs are advisory, never load-blocking.
         self.costs: Dict[str, Any] = {}
+        #: optional AOT program-store index: serialized compiled-program
+        #: entries keyed by (segment fingerprint × padding bucket), with
+        #: the jaxlib version + device kind they were exported for and
+        #: the covered plan identities (transmogrifai_tpu/programstore/;
+        #: blobs live in the ``programs/`` subdirectory). Same tolerance
+        #: contract as ``costs``: absent or corrupt sections load as {}
+        #: — a garbled program index degrades to the trace path, never
+        #: blocks a load.
+        self.programs: Dict[str, Any] = {}
 
     @property
     def path(self) -> str:
@@ -168,6 +199,8 @@ class CheckpointManifest:
         # garbled cost table must never block loading a good model)
         costs = doc.get("costs", {})
         m.costs = dict(costs) if isinstance(costs, dict) else {}
+        programs = doc.get("programs", {})
+        m.programs = dict(programs) if isinstance(programs, dict) else {}
         return m, None
 
     def save(self) -> None:
@@ -187,6 +220,8 @@ class CheckpointManifest:
             doc["drift"] = self.drift
         if self.costs:
             doc["costs"] = self.costs
+        if self.programs:
+            doc["programs"] = self.programs
         atomic_write_json(self.path, doc, indent=1)
 
     # -- recording -----------------------------------------------------------
@@ -271,8 +306,11 @@ class CheckpointManifest:
             recorded.add(rec.get("file"))
         out = []
         for fname in sorted(os.listdir(self.dirpath)):
-            # the run sentinel is liveness metadata, not checkpoint payload
-            if fname in (MANIFEST_FILE, SENTINEL_FILE) or fname.endswith(".tmp"):
+            # the run sentinel is liveness metadata, not checkpoint
+            # payload; the AOT program store is indexed by the manifest
+            # `programs` section, not per-file records
+            if fname in (MANIFEST_FILE, SENTINEL_FILE, "programs") \
+                    or fname.endswith(".tmp"):
                 continue
             if fname not in recorded:
                 out.append(fname)

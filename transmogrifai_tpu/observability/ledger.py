@@ -31,7 +31,17 @@ identity, wall time, and the ledger classifies the *cause*:
     an unchanged program was rebuilt — its key was evicted from a
     bounded LRU (``TG_PLAN_CACHE_MAX`` / ``TG_FUSED_CACHE_MAX``; the
     caches report evictions via :func:`record_eviction`) or the cache
-    was cleared.
+    was cleared;
+``aot-miss``
+    the AOT program store was active but could not serve this build —
+    no entry for the key, a jaxlib/device-kind mismatch, a corrupt
+    blob, or a deserialization failure (the store notes the key via
+    :func:`note_aot_miss` with the miss reason right before the caller
+    falls back to the trace path — transmogrifai_tpu/programstore/,
+    docs/serving.md "AOT cold start & the program store"). Near-miss
+    causes with real forensics (``schema-change``/``bucket-change``)
+    still win when the identity was built before: the AOT note only
+    explains builds that would otherwise read ``cold``.
 
 Exports: ``tg_compile_total{cause,subsystem}`` +
 ``tg_compile_seconds{subsystem}`` through the gated metrics helpers
@@ -71,7 +81,7 @@ DEFAULT_MAX_RECORDS = 1024
 #: the closed cause taxonomy (docs/observability.md "Compile & memory
 #: ledger"); classification can return nothing else
 CAUSES = ("cold", "schema-change", "bucket-change", "donation-mismatch",
-          "cache-eviction")
+          "cache-eviction", "aot-miss")
 
 #: the dispatch subsystems that report builds (docs/observability.md)
 SUBSYSTEMS = ("plan", "sweep", "serve", "stream")
@@ -240,6 +250,10 @@ class CompileLedger:
         self._last: Dict[str, CompileRecord] = {}
         #: keys reported evicted by the bounded caches, awaiting rebuild
         self._evicted: "OrderedDict[str, bool]" = OrderedDict()
+        #: keys the AOT program store failed to serve, awaiting the
+        #: trace-path build they explain (key -> miss reason; bounded
+        #: like the eviction memory)
+        self._aot_misses: "OrderedDict[str, str]" = OrderedDict()
         #: (subsystem, cause) → builds (survives ring wrap)
         self._counts: Dict[Tuple[str, str], int] = {}
         self.seconds_total = 0.0
@@ -255,6 +269,17 @@ class CompileLedger:
             while len(self._evicted) > self.EVICTED_MAX:
                 self._evicted.popitem(last=False)
 
+    def note_aot_miss(self, key: str, reason: str) -> None:
+        """The AOT program store could not serve ``key``: the trace-path
+        build the caller is about to pay classifies ``aot-miss`` with
+        ``reason`` as its diff (programstore/store.py fallback ladder)."""
+        if not ledger_enabled():
+            return
+        with self._lock:
+            self._aot_misses[key] = reason
+            while len(self._aot_misses) > self.EVICTED_MAX:
+                self._aot_misses.popitem(last=False)
+
     # -- classification ------------------------------------------------------
     def _classify(self, identity: str, key: str, fingerprint: Any,
                   bucket: Optional[int], donation: Optional[Tuple]
@@ -262,7 +287,13 @@ class CompileLedger:
         """Lock held. Compare against the identity's previous build."""
         prev = self._last.get(identity)
         evicted = self._evicted.pop(key, False)
+        aot_reason = self._aot_misses.pop(key, None)
         if prev is None:
+            # a would-be-cold build the AOT store should have served:
+            # name the miss. Builds with an in-process baseline keep
+            # their richer near-miss causes (schema/bucket diffs) below.
+            if aot_reason is not None:
+                return "aot-miss", [aot_reason]
             return "cold", []
         if prev.fingerprint != fingerprint:
             diff = fingerprint_diff(prev.fingerprint, fingerprint)
@@ -383,6 +414,7 @@ class CompileLedger:
             self._records.clear()
             self._last.clear()
             self._evicted.clear()
+            self._aot_misses.clear()
             self._counts.clear()
             self._seq = 0
             self.dropped = 0
@@ -431,6 +463,11 @@ def record_build(subsystem: Optional[str] = None, *, identity: str,
 def record_eviction(key: str) -> None:
     if ledger_enabled():
         _LEDGER.record_eviction(key)
+
+
+def note_aot_miss(key: str, reason: str) -> None:
+    if ledger_enabled():
+        _LEDGER.note_aot_miss(key, reason)
 
 
 def cache_key_hash(key: Any) -> str:

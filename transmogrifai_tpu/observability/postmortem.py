@@ -36,6 +36,9 @@ doctor``)::
                               // observability/slo.py)
       "samples":     [...],   // recent windowed-sampler samples (v3;
                               // observability/timeseries.py)
+      "aot":         {...},   // AOT program-store snapshot: sessions,
+                              // hit/miss/export accounting (v4;
+                              // transmogrifai_tpu/programstore/)
       "environment": {"jax", "jaxlib", "backend", "devices", "python"}
     }
 
@@ -59,11 +62,12 @@ from . import blackbox as _blackbox
 
 #: current bundle schema. v2 (PR 12) added the compile-ledger tail and
 #: the device-memory snapshot; v3 (PR 13) added the SLO tracker
-#: snapshots and the recent windowed-sampler samples; older bundles (no
-#: such sections) must stay readable — validate_bundle accepts every
+#: snapshots and the recent windowed-sampler samples; v4 (PR 15) added
+#: the AOT program-store snapshot; older bundles (no such sections)
+#: must stay readable — validate_bundle accepts every
 #: SUPPORTED_SCHEMA_VERSIONS
-SCHEMA_VERSION = 3
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
+SCHEMA_VERSION = 4
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
 #: how many ledger records a bundle carries (most recent builds)
 LEDGER_TAIL = 32
 
@@ -254,6 +258,11 @@ def trigger(kind: str, corr: Optional[str] = None,
         doc["samples"] = [{"source": s.name, **s.snapshot(),
                            "recent": s.recent(8)}
                           for s in _timeseries.attached()]
+        # AOT program-store context (schema v4): was the incident's
+        # process serving deserialized programs, and had the store been
+        # missing/falling back? (transmogrifai_tpu/programstore/)
+        from ..programstore import store as _pstore
+        doc["aot"] = _pstore.snapshot()
     except Exception as e:  # context gathering must not kill the dump
         doc["contextError"] = f"{type(e).__name__}: {e}"[:300]
     path = os.path.join(postmortem_dir(),
@@ -338,4 +347,8 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
             problems.append("missing slo section (schema v3)")
         if not isinstance(doc.get("samples"), list):
             problems.append("missing samples section (schema v3)")
+    if isinstance(version, int) and version >= 4:
+        # v4 section; v3 bundles predate the AOT store and stay valid
+        if not isinstance(doc.get("aot"), dict):
+            problems.append("missing aot section (schema v4)")
     return problems

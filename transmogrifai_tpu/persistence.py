@@ -387,6 +387,18 @@ def save_model(model, path: str) -> None:
     except Exception:
         pass
     manifest.save()
+    # AOT program store: drive the serve scorer once under a capture
+    # scope so the model ships with its serialized compiled programs
+    # (programstore/ — entries land in the manifest `programs` section,
+    # blobs under `programs/`), and a fresh process's registry.load
+    # deserializes instead of tracing. Same contract as the three
+    # advisory entries above: population must never fail a save
+    # (TG_AOT_SAVE=0 defers it to the first warm load).
+    try:
+        from .programstore import populate_for_save
+        populate_for_save(model, path)
+    except Exception:
+        pass
 
 
 def _collect_stage_ref_uids(v: Any) -> set:

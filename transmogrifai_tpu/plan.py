@@ -109,7 +109,10 @@ def planning_applicable() -> bool:
     planner would disable exactly the path under test; sites prefixed
     ``fleet.`` target the replica front door a further layer up
     (serving/frontdoor.py) and keep the planner active for the same
-    reason as ``serve.*``."""
+    reason as ``serve.*``; the ``aot.load`` site targets the AOT
+    program-store load path *inside* the planner's segment dispatch
+    (programstore/store.py) — disabling the planner would disable
+    exactly the fallback ladder under test."""
     if not plan_enabled():
         return False
     from .robustness import faults
@@ -117,7 +120,7 @@ def planning_applicable() -> bool:
         return False
     armed = faults.active_sites()
     if any(not s.startswith(("plan.", "serve.", "drift.", "oom.",
-                             "fleet."))
+                             "fleet.", "aot."))
            for s in armed):
         return False
     return True
@@ -185,7 +188,8 @@ class _DeviceSegment:
     segment), ``out_names`` the columns it materializes."""
 
     __slots__ = ("stages", "in_names", "out_names", "chain", "out_meta",
-                 "out_shape", "seen_buckets", "fp_key", "pred_cache")
+                 "out_shape", "in_shape", "seen_buckets", "fp_key",
+                 "pred_cache", "aot_progs")
 
     def __init__(self, stages: List[Any], in_names: List[str],
                  out_names: List[str]):
@@ -196,6 +200,11 @@ class _DeviceSegment:
         #: output column (itemsize, trailing shape) from the zero-row
         #: probe — what the byte prediction needs (devicemem)
         self.out_shape: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        #: input column trailing shapes from the zero-row probe — enough
+        #: to reconstruct the traced avals at any padding bucket (staged
+        #: inputs are always f32 values + a bool mask), which is what
+        #: AOT export needs without a live dispatch (programstore/)
+        self.in_shape: Dict[str, Tuple[int, ...]] = {}
         #: padding buckets this segment's jitted chain has already been
         #: dispatched at: the first dispatch of a NEW bucket is an XLA
         #: compile, recorded in the compile ledger
@@ -206,6 +215,10 @@ class _DeviceSegment:
         #: bucket → predicted bytes (schema-fixed per plan, so one
         #: computation per bucket serves every later dispatch)
         self.pred_cache: Dict[int, int] = {}
+        #: bucket → AOT-deserialized program (programstore/store.py):
+        #: dispatched INSTEAD of tracing ``chain`` — the zero-retrace
+        #: cold-start path (docs/serving.md "AOT cold start")
+        self.aot_progs: Dict[int, Any] = {}
         import jax
         fused = list(stages)
         outs = list(out_names)
@@ -233,6 +246,11 @@ class TransformPlan:
         #: classification baseline (observability/ledger.py)
         self.ident: str = "plan"
         self.fp_json: Any = None
+        #: process-independent hash of (ident × schema fingerprint) —
+        #: the AOT program store's plan-coverage key (stage uids survive
+        #: save/load, so a fresh process computes the same hash;
+        #: programstore/store.py)
+        self.ident_hash: Optional[str] = None
 
     @property
     def num_segments(self) -> int:
@@ -368,12 +386,32 @@ class TransformPlan:
         _devicemem.record_dispatch(subsystem, predicted, bucket=n_pad,
                                    rows=n)
         first_bucket = n_pad not in seg.seen_buckets
+        if seg.fp_key is None:
+            seg.fp_key = _ledger.cache_key_hash(
+                (self.ident, seg_idx, tuple(seg.in_names),
+                 tuple(seg.out_names), self.fp_json))
+        seg_fp = seg.fp_key
+        # AOT program store: the first dispatch at a new bucket asks the
+        # open store sessions for a deserialized program BEFORE tracing
+        # the jitted chain — the zero-retrace cold-start path. Any miss
+        # (absent / key mismatch / corrupt / injected) degrades to the
+        # trace below with a typed record (programstore/store.py).
+        aot_fn = seg.aot_progs.get(n_pad)
+        if aot_fn is None and first_bucket:
+            from .programstore import store as _pstore
+            aot_fn = _pstore.lookup(seg_fp, n_pad,
+                                    component="plan-segment",
+                                    ledger_key=f"{seg_fp}@{n_pad}")
+            if aot_fn is not None:
+                seg.aot_progs[n_pad] = aot_fn
         pre_stats = _devicemem.memory_stats()
         t_disp = time.perf_counter()
         with _obs_span("plan.segment", cat=self.cat,
                        stages=len(seg.stages), rows=n,
-                       inputs=len(seg.in_names), outputs=len(seg.out_names)):
-            outs = seg.chain(tuple(vals_list), tuple(mask_list))
+                       inputs=len(seg.in_names), outputs=len(seg.out_names),
+                       aot=aot_fn is not None):
+            outs = (aot_fn or seg.chain)(tuple(vals_list),
+                                         tuple(mask_list))
         disp_secs = time.perf_counter() - t_disp
         post_stats = _devicemem.sample_measured(subsystem)
         # cost bytes: measured allocation delta where the backend reports
@@ -384,25 +422,38 @@ class TransformPlan:
                      - pre_stats.get("bytes_in_use", 0))
             if delta > 0:
                 cost_bytes = delta
-        if seg.fp_key is None:
-            seg.fp_key = _ledger.cache_key_hash(
-                (self.ident, seg_idx, tuple(seg.in_names),
-                 tuple(seg.out_names), self.fp_json))
-        seg_fp = seg.fp_key
         if first_bucket:
             seg_ident = f"{self.ident}/seg{seg_idx}"
             seg.seen_buckets.add(n_pad)
-            # the first dispatch at a NEW padding bucket traces+compiles
-            # a fresh XLA executable inside the jitted chain — that IS a
-            # program build (cold for the first bucket, bucket-change
-            # when row growth crossed a bucket boundary)
-            _ledger.record_build(
-                subsystem, identity=seg_ident,
-                key=f"{seg_fp}@{n_pad}", fingerprint=self.fp_json,
-                bucket=n_pad, seconds=disp_secs, rows=n,
-                stages=len(seg.stages), cat=self.cat)
-            _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
-                                   compile_s=disp_secs)
+            if aot_fn is not None:
+                # AOT hit: nothing was traced — no ledger build. The
+                # dispatch still lands a cost row (execute side) so the
+                # admission table stays warm.
+                _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
+                                       execute_s=disp_secs)
+            else:
+                # the first dispatch at a NEW padding bucket
+                # traces+compiles a fresh XLA executable inside the
+                # jitted chain — that IS a program build (cold for the
+                # first bucket, bucket-change when row growth crossed a
+                # bucket boundary, aot-miss when a store should have
+                # served it)
+                _ledger.record_build(
+                    subsystem, identity=seg_ident,
+                    key=f"{seg_fp}@{n_pad}", fingerprint=self.fp_json,
+                    bucket=n_pad, seconds=disp_secs, rows=n,
+                    stages=len(seg.stages), cat=self.cat)
+                _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
+                                       compile_s=disp_secs)
+                # populate: offer the freshly traced program to any
+                # active capture scope / cross-model store so the NEXT
+                # process (or replica) deserializes instead of tracing
+                from .programstore import store as _pstore
+                _pstore.offer_segment(
+                    seg_fp, n_pad, seg.chain,
+                    (tuple(vals_list), tuple(mask_list)),
+                    component="plan-segment", identity=seg_ident,
+                    plan_ident=self.ident_hash)
         else:
             _devicemem.record_cost(seg_fp, n_pad, cost_bytes,
                                    execute_s=disp_secs)
@@ -571,6 +622,9 @@ def _build_plan(stages: List[Any], table: FeatureTable,
                 itemsize = 4
             payload.out_shape[nm] = (
                 itemsize, tuple(int(x) for x in np.shape(col.values)[1:]))
+        for nm in payload.in_names:
+            payload.in_shape[nm] = tuple(
+                int(x) for x in np.shape(probe[nm].values)[1:])
     return plan
 
 
@@ -657,20 +711,79 @@ def get_plan(stages: Sequence[Any], table: FeatureTable, *,
             str(getattr(s, "uid", "?")) for s in stages)
         plan.fp_json = [[nm, dt, list(shape), bool(maskless)]
                         for nm, dt, shape, maskless in (fp or ())]
-        _ledger.record_build(
-            _ledger.current_subsystem("plan"),
-            identity=(plan.ident
-                      + f"/ki={int(keep_intermediates)}"
-                      + f"/ek={','.join(sorted(extra_keep))}"),
-            key=_ledger.cache_key_hash(key), fingerprint=plan.fp_json,
-            seconds=time.perf_counter() - t0,
-            segments=plan.num_segments, cat=cat)
+        plan.ident_hash = _ledger.cache_key_hash(
+            (plan.ident, plan.fp_json, keep_intermediates,
+             tuple(sorted(extra_keep))))
+        # AOT program store: a plan whose identity an open store session
+        # covers is an assembly step, not a build — its segments will
+        # dispatch deserialized programs, so recording a ledger build
+        # here would fail the zero-retrace gate for work that was never
+        # traced. An active store that does NOT cover it classifies the
+        # build aot-miss (programstore/store.py; docs/serving.md).
+        from .programstore import store as _pstore
+        if _pstore.plan_covered(plan.ident_hash):
+            _pstore.record_plan_hit(plan.ident_hash)
+        else:
+            if _pstore.sessions_active():
+                _pstore.note_plan_miss(_ledger.cache_key_hash(key))
+            _pstore.offer_plan_ident(plan.ident_hash)
+            _ledger.record_build(
+                _ledger.current_subsystem("plan"),
+                identity=(plan.ident
+                          + f"/ki={int(keep_intermediates)}"
+                          + f"/ek={','.join(sorted(extra_keep))}"),
+                key=_ledger.cache_key_hash(key), fingerprint=plan.fp_json,
+                seconds=time.perf_counter() - t0,
+                segments=plan.num_segments, cat=cat)
     _PLAN_CACHE[key] = plan
     _PLAN_CACHE.move_to_end(key)
     while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
         evicted_key, _ = _PLAN_CACHE.popitem(last=False)
         _ledger.record_eviction(_ledger.cache_key_hash(evicted_key))
     return plan
+
+
+def export_plan_programs(plan: TransformPlan,
+                         bucket: Optional[int] = None) -> int:
+    """Offer every device segment of ``plan`` to the AOT program store
+    at ``bucket`` (default the minimum padding bucket — where every warm
+    flush of up to 256 rows lands), WITHOUT dispatching anything: the
+    traced avals are reconstructed from the zero-row probe's shapes
+    (staged inputs are always f32 values padded to the bucket plus a
+    bool validity mask — `_run_segment`'s staging contract). This is the
+    save-time populate path (``programstore.populate_for_save``) and the
+    first-replica fallback when warm dispatches were already traced
+    in-process. Returns segments offered; no-op (0) outside a capture
+    scope / env store."""
+    from .programstore import store as _pstore
+    from .utils.padding import _MIN_BUCKET
+    if not _pstore.aot_enabled():
+        return 0
+    import jax
+    import jax.numpy as jnp
+    n_pad = int(bucket or _MIN_BUCKET)
+    offered = 0
+    seg_idx = 0
+    for kind, seg in plan.steps:
+        if kind != "device":
+            continue
+        if seg.fp_key is None:
+            seg.fp_key = _ledger.cache_key_hash(
+                (plan.ident, seg_idx, tuple(seg.in_names),
+                 tuple(seg.out_names), plan.fp_json))
+        vals = tuple(
+            jax.ShapeDtypeStruct((n_pad,) + seg.in_shape.get(nm, ()),
+                                 jnp.float32)
+            for nm in seg.in_names)
+        masks = tuple(jax.ShapeDtypeStruct((n_pad,), jnp.bool_)
+                      for _ in seg.in_names)
+        offered += 1 if _pstore.offer_segment(
+            seg.fp_key, n_pad, seg.chain, (vals, masks),
+            component="plan-segment",
+            identity=f"{plan.ident}/seg{seg_idx}",
+            plan_ident=plan.ident_hash) else 0
+        seg_idx += 1
+    return offered
 
 
 def _concat_columns(a: Column, b: Column) -> Column:

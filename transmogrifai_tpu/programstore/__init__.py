@@ -1,0 +1,14 @@
+"""AOT program store: zero-retrace cold start + a fleet-wide compile cache.
+
+See :mod:`.store` (content-addressed artifact store, sessions, the
+``aot.load`` chaos site) and :mod:`.aot` (jax.export serialize /
+deserialize helpers). docs/serving.md "AOT cold start & the program
+store" is the operator-facing contract.
+"""
+from .store import (  # noqa: F401
+    AOT_ENV, PROGRAMS_DIR, ProgramStore, StoreEntryError, active_captures,
+    aot_enabled, capture, close_sessions, enable_aot, lookup,
+    offer_segment, open_env_session, open_model_session, plan_covered,
+    populate_for_save, record_plan_hit, reset, sessions_active, snapshot,
+    stats,
+)

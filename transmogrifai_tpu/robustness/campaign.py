@@ -83,6 +83,7 @@ ACCOUNT_KINDS = {
     "fleet.route": "fleet_failover",
     "fleet.replica_kill": "replica_lost",
     "fleet.probe": "fleet_probe_failed",
+    "aot.load": "aot_fallback",
 }
 
 
@@ -380,7 +381,15 @@ class _ServeHealScenario(_Scenario):
     """Registry + drift monitor + background refit under shifted traffic:
     the self-healing loop. With ``drift.refit`` armed the refit must fail
     typed, the OLD model must keep serving, and the breaker must stay
-    untouched — even while ``oom.serve`` splits flushes underneath."""
+    untouched — even while ``oom.serve`` splits flushes underneath.
+
+    Also the AOT program store's scenario: ``setup`` saves the model
+    (populating ``<dir>/programs/`` + the manifest ``programs`` section),
+    so every ``registry.load`` here warm-starts through deserialized
+    programs. With ``aot.load`` armed, the injected bad artifact must
+    degrade to a bit-equal re-traced result with a typed ``aot_fallback``
+    on the runtime's fault log (ACCOUNT_KINDS) — never a crash or a
+    silently divergent record (the per-row bit-equality oracle)."""
 
     name = "serve_heal"
 

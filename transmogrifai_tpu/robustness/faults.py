@@ -110,6 +110,16 @@ site                         fires in
                              replica's ``health()`` read (consecutive
                              failures walk the ejection ladder; healthy
                              probes readmit)
+``aot.load``                 in the AOT program store, after an entry is
+                             found and before its artifact loads
+                             (programstore/store.py; models a corrupt /
+                             truncated / stale-jaxlib artifact — the
+                             dispatch falls back to the trace path
+                             bit-equally with a typed ``aot_fallback``
+                             record and an ``aot-miss`` ledger cause;
+                             ``aot.*`` sites keep the planner active
+                             like ``plan.*`` — the store lives inside
+                             the planner's segment dispatch)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
@@ -289,6 +299,10 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
     _site("fleet.probe", "raise", "serving/frontdoor.py", "fleet",
           "probe failure counted; consecutive failures eject the "
           "replica, healthy probes readmit it — requests unaffected"),
+    _site("aot.load", "raise", "programstore/store.py", "serve_heal",
+          "bad AOT artifact falls back to the trace path bit-equally; "
+          "typed aot_fallback recorded, ledger build classified "
+          "aot-miss — never a request error"),
     _site("preempt.stage_fit", "preempt", "dag.py", "train|stream",
           "train(resume=True) restores verified stages, bit-exact"),
     _site("preempt.checkpoint_write", "preempt", "persistence.py",

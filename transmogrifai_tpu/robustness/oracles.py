@@ -177,6 +177,24 @@ def clean_slo_state() -> List[str]:
     return cleaned
 
 
+def programstore_violations() -> List[str]:
+    """AOT program-store state that must not outlive a test or campaign
+    schedule: an active capture scope (captures are strictly
+    context-managed — one still open means a populate path leaked) and
+    a lingering forced TG_AOT override. Open *sessions* are passive
+    read-side dicts and are swept (not flagged) by the conftest fixture
+    — but their presence changes later builds' ledger classification,
+    so the sweep is mandatory."""
+    from ..programstore import store as _pstore
+    out: List[str] = []
+    caps = _pstore.active_captures()
+    if caps:
+        out.append(f"AOT capture scope(s) still active: {caps}")
+    if _pstore._enabled_override is not None:
+        out.append("a forced AOT enable/disable override is active")
+    return out
+
+
 def plan_cache_violations() -> List[str]:
     """The compiled-plan LRU must stay bounded and no forced
     planner-enable override may linger."""
@@ -284,4 +302,11 @@ def campaign_violations(clean: bool = True,
     out.extend(plan_cache_violations())
     out.extend(blackbox_violations())
     out.extend(ledger_violations())
+    out.extend(programstore_violations())
+    if clean:
+        # sessions opened by a schedule's registry.load must not change
+        # the NEXT schedule's ledger classification (an open session
+        # turns would-be-cold builds into aot-miss)
+        from ..programstore import store as _pstore
+        _pstore.close_sessions()
     return out

@@ -387,8 +387,13 @@ class SubprocessReplica:
                     "path": model_or_path}).result(timeout=180.0)
 
     def warm_reports(self) -> Dict[str, Any]:
+        """Per-model warm reports read through the health protocol —
+        ``registry.health()`` carries each runtime's ``warm_info`` under
+        ``models.<name>.warm`` (the bench's per-replica zero-compile +
+        AOT-hit evidence crosses the process boundary here)."""
         try:
-            return self.health().get("warm", {})
+            models = self.health().get("models", {})
+            return {name: m.get("warm") for name, m in models.items()}
         except Exception:
             return {}
 

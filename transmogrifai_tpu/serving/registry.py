@@ -73,9 +73,12 @@ class ModelRegistry:
                  warm: bool = False,
                  warm_entry: Optional[Dict[str, Any]] = None,
                  drift_monitor: Optional["_drift.DriftMonitor"] = None,
+                 store_path: Optional[str] = None,
                  ) -> ServingRuntime:
         """Start a runtime for ``model`` under ``name``. ``warm=True``
-        pre-traces the serve plans before the runtime takes traffic;
+        pre-warms the serve programs before the runtime takes traffic
+        (deserialized from the AOT store when a session is open, traced
+        otherwise — and captured back into ``store_path`` when given);
         ``drift_monitor`` attaches online distribution monitoring."""
         with self._lock:
             if name in self._runtimes:
@@ -90,7 +93,7 @@ class ModelRegistry:
             self._runtimes[name] = rt
         self._wire_drift(name, rt)
         if warm:
-            _warmup.warm_runtime(rt, warm_entry)
+            _warmup.warm_runtime(rt, warm_entry, store_path=store_path)
         rt.start()
         return rt
 
@@ -105,7 +108,8 @@ class ModelRegistry:
         model, entry, monitor = self._load_parts(path, workflow)
         rt = self.register(name, model, config=config, warm=warm,
                            warm_entry=entry or None,
-                           drift_monitor=monitor)
+                           drift_monitor=monitor,
+                           store_path=path if warm else None)
         if warm:
             # warmup-time cost persistence: the warm pre-trace just
             # measured this process's (segment fingerprint × bucket)
@@ -122,6 +126,13 @@ class ModelRegistry:
     def _load_parts(path: str, workflow=None):
         from ..manifest import CheckpointManifest
         from ..persistence import FORMAT_VERSION, load_model
+        from ..programstore import store as _pstore
+        # AOT program store: open the session over the manifest
+        # `programs` section BEFORE anything can trace, so the warm
+        # pre-pass (and every later new-bucket dispatch) deserializes
+        # stored programs instead of compiling (docs/serving.md "AOT
+        # cold start & the program store"; None when absent/disabled)
+        _pstore.open_model_session(path)
         model = load_model(path, workflow=workflow)
         manifest, err = CheckpointManifest.load(path, FORMAT_VERSION)
         entry = dict(manifest.serving) if err is None else {}
@@ -260,7 +271,10 @@ class ModelRegistry:
                                 drift_monitor=monitor, auto_start=False)
         self._wire_drift(name, new_rt)
         if warm:
-            _warmup.warm_runtime(new_rt, entry or None)
+            _warmup.warm_runtime(new_rt, entry or None,
+                                 store_path=(model_or_path
+                                             if isinstance(model_or_path,
+                                                           str) else None))
         new_rt.start()
         with self._lock:
             if self._runtimes.get(name) is not old:

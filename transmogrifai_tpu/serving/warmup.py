@@ -62,28 +62,68 @@ def manifest_serving_entry(model) -> Dict[str, Any]:
 
 
 def warm_runtime(runtime, entry: Optional[Dict[str, Any]] = None,
-                 rows: Optional[int] = None) -> Dict[str, Any]:
-    """Pre-trace the runtime's serve plans; returns the warm report that
-    lands in ``runtime.warm_info`` / the registry health snapshot:
-    ``{"rows", "plansWarmed", "ok", "fingerprintMatch", "error"}``.
+                 rows: Optional[int] = None,
+                 store_path: Optional[str] = None) -> Dict[str, Any]:
+    """Pre-warm the runtime's serve programs; returns the warm report
+    that lands in ``runtime.warm_info`` / the registry health snapshot:
+    ``{"rows", "plansWarmed", "ok", "fingerprintMatch", "error",
+    "compiles", "compileCauses", "aotHits", "aotMisses", "aotExports"}``.
 
-    Never raises — a model whose raw extracts cannot handle an all-missing
-    probe row simply serves its first request cold (reported)."""
+    With an AOT program-store session open over the model dir
+    (``registry.load`` opens it before calling here), the warm pass
+    *deserializes* the stored programs instead of tracing — zero
+    compile-ledger builds, ``aotHits`` > 0. When ``store_path`` is given
+    and the store missed (first replica, pre-AOT model dir), the traced
+    warm dispatches are captured back into ``<store_path>/programs/`` +
+    the manifest ``programs`` section so the NEXT load deserializes —
+    a fleet's N replicas compile once total (docs/serving.md "AOT cold
+    start & the program store").
+
+    Never raises — a model whose raw extracts cannot handle an
+    all-missing probe row simply serves its first request cold
+    (reported)."""
+    import contextlib
+
     from .. import plan as _plan
     from ..observability import ledger as _ledger
+    from ..programstore import store as _pstore
     n = _warm_rows(rows if rows is not None
                    else (entry or {}).get("warmRows"))
     before = _plan.cache_stats()["entries"]
     led = _ledger.ledger()
     mark = led.mark()
+    aot_before = _pstore.stats()
     info: Dict[str, Any] = {"rows": n, "plansWarmed": 0, "ok": True,
                             "fingerprintMatch": None, "error": None}
+    cap = (_pstore.capture(store_path) if store_path is not None
+           else contextlib.nullcontext())
     try:
-        runtime.warm(n)
+        # the warm pass runs under the runtime's fault log so a store
+        # fallback (typed `aot_fallback`) lands where health/campaign
+        # oracles read it, and under the capture scope so traced
+        # programs populate the store
+        with runtime.fault_log.activate(), cap:
+            runtime.warm(n)
+            if store_path is not None:
+                mid = _pstore.stats()
+                if mid["hitsTotal"] - aot_before["hitsTotal"] == 0:
+                    # the store did not serve this model (first replica,
+                    # pre-AOT dir): populate it so the NEXT load
+                    # deserializes. Dispatch-time offers cover freshly
+                    # traced segments; a plan the process had already
+                    # traced needs this explicit probe-aval export.
+                    p = _pstore.serve_plan_for(runtime.model, n)
+                    if p is not None:
+                        _plan.export_plan_programs(p)
     except Exception as e:
         info["ok"] = False
         info["error"] = f"{type(e).__name__}: {e}"[:300]
     info["plansWarmed"] = max(0, _plan.cache_stats()["entries"] - before)
+    aot_after = _pstore.stats()
+    info["aotHits"] = aot_after["hitsTotal"] - aot_before["hitsTotal"]
+    info["aotMisses"] = (aot_after["missesTotal"]
+                         - aot_before["missesTotal"])
+    info["aotExports"] = aot_after["exports"] - aot_before["exports"]
     # compile-ledger accounting: the builds warmup pre-paid (subsystem
     # "serve") — what the warm-path zero-retrace gate subtracts before
     # asserting the first real request compiles NOTHING
