@@ -865,6 +865,101 @@ def _run_serve(platform):
         _shutil.rmtree(fleet_pm, ignore_errors=True)
         os.environ.pop("TG_POSTMORTEM_DIR", None)
 
+    # network-edge wire lines (docs/serving.md "Network edge"): the same
+    # clean open-loop rate over real localhost sockets, one line per
+    # framing, against an in-process reference on the SAME runtime at
+    # the SAME rate — protocol overhead is a measured, gated number.
+    # Then a disconnect-chaos arm: forced net.read/net.write drops plus
+    # a reconnect mix, asserting the wire accounting identity (zero
+    # lost futures, zero untyped failures, disconnects land in the
+    # typed shedDisconnect bucket).
+    from transmogrifai_tpu.serving.loadgen import run_wire_open_loop
+    from transmogrifai_tpu.serving.netedge import NetEdge
+    wire_seconds = float(os.environ.get("BENCH_WIRE_SECONDS", seconds))
+    wire_rps = max(10.0, runtime_capacity
+                   * float(os.environ.get("BENCH_SERVE_CLEAN_FRACTION",
+                                          0.35)))
+    min_frac = float(os.environ.get("BENCH_WIRE_MIN_FRACTION", 0.5))
+    # batched requests are the columnar framing's natural shape; 1-row
+    # requests over a handful of synchronous connections would measure
+    # client round-trip latency, not the edge
+    wire_batch = int(os.environ.get("BENCH_WIRE_BATCH_ROWS", 32))
+    with ServingRuntime(model, "wire", cfg) as rt:
+        rt.warm()
+        inproc = run_open_loop(rt, rows, wire_seconds, wire_rps,
+                               deadline_ms=deadline_ms)
+        with NetEdge(rt, name="bench") as edge:
+            whost, wport = edge.address
+            for proto in ("http", "binary"):
+                wrep = run_wire_open_loop(
+                    whost, wport, rows, wire_seconds, wire_rps,
+                    deadline_ms=deadline_ms, protocols=(proto,),
+                    batch_rows=wire_batch)
+                assert wrep["lost"] == 0 and wrep["failed"] == 0, wrep
+                assert wrep["accountingOk"], wrep
+                ratio = (wrep["rowsPerSec"]
+                         / max(inproc["rowsPerSec"], 1.0))
+                if proto == "binary":
+                    # the fast-path gate: binary framing must sustain at
+                    # least BENCH_WIRE_MIN_FRACTION of the in-process
+                    # line at the same offered rate
+                    assert ratio >= min_frac, (
+                        f"binary wire line sustained only "
+                        f"{wrep['rowsPerSec']:.1f} rows/s vs "
+                        f"{inproc['rowsPerSec']:.1f} in-process "
+                        f"(ratio {ratio:.3f} < gate {min_frac})")
+                pp = wrep["protocols"][proto]
+                print(json.dumps({
+                    "metric": f"serve_wire_{proto}_rows_per_sec_"
+                              f"{d}feat_{platform}",
+                    "value": wrep["rowsPerSec"],
+                    "unit": "rows/sec",
+                    "vs_baseline": round(ratio, 3),
+                    "phases": {
+                        "inProcessRowsPerSec": inproc["rowsPerSec"],
+                        "wireOverheadPct": round(100.0 * (1.0 - ratio),
+                                                 1),
+                        "batchRows": wire_batch,
+                        "offeredRps": wrep["offeredRps"],
+                        "p50Ms": pp["p50Ms"], "p99Ms": pp["p99Ms"],
+                        "lost": wrep["lost"], "failed": wrep["failed"],
+                        "shedOverload": wrep["shedOverload"],
+                        "shedDisconnect": wrep["shedDisconnect"],
+                    },
+                }), flush=True)
+            # disconnect-chaos arm: drop a handful of connections at the
+            # read and write sites mid-soak while the driver also churns
+            # connections (reconnect_every) — the identity must hold
+            with faults.injected({
+                    "net.read": {"mode": "raise", "nth": 5, "count": 3},
+                    "net.write": {"mode": "raise", "nth": 9,
+                                  "count": 3}}):
+                crep = run_wire_open_loop(
+                    whost, wport, rows, wire_seconds, wire_rps,
+                    deadline_ms=deadline_ms,
+                    protocols=("http", "binary"), reconnect_every=7,
+                    batch_rows=wire_batch)
+            assert crep["lost"] == 0 and crep["failed"] == 0, crep
+            assert crep["accountingOk"], crep
+            assert crep["shedDisconnect"] >= 1, (
+                f"disconnect chaos armed but no shedDisconnect: {crep}")
+            print(json.dumps({
+                "metric": f"serve_wire_chaos_rows_per_sec_"
+                          f"{d}feat_{platform}",
+                "value": crep["rowsPerSec"],
+                "unit": "rows/sec",
+                "vs_baseline": round(
+                    crep["rowsPerSec"]
+                    / max(inproc["rowsPerSec"], 1.0), 3),
+                "phases": {
+                    "shedDisconnect": crep["shedDisconnect"],
+                    "shedOverload": crep["shedOverload"],
+                    "lost": crep["lost"], "failed": crep["failed"],
+                    "accountingOk": crep["accountingOk"],
+                    "p99Ms": crep["p99Ms"],
+                },
+            }), flush=True)
+
 
 def _run_stream(platform):
     """BENCH_MODE=stream: the out-of-core line (docs/streaming.md). Trains
@@ -1136,7 +1231,9 @@ def _run_campaign(platform):
     randomized multi-fault schedules (default 200; coverage singletons
     for every registered site first — the fleet.* sites included, so the
     site-coverage guard extends to the replica front door automatically)
-    across all seven scenario harnesses
+    across all eight scenario harnesses (the ``net`` scenario drives the
+    socket edge, so the ``net.*`` sites are covered over real
+    connections)
     and asserts the campaign contract: 100% site coverage, ZERO invariant
     violations, and full serve request accounting (zero lost / zero
     failed futures). A violation prints the minimized one-command

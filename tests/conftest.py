@@ -281,6 +281,30 @@ def _no_fleet_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_net_leak():
+    """A network edge owns a listening socket plus a ``tg-net`` thread
+    running a private asyncio loop — a leaked edge keeps accepting
+    connections (and holding its port) underneath every later test.
+    Defined AFTER the fleet fixture so this teardown runs FIRST:
+    closing a leaked edge resolves its in-flight connections (typed
+    ``server_close`` sheds) while the fleet/runtime it fronts still
+    accepts. Probes + cleanup live in robustness/oracles.py
+    (``net_violations``, also run by the campaign engine after every
+    schedule)."""
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.net_violations(), (
+        "network edge(s) leaked from a previous test: "
+        f"{oracles.net_violations()}")
+    yield
+    leaked = oracles.close_leaked_net_edges()
+    assert not leaked, (
+        f"a test leaked running network edge(s): {leaked}")
+    stray = oracles.leaked_threads(("tg-net",))
+    assert not stray, f"net edge thread(s) survived a test: {stray}"
+
+
+@pytest.fixture(autouse=True)
 def _no_drift_leak():
     """Drift refits run on background ``tg-drift-refit`` daemon threads
     (serving/registry.py) that retrain + save + hot-swap a model. A refit

@@ -1,0 +1,496 @@
+"""Network edge (serving/netedge.py + serving/netproto.py;
+docs/serving.md "Network edge").
+
+The contract under test extends ROADMAP item 1's zero-lost-futures
+identity across a real socket: every wire failure mode — malformed
+frame, oversized payload, slow-loris reader, half-open peer, chaos at
+``net.accept``/``net.read``/``net.write`` — resolves as a *typed* shed
+with a mapped status code, futures submitted before a disconnect are
+always awaited, ``Retry-After`` tracks the windowed shed rate (absent
+when clean, clamped otherwise), and the campaign ``net`` scenario holds
+the same accounting oracles as the in-process scenarios.
+"""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.local import micro_batch_score_function
+from transmogrifai_tpu.robustness import faults, oracles
+from transmogrifai_tpu.robustness.campaign import (
+    ACCOUNT_KINDS, ChaosCampaign,
+)
+from transmogrifai_tpu.serving import (
+    NetEdge, NetEdgeConfig, ServeConfig, ServingRuntime, derive_retry_after,
+    live_edges,
+)
+from transmogrifai_tpu.serving import netproto
+from transmogrifai_tpu.serving.loadgen import (
+    run_wire_open_loop, synthetic_rows,
+)
+from transmogrifai_tpu.serving.netproto import (
+    FrameError, WireClient, WireDisconnect,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.net
+
+
+def _train_model(n=300, seed=7):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2) > 0).astype(float)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(c).extract_field().as_predictor()
+             for c in ("x1", "x2")]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed,
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train_model()
+
+
+def _rows(model, n=8, seed=57):
+    return synthetic_rows(model, n, seed=seed)
+
+
+def _cfg(**kw):
+    base = dict(max_batch=32, max_queue=128, max_wait_ms=5.0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _counter(edge, name, **labels):
+    """Sum of an edge-local counter across matching label sets."""
+    total = 0.0
+    for key, value in edge.metrics.snapshot().get(name, {}).items():
+        lbls = dict(p.split("=", 1) for p in key.split(",") if "=" in p)
+        if all(lbls.get(k) == v for k, v in labels.items()):
+            total += value
+    return total
+
+
+def _wait_counter(edge, name, target, timeout=5.0, **labels):
+    """Poll an edge counter up to ``target`` (sheds are recorded after
+    the response is written, so a fast client can read first)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = _counter(edge, name, **labels)
+        if v >= target:
+            return v
+        time.sleep(0.02)
+    return _counter(edge, name, **labels)
+
+
+# -- the framing itself (no socket) ----------------------------------------
+
+def test_binary_roundtrip_preserves_rows_and_types():
+    rows = [{"f": 1.5, "i": 2, "b": True, "s": "αβ", "n": None},
+            {"f": -0.25, "i": -7, "b": False, "s": "", "n": 3.0},
+            {"f": None, "i": 0, "b": None, "s": "x", "n": None}]
+    frame = netproto.encode_binary_request(
+        rows, tenant="t1", token="tok", deadline_ms=125.0)
+    # strip the frame header: decode takes the payload the server reads
+    header, out = netproto.decode_binary_request(
+        frame[netproto.FRAME_HEADER.size:])
+    assert header["tenant"] == "t1" and header["token"] == "tok"
+    assert header["deadlineMs"] == 125.0
+    assert len(out) == len(rows)
+    for a, b in zip(out, rows):
+        assert set(a) == set(b)
+        for k in b:
+            if isinstance(b[k], float):
+                assert a[k] == b[k]  # bit-exact f8 columns
+            else:
+                assert a[k] == b[k]
+
+
+def test_binary_decode_rejects_garbage_and_trailing_bytes():
+    with pytest.raises(FrameError):
+        netproto.decode_binary_request(b"\x00\x01garbage")
+    good = netproto.encode_binary_request(
+        [{"x": 1.0}])[netproto.FRAME_HEADER.size:]
+    with pytest.raises(FrameError):
+        netproto.decode_binary_request(good + b"trailing")
+    # truncated column block
+    with pytest.raises(FrameError):
+        netproto.decode_binary_request(good[:-3])
+
+
+def test_columns_from_rows_first_seen_order_and_nulls():
+    names, cols = netproto.columns_from_rows(
+        [{"a": 1.0, "b": "x"}, {"b": "y", "c": None, "a": 2.0}])
+    assert names == ["a", "b", "c"]
+    assert [len(c) for c in cols] == [2, 2, 2]
+
+
+# -- Retry-After derivation ------------------------------------------------
+
+def test_derive_retry_after_clean_window_is_absent():
+    assert derive_retry_after(0.0) is None
+    assert derive_retry_after(-1.0) is None
+    assert derive_retry_after(None) is None
+
+
+def test_derive_retry_after_scales_and_clamps():
+    cfg = NetEdgeConfig(retry_scale_s=2.0, retry_min_s=1.0,
+                        retry_max_s=30.0)
+    assert derive_retry_after(0.01, cfg) == 1.0       # floor clamp
+    assert derive_retry_after(5.0, cfg) == 10.0       # linear midrange
+    assert derive_retry_after(1e9, cfg) == 30.0       # ceiling clamp
+    # monotone in the observed pressure
+    hints = [derive_retry_after(r, cfg) for r in (0.1, 1.0, 5.0, 100.0)]
+    assert hints == sorted(hints)
+
+
+def test_retry_after_tracks_windowed_shed_rate(model):
+    with ServingRuntime(model, "ra", _cfg()) as rt:
+        with NetEdge(rt, name="ra-edge") as edge:
+            # clean windows on both samplers: no hint, no header
+            assert edge.retry_after_s() is None
+            # 40 sheds over a sampled 10s window -> 4/s -> 4s hint
+            # (deterministic: ticks are forced with explicit clocks,
+            # future-dated so they land after the attach-time sample)
+            s = edge.sampler
+            t0 = time.monotonic() + 120.0
+            s.tick(now=t0)
+            edge.metrics.counter(
+                "tg_net_shed_total", "", reason="overload",
+                proto="http", edge=edge.name).inc(40)
+            s.tick(now=t0 + 10.0)
+            hint = derive_retry_after(
+                s.rate("tg_net_shed_total", edge.config.retry_window_s,
+                       now=t0 + 10.0),
+                edge.config)
+            assert hint is not None and 1.0 <= hint <= 30.0
+            assert abs(hint - 4.0) < 0.5
+
+
+def test_wire_429_carries_retry_after_and_clean_200_does_not(model):
+    # a queue of 1 with a slow flush: the second submit overloads
+    with ServingRuntime(model, "bp", _cfg(max_queue=1,
+                                          max_wait_ms=300.0)) as rt:
+        with NetEdge(rt, name="bp-edge") as edge:
+            host, port = edge.address
+            with WireClient(host, port, protocol="binary") as cli:
+                sheds = 0
+                for _ in range(12):
+                    res = cli.request(_rows(model, 4))
+                    if res.status == 429:
+                        sheds += 1
+                        # the shed itself lands in the edge window; a
+                        # forced tick makes the NEXT refusal carry the
+                        # clamped windowed hint
+                        edge.sampler.tick()
+                assert sheds >= 1, "queue=1 never overloaded"
+                res = cli.request(_rows(model, 4))
+                while res.status != 429:
+                    res = cli.request(_rows(model, 4))
+                assert res.retry_after_s is not None
+                assert (edge.config.retry_min_s <= res.retry_after_s
+                        <= edge.config.retry_max_s)
+    # a clean edge never volunteers the header
+    with ServingRuntime(model, "bp2", _cfg()) as rt:
+        with NetEdge(rt, name="bp2-edge") as edge:
+            with WireClient(*edge.address) as cli:
+                res = cli.request(_rows(model, 2))
+                assert res.status == 200
+                assert res.retry_after_s is None
+
+
+# -- end-to-end scoring ----------------------------------------------------
+
+def test_both_protocols_score_bit_equal_to_in_process(model):
+    rows = _rows(model, 12)
+    base = micro_batch_score_function(model)(rows)
+    with ServingRuntime(model, "wire-eq", _cfg()) as rt:
+        with NetEdge(rt, name="eq-edge") as edge:
+            host, port = edge.address
+            for proto in ("http", "binary"):
+                with WireClient(host, port, protocol=proto) as cli:
+                    res = cli.request(rows)
+                    assert res.status == 200, res
+                    assert res.protocol == proto
+                    assert res.records == base, (
+                        f"{proto} records differ from in-process")
+
+
+def test_keep_alive_connection_reused_across_requests(model):
+    with ServingRuntime(model, "ka", _cfg()) as rt:
+        with NetEdge(rt, name="ka-edge") as edge:
+            with WireClient(*edge.address) as cli:
+                for _ in range(3):
+                    assert cli.request(_rows(model, 2)).status == 200
+                assert cli.connected
+            conns = _counter(edge, "tg_net_connections_total")
+            assert conns == 1.0, f"expected 1 connection, saw {conns}"
+
+
+# -- wire failure modes are typed sheds ------------------------------------
+
+def test_malformed_http_json_is_400_and_keep_alive_survives(model):
+    with ServingRuntime(model, "bad", _cfg()) as rt:
+        with NetEdge(rt, name="bad-edge") as edge:
+            host, port = edge.address
+            with socket.create_connection((host, port), timeout=5) as s:
+                body = b"{not json"
+                s.sendall(b"POST /score HTTP/1.1\r\n"
+                          b"Content-Type: application/json\r\n"
+                          + f"Content-Length: {len(body)}\r\n\r\n"
+                          .encode() + body)
+                reader = netproto._SockReader(s)
+                status, headers, resp = netproto.read_http_response(reader)
+                assert status == 400
+                assert json.loads(resp)["error"] == "bad_frame"
+                # the body was fully drained: same socket still works
+                good = json.dumps(
+                    {"rows": _rows(model, 2)}).encode()
+                s.sendall(b"POST /score HTTP/1.1\r\n"
+                          + f"Content-Length: {len(good)}\r\n\r\n"
+                          .encode() + good)
+                status, _, resp = netproto.read_http_response(reader)
+                assert status == 200
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="bad_frame") >= 1
+
+
+def test_http_bad_path_is_404_typed(model):
+    with ServingRuntime(model, "path", _cfg()) as rt:
+        with NetEdge(rt, name="path-edge") as edge:
+            with socket.create_connection(edge.address, timeout=5) as s:
+                s.sendall(b"GET /metrics HTTP/1.1\r\n\r\n")
+                status, _, resp = netproto.read_http_response(
+                    netproto._SockReader(s))
+                assert status == 404
+                assert json.loads(resp)["error"] == "bad_path"
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="bad_path") == 1.0
+
+
+def test_oversized_frame_is_413_and_connection_closes(model):
+    cfg = NetEdgeConfig(max_frame_bytes=512)
+    with ServingRuntime(model, "big", _cfg()) as rt:
+        with NetEdge(rt, name="big-edge", config=cfg) as edge:
+            host, port = edge.address
+            # binary: an honest length header above the cap is refused
+            # before the payload is read
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(netproto.MAGIC
+                          + bytes([netproto.KIND_REQUEST])
+                          + (1 << 16).to_bytes(4, "big"))
+                rdr = netproto._SockReader(s)
+                magic, kind, ln = struct.unpack(
+                    ">4sBI", rdr.read_exact(9))
+                obj = json.loads(rdr.read_exact(ln))
+                assert obj["status"] == 413
+                with pytest.raises(WireDisconnect):
+                    rdr.read_exact(1)  # server closed: cannot skip
+            # http: Content-Length above the cap
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(b"POST /score HTTP/1.1\r\n"
+                          b"Content-Length: 999999\r\n\r\n")
+                status, headers, _ = netproto.read_http_response(
+                    netproto._SockReader(s))
+                assert status == 413
+            assert _wait_counter(edge, "tg_net_shed_total", 2.0,
+                                 reason="oversize") == 2.0
+
+
+def test_slow_loris_and_half_open_shed_without_touching_the_runtime(
+        model):
+    cfg = NetEdgeConfig(read_timeout_s=0.3)
+    with ServingRuntime(model, "loris", _cfg()) as rt:
+        with NetEdge(rt, name="loris-edge", config=cfg) as edge:
+            host, port = edge.address
+            # slow-loris: two bytes then a stall — typed read_timeout
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(b"PO")
+                time.sleep(0.6)
+            # half-open mid-frame: a binary header promising 64 bytes,
+            # then a hard close — the edge must resolve the connection
+            # without losing anything (nothing was ever submitted)
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(netproto.MAGIC
+                          + bytes([netproto.KIND_REQUEST])
+                          + (64).to_bytes(4, "big") + b"short")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and _counter(
+                    edge, "tg_net_shed_total", reason="read_timeout") < 2:
+                time.sleep(0.05)
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="read_timeout") >= 2
+            assert _counter(edge, "tg_net_lost_total") == 0
+            # the runtime behind the edge is untouched: a real request
+            # on a fresh connection scores normally
+            with WireClient(host, port, protocol="binary") as cli:
+                assert cli.request(_rows(model, 2)).status == 200
+
+
+# -- auth/quota at the socket ----------------------------------------------
+
+def test_token_auth_maps_tenant_and_rejects_unknown(model):
+    with ServingRuntime(model, "auth", _cfg()) as rt:
+        with NetEdge(rt, name="auth-edge",
+                     tokens={"sekrit": "acme"}) as edge:
+            host, port = edge.address
+            for proto in ("http", "binary"):
+                with WireClient(host, port, protocol=proto) as cli:
+                    assert cli.request(_rows(model, 2)).status == 401
+                with WireClient(host, port, protocol=proto,
+                                token="wrong") as cli:
+                    assert cli.request(_rows(model, 2)).status == 401
+                with WireClient(host, port, protocol=proto,
+                                token="sekrit") as cli:
+                    assert cli.request(_rows(model, 2)).status == 200
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="auth") == 4.0
+
+
+def test_tenant_quota_sheds_429_at_the_edge(model):
+    cfg = NetEdgeConfig(tenant_rps=2.0)
+    with ServingRuntime(model, "quota", _cfg()) as rt:
+        with NetEdge(rt, name="quota-edge", config=cfg,
+                     tokens={"k": "noisy"}) as edge:
+            with WireClient(*edge.address, protocol="binary",
+                            token="k") as cli:
+                statuses = [cli.request(_rows(model, 1)).status
+                            for _ in range(5)]
+            assert statuses.count(200) == 2, statuses
+            assert statuses.count(429) == 3, statuses
+            assert _counter(edge, "tg_net_tenant_shed_total",
+                            tenant="noisy") == 3.0
+
+
+# -- chaos sites -----------------------------------------------------------
+
+def test_chaos_net_accept_drops_connection_as_typed_shed(model):
+    with ServingRuntime(model, "ca", _cfg()) as rt:
+        with NetEdge(rt, name="ca-edge") as edge:
+            with faults.injected({"net.accept": {"mode": "raise",
+                                                 "nth": 1, "count": 1}}):
+                with pytest.raises(WireDisconnect):
+                    with WireClient(*edge.address,
+                                    protocol="binary") as cli:
+                        cli.request(_rows(model, 2))
+                # fired counts reset when the injection context exits
+                assert faults.fired_counts().get("net.accept"), \
+                    "net.accept armed but never fired"
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="accept_fault") == 1.0
+            kinds = [r.kind for r in edge.fault_log.reports]
+            assert ACCOUNT_KINDS["net.accept"] in kinds
+            # the listener recovered: next connection scores
+            with WireClient(*edge.address, protocol="binary") as cli:
+                assert cli.request(_rows(model, 2)).status == 200
+
+
+@pytest.mark.parametrize("site,reason", [
+    ("net.read", "read_fault"), ("net.write", "write_fault")])
+def test_chaos_read_write_resolve_as_typed_sheds_never_lost(
+        model, site, reason):
+    rows = _rows(model, 4)
+    base = micro_batch_score_function(model)(rows)
+    with ServingRuntime(model, "crw", _cfg()) as rt:
+        with NetEdge(rt, name="crw-edge") as edge:
+            with faults.injected({site: {"mode": "raise",
+                                         "nth": 1, "count": 1}}):
+                with pytest.raises(WireDisconnect):
+                    with WireClient(*edge.address,
+                                    protocol="http") as cli:
+                        cli.request(rows)
+            assert _counter(edge, "tg_net_shed_total",
+                            reason=reason) == 1.0
+            assert _counter(edge, "tg_net_lost_total") == 0
+            kinds = [r.kind for r in edge.fault_log.reports]
+            assert ACCOUNT_KINDS[site] in kinds
+            # for net.write every submitted future already resolved
+            # inside the target before the drop; either way the runtime
+            # serves the identical answer afterwards
+            with WireClient(*edge.address, protocol="binary") as cli:
+                res = cli.request(rows)
+                assert res.status == 200 and res.records == base
+
+
+def test_campaign_net_scenario_randomized_schedule_holds_oracles():
+    eng = ChaosCampaign(seed=11)
+    try:
+        for fault_spec in ({"net.read": {"mode": "raise", "nth": 2,
+                                         "count": 1}},
+                           {"net.accept": {"mode": "raise", "nth": 1,
+                                           "count": 1},
+                            "net.write": {"mode": "raise", "nth": 3,
+                                          "count": 1}}):
+            res = eng.run_schedule({"scenario": "net",
+                                    "faults": fault_spec})
+            assert res["violations"] == [], res
+            acct = res["accounting"]
+            assert acct["lost"] == 0 and acct["failed"] == 0, acct
+            assert acct["submitted"] == acct["completed"] + acct["shed"]
+    finally:
+        eng.close()
+
+
+# -- socket-mode load generation -------------------------------------------
+
+def test_wire_loadgen_accounting_clean_with_protocol_breakdown(model):
+    with ServingRuntime(model, "lg", _cfg()) as rt:
+        with NetEdge(rt, name="lg-edge") as edge:
+            rep = run_wire_open_loop(
+                *edge.address, _rows(model, 32), seconds=0.8, rps=120.0,
+                batch_rows=4)
+            assert rep["accountingOk"], rep
+            assert rep["lost"] == 0 and rep["failed"] == 0, rep
+            assert rep["completed"] > 0
+            for proto in ("http", "binary"):
+                pp = rep["protocols"][proto]
+                assert pp["requests"] > 0
+                assert pp["p99Ms"] == pp["p99Ms"]  # not NaN
+
+
+def test_wire_loadgen_disconnect_chaos_typed_never_lost(model):
+    with ServingRuntime(model, "lgc", _cfg()) as rt:
+        with NetEdge(rt, name="lgc-edge") as edge:
+            with faults.injected({
+                    "net.read": {"mode": "raise", "nth": 4, "count": 2},
+                    "net.write": {"mode": "raise", "nth": 9,
+                                  "count": 2}}):
+                rep = run_wire_open_loop(
+                    *edge.address, _rows(model, 32), seconds=1.0,
+                    rps=160.0, batch_rows=4, reconnect_every=5)
+            assert rep["shedDisconnect"] > 0, rep
+            assert rep["lost"] == 0 and rep["failed"] == 0, rep
+            assert rep["accountingOk"], rep
+
+
+# -- leak oracle -----------------------------------------------------------
+
+def test_net_oracle_reports_and_cleans_a_leaked_edge(model):
+    with ServingRuntime(model, "leak", _cfg()) as rt:
+        edge = NetEdge(rt, name="leak-edge")
+        try:
+            assert any("leak-edge" in v
+                       for v in oracles.net_violations())
+            assert edge in live_edges()
+        finally:
+            cleaned = oracles.close_leaked_net_edges()
+            assert any("leak-edge" in c for c in cleaned)
+        assert oracles.net_violations() == []
+        assert edge not in live_edges()

@@ -412,7 +412,8 @@ def run_trace(output: str, dataset: str = "synthetic", rows: int = 600,
 def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
               deadline_ms: Optional[float] = None, max_batch: int = 256,
               queue_max: int = 1024, name: str = "model",
-              output: Optional[str] = None, seed: int = 42) -> Dict[str, Any]:
+              output: Optional[str] = None, seed: int = 42,
+              listen: Optional[str] = None) -> Dict[str, Any]:
     """``op serve`` (docs/serving.md): load a saved model into the serving
     registry (warm plan caches from its MANIFEST), drive the open-loop
     synthetic load generator for ``seconds``, print the SLO / shed /
@@ -421,7 +422,14 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
     ``rps=0`` auto-calibrates: a short saturating run measures what the
     runtime sustains in this process, and the measured load runs at half
     of it — sustained throughput with an SLO-shaped tail, not a shed
-    report (pass an explicit --rps to study overload)."""
+    report (pass an explicit --rps to study overload).
+
+    ``--listen host:port`` serves over the network edge instead
+    (docs/serving.md "Network edge"): the runtime sits behind a real
+    asyncio listener and the socket load generator drives both wire
+    framings (HTTP/JSON + binary) through it — port 0 picks a free
+    port. Exits non-zero on any lost future or a broken accounting
+    identity, same contract as ``op fleet``."""
     import json as _json
     import time as _time
 
@@ -451,8 +459,22 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
                 cap = 3 * len(batch) / (_time.perf_counter() - t0)
                 cal = run_open_loop(rt, rows, min(1.0, seconds), cap)
                 rps = max(10.0, 0.5 * cal["rowsPerSec"])
-            report = run_open_loop(rt, rows, seconds, rps,
-                                   deadline_ms=deadline_ms)
+            edge_addr = None
+            if listen:
+                from .serving.loadgen import run_wire_open_loop
+                from .serving.netedge import NetEdge
+                lhost, _, lport = listen.rpartition(":")
+                with NetEdge(rt, host=lhost or "127.0.0.1",
+                             port=int(lport or 0), name=name) as edge:
+                    edge_addr = "%s:%d" % edge.address
+                    print(f"serving '{name}' on {edge_addr} "
+                          f"(HTTP/JSON + binary framing)")
+                    report = run_wire_open_loop(
+                        *edge.address, rows, seconds, rps,
+                        deadline_ms=deadline_ms, batch_rows=16)
+            else:
+                report = run_open_loop(rt, rows, seconds, rps,
+                                       deadline_ms=deadline_ms)
             health = reg.health()
             # drift report (docs/serving.md): per-feature JS/fill vs the
             # training baseline + the verdict history. The monitor folds
@@ -467,6 +489,7 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
                     pass  # report whatever the last pass computed
                 drift_report = rt.drift_monitor.report()
         summary = {"model": model_path, "rpsOffered": round(rps, 1),
+                   "listen": edge_addr,
                    "load": report, "health": health["models"][name],
                    "drift": drift_report}
         print(_json.dumps(summary, indent=2, default=str))
@@ -480,6 +503,12 @@ def run_serve(model_path: str, seconds: float = 5.0, rps: float = 0.0,
                 _json.dump(summary, fh, indent=2, default=str)
             print(f"wrote trace.json, spans.jsonl, metrics.prom, "
                   f"serve_summary.json to {output}/")
+        if listen and (report["lost"] or report["failed"]
+                       or not report["accountingOk"]):
+            print(f"WIRE SOAK FAILED: lost={report['lost']} "
+                  f"failed={report['failed']} "
+                  f"accountingOk={report['accountingOk']}")
+            raise SystemExit(1)
         return summary
     finally:
         obs_trace.enable_tracing(None)
@@ -996,6 +1025,18 @@ def run_doctor(bundle: str, as_json: bool = False,
                 if isinstance(v, dict):
                     v = f"count={v.get('count')}"
                 print(f"   {fname}{{{key}}}: {v}")
+    # network edge (docs/serving.md "Network edge") — connection /
+    # request / shed accounting from the tg_net_* series the bundle
+    # snapshotted (per-protocol, per-reason)
+    net_series = {n: s for n, s in metrics.items()
+                  if n.startswith("tg_net_")}
+    if net_series:
+        print("-- network --")
+        for fname, series in sorted(net_series.items()):
+            for key, v in sorted(series.items()):
+                if isinstance(v, dict):
+                    v = f"count={v.get('count')}"
+                print(f"   {fname}{{{key}}}: {v}")
     # SLO & budgets (bundle schema v3; docs/observability.md "SLOs,
     # budgets & burn rates") — was the budget already burning before
     # this incident, and what would the autoscaler have done?
@@ -1112,6 +1153,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="admission bound (beyond it requests shed with "
                          "OverloadError)")
     sv.add_argument("--name", default="model", help="registry model name")
+    sv.add_argument("--listen", default=None, metavar="HOST:PORT",
+                    help="serve over the network edge: terminate "
+                         "HTTP/JSON + binary framing on a real socket "
+                         "and drive the socket load generator through "
+                         "it (port 0 = pick a free port; exits non-zero "
+                         "on any lost future or accounting break; "
+                         "docs/serving.md \"Network edge\")")
     sv.add_argument("--output", default=None,
                     help="directory for the telemetry bundle (trace.json / "
                          "spans.jsonl / metrics.prom / serve_summary.json)")
@@ -1193,7 +1241,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "sequence")
     cp.add_argument("--scenario", default=None,
                     help="restrict to one scenario harness (train | sweep "
-                         "| serve | serve_heal | stream | fleet | "
+                         "| serve | serve_heal | stream | fleet | net | "
                          "transfer); required in repro mode")
     cp.add_argument("--faults", default=None,
                     help="repro mode: a TG_FAULTS-style JSON schedule to "
@@ -1243,7 +1291,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         run_serve(a.model, seconds=a.seconds, rps=a.rps,
                   deadline_ms=a.deadline_ms, max_batch=a.max_batch,
                   queue_max=a.queue_max, name=a.name, output=a.output,
-                  seed=a.seed)
+                  seed=a.seed, listen=a.listen)
     elif a.command == "fleet":
         run_fleet(a.model, replicas=a.replicas, seconds=a.seconds,
                   rps=a.rps, deadline_ms=a.deadline_ms,

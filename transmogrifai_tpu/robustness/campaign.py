@@ -84,6 +84,9 @@ ACCOUNT_KINDS = {
     "fleet.replica_kill": "replica_lost",
     "fleet.probe": "fleet_probe_failed",
     "aot.load": "aot_fallback",
+    "net.accept": "net_accept_refused",
+    "net.read": "net_read_shed",
+    "net.write": "net_write_shed",
 }
 
 
@@ -696,6 +699,110 @@ class _FleetScenario(_Scenario):
         return out
 
 
+class _NetScenario(_Scenario):
+    """The network edge over one serving runtime: every request crosses
+    a real localhost socket (alternating HTTP/JSON and binary framing)
+    while ``net.accept``/``net.read``/``net.write`` chaos drops
+    connections at each lifecycle stage. Oracles: the wire accounting
+    identity — submitted = completed + *typed* sheds (an error status or
+    a mid-request disconnect), zero failed (untyped 500s), zero lost
+    futures — plus bit-equal completed records vs the fault-free
+    in-process run, and fired net sites leaving their recovery kinds on
+    the edge's FaultLog (net_accept_refused / net_read_shed /
+    net_write_shed)."""
+
+    name = "net"
+
+    def setup(self) -> None:
+        from ..local import micro_batch_score_function
+        from ..serving.loadgen import synthetic_rows
+        self.model = self.engine.small_model()
+        self.rows = synthetic_rows(self.model, 16, seed=61)
+        self.baseline = micro_batch_score_function(self.model)(
+            list(self.rows))
+
+    def run(self, log: FaultLog) -> Dict[str, Any]:
+        import socket as _socket
+
+        from ..serving.netedge import NetEdge
+        from ..serving.netproto import WireClient, WireDisconnect
+        from ..serving.runtime import ServeConfig, ServingRuntime
+        cfg = ServeConfig(max_batch=16, max_queue=64, max_wait_ms=5.0)
+        completed: Dict[int, Dict[str, Any]] = {}
+        shed: Dict[int, str] = {}
+        failed: Dict[int, str] = {}
+        lost: List[int] = []
+        rt = ServingRuntime(self.model, name="m", config=cfg)
+        try:
+            with NetEdge(rt, name="net", fault_log=log) as edge:
+                host, port = edge.address
+                clients = {p: WireClient(
+                    host, port, protocol=p,
+                    timeout=self.engine.collect_timeout)
+                    for p in ("http", "binary")}
+                try:
+                    for i, row in enumerate(self.rows):
+                        cli = clients["binary" if i % 2 else "http"]
+                        try:
+                            res = cli.request([row])
+                        except WireDisconnect:
+                            # mid-request disconnect: the typed wire shed
+                            # (the future, if submitted, still resolves
+                            # inside the runtime — proven by lost == 0)
+                            shed[i] = "WireDisconnect"
+                            continue
+                        except _socket.timeout:
+                            lost.append(i)
+                            continue
+                        if res.status == 200 and res.records:
+                            completed[i] = res.records[0]
+                        elif res.status >= 500 and res.error == "lost":
+                            lost.append(i)
+                        elif res.status == 500:
+                            failed[i] = f"status 500: {res.error}"
+                        else:
+                            shed[i] = f"{res.status}:{res.error}"
+                finally:
+                    for c in clients.values():
+                        c.close()
+        finally:
+            rt.close(drain=False)
+        return {"completed": completed, "shed": shed, "failed": failed,
+                "lost": lost,
+                "accounting": {"submitted": len(self.rows),
+                               "completed": len(completed),
+                               "shed": len(shed), "failed": len(failed),
+                               "lost": len(lost)}}
+
+    def violations(self, result, fired, log) -> List[str]:
+        out: List[str] = []
+        n = len(self.rows)
+        if result["lost"]:
+            out.append(f"net: {len(result['lost'])} request(s) never got "
+                       f"a response nor a typed shed (lost): "
+                       f"{result['lost']}")
+        if result["failed"]:
+            out.append(f"net: request(s) failed untyped (requests must "
+                       f"complete or shed typed): {result['failed']}")
+        total = (len(result["completed"]) + len(result["shed"])
+                 + len(result["failed"]) + len(result["lost"]))
+        if total != n:
+            out.append(f"net: request accounting broken: "
+                       f"{total} accounted of {n} submitted")
+        mismatched = [i for i, rec in result["completed"].items()
+                      if rec != self.baseline[i]]
+        if mismatched:
+            out.append(f"net: completed record(s) not bit-equal to the "
+                       f"fault-free run: rows {sorted(mismatched)[:8]}")
+        kinds = {r.kind for r in log.reports}
+        for site in fired:
+            want = ACCOUNT_KINDS.get(site)
+            if want and want not in kinds:
+                out.append(f"net: site {site} fired but recovery kind "
+                           f"'{want}' was never recorded")
+        return out
+
+
 class _TransferScenario(_Scenario):
     """The guarded host<->device transfer helpers alone: a placement and
     a readback through the always-on retry policies must round-trip
@@ -768,12 +875,12 @@ class ChaosCampaign:
     """
 
     #: scenario draw weights for the randomized (post-coverage) schedules
-    SCENARIO_WEIGHTS = (("serve", 0.28), ("train", 0.23), ("sweep", 0.18),
-                        ("stream", 0.13), ("fleet", 0.08),
+    SCENARIO_WEIGHTS = (("serve", 0.26), ("train", 0.21), ("sweep", 0.16),
+                        ("stream", 0.13), ("fleet", 0.08), ("net", 0.06),
                         ("serve_heal", 0.05), ("transfer", 0.05))
     _SCENARIOS = (_TrainScenario, _SweepScenario, _ServeScenario,
                   _ServeHealScenario, _StreamScenario, _FleetScenario,
-                  _TransferScenario)
+                  _NetScenario, _TransferScenario)
 
     def __init__(self, seed: Optional[int] = None,
                  workdir: Optional[str] = None,
@@ -918,7 +1025,7 @@ class ChaosCampaign:
             # serve-side flushes coalesce (and fleet routing reacts to
             # live queue depths), so only first-call triggers are
             # schedule-deterministic there
-            force = scn in ("serve", "serve_heal", "fleet")
+            force = scn in ("serve", "serve_heal", "fleet", "net")
             fault_specs = {}
             for s in sorted(sites):
                 mode = str(rng.choice(ALL_SITES[s].modes))

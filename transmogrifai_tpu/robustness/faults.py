@@ -120,6 +120,25 @@ site                         fires in
                              ``aot.*`` sites keep the planner active
                              like ``plan.*`` — the store lives inside
                              the planner's segment dispatch)
+``net.accept``               in the network edge, per connection right
+                             after the socket accept (serving/netedge.py;
+                             a raise drops the connection as a typed
+                             ``accept_fault`` shed with a
+                             ``net_accept_refused`` FaultLog record —
+                             nothing was submitted, nothing can be lost;
+                             ``net.*`` sites keep the planner active
+                             like ``serve.*``)
+``net.read``                 per request, before the frame/body is read
+                             off the socket (a raise models the read
+                             path dying mid-request: the peer observes a
+                             disconnect, the edge accounts a typed
+                             ``read_fault`` shed + ``net_read_shed``)
+``net.write``                per response, before the bytes are written
+                             back (by this point every submitted future
+                             has already resolved — the peer sees a
+                             mid-request disconnect, the edge accounts a
+                             typed ``write_fault`` shed +
+                             ``net_write_shed``; never a lost future)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
@@ -303,6 +322,15 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
           "bad AOT artifact falls back to the trace path bit-equally; "
           "typed aot_fallback recorded, ledger build classified "
           "aot-miss — never a request error"),
+    _site("net.accept", "raise", "serving/netedge.py", "net",
+          "connection dropped at accept as a typed accept_fault shed; "
+          "net_accept_refused recorded, nothing submitted, zero lost"),
+    _site("net.read", "raise", "serving/netedge.py", "net",
+          "read path dies mid-request; peer sees a disconnect, edge "
+          "accounts a typed read_fault shed (net_read_shed)"),
+    _site("net.write", "raise", "serving/netedge.py", "net",
+          "write path dies mid-response after every future resolved; "
+          "typed write_fault shed (net_write_shed), never a lost future"),
     _site("preempt.stage_fit", "preempt", "dag.py", "train|stream",
           "train(resume=True) restores verified stages, bit-exact"),
     _site("preempt.checkpoint_write", "preempt", "persistence.py",

@@ -29,7 +29,7 @@ from typing import Iterable, List, Optional
 #: thread-name prefixes owned by framework worker threads; anything alive
 #: with one of these names after a close/teardown is a leak
 THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog",
-                   "tg-sampler", "tg-fleet")
+                   "tg-sampler", "tg-fleet", "tg-net")
 
 
 # -- probes (read-only) ------------------------------------------------------
@@ -45,6 +45,28 @@ def leaked_fleets() -> List[str]:
     probe thread plus N replica registries' worth of batcher threads."""
     from ..serving import frontdoor as _fd
     return [fd.name for fd in _fd.live_fleets()]
+
+
+def leaked_net_edges() -> List[str]:
+    """Names of live (started, unclosed) network edges — each owns a
+    listening socket plus a ``tg-net`` event-loop thread."""
+    from ..serving import netedge as _ne
+    return [e.name for e in _ne.live_edges()]
+
+
+def net_violations() -> List[str]:
+    """The network-edge no-leak oracle: no listening socket, no
+    ``tg-net`` thread, no pending connection task may survive (wired
+    into :func:`campaign_violations` and the conftest ``_no_net_leak``
+    fixture)."""
+    from ..serving import netedge as _ne
+    out: List[str] = []
+    for e in _ne.live_edges():
+        pending = e.pending_tasks()
+        out.append(f"network edge '{e.name}' leaked (port "
+                   f"{e.bound_port}, {pending} pending connection "
+                   f"task(s))")
+    return out
 
 
 def leaked_stream_feeds() -> List[str]:
@@ -222,6 +244,17 @@ def close_leaked_serving() -> List[str]:
     return [rt.name for rt in leaked]
 
 
+def close_leaked_net_edges() -> List[str]:
+    """Force-close leftover network edges — closed BEFORE the fleets
+    and runtimes they front, so their connection handlers resolve
+    (typed ``server_close`` sheds) while the targets still accept."""
+    from ..serving import netedge as _ne
+    leaked = _ne.live_edges()
+    for e in leaked:
+        e.close()
+    return [e.name for e in leaked]
+
+
 def close_leaked_fleets() -> List[str]:
     """Force-close leftover front doors (replicas included) — closed
     BEFORE the runtime sweep so a fleet's runtimes are not reported
@@ -272,6 +305,7 @@ def campaign_violations(clean: bool = True,
     still = join_drift_refits(timeout=refit_join_timeout)
     if still:
         out.append(f"drift refit thread(s) outlived the schedule: {still}")
+    out.extend(net_violations())
     fds = leaked_fleets()
     if fds:
         out.append(f"fleet front door(s) leaked: {fds}")
@@ -286,6 +320,7 @@ def campaign_violations(clean: bool = True,
         out.append(f"watchdog heart(s) leaked: {hearts}")
     out.extend(slo_violations())
     if clean:
+        close_leaked_net_edges()
         close_leaked_fleets()
         close_leaked_serving()
         close_leaked_feeds()

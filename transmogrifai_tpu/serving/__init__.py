@@ -17,6 +17,13 @@ sick replicas, fails requests over on replica loss with zero lost
 futures, refuses-or-splits flushes against ``TG_DEVICE_BUDGET`` before
 dispatch, rolls deploys replica-by-replica, and autoscales on
 ``scale_hint``.
+
+The process boundary (docs/serving.md "Network edge"): a chaos-hardened
+asyncio front end (``netedge.py`` + ``netproto.py``) terminating
+HTTP/JSON and a length-prefixed binary columnar framing on a real
+socket, with per-tenant auth/quota at the edge, ``Retry-After`` derived
+from the windowed shed rate, and typed sheds for every wire failure
+mode — the zero-lost-futures identity extends across the network.
 """
 from .breaker import BREAKER_GAUGE, CircuitBreaker  # noqa: F401
 from .drift import (  # noqa: F401
@@ -28,7 +35,15 @@ from .fleet import (  # noqa: F401
     SubprocessReplica,
 )
 from .frontdoor import FrontDoor, live_fleets  # noqa: F401
-from .loadgen import run_open_loop, synthetic_rows  # noqa: F401
+from .loadgen import (  # noqa: F401
+    run_open_loop, run_wire_open_loop, synthetic_rows,
+)
+from .netedge import (  # noqa: F401
+    SHED_STATUS, NetEdge, NetEdgeConfig, derive_retry_after, live_edges,
+)
+from .netproto import (  # noqa: F401
+    FrameError, WireClient, WireDisconnect, WireResult,
+)
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import (  # noqa: F401
     DeadlineExceededError, OverloadError, RuntimeStoppedError, ServeConfig,
