@@ -135,6 +135,31 @@ def test_binary_decode_rejects_garbage_and_trailing_bytes():
         netproto.decode_binary_request(good[:-3])
 
 
+def test_binary_decode_bounds_declared_row_count(monkeypatch):
+    # a ~40-byte frame claiming 10**12 rows must be a FrameError, never
+    # an allocation sized by untrusted input
+    def _payload(n, columns=()):
+        hdr = json.dumps({"rows": n, "columns": list(columns)},
+                         separators=(",", ":")).encode()
+        return struct.pack(">H", len(hdr)) + hdr
+    with pytest.raises(FrameError, match="TG_NET_MAX_ROWS"):
+        netproto.decode_binary_request(_payload(10**12))
+    # declared rows with no column blocks backing them are refused too
+    with pytest.raises(FrameError, match="no column blocks"):
+        netproto.decode_binary_request(_payload(3))
+    # zero rows stays legal either way
+    assert netproto.decode_binary_request(_payload(0))[1] == []
+    # explicit cap argument and the env knob both bind
+    good = netproto.encode_binary_request(
+        [{"x": float(i)} for i in range(4)])[netproto.FRAME_HEADER.size:]
+    with pytest.raises(FrameError):
+        netproto.decode_binary_request(good, max_rows=2)
+    assert len(netproto.decode_binary_request(good, max_rows=4)[1]) == 4
+    monkeypatch.setenv("TG_NET_MAX_ROWS", "2")
+    with pytest.raises(FrameError):
+        netproto.decode_binary_request(good)
+
+
 def test_columns_from_rows_first_seen_order_and_nulls():
     names, cols = netproto.columns_from_rows(
         [{"a": 1.0, "b": "x"}, {"b": "y", "c": None, "a": 2.0}])
@@ -312,6 +337,82 @@ def test_oversized_frame_is_413_and_connection_closes(model):
                 assert status == 413
             assert _wait_counter(edge, "tg_net_shed_total", 2.0,
                                  reason="oversize") == 2.0
+
+
+def test_tiny_frame_claiming_huge_rows_is_400_not_oom(model):
+    with ServingRuntime(model, "rows", _cfg()) as rt:
+        with NetEdge(rt, name="rows-edge") as edge:
+            hdr = json.dumps({"rows": 10**12, "columns": []},
+                             separators=(",", ":")).encode()
+            payload = struct.pack(">H", len(hdr)) + hdr
+            frame = netproto.FRAME_HEADER.pack(
+                netproto.MAGIC, netproto.KIND_REQUEST, len(payload)) \
+                + payload
+            with socket.create_connection(edge.address, timeout=5) as s:
+                s.sendall(frame)
+                rdr = netproto._SockReader(s)
+                _, kind, ln = struct.unpack(">4sBI", rdr.read_exact(9))
+                obj = json.loads(rdr.read_exact(ln))
+                assert obj["status"] == 400
+                assert obj["error"] == "bad_frame"
+                # payload fully consumed: the same socket still scores
+                s.sendall(netproto.encode_binary_request(
+                    _rows(model, 2)))
+                _, kind, ln = struct.unpack(">4sBI", rdr.read_exact(9))
+                assert kind == netproto.KIND_RESPONSE
+                rdr.read_exact(ln)
+            assert _counter(edge, "tg_net_shed_total",
+                            reason="bad_frame") == 1.0
+
+
+def test_http_header_line_above_stream_limit_is_typed_oversize(model):
+    # a single header line longer than the asyncio stream limit makes
+    # readline() raise before the byte-count check fires — it must land
+    # in the same typed oversize shed, not an unretrieved task exception
+    with ServingRuntime(model, "hline", _cfg()) as rt:
+        with NetEdge(rt, name="hline-edge") as edge:
+            limit = max(65536, edge.config.max_frame_bytes)
+            with socket.create_connection(edge.address, timeout=5) as s:
+                s.sendall(b"POST /score HTTP/1.1\r\n"
+                          b"X-Big: " + b"a" * (limit + 1024) + b"\r\n")
+                status, _, resp = netproto.read_http_response(
+                    netproto._SockReader(s))
+                assert status == 413
+                assert json.loads(resp)["error"] == "oversize"
+            assert _wait_counter(edge, "tg_net_shed_total", 1.0,
+                                 reason="oversize") == 1.0
+    assert oracles.net_violations() == []
+
+
+def test_wire_client_timeout_closes_desynchronized_connection():
+    # a request that times out leaves a reply in flight; reusing the
+    # stream would mis-pair it with the next request — the client must
+    # reconnect clean
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        cli = WireClient(*srv.getsockname(), protocol="binary",
+                         timeout=0.3)
+        with pytest.raises(socket.timeout):
+            cli.request([{"x": 1.0}])
+        assert not cli.connected
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_from_env_explicit_zero_is_respected(monkeypatch):
+    # an explicit 0 in the environment must mean 0 (tenant_rps=0 is
+    # documented as unlimited), not silently fall back to the default
+    monkeypatch.setenv("TG_NET_TENANT_RPS", "0")
+    monkeypatch.setenv("TG_NET_RETRY_MIN_S", "0")
+    monkeypatch.setenv("TG_NET_RETRY_SCALE_S", "0.5")
+    cfg = NetEdgeConfig.from_env()
+    assert cfg.tenant_rps == 0.0
+    assert cfg.retry_min_s == 0.0
+    assert cfg.retry_scale_s == 0.5
+    assert cfg.read_timeout_s == 5.0  # unset keeps its default
 
 
 def test_slow_loris_and_half_open_shed_without_touching_the_runtime(

@@ -110,19 +110,22 @@ class NetEdgeConfig:
 
     @classmethod
     def from_env(cls) -> "NetEdgeConfig":
+        # _env_float returns its (non-None) default for unset/empty/bad
+        # values and the parsed float otherwise — an explicit 0 in the
+        # environment must stay 0 (tenant_rps=0 means unlimited), so no
+        # truthiness fallbacks here
         return cls(
             max_frame_bytes=_env_int("TG_NET_MAX_FRAME_BYTES", 1 << 20),
-            read_timeout_s=_env_float("TG_NET_READ_TIMEOUT_S", 5.0) or 5.0,
-            write_timeout_s=_env_float("TG_NET_WRITE_TIMEOUT_S", 5.0) or 5.0,
-            idle_timeout_s=_env_float("TG_NET_IDLE_TIMEOUT_S", 30.0) or 30.0,
+            read_timeout_s=_env_float("TG_NET_READ_TIMEOUT_S", 5.0),
+            write_timeout_s=_env_float("TG_NET_WRITE_TIMEOUT_S", 5.0),
+            idle_timeout_s=_env_float("TG_NET_IDLE_TIMEOUT_S", 30.0),
             max_connections=_env_int("TG_NET_MAX_CONNS", 256),
-            tenant_rps=_env_float("TG_NET_TENANT_RPS", 0.0) or 0.0,
-            retry_window_s=_env_float("TG_NET_RETRY_WINDOW_S", 10.0) or 10.0,
-            retry_scale_s=_env_float("TG_NET_RETRY_SCALE_S", 1.0) or 1.0,
-            retry_min_s=_env_float("TG_NET_RETRY_MIN_S", 1.0) or 1.0,
-            retry_max_s=_env_float("TG_NET_RETRY_MAX_S", 30.0) or 30.0,
-            collect_timeout_s=_env_float(
-                "TG_NET_COLLECT_TIMEOUT_S", 30.0) or 30.0)
+            tenant_rps=_env_float("TG_NET_TENANT_RPS", 0.0),
+            retry_window_s=_env_float("TG_NET_RETRY_WINDOW_S", 10.0),
+            retry_scale_s=_env_float("TG_NET_RETRY_SCALE_S", 1.0),
+            retry_min_s=_env_float("TG_NET_RETRY_MIN_S", 1.0),
+            retry_max_s=_env_float("TG_NET_RETRY_MAX_S", 30.0),
+            collect_timeout_s=_env_float("TG_NET_COLLECT_TIMEOUT_S", 30.0))
 
 
 def derive_retry_after(shed_rate_per_s: float,
@@ -376,6 +379,10 @@ class NetEdge:
             self._gauge("tg_net_active_connections", float(self._active))
             try:
                 writer.close()
+                # wait for the transport to actually tear down so the
+                # fd is released before the connection task completes
+                # (pending_tasks()/the no-leak oracle track task exits)
+                await writer.wait_closed()
             except Exception:
                 pass
 
@@ -519,6 +526,17 @@ class NetEdge:
             await self._respond_http(writer, corr, 408,
                                      {"error": "read_timeout"}, close=True,
                                      best_effort=True)
+            return False
+        except (asyncio.LimitOverrunError, ValueError):
+            # one header line above the stream limit: readline raises
+            # before the hdr_bytes check can fire — same typed oversize
+            # shed as the counted path, connection closes
+            await self._respond_http(writer, corr, 413,
+                                     {"error": "oversize",
+                                      "message": "header line exceeds "
+                                      "the stream limit"},
+                                     close=True, best_effort=True)
+            self._shed("oversize", corr, proto="http")
             return False
         parts = line.rstrip(b"\r\n").split()
         if len(parts) < 3:

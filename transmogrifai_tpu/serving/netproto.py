@@ -42,6 +42,7 @@ the typed ``shedDisconnect`` bucket — never ``lost``.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 from dataclasses import dataclass
@@ -62,6 +63,20 @@ _U32 = struct.Struct(">I")
 #: column kinds: dtype for the fixed-width ones, None for utf-8
 COLUMN_KINDS: Dict[str, Optional[str]] = {
     "f8": "<f8", "i8": "<i8", "b1": "u1", "u8": None}
+
+#: hard per-request row cap (override: TG_NET_MAX_ROWS). The header's
+#: "rows" field is untrusted input and must never size an allocation on
+#: its own — column truncation checks bound it when blocks exist, this
+#: cap bounds the degenerate cases.
+DEFAULT_MAX_ROWS = 1 << 20
+
+
+def _max_rows() -> int:
+    try:
+        return int(os.environ.get("TG_NET_MAX_ROWS", "")
+                   or DEFAULT_MAX_ROWS)
+    except ValueError:
+        return DEFAULT_MAX_ROWS
 
 
 class FrameError(ValueError):
@@ -217,12 +232,16 @@ def _decode_column(kind: str, n: int, payload: bytes, off: int,
     return vals, off
 
 
-def decode_binary_request(payload: bytes
+def decode_binary_request(payload: bytes,
+                          max_rows: Optional[int] = None
                           ) -> Tuple[Dict[str, Any],
                                      List[Dict[str, Any]]]:
     """Decode a request payload into ``(header, rows)``. Column blocks
     decode with one ``np.frombuffer`` sweep each; rows materialize in a
-    single ``zip`` sweep at the end (the submit boundary)."""
+    single ``zip`` sweep at the end (the submit boundary). The declared
+    row count is bounded (``max_rows``, default ``TG_NET_MAX_ROWS``) and
+    must be backed by column blocks — a 40-byte frame claiming 10**12
+    rows is a :class:`FrameError`, not an allocation."""
     if len(payload) < _U16.size:
         raise FrameError("request payload shorter than its header length")
     hlen = _U16.unpack_from(payload, 0)[0]
@@ -242,6 +261,13 @@ def decode_binary_request(payload: bytes
         raise FrameError(f"request header missing 'rows': {e}") from e
     if n < 0:
         raise FrameError("negative row count")
+    cap = _max_rows() if max_rows is None else int(max_rows)
+    if n > cap:
+        raise FrameError(
+            f"row count {n} exceeds TG_NET_MAX_ROWS={cap}")
+    if n and not col_meta:
+        raise FrameError(
+            f"{n} row(s) declared but no column blocks back them")
     names: List[str] = []
     cols: List[List[Any]] = []
     for cm in col_meta:
@@ -399,6 +425,10 @@ class WireClient:
         try:
             return self._exchange(rows, deadline_ms)
         except socket.timeout:
+            # a late reply would be read as the answer to the *next*
+            # request — the keep-alive stream is desynchronized, so the
+            # next request must reconnect on a clean one
+            self.close()
             raise
         except WireDisconnect:
             self.close()
