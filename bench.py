@@ -960,6 +960,152 @@ def _run_serve(platform):
                 },
             }), flush=True)
 
+    # multi-model density lines (round 17; docs/serving.md "Multi-model
+    # placement & paging"): M models bin-packed onto 2 replicas under a
+    # warm bound, a uniform per-model traffic mix, and the per-model
+    # accounting identity gated. Then a warm-copy kill arm: murder the
+    # replica holding the ONLY warm copy of one model mid-soak — the
+    # model must page in on a survivor (an AOT deserialize, never a
+    # compile), records stay bit-equal, and zero futures are lost.
+    from transmogrifai_tpu.serving import PlaceConfig
+    n_models = int(os.environ.get("BENCH_DENSITY_MODELS", 3))
+    ddir = _tempfile.mkdtemp(prefix="tg_bench_density_model_")
+    try:
+        model.save(ddir)  # one artifact, M logical models: the density
+        # line measures placement/paging, not M distinct fits
+        dmodels = {f"m{i}": ddir for i in range(n_models)}
+        mix = [(m, 1.0) for m in sorted(dmodels)]
+        # max_warm=1 on 2 replicas: fleet-wide warm capacity (2) is
+        # BELOW the catalog (N models, N >= 3) — the clean arm itself
+        # must demand-page, which is the density point
+        pc = PlaceConfig(max_warm=1)
+        fc = FleetConfig(min_replicas=1, max_replicas=2,
+                         probe_interval_ms=200.0, max_failovers=3,
+                         autoscale=False, subprocess=fleet_subproc)
+        _pstore.close_sessions()
+        with FrontDoor(dmodels, replicas=2, config=cfg,
+                       fleet_config=fc, warm=True, placement=pc) as fd:
+            drep = run_open_loop(fd, rows, fleet_seconds,
+                                 runtime_capacity * 0.8,
+                                 deadline_ms=deadline_ms, models=mix)
+            dsummary = fd.summary()
+            dplace = fd.fleet_snapshot()["placement"]
+        assert drep["lost"] == 0 and drep["failed"] == 0, drep
+        assert drep["accountingOk"], drep
+        per = drep["models"] or {}
+        assert sum(b["offered"] for b in per.values()) == \
+            drep["offered"], per
+        assert sum(b["completed"] for b in per.values()) == \
+            drep["completed"], per
+        assert dplace["pageIns"] >= 1, (
+            f"density clean arm paged nothing in despite "
+            f"{n_models} models over 2 warm slots: {dplace}")
+        assert dplace["pageInP99Ms"] is not None, dplace
+        # zero cross-model SLO page alerts on the clean arm: typed
+        # paging sheds must not burn a co-resident model's budget to
+        # the page line
+        dpage = _slo_page_fires(dsummary)
+        assert dpage == 0, (
+            f"density clean arm fired {dpage} page-severity SLO "
+            f"alert(s)")
+        print(json.dumps({
+            "metric": f"serve_density{n_models}m_rows_per_sec_"
+                      f"{d}feat_{platform}",
+            "value": drep["rowsPerSec"],
+            "unit": "rows/sec",
+            "vs_baseline": round(
+                drep["rowsPerSec"] / runtime_capacity, 3),
+            "phases": {
+                "models": n_models, "replicas": 2,
+                "maxWarm": pc.max_warm,
+                "offeredRps": drep["offeredRps"],
+                "p50Ms": drep["p50Ms"], "p99Ms": drep["p99Ms"],
+                "perModelOffered": {m: b["offered"]
+                                    for m, b in sorted(per.items())},
+                "resident": dplace["resident"],
+                "pageIns": dplace["pageIns"],
+                "evictions": dplace["evictions"],
+                "pageInP99Ms": dplace["pageInP99Ms"],
+                "sloPageAlerts": dpage,
+                "lost": drep["lost"], "failed": drep["failed"],
+            },
+        }), flush=True)
+
+        _pstore.close_sessions()
+        with FrontDoor(dmodels, replicas=2, config=cfg,
+                       fleet_config=fc, warm=True, placement=pc) as fd:
+            lone = next(m for m in sorted(dmodels)
+                        if len(fd.placer.holders(m)) == 1)
+            victim = fd.placer.holders(lone)[0]
+            dbaseline = mb(rows[:8])
+
+            def _kill_lone_holder():
+                fd.kill_replica(victim)
+            killer = _threading.Timer(fleet_seconds / 2.0,
+                                      _kill_lone_holder)
+            killer.daemon = True
+            killer.start()
+            try:
+                dkrep = run_open_loop(fd, rows, fleet_seconds,
+                                      runtime_capacity * 0.6,
+                                      deadline_ms=deadline_ms,
+                                      models=mix)
+            finally:
+                killer.cancel()
+            # the orphaned model paged in on a survivor: warm again,
+            # and bit-equal to the in-process scorer. The survivor may
+            # sit ejected for a few probe cycles right after the soak
+            # (overload made it un-ready) — wait out readmission; the
+            # retries are typed sheds, not failures
+            from transmogrifai_tpu.serving import OverloadError
+            retry_until = time.perf_counter() + 30.0
+            while True:
+                try:
+                    drecs = [fd.submit(r, model=lone).result(timeout=30)
+                             for r in rows[:8]]
+                    break
+                except OverloadError:
+                    if time.perf_counter() > retry_until:
+                        raise
+                    time.sleep(0.25)
+            assert drecs == dbaseline, (
+                f"density kill arm: model '{lone}' records diverged "
+                f"after paging in on a survivor")
+            dksnap = fd.fleet_snapshot()
+            dkinds = {r.kind for r in fd.fault_log.reports}
+        assert dkrep["lost"] == 0 and dkrep["failed"] == 0, dkrep
+        assert dkrep["accountingOk"], dkrep
+        assert dksnap["kills"] >= 1, "density kill timer never fired"
+        assert "replica_lost" in dkinds, dkinds
+        assert "placement_paged_in" in dkinds, (
+            f"killing {lone}'s only warm copy triggered no page-in: "
+            f"{sorted(dkinds)}")
+        dkplace = dksnap["placement"]
+        print(json.dumps({
+            "metric": f"serve_density{n_models}m_kill_rows_per_sec_"
+                      f"{d}feat_{platform}",
+            "value": dkrep["rowsPerSec"],
+            "unit": "rows/sec",
+            "vs_baseline": round(
+                dkrep["rowsPerSec"] / runtime_capacity, 3),
+            "phases": {
+                "models": n_models, "replicas": 2,
+                "killedReplica": victim, "orphanedModel": lone,
+                "kills": dksnap["kills"],
+                "failovers": dksnap["failovers"],
+                "pageIns": dkplace["pageIns"],
+                "evictions": dkplace["evictions"],
+                "pageInP99Ms": dkplace["pageInP99Ms"],
+                "resident": dkplace["resident"],
+                "shedNoReplica": dkrep["shedNoReplica"],
+                "shedOverload": dkrep["shedOverload"],
+                "shedDeadline": dkrep["shedDeadline"],
+                "lost": dkrep["lost"], "failed": dkrep["failed"],
+            },
+        }), flush=True)
+    finally:
+        _shutil.rmtree(ddir, ignore_errors=True)
+
 
 def _run_stream(platform):
     """BENCH_MODE=stream: the out-of-core line (docs/streaming.md). Trains

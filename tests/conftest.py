@@ -258,6 +258,28 @@ def _no_serving_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_placement_leak():
+    """A fleet placer holds residency/LRU state plus single-flight
+    page-in events — a leaked placer with an in-flight page-in would
+    block every later waiter for that model, and a stale residency map
+    would misroute later fleets sharing the name. Defined BEFORE the
+    fleet fixture so this teardown runs AFTER the fleet sweep: closing
+    a leaked front door closes its placer, and anything still live here
+    was detached. Probes + cleanup live in robustness/oracles.py
+    (``placement_violations``, also run by the campaign engine after
+    every schedule)."""
+    from transmogrifai_tpu.robustness import oracles
+
+    assert not oracles.placement_violations(), (
+        "placer(s) leaked from a previous test: "
+        f"{oracles.placement_violations()}")
+    yield
+    leaks = oracles.placement_violations()
+    oracles.close_leaked_placers()
+    assert not leaks, f"a test leaked live placer(s): {leaks}"
+
+
+@pytest.fixture(autouse=True)
 def _no_fleet_leak():
     """A fleet front door owns a probe thread plus N replica registries'
     worth of batcher threads — a leaked fleet keeps routing (and

@@ -303,7 +303,8 @@ def test_rolling_deploy_zero_loss(model, saved):
         assert recs == baseline  # zero loss, zero sheds, bit-equal
         snap = fd.fleet_snapshot()
         assert snap["sheds"] == {"overload": 0.0, "deadline": 0.0,
-                                 "admission": 0.0, "no_replica": 0.0}
+                                 "admission": 0.0, "no_replica": 0.0,
+                                 "placement": 0.0, "unknown_model": 0.0}
         assert snap["counts"] == {"active": 2}
         assert fd.deploy_history[-1]["ok"]
         # future autoscale spawns come up on the deployed artifact
@@ -463,7 +464,8 @@ def test_summary_and_health_shapes(model):
         assert s["state"] == "ready" and s["rowsScored"] == 1.0
         assert s["scaleHint"]["hint"] in ("up", "hold", "down")
         assert set(s["shed"]) == {"overload", "deadline", "admission",
-                                  "no_replica"}
+                                  "no_replica", "placement",
+                                  "unknown_model"}
         h = fd.health()
         assert h["ready"]
         assert set(h["replicas"]) == {"r0", "r1"}

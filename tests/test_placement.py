@@ -1,0 +1,512 @@
+"""Multi-model fleet density (serving/placement.py; docs/serving.md
+"Multi-model placement & paging").
+
+The contract under test is ROADMAP item 4's fleet-density invariant: a
+front door bin-packing many models onto few replicas keeps the
+zero-lost-futures identity through cold-model paging, LRU eviction, and
+warm-copy loss — every accepted future resolves exactly once, a record
+bit-equal to the single-process run or a *typed* shed, and a page-in is
+a *deserialize* (zero CompileLedger builds), never a compile. Chaos
+sites exercised here by literal name: ``place.assign``,
+``place.evict``, ``place.pagein``.
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.local import micro_batch_score_function
+from transmogrifai_tpu.observability import blackbox as _blackbox
+from transmogrifai_tpu.observability import ledger as lg
+from transmogrifai_tpu.observability import postmortem as pm
+from transmogrifai_tpu.robustness import faults, oracles
+from transmogrifai_tpu.robustness.campaign import ChaosCampaign
+from transmogrifai_tpu.robustness.faults import ALL_SITES
+from transmogrifai_tpu.robustness.policy import FaultLog
+from transmogrifai_tpu.serving import (
+    FleetConfig, FrontDoor, PlaceConfig, Placer, PlacementRefusedError,
+    ServeConfig, UnknownModelError, live_placers, model_cost_bytes,
+)
+from transmogrifai_tpu.serving import placement as placement_mod
+from transmogrifai_tpu.serving.loadgen import run_open_loop, synthetic_rows
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.density
+
+
+def _train_model(n=300, seed=7):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2) > 0).astype(float)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(c).extract_field().as_predictor()
+             for c in ("x1", "x2")]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed,
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train_model()
+
+
+@pytest.fixture(scope="module")
+def saved(model, tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("place_model") / "m")
+    model.save(d)
+    return d
+
+
+def _rows(model, n=12, seed=57):
+    return synthetic_rows(model, n, seed=seed)
+
+
+def _cfg(**kw):
+    base = dict(max_batch=64, max_queue=256, max_wait_ms=10.0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _fc(**kw):
+    base = dict(min_replicas=1, max_replicas=4, probe_interval_ms=0.0,
+                probe_failures=3, readmit_probes=2, max_failovers=2,
+                autoscale=False)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _noop(_m):
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Site registry agreement
+# ---------------------------------------------------------------------------
+
+def test_place_sites_registered():
+    for site in ("place.assign", "place.evict", "place.pagein"):
+        spec = ALL_SITES[site]
+        assert "density" in spec.scenarios
+        assert spec.modes == ("raise",)
+        assert spec.module == "serving/placement.py"
+        assert spec.bit_equal  # every placement recovery is bit-preserving
+
+
+# ---------------------------------------------------------------------------
+# Cost prediction & blind admit (absent/corrupt MANIFEST costs)
+# ---------------------------------------------------------------------------
+
+def test_model_cost_bytes_none_for_unusable_sources(tmp_path):
+    # in-memory model objects carry no manifest
+    assert model_cost_bytes(object()) is None
+    # a directory with no checkpoint at all
+    assert model_cost_bytes(str(tmp_path / "nope")) is None
+
+
+def test_model_cost_bytes_reads_manifest_costs(saved):
+    b = model_cost_bytes(saved)
+    # the saved model recorded per-segment measured bytes at save time
+    # (observability/devicemem.py persist_costs); absent costs are also
+    # legal — but whichever it is, the answer must be stable
+    assert b == model_cost_bytes(saved)
+    if b is not None:
+        assert b > 0
+
+
+def test_blind_admit_is_typed_not_fatal(tmp_path):
+    """A model with no usable costs under an active byte budget is
+    admitted at zero predicted bytes with a typed
+    ``placement_blind_admit`` warning — never refused, never a crash."""
+    log = FaultLog()
+    with Placer({"blind": str(tmp_path / "missing")},
+                PlaceConfig(device_budget=1000), name="t",
+                fault_log=log) as p:
+        assert p.bytes["blind"] is None
+        assert "blind" in p.blind and "blind" not in p.refused
+        p.check_admitted("blind")  # admitted — no raise
+        kinds = [r.kind for r in log.reports]
+        assert "placement_blind_admit" in kinds
+        assert p.snapshot()["blindAdmits"] == ["blind"]
+
+
+def test_oversized_model_refused_typed(monkeypatch):
+    monkeypatch.setattr(placement_mod, "model_cost_bytes",
+                        lambda src: {"big": 100, "small": 10}[src])
+    log = FaultLog()
+    with Placer({"big": "big", "small": "small"},
+                PlaceConfig(device_budget=50), name="t",
+                fault_log=log) as p:
+        assert p.refused == {"big"}
+        with pytest.raises(PlacementRefusedError):
+            p.check_admitted("big")
+        p.check_admitted("small")
+        assert "placement_refused" in [r.kind for r in log.reports]
+        # bin-packing never places a refused model
+        assert "big" not in {m for ms in p.plan(["r0"]).values()
+                             for m in ms}
+
+
+# ---------------------------------------------------------------------------
+# Bin-packing determinism
+# ---------------------------------------------------------------------------
+
+def test_plan_first_fit_decreasing_deterministic(monkeypatch):
+    sizes = {"a": 30, "b": 50, "c": 20, "d": 50}
+    monkeypatch.setattr(placement_mod, "model_cost_bytes",
+                        lambda src: sizes[src])
+    def _mk():
+        return Placer({m: m for m in sizes},
+                      PlaceConfig(device_budget=80), name="t")
+    with _mk() as p1, _mk() as p2:
+        plan1 = p1.plan(["r0", "r1"])
+        # FFD by (-bytes, name): b(50)->r0, d(50)->r1, a(30)->r0(80),
+        # c(20)->r1(70)
+        assert plan1 == {"r0": ["a", "b"], "r1": ["c", "d"]}
+        assert p2.plan(["r0", "r1"]) == plan1  # same inputs, same pack
+
+
+# ---------------------------------------------------------------------------
+# Eviction boundaries
+# ---------------------------------------------------------------------------
+
+def test_lru_victim_tiebreak_deterministic():
+    with Placer({m: None for m in ("c", "a", "b")}, PlaceConfig(),
+                name="t") as p:
+        for m in ("a", "b", "c"):
+            p.note_resident("r0", m)
+        # never-touched models carry their sorted-name insertion order:
+        # "a" seeded first is the victim, deterministically
+        assert p.victim("r0") == "a"
+        p.touch("a")
+        assert p.victim("r0") == "b"
+        p.touch("b")
+        assert p.victim("r0") == "c"
+        # exclusion walks the same deterministic order
+        assert p.victim("r0", exclude={"c"}) == "a"
+
+
+def test_evict_mid_pagein_refused_typed():
+    """Evicting the model that is itself mid-page-in would orphan the
+    in-flight load — the placer refuses typed instead."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def _block_load(_m):
+        entered.set()
+        assert gate.wait(5.0)
+
+    with Placer({"a": None}, PlaceConfig(), name="t") as p:
+        t = threading.Thread(
+            target=lambda: p.page_in("r0", "a", _block_load, _noop),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+        assert p.paging("r0", "a")
+        with pytest.raises(PlacementRefusedError):
+            p.evict("r0", "a", _noop)
+        gate.set()
+        t.join(timeout=5.0)
+        assert p.is_resident("r0", "a")
+        assert not p.inflight()
+
+
+def test_evict_protected_model_skipped():
+    """A model with active SLO burn is exempt from victim selection —
+    one noisy neighbor cannot page out a model already missing its
+    objectives."""
+    with Placer({"a": None, "b": None}, PlaceConfig(protect_slo=True),
+                name="t", protect=lambda m: m == "a") as p:
+        p.note_resident("r0", "a")
+        p.note_resident("r0", "b")
+        assert p.victim("r0") == "b"  # "a" is LRU-older but protected
+        p.touch("b")
+        assert p.victim("r0") == "b"  # still the only candidate
+
+
+def test_single_flight_under_thread_storm():
+    """16 threads demanding the same cold model trigger exactly ONE
+    load; every caller sees the model warm."""
+    calls = []
+    lock = threading.Lock()
+
+    def _load(m):
+        with lock:
+            calls.append(m)
+        time.sleep(0.05)
+
+    with Placer({"m": None}, PlaceConfig(), name="t") as p:
+        results = [None] * 16
+        def _run(i):
+            results[i] = p.page_in("r0", "m", _load, _noop)
+        threads = [threading.Thread(target=_run, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert calls == ["m"]
+        assert results == [True] * 16
+        assert not p.inflight()
+
+
+# ---------------------------------------------------------------------------
+# Front door integration: paging, zero compiles, failover
+# ---------------------------------------------------------------------------
+
+def test_unknown_model_is_typed_client_error(model):
+    with FrontDoor({"m": model}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(),
+                   placement=PlaceConfig(max_warm=2)) as fd:
+        with pytest.raises(UnknownModelError):
+            fd.submit({"x1": 0.0, "x2": 0.0}, model="nope")
+        fs = fd.fleet_snapshot()
+        # never accepted: the accounting identity holds at zero
+        assert fs["submitted"] == 0
+        assert fs["sheds"].get("unknown_model", 0) == 1
+
+
+def test_evict_then_request_pages_back_with_zero_compiles(saved, model):
+    """The density acceptance gate: after an eviction, the next request
+    for the cold model pages it back in through the AOT store — a
+    deserialize, asserted as ZERO CompileLedger builds — and the record
+    stays bit-equal."""
+    rows = _rows(model, 6)
+    baseline = micro_batch_score_function(model)(rows)
+    with FrontDoor({"a": saved, "b": saved}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(), warm=True,
+                   placement=PlaceConfig(max_warm=1)) as fd:
+        pl = fd.placer
+        assert pl is not None
+        # max_warm=1: exactly one model fits warm, the other is cold
+        assert pl.residents("r0") == ["a"]
+        assert pl.snapshot()["cold"] == ["b"]
+        # warm traffic on "a" so it is NOT the LRU victim by accident
+        assert fd.submit(rows[0], model="a").result(30) == baseline[0]
+        mark = lg.ledger().mark()
+        # demand for cold "b": evicts "a" (advisory), deserializes "b"
+        recs = [fd.submit(r, model="b").result(30) for r in rows]
+        assert recs == baseline
+        built = lg.ledger().since(mark)
+        assert built == [], [r.to_json() for r in built]
+        assert pl.residents("r0") == ["b"]
+        snap = pl.snapshot()
+        assert snap["pageIns"] >= 1 and snap["evictions"] >= 1
+        kinds = [r.kind for r in fd.fault_log.reports]
+        assert "placement_evicted" in kinds
+        assert "placement_paged_in" in kinds
+        # ...and back: "a" pages in again, still zero compiles
+        mark = lg.ledger().mark()
+        assert fd.submit(rows[1], model="a").result(30) == baseline[1]
+        assert lg.ledger().since(mark) == []
+
+
+def test_warm_copy_kill_pages_in_on_survivor(saved, model):
+    """Kill the replica holding the ONLY warm copy of a model: already
+    accepted requests fail over, the model pages in on a survivor, and
+    every record stays bit-equal — zero lost futures."""
+    rows = _rows(model, 8)
+    baseline = micro_batch_score_function(model)(rows)
+    with FrontDoor({"a": saved, "b": saved}, replicas=2, config=_cfg(),
+                   fleet_config=_fc(min_replicas=2, max_replicas=2),
+                   warm=True, placement=PlaceConfig(max_warm=1)) as fd:
+        pl = fd.placer
+        holders = pl.holders("a")
+        assert len(holders) == 1  # max_warm=1 on 2 replicas, 2 models
+        victim_rid = holders[0]
+        survivor = next(r for r in ("r0", "r1") if r != victim_rid)
+        futs = [fd.submit(r, model="a") for r in rows]
+        fd.kill_replica(victim_rid)
+        recs = [f.result(30) for f in futs]
+        assert recs == baseline
+        # the orphaned model is warm again, on the survivor
+        assert fd.submit(rows[0], model="a").result(30) == baseline[0]
+        assert pl.holders("a") == [survivor]
+        lost = [r for r in fd.fault_log.reports if r.kind == "replica_lost"]
+        assert lost and lost[0].detail.get("orphanedModels") == ["a"]
+        fs = fd.fleet_snapshot()
+        assert fs["submitted"] == len(rows) + 1
+        assert sum(fs["sheds"].values()) == 0
+
+
+def test_pagein_chaos_is_typed_and_retried(saved, model):
+    """An injected ``place.pagein`` fault fails the first page-in typed;
+    the front door retries within its failover budget and the request
+    still completes bit-equal."""
+    rows = _rows(model, 4)
+    baseline = micro_batch_score_function(model)(rows)
+    with FrontDoor({"a": saved, "b": saved}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(), warm=True,
+                   placement=PlaceConfig(max_warm=1)) as fd:
+        with faults.injected({"place.pagein":
+                              {"mode": "raise", "nth": 1, "count": 1}}):
+            assert fd.submit(rows[0], model="b").result(30) == baseline[0]
+        kinds = [r.kind for r in fd.fault_log.reports]
+        assert "place_pagein_failed" in kinds
+        assert "placement_paged_in" in kinds
+
+
+def test_assign_chaos_leaves_model_cold_zero_impact(saved, model):
+    """An injected ``place.assign`` fault leaves the model cold at
+    startup (typed ``place_assign_failed``); first demand pages it in —
+    requests never notice."""
+    rows = _rows(model, 4)
+    baseline = micro_batch_score_function(model)(rows)
+    with faults.injected({"place.assign":
+                          {"mode": "raise", "nth": 1, "count": 1}}):
+        with FrontDoor({"a": saved}, replicas=1, config=_cfg(),
+                       fleet_config=_fc(), warm=True,
+                       placement=PlaceConfig(max_warm=2)) as fd:
+            kinds = [r.kind for r in fd.fault_log.reports]
+            assert "place_assign_failed" in kinds
+            assert fd.submit(rows[0], model="a").result(30) == baseline[0]
+
+
+def test_evict_chaos_skips_eviction_and_proceeds(saved, model):
+    """An injected ``place.evict`` fault skips the eviction (capacity is
+    advisory, typed ``place_evict_failed``) and the page-in proceeds
+    over-budget — the request completes."""
+    rows = _rows(model, 4)
+    baseline = micro_batch_score_function(model)(rows)
+    with FrontDoor({"a": saved, "b": saved}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(), warm=True,
+                   placement=PlaceConfig(max_warm=1)) as fd:
+        with faults.injected({"place.evict":
+                              {"mode": "raise", "nth": 1, "count": 1}}):
+            assert fd.submit(rows[0], model="b").result(30) == baseline[0]
+        kinds = [r.kind for r in fd.fault_log.reports]
+        assert "place_evict_failed" in kinds
+        # both models warm: the eviction was skipped, not retried
+        assert fd.placer.residents("r0") == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# Model routing on the wire (netedge/netproto satellite)
+# ---------------------------------------------------------------------------
+
+def test_wire_model_routing_and_unknown_model_404(model):
+    """Both framings carry an optional model id (TGB1 ``"model"``
+    header / ``X-TG-Model``); a wrong id is a typed 404 shed at the
+    edge — a client error, never a lost future or a 500."""
+    from transmogrifai_tpu.serving import NetEdge
+    from transmogrifai_tpu.serving.netproto import WireClient
+    rows = _rows(model, 6)
+    baseline = micro_batch_score_function(model)(rows)
+    with FrontDoor({"a": model, "b": model}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(),
+                   placement=PlaceConfig(max_warm=2)) as fd:
+        with NetEdge(fd, name="place-edge") as edge:
+            host, port = edge.address
+            for proto in ("http", "binary"):
+                with WireClient(host, port, protocol=proto) as cli:
+                    res = cli.request(rows, model="b")
+                    assert res.status == 200, (proto, res)
+                    assert res.records == baseline
+                    bad = cli.request(rows, model="nope")
+                    assert bad.status == 404, (proto, bad)
+            shed = sum(
+                v for k, v in edge.metrics.snapshot().get(
+                    "tg_net_shed_total", {}).items()
+                if "reason=unknown_model" in k)
+            assert shed >= 2, edge.metrics.snapshot()
+        fs = fd.fleet_snapshot()
+        assert fs["sheds"].get("unknown_model", 0) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Load generator model mix
+# ---------------------------------------------------------------------------
+
+def test_loadgen_model_mix_accounting(model):
+    with FrontDoor({"a": model, "b": model}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(),
+                   placement=PlaceConfig(max_warm=2)) as fd:
+        report = run_open_loop(fd, _rows(model, 16), seconds=0.5,
+                               rps=120.0, models=[("a", 3.0), ("b", 1.0)],
+                               model_seed=5)
+    assert report["accountingOk"]
+    assert report["lost"] == 0 and report["failed"] == 0
+    per = report["models"]
+    assert set(per) <= {"a", "b"}
+    # the per-model buckets sum to the totals — the same identity the
+    # per-tenant breakdown keeps
+    assert sum(b["offered"] for b in per.values()) == report["offered"]
+    assert sum(b["completed"] for b in per.values()) == report["completed"]
+    # 3:1 weights: "a" must dominate (deterministic under model_seed)
+    assert per["a"]["offered"] > per.get("b", {"offered": 0})["offered"]
+
+
+# ---------------------------------------------------------------------------
+# Post-mortem bundle (schema v5) & snapshot plumbing
+# ---------------------------------------------------------------------------
+
+def test_postmortem_v5_carries_placement_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("TG_POSTMORTEM_DIR", str(tmp_path))
+    with Placer({"a": None}, PlaceConfig(), name="pmfleet") as p:
+        p.note_resident("r0", "a")
+        _blackbox.record("place.assign", fleet="pmfleet", model="a",
+                         replica="r0")
+        path = pm.trigger("campaign_escape", detail={"why": "test"})
+        assert path is not None
+        doc = pm.read_bundle(path)
+    assert doc["schemaVersion"] == pm.SCHEMA_VERSION >= 5
+    assert pm.validate_bundle(doc) == []
+    assert doc["placement"]["pmfleet"]["resident"] == {"r0": ["a"]}
+    # a v5 bundle stripped of its placement section must flag it
+    broken = dict(doc)
+    broken.pop("placement")
+    assert any("placement" in pr for pr in pm.validate_bundle(broken))
+
+
+def test_fleet_snapshot_carries_placement(model):
+    with FrontDoor({"a": model, "b": model}, replicas=1, config=_cfg(),
+                   fleet_config=_fc(),
+                   placement=PlaceConfig(max_warm=2)) as fd:
+        snap = fd.fleet_snapshot()
+        place = snap["placement"]
+        assert place["fleet"] == fd.name
+        assert place["models"] == ["a", "b"]
+        assert snap["replicas"]["r0"]["resident"] == ["a", "b"]
+
+
+def test_placer_leak_oracle_detects_and_cleans():
+    p = Placer({"a": None}, PlaceConfig(), name="leaky")
+    assert any("leaky" in v for v in oracles.placement_violations())
+    closed = oracles.close_leaked_placers()
+    assert "leaky" in closed
+    assert oracles.placement_violations() == []
+    assert p not in live_placers()
+
+
+# ---------------------------------------------------------------------------
+# Campaign density scenario (the three place.* coverage singletons)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.campaign
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_density_scenario_covers_place_sites():
+    eng = ChaosCampaign(seed=11, scenarios=["density"])
+    try:
+        for site in ("place.assign", "place.evict", "place.pagein"):
+            res = eng.run_schedule({
+                "scenario": "density",
+                "faults": {site: {"mode": "raise", "nth": 1, "count": 1,
+                                  "transient": False}}})
+            assert res["violations"] == [], (site, res["violations"])
+            assert sum(res["fired"].get(site, {}).values()) >= 1
+    finally:
+        eng.close()

@@ -708,15 +708,20 @@ def run_fleet(model_path: str, replicas: int = 2, seconds: float = 5.0,
               max_batch: int = 256, queue_max: int = 1024,
               kill: bool = False, use_subprocess: bool = False,
               name: str = "model", output: Optional[str] = None,
-              seed: int = 42) -> Dict[str, Any]:
+              seed: int = 42, models: int = 1) -> Dict[str, Any]:
     """``op fleet`` (docs/serving.md "Replica fleet & front door"): start
     ``replicas`` worker replicas of a saved model behind a front door,
     drive the open-loop load generator for ``seconds``, and print the
     fleet report — per-replica routing distribution, failovers,
     ejections, scale events, sheds, and the SLO tail. ``--kill`` murders
     one replica mid-soak (the zero-lost-requests drill: the run must
-    still account every request). Exits non-zero on ANY lost request or
-    broken accounting."""
+    still account every request). ``--models N`` registers the saved
+    dir under N model names with the placement layer enabled
+    (docs/serving.md "Multi-model placement & paging") and drives an
+    equal-weight model mix, so routing/paging/eviction are exercised;
+    the report then carries the per-model breakdown and the placement
+    snapshot. Exits non-zero on ANY lost request or broken
+    accounting."""
     import json as _json
     import threading as _threading
     import time as _time
@@ -740,8 +745,26 @@ def run_fleet(model_path: str, replicas: int = 2, seconds: float = 5.0,
         fc.max_replicas = max(fc.max_replicas, replicas)
         model = load_model(model_path)
         rows = synthetic_rows(model, 512, seed=seed)
-        with FrontDoor({name: model_path}, replicas=replicas, config=cfg,
-                       fleet_config=fc, warm=True) as fd:
+        n_models = max(1, int(models))
+        model_map = ({name: model_path} if n_models == 1 else
+                     {f"{name}{i}": model_path
+                      for i in range(1, n_models + 1)})
+        placement = None
+        model_mix = None
+        if n_models > 1:
+            from .serving import PlaceConfig
+            placement = PlaceConfig.from_env()
+            if placement.max_warm <= 0 and placement.device_budget <= 0:
+                # no env bound: keep one model cold so paging is real
+                placement = PlaceConfig(
+                    max_warm=n_models - 1,
+                    device_budget=placement.device_budget,
+                    pagein_timeout_s=placement.pagein_timeout_s,
+                    protect_slo=placement.protect_slo)
+            model_mix = [(m, 1.0) for m in sorted(model_map)]
+        with FrontDoor(model_map, replicas=replicas, config=cfg,
+                       fleet_config=fc, warm=True,
+                       placement=placement) as fd:
             if rps <= 0:
                 from .local import micro_batch_score_function
                 mb = micro_batch_score_function(model)
@@ -765,14 +788,18 @@ def run_fleet(model_path: str, replicas: int = 2, seconds: float = 5.0,
                 killer.start()
             try:
                 report = run_open_loop(fd, rows, seconds, rps,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       models=model_mix)
             finally:
                 if killer is not None:
                     killer.cancel()
             health = fd.health()
         summary = {"model": model_path, "replicas": replicas,
+                   "models": sorted(model_map),
                    "rpsOffered": round(rps, 1), "load": report,
                    "fleet": report.get("fleet"),
+                   "placement": (report.get("fleet") or {}).get("placement"),
+                   "perModel": report.get("models"),
                    "routing": report.get("replicas"),
                    "ready": health["ready"],
                    "replicaStates": {rid: r.get("state")
@@ -1025,6 +1052,35 @@ def run_doctor(bundle: str, as_json: bool = False,
                 if isinstance(v, dict):
                     v = f"count={v.get('count')}"
                 print(f"   {fname}{{{key}}}: {v}")
+    # placement (bundle schema v5; docs/serving.md "Multi-model
+    # placement & paging") — which models were resident where, page-in
+    # p99, evictions, blind admits, refusals: the "did this replica
+    # hold the only warm copy?" context
+    place_doc = doc.get("placement") or {}
+    place_series = {n: s for n, s in metrics.items()
+                    if n.startswith("tg_place_")}
+    if place_doc or place_series:
+        print("-- placement --")
+        for fleet_name, snap in sorted(place_doc.items()):
+            resident = snap.get("resident") or {}
+            for rid, names in sorted(resident.items()):
+                print(f"   {fleet_name}/{rid}: resident="
+                      f"{','.join(names) or '-'}")
+            cold = snap.get("cold") or []
+            if cold:
+                print(f"   {fleet_name}: cold={','.join(cold)}")
+            refused = snap.get("refused") or []
+            if refused:
+                print(f"   {fleet_name}: refused={','.join(refused)}")
+            print(f"   {fleet_name}: pageIns={snap.get('pageIns')} "
+                  f"evictions={snap.get('evictions')} "
+                  f"blindAdmits={snap.get('blindAdmits')} "
+                  f"pageInP99Ms={snap.get('pageInP99Ms')}")
+        for fname, series in sorted(place_series.items()):
+            for key, v in sorted(series.items()):
+                if isinstance(v, dict):
+                    v = f"count={v.get('count')}"
+                print(f"   {fname}{{{key}}}: {v}")
     # network edge (docs/serving.md "Network edge") — connection /
     # request / shed accounting from the tg_net_* series the bundle
     # snapshotted (per-protocol, per-reason)
@@ -1189,6 +1245,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="subprocess replicas (one OS process each; "
                          "TG_FLEET_SUBPROCESS)")
     fl.add_argument("--name", default="model", help="registry model name")
+    fl.add_argument("--models", type=int, default=1,
+                    help="register the saved model under N names with "
+                         "the placement layer enabled and drive an "
+                         "equal-weight model mix (routing + paging + "
+                         "eviction; docs/serving.md \"Multi-model "
+                         "placement & paging\")")
     fl.add_argument("--output", default=None,
                     help="directory for metrics.prom + "
                          "fleet_summary.json")
@@ -1297,7 +1359,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                   rps=a.rps, deadline_ms=a.deadline_ms,
                   max_batch=a.max_batch, queue_max=a.queue_max,
                   kill=a.kill, use_subprocess=a.subprocess,
-                  name=a.name, output=a.output, seed=a.seed)
+                  name=a.name, output=a.output, seed=a.seed,
+                  models=a.models)
     elif a.command == "slo":
         run_slo(a.model, seconds=a.seconds, rps=a.rps,
                 availability=a.availability, p99_ms=a.p99_ms,

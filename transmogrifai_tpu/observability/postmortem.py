@@ -39,6 +39,9 @@ doctor``)::
       "aot":         {...},   // AOT program-store snapshot: sessions,
                               // hit/miss/export accounting (v4;
                               // transmogrifai_tpu/programstore/)
+      "placement":   {...},   // per-fleet placer snapshots: residency,
+                              // page-in/eviction accounting, refusals
+                              // (v5; serving/placement.py)
       "environment": {"jax", "jaxlib", "backend", "devices", "python"}
     }
 
@@ -63,11 +66,12 @@ from . import blackbox as _blackbox
 #: current bundle schema. v2 (PR 12) added the compile-ledger tail and
 #: the device-memory snapshot; v3 (PR 13) added the SLO tracker
 #: snapshots and the recent windowed-sampler samples; v4 (PR 15) added
-#: the AOT program-store snapshot; older bundles (no such sections)
+#: the AOT program-store snapshot; v5 adds the fleet placement
+#: snapshots (serving/placement.py); older bundles (no such sections)
 #: must stay readable — validate_bundle accepts every
 #: SUPPORTED_SCHEMA_VERSIONS
-SCHEMA_VERSION = 4
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
+SCHEMA_VERSION = 5
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 #: how many ledger records a bundle carries (most recent builds)
 LEDGER_TAIL = 32
 
@@ -263,6 +267,17 @@ def trigger(kind: str, corr: Optional[str] = None,
         # missing/falling back? (transmogrifai_tpu/programstore/)
         from ..programstore import store as _pstore
         doc["aot"] = _pstore.snapshot()
+        # placement context (schema v5): which models were resident
+        # where, what paged in/evicted, and what the budget refused —
+        # the "did the incident's replica hold the only warm copy?"
+        # context. Consulted only when the placement module is already
+        # loaded (train-side triggers must not drag serving in).
+        place_doc: Dict[str, Any] = {}
+        pl_mod = _sys.modules.get("transmogrifai_tpu.serving.placement")
+        if pl_mod is not None:
+            for p in pl_mod.live_placers():
+                place_doc[p.name] = p.snapshot()
+        doc["placement"] = place_doc
     except Exception as e:  # context gathering must not kill the dump
         doc["contextError"] = f"{type(e).__name__}: {e}"[:300]
     path = os.path.join(postmortem_dir(),
@@ -351,4 +366,8 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
         # v4 section; v3 bundles predate the AOT store and stay valid
         if not isinstance(doc.get("aot"), dict):
             problems.append("missing aot section (schema v4)")
+    if isinstance(version, int) and version >= 5:
+        # v5 section; v4 bundles predate the placement layer
+        if not isinstance(doc.get("placement"), dict):
+            problems.append("missing placement section (schema v5)")
     return problems

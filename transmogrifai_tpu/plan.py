@@ -112,7 +112,10 @@ def planning_applicable() -> bool:
     reason as ``serve.*``; the ``aot.load`` site targets the AOT
     program-store load path *inside* the planner's segment dispatch
     (programstore/store.py) — disabling the planner would disable
-    exactly the fallback ladder under test."""
+    exactly the fallback ladder under test; sites prefixed ``place.``
+    target the fleet's model-placement layer (serving/placement.py),
+    another floor above the planner, and keep it active like
+    ``fleet.*``."""
     if not plan_enabled():
         return False
     from .robustness import faults
@@ -120,7 +123,7 @@ def planning_applicable() -> bool:
         return False
     armed = faults.active_sites()
     if any(not s.startswith(("plan.", "serve.", "drift.", "oom.",
-                             "fleet.", "aot."))
+                             "fleet.", "aot.", "place."))
            for s in armed):
         return False
     return True

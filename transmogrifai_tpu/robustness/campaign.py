@@ -20,8 +20,12 @@ closes that gap *compositionally*:
   ``serve_heal`` (registry + drift monitor + background refit under
   shifted traffic), ``stream`` (out-of-core train + resume), ``fleet``
   (a two-replica front door with routing/failover/probe faults — the
-  zero-lost-futures accounting identity under replica kills), and
-  ``transfer`` (the guarded host<->device helpers).
+  zero-lost-futures accounting identity under replica kills),
+  ``density`` (three models packed onto two one-warm-slot replicas:
+  LRU eviction + demand paging + warm-copy failover under the
+  ``place.*`` and ``fleet.*`` sites — the same accounting identity
+  through model mobility), and ``transfer`` (the guarded host<->device
+  helpers).
 * **oracles** — after every run a library of invariants is checked:
   bit-equality of recovered results against the fault-free baseline
   wherever the site table promises it; full request accounting
@@ -87,6 +91,9 @@ ACCOUNT_KINDS = {
     "net.accept": "net_accept_refused",
     "net.read": "net_read_shed",
     "net.write": "net_write_shed",
+    "place.assign": "place_assign_failed",
+    "place.evict": "place_evict_failed",
+    "place.pagein": "place_pagein_failed",
 }
 
 
@@ -699,6 +706,126 @@ class _FleetScenario(_Scenario):
         return out
 
 
+class _DensityScenario(_Scenario):
+    """Multi-model fleet density: three models packed onto two replicas
+    with ONE warm slot each (``PlaceConfig(max_warm=1)``), requests
+    round-robined across the models — so every schedule exercises LRU
+    eviction, single-flight demand paging, and (when
+    ``fleet.replica_kill`` draws in) warm-copy loss with page-in
+    recovery on the survivor. Oracles: the fleet accounting identity —
+    submitted = completed + *typed* sheds, zero failed, zero lost
+    futures — through model mobility; every completed record bit-equal
+    to its model's fault-free run; fired ``place.*``/``fleet.*`` sites
+    leave their recovery kinds on the front door's FaultLog."""
+
+    name = "density"
+
+    def setup(self) -> None:
+        from ..local import micro_batch_score_function
+        from ..serving.loadgen import synthetic_rows
+        self.model_names = ("m7", "m8", "m9")
+        self.models = {"m7": self.engine.small_model(7),
+                       "m8": self.engine.small_model(8),
+                       "m9": self.engine.small_model(9)}
+        self.rows = {m: synthetic_rows(self.models[m], 6, seed=71 + i)
+                     for i, m in enumerate(self.model_names)}
+        self.baseline = {
+            m: micro_batch_score_function(self.models[m])(
+                list(self.rows[m]))
+            for m in self.model_names}
+        #: interleaved (model, row-index) submit order — maximal paging
+        self.order = [(m, j) for j in range(6) for m in self.model_names]
+
+    def run(self, log: FaultLog) -> Dict[str, Any]:
+        from ..serving.fleet import FleetConfig
+        from ..serving.frontdoor import FrontDoor
+        from ..serving.placement import PlaceConfig
+        from ..serving.runtime import ServeConfig
+        cfg = ServeConfig(max_batch=16, max_queue=64, max_wait_ms=10.0)
+        fc = FleetConfig(min_replicas=2, max_replicas=2,
+                         probe_interval_ms=0.0, probe_failures=1,
+                         readmit_probes=1, max_failovers=2,
+                         autoscale=False)
+        completed: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        shed: Dict[Tuple[str, int], str] = {}
+        failed: Dict[Tuple[str, int], str] = {}
+        lost: List[Tuple[str, int]] = []
+        fd = FrontDoor(dict(self.models), replicas=2, config=cfg,
+                       fleet_config=fc, fault_log=log,
+                       placement=PlaceConfig(max_warm=1))
+        try:
+            pending = []
+            for m, j in self.order:
+                try:
+                    pending.append(
+                        ((m, j), fd.submit(self.rows[m][j], model=m)))
+                except Exception as e:
+                    if isinstance(e, self.engine.typed_escapes()):
+                        shed[(m, j)] = type(e).__name__
+                    else:
+                        raise  # untyped submit failure = discipline breach
+            fd.probe_now()
+            deadline = time.monotonic() + self.engine.collect_timeout
+            for key, fut in pending:
+                try:
+                    completed[key] = fut.result(
+                        timeout=max(0.05, deadline - time.monotonic()))
+                except _FutureTimeout:
+                    lost.append(key)
+                except Exception as e:
+                    if isinstance(e, self.engine.typed_escapes()):
+                        shed[key] = type(e).__name__
+                    else:
+                        failed[key] = f"{type(e).__name__}: {e}"
+            snapshot = fd.fleet_snapshot()
+        finally:
+            fd.close(drain=False)
+        return {"completed": completed, "shed": shed, "failed": failed,
+                "lost": lost, "fleet": snapshot,
+                "placement": snapshot.get("placement"),
+                "accounting": {"submitted": len(self.order),
+                               "completed": len(completed),
+                               "shed": len(shed), "failed": len(failed),
+                               "lost": len(lost)}}
+
+    def violations(self, result, fired, log) -> List[str]:
+        out: List[str] = []
+        n = len(self.order)
+        if result["lost"]:
+            out.append(f"density: {len(result['lost'])} request "
+                       f"future(s) never resolved (lost): "
+                       f"{sorted(result['lost'])[:8]}")
+        if result["failed"]:
+            out.append(f"density: request future(s) failed untyped "
+                       f"(requests must complete or shed typed): "
+                       f"{result['failed']}")
+        total = (len(result["completed"]) + len(result["shed"])
+                 + len(result["failed"]) + len(result["lost"]))
+        if total != n:
+            out.append(f"density: request accounting broken: "
+                       f"{total} accounted of {n} submitted")
+        mismatched = [k for k, rec in result["completed"].items()
+                      if rec != self.baseline[k[0]][k[1]]]
+        if mismatched:
+            out.append(f"density: completed record(s) not bit-equal to "
+                       f"the fault-free run: {sorted(mismatched)[:8]}")
+        kinds = {r.kind for r in log.reports}
+        for site in fired:
+            want = ACCOUNT_KINDS.get(site)
+            if want and want not in kinds:
+                out.append(f"density: site {site} fired but recovery "
+                           f"kind '{want}' was never recorded")
+        pl = result.get("placement") or {}
+        if pl.get("inflightPageIns"):
+            out.append(f"density: page-in(s) still in flight at "
+                       f"snapshot: {pl['inflightPageIns']}")
+        if ("fleet.replica_kill" in fired
+                and not result["fleet"]["kills"]):
+            out.append("density: fleet.replica_kill fired but the fleet "
+                       "snapshot shows no kill")
+        return out
+
+
 class _NetScenario(_Scenario):
     """The network edge over one serving runtime: every request crosses
     a real localhost socket (alternating HTTP/JSON and binary framing)
@@ -875,12 +1002,13 @@ class ChaosCampaign:
     """
 
     #: scenario draw weights for the randomized (post-coverage) schedules
-    SCENARIO_WEIGHTS = (("serve", 0.26), ("train", 0.21), ("sweep", 0.16),
-                        ("stream", 0.13), ("fleet", 0.08), ("net", 0.06),
-                        ("serve_heal", 0.05), ("transfer", 0.05))
+    SCENARIO_WEIGHTS = (("serve", 0.24), ("train", 0.20), ("sweep", 0.15),
+                        ("stream", 0.12), ("fleet", 0.08), ("density", 0.06),
+                        ("net", 0.05), ("serve_heal", 0.05),
+                        ("transfer", 0.05))
     _SCENARIOS = (_TrainScenario, _SweepScenario, _ServeScenario,
                   _ServeHealScenario, _StreamScenario, _FleetScenario,
-                  _NetScenario, _TransferScenario)
+                  _DensityScenario, _NetScenario, _TransferScenario)
 
     def __init__(self, seed: Optional[int] = None,
                  workdir: Optional[str] = None,
@@ -1025,7 +1153,8 @@ class ChaosCampaign:
             # serve-side flushes coalesce (and fleet routing reacts to
             # live queue depths), so only first-call triggers are
             # schedule-deterministic there
-            force = scn in ("serve", "serve_heal", "fleet", "net")
+            force = scn in ("serve", "serve_heal", "fleet", "net",
+                            "density")
             fault_specs = {}
             for s in sorted(sites):
                 mode = str(rng.choice(ALL_SITES[s].modes))

@@ -139,6 +139,25 @@ site                         fires in
                              mid-request disconnect, the edge accounts a
                              typed ``write_fault`` shed +
                              ``net_write_shed``; never a lost future)
+``place.assign``             in the placement bin-pack, per model as it
+                             is assigned to a replica
+                             (serving/placement.py; a raise leaves the
+                             model cold — typed ``place_assign_failed``
+                             — and it pages in on first demand, zero
+                             request impact; ``place.*`` sites keep the
+                             planner active like ``fleet.*``)
+``place.evict``              before an LRU victim's runtime unloads (a
+                             raise skips the eviction — the predicted
+                             capacity is advisory — with a typed
+                             ``place_evict_failed``; the page-in
+                             proceeds anyway)
+``place.pagein``             in the single-flight page-in leader,
+                             before the cold model's runtime loads (a
+                             raise fails the page-in typed —
+                             ``place_pagein_failed`` — and the front
+                             door retries within its bounded failover
+                             budget: typed shed when exhausted, never
+                             a lost future)
 ===========================  ====================================================
 
 Preemption sites (``mode: "preempt"`` — raise :class:`SimulatedPreemption`,
@@ -309,13 +328,16 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
           "packed grid splits and fold metrics merge (identical winner); "
           "exhaustion persisting to a single config quarantines the "
           "family", bit_equal=False),
-    _site("fleet.route", "raise", "serving/frontdoor.py", "fleet",
+    _site("fleet.route", "raise", "serving/frontdoor.py", "fleet|density",
           "request fails over to another replica (bounded budget); "
           "typed shed when exhausted — never a lost future"),
-    _site("fleet.replica_kill", "raise", "serving/frontdoor.py", "fleet",
+    _site("fleet.replica_kill", "raise", "serving/frontdoor.py",
+          "fleet|density",
           "replica killed mid-flight; queued requests fail over to "
-          "survivors, replica_lost post-mortem dumped, zero lost"),
-    _site("fleet.probe", "raise", "serving/frontdoor.py", "fleet",
+          "survivors, replica_lost post-mortem dumped, zero lost — "
+          "under placement, models whose only warm copy died page in "
+          "on a survivor"),
+    _site("fleet.probe", "raise", "serving/frontdoor.py", "fleet|density",
           "probe failure counted; consecutive failures eject the "
           "replica, healthy probes readmit it — requests unaffected"),
     _site("aot.load", "raise", "programstore/store.py", "serve_heal",
@@ -331,6 +353,16 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
     _site("net.write", "raise", "serving/netedge.py", "net",
           "write path dies mid-response after every future resolved; "
           "typed write_fault shed (net_write_shed), never a lost future"),
+    _site("place.assign", "raise", "serving/placement.py", "density",
+          "model left cold by the bin-pack (place_assign_failed); it "
+          "pages in on first demand — zero request impact"),
+    _site("place.evict", "raise", "serving/placement.py", "density",
+          "eviction skipped (capacity prediction is advisory) with a "
+          "typed place_evict_failed; the page-in proceeds anyway"),
+    _site("place.pagein", "raise", "serving/placement.py", "density",
+          "page-in fails typed (place_pagein_failed); the front door "
+          "retries within the bounded failover budget — typed shed "
+          "when exhausted, never a lost future"),
     _site("preempt.stage_fit", "preempt", "dag.py", "train|stream",
           "train(resume=True) restores verified stages, bit-exact"),
     _site("preempt.checkpoint_write", "preempt", "persistence.py",

@@ -69,6 +69,29 @@ def net_violations() -> List[str]:
     return out
 
 
+def leaked_placers() -> List[str]:
+    """Names of live (unclosed) fleet placers — each holds residency
+    state plus single-flight page-in events that block waiters."""
+    from ..serving import placement as _pl
+    return [p.name for p in _pl.live_placers()]
+
+
+def placement_violations() -> List[str]:
+    """The placement no-leak oracle: no placer may outlive its front
+    door, and no single-flight page-in may still be in flight (a stuck
+    page-in would block every later waiter for that model). Wired into
+    :func:`campaign_violations` and the conftest ``_no_placement_leak``
+    fixture."""
+    from ..serving import placement as _pl
+    out: List[str] = []
+    for p in _pl.live_placers():
+        inflight = p.inflight()
+        out.append(f"placer '{p.name}' leaked"
+                   + (f" ({len(inflight)} page-in(s) in flight: "
+                      f"{sorted(inflight)})" if inflight else ""))
+    return out
+
+
 def leaked_stream_feeds() -> List[str]:
     """repr of open DeviceFeeds."""
     from ..streaming import feed as _feed
@@ -266,6 +289,17 @@ def close_leaked_fleets() -> List[str]:
     return [fd.name for fd in leaked]
 
 
+def close_leaked_placers() -> List[str]:
+    """Force-close leftover placers (releases any blocked page-in
+    waiters) — normally a placer closes with its front door, so anything
+    here was detached from a fleet that already leaked."""
+    from ..serving import placement as _pl
+    leaked = _pl.live_placers()
+    for p in leaked:
+        p.close()
+    return [p.name for p in leaked]
+
+
 def close_leaked_feeds() -> List[str]:
     from ..streaming import feed as _feed
     leaked = _feed.live_feeds()
@@ -309,6 +343,7 @@ def campaign_violations(clean: bool = True,
     fds = leaked_fleets()
     if fds:
         out.append(f"fleet front door(s) leaked: {fds}")
+    out.extend(placement_violations())
     rts = leaked_serving_runtimes()
     if rts:
         out.append(f"serving runtime(s) leaked: {rts}")
@@ -322,6 +357,7 @@ def campaign_violations(clean: bool = True,
     if clean:
         close_leaked_net_edges()
         close_leaked_fleets()
+        close_leaked_placers()
         close_leaked_serving()
         close_leaked_feeds()
         close_leaked_hearts()

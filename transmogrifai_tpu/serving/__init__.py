@@ -18,6 +18,13 @@ futures, refuses-or-splits flushes against ``TG_DEVICE_BUDGET`` before
 dispatch, rolls deploys replica-by-replica, and autoscales on
 ``scale_hint``.
 
+Fleet density (ROADMAP item 4, docs/serving.md "Multi-model placement &
+paging"): ``placement.py`` bin-packs many models onto few replicas
+against predicted MANIFEST ``costs`` bytes / a warm-count cap, pages
+cold models in on demand (single-flight, a deserialize via the AOT
+program store), LRU-evicts idle ones (SLO-burn protected), and keeps
+the zero-lost-futures identity through warm-copy loss.
+
 The process boundary (docs/serving.md "Network edge"): a chaos-hardened
 asyncio front end (``netedge.py`` + ``netproto.py``) terminating
 HTTP/JSON and a length-prefixed binary columnar framing on a real
@@ -43,6 +50,10 @@ from .netedge import (  # noqa: F401
 )
 from .netproto import (  # noqa: F401
     FrameError, WireClient, WireDisconnect, WireResult,
+)
+from .placement import (  # noqa: F401
+    PlaceConfig, Placer, PlacementRefusedError, UnknownModelError,
+    live_placers, model_cost_bytes,
 )
 from .registry import ModelRegistry  # noqa: F401
 from .runtime import (  # noqa: F401

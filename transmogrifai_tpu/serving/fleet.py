@@ -206,6 +206,30 @@ class Replica:
         one drains after)."""
         self.registry.swap(model, model_or_path)
 
+    # -- model mobility (the placement layer's page-in/evict hooks) ----------
+    def load(self, name: str, src: Any,
+             warm: Optional[bool] = None) -> None:
+        """Page a model in: manifest-verified load (a *deserialize* via
+        the AOT program store when the manifest carries one — not a
+        compile) or registration of a live model object."""
+        if self._dead:
+            raise ReplicaLostError(f"replica '{self.rid}' is dead")
+        if isinstance(src, str):
+            self.registry.load(name, src,
+                               warm=True if warm is None else warm)
+        else:
+            self.registry.register(name, src, warm=bool(warm))
+
+    def unload(self, name: str, drain: bool = True) -> None:
+        """Page a model out: close its runtime (draining queued work by
+        default). The saved-model artifact and its AOT program store
+        entry stay — a later page-in deserializes."""
+        self.registry.unregister(name, drain=drain)
+
+    def resident(self) -> List[str]:
+        """Models currently warm on this replica."""
+        return self.registry.names()
+
     def warm_reports(self) -> Dict[str, Any]:
         """Per-model warm reports (the bench's per-replica zero-retrace
         evidence)."""

@@ -50,6 +50,20 @@ ejections, rolling deploys and autoscale events. The chaos-campaign
   replica's ``scale_hint`` (observability/slo.py, via ``health()``):
   any ``up`` spawns a replica below ``TG_FLEET_MAX``; unanimous
   ``down`` retires (drains) one above ``TG_FLEET_MIN``.
+* **multi-model placement & paging** (``placement=`` / ROADMAP item 4)
+  — a :class:`~.placement.Placer` bin-packs the model set onto replicas
+  against per-replica capacity (``TG_PLACE_MAX_WARM`` count cap /
+  ``TG_PLACE_BUDGET`` predicted bytes from MANIFEST ``costs``), routes
+  each request to a replica holding its model *warm* (falling back to
+  the best page-in candidate and steering around replicas that are
+  mid-page-in), demand-pages cold models under a single-flight guard
+  (a deserialize via the AOT program store, not a compile), and LRU-
+  evicts idle models — exempting any with active SLO page alerts.
+  Requests for a model this fleet does not serve raise the typed
+  :class:`~.placement.UnknownModelError` (the network edge's 404).
+  Off (``placement=None``) the front door behaves exactly as before;
+  subprocess fleets ignore placement (replicas hold the full set —
+  typed ``placement_unsupported`` warning).
 
 Front-door sheds (admission refusal, no healthy replica, deadline)
 count on the SAME ``tg_serve_shed_total`` / ``tg_serve_tenant_shed_total``
@@ -61,7 +75,9 @@ loss dumps a ``replica_lost`` post-mortem bundle
 
 Chaos sites: ``fleet.route`` (routing/dispatch failure → failover),
 ``fleet.replica_kill`` (replica crash mid-flight → failover + bundle),
-``fleet.probe`` (probe transport failure → ejection ladder).
+``fleet.probe`` (probe transport failure → ejection ladder); the
+placement layer adds ``place.assign`` / ``place.evict`` /
+``place.pagein`` (serving/placement.py).
 """
 from __future__ import annotations
 
@@ -84,6 +100,7 @@ from .fleet import (
     ACTIVE, DEAD, DRAINING, EJECTED, RETIRED, AdmissionRefusedError,
     FleetConfig, ReplicaLostError, build_replica,
 )
+from .placement import PlaceConfig, Placer, UnknownModelError
 from .runtime import (
     DeadlineExceededError, OverloadError, RuntimeStoppedError, ServeConfig,
     ServingError,
@@ -141,6 +158,7 @@ class FrontDoor:
                  fleet_config: Optional[FleetConfig] = None,
                  fault_log: Optional[FaultLog] = None,
                  warm: Optional[bool] = None,
+                 placement: Any = None,
                  auto_start: bool = True):
         if not models:
             raise ValueError("a fleet needs at least one model")
@@ -177,6 +195,11 @@ class FrontDoor:
         self.scale_events: List[Dict[str, Any]] = []
         self.deploy_history: List[Dict[str, Any]] = []
         self._admission: Dict[str, Any] = {"enabled": False}
+        #: multi-model placement (None = off, legacy every-model-on-
+        #: every-replica behavior; True = PlaceConfig.from_env())
+        self._placement = placement
+        self.placer: Optional[Placer] = None
+        self._planned: Dict[str, List[str]] = {}
         n = replicas if replicas is not None else max(
             1, self.fleet_config.min_replicas)
         self._initial_replicas = n
@@ -192,6 +215,28 @@ class FrontDoor:
                 return self
             self._started = True
             self._accepting = True
+        if self._placement:
+            if self.fleet_config.subprocess:
+                # subprocess replicas hold their full model set over the
+                # worker protocol; paging needs in-proc registries —
+                # degrade typed rather than half-work
+                self.fault_log.add(FaultReport(
+                    site="place.assign", kind="placement_unsupported",
+                    detail={"fleet": self.name,
+                            "reason": "subprocess fleet: replicas hold "
+                            "the full model set, placement disabled"}))
+            else:
+                pc = (self._placement
+                      if isinstance(self._placement, PlaceConfig)
+                      else PlaceConfig.from_env())
+                self.placer = Placer(
+                    self.models, pc, name=self.name,
+                    fault_log=self.fault_log, metrics=self.metrics,
+                    protect=self._slo_protected)
+                with self._lock:
+                    rids = [f"r{self._seq + i}"
+                            for i in range(self._initial_replicas)]
+                self._planned = self.placer.plan(rids)
         for _ in range(self._initial_replicas):
             self.spawn_replica(count_event=False)
         self.admission_check()
@@ -243,6 +288,8 @@ class FrontDoor:
                 rep.state = RETIRED
         _timeseries.detach(self.sampler)
         self.sampler = None
+        if self.placer is not None:
+            self.placer.close()
         with self._lock:
             self._closed = True
         with _LIVE_LOCK:
@@ -266,7 +313,18 @@ class FrontDoor:
         admitted = self._admission.get("admittedRows")
         if admitted and admitted < cfg.max_batch:
             cfg.max_batch = int(admitted)
-        rep = build_replica(rid, self.models, config=cfg,
+        models = self.models
+        if self.placer is not None:
+            assigned = self._planned.pop(rid, None)
+            if assigned is None:
+                assigned = self.placer.assign_new(rid)
+            if not assigned:
+                # an empty replica never reports ready — seed it with a
+                # warm copy of the default model (warm-copy redundancy)
+                assigned = [self.default_model]
+                self.placer.note_resident(rid, self.default_model)
+            models = {m: self.models[m] for m in assigned}
+        rep = build_replica(rid, models, config=cfg,
                             fleet_config=self.fleet_config,
                             warm=self._warm)
         with self._lock:
@@ -288,6 +346,8 @@ class FrontDoor:
             rep.state = DRAINING
         rep.close(drain=True)
         rep.state = RETIRED
+        if self.placer is not None:
+            self.placer.drop_replica(rid)
         self._count("tg_fleet_scale_events_total", direction="down")
         _blackbox.record("fleet.retire", fleet=self.name, replica=rid)
         self._set_replica_gauges()
@@ -308,11 +368,17 @@ class FrontDoor:
             inflight = rep.queue_depth(self.default_model)
         except Exception:
             pass
+        orphaned: List[str] = []
+        if self.placer is not None:
+            # models whose ONLY warm copy died page in on a survivor on
+            # next demand — the density scenario's recovery contract
+            orphaned = self.placer.drop_replica(rid)
         self._count("tg_fleet_replica_lost_total", replica=rid)
         self.fault_log.add(FaultReport(
             site="fleet.replica_kill", kind="replica_lost",
             detail={"fleet": self.name, "replica": rid,
                     "inflight": inflight,
+                    "orphanedModels": orphaned or None,
                     "error": (f"{type(error).__name__}: {error}"[:200]
                               if error else None)}))
         _blackbox.record("fleet.replica_lost", fleet=self.name,
@@ -324,6 +390,7 @@ class FrontDoor:
             "replica_lost", fault_log=self.fault_log, metrics=self.metrics,
             detail={"fleet": self.name, "replica": rid,
                     "inflight": inflight,
+                    "orphanedModels": orphaned or None,
                     "error": (f"{type(error).__name__}: {error}"[:200]
                               if error else None)})
         # closing without drain fails every queued future — each failure
@@ -404,6 +471,11 @@ class FrontDoor:
                     pass
 
     def _admit(self, model: str, tenant: Optional[str]) -> None:
+        if self.placer is not None and model in self.placer.refused:
+            # per-model admission: the model's predicted resident bytes
+            # fit on NO replica — typed refusal, never a lost future
+            self._shed(model, "placement", tenant)
+            self.placer.check_admitted(model)  # raises typed
         plan = self._admission
         if plan.get("refused"):
             self._shed(model, "admission", tenant)
@@ -422,12 +494,21 @@ class FrontDoor:
         once — a record, or a typed shed — regardless of replica loss
         (the zero-lost-futures contract)."""
         model = model or self.default_model
+        if model not in self.models:
+            # a wrong model id is a *client* error (the network edge's
+            # 404), typed before the request is counted as accepted
+            self._shed(model, "unknown_model", tenant)
+            raise UnknownModelError(
+                f"fleet '{self.name}' serves no model '{model}' "
+                f"(have: {sorted(self.models)})")
         with self._lock:
             if not self._accepting:
                 raise RuntimeStoppedError(
                     f"fleet '{self.name}' is not accepting requests")
             self._submitted += 1
         self._admit(model, tenant)
+        if self.placer is not None:
+            self.placer.touch(model)
         dl_ms = (deadline_ms if deadline_ms is not None
                  else self.config.default_deadline_ms)
         now = time.monotonic()
@@ -448,7 +529,10 @@ class FrontDoor:
         """Load-aware replica selection: min(queue_depth + p99 penalty),
         ties by replica id. Draining replicas only when nothing else is
         active (a single-replica rolling deploy keeps serving —
-        ``registry.swap`` is zero-loss)."""
+        ``registry.swap`` is zero-loss). Under placement the pick is
+        model-aware: replicas holding ``model`` warm win, replicas
+        mid-page-in are steered around, and when every warm copy is
+        gone the least-loaded survivor becomes the page-in candidate."""
         with self._lock:
             cands = [r for r in self._replicas.values()
                      if r.state == ACTIVE and r.rid not in exclude]
@@ -466,7 +550,29 @@ class FrontDoor:
                 return (float("inf"), r.rid)
             return (depth + w * r.probe.p99_ms.get(model, 0.0), r.rid)
 
-        return min(cands, key=score)
+        if self.placer is None:
+            return min(cands, key=score)
+        pl = self.placer
+        warm = [r for r in cands if pl.is_resident(r.rid, model)]
+        if warm:
+            # route AROUND replicas busy deserializing another model —
+            # unless they hold the only warm copies
+            quiet = [r for r in warm if not pl.paging(r.rid)]
+            return min(quiet or warm, key=score)
+        # model is cold fleet-wide: best page-in candidate by total
+        # resident queue depth (again preferring non-paging replicas)
+        calm = [r for r in cands if not pl.paging(r.rid)]
+
+        def total_depth(r):
+            d = 0
+            for m in pl.residents(r.rid):
+                try:
+                    d += r.queue_depth(m)
+                except Exception:
+                    pass
+            return (d, r.rid)
+
+        return min(calm or cands, key=total_depth)
 
     def _dispatch(self, st: _FrontRequest,
                   raise_to_caller: bool = False) -> None:
@@ -509,6 +615,24 @@ class FrontDoor:
                 self.kill_replica(rep.rid, error=e)
                 st.tried.add(rep.rid)
                 continue
+            if (self.placer is not None
+                    and not self.placer.is_resident(rep.rid, st.model)):
+                # cold model: demand page-in (single-flight — concurrent
+                # requests for it ride ONE deserialize). A failed
+                # page-in burns a failover attempt, bounded as ever.
+                if not self._page_in(rep, st.model):
+                    st.attempts += 1
+                    self._record_failover(st, rep.rid, RuntimeError(
+                        f"page-in of model '{st.model}' on replica "
+                        f"'{rep.rid}' failed"))
+                    if st.attempts > self.fleet_config.max_failovers:
+                        self._shed(st.model, "no_replica", st.tenant,
+                                   corr=st.corr)
+                        raise OverloadError(
+                            f"request shed after {st.attempts} attempts: "
+                            f"model '{st.model}' could not page in "
+                            f"(fleet '{self.name}')")
+                    continue
             try:
                 # chaos: the routing/dispatch hop itself fails (listener
                 # death, connection reset) — failover, bounded
@@ -574,6 +698,43 @@ class FrontDoor:
                 f"with {type(exc).__name__})"))
             return
         self._dispatch(st, raise_to_caller=False)
+
+    def _page_in(self, rep, model: str) -> bool:
+        """Make ``model`` warm on ``rep`` through the placer's
+        single-flight guard (a deserialize via the model's AOT program
+        store, not a compile). False → the caller burns a failover
+        attempt; the placer already typed the failure."""
+        reg = getattr(rep, "registry", None)
+        if reg is None:  # pragma: no cover - placement gates subprocess
+            return False
+
+        def _load(m: str) -> None:
+            src = self.models[m]
+            warm = True if self._warm is None else self._warm
+            if isinstance(src, str):
+                reg.load(m, src, warm=warm)
+            else:
+                reg.register(m, src, warm=bool(self._warm))
+
+        def _unload(m: str) -> None:
+            reg.unregister(m, drain=True)
+
+        return self.placer.page_in(rep.rid, model, _load, _unload)
+
+    def _slo_protected(self, model: str) -> bool:
+        """The placer's eviction-protection hook: a model with an active
+        SLO alert (page/ticket burning now) must not be paged out —
+        eviction latency would deepen the very burn it is alerted on."""
+        for t in self.slo_trackers:
+            spec = getattr(t, "spec", None)
+            if spec is None or getattr(spec, "model", None) != model:
+                continue
+            try:
+                if t.active_alerts():
+                    return True
+            except Exception:  # pragma: no cover - defensive
+                pass
+        return False
 
     def _record_failover(self, st: _FrontRequest, rid: Optional[str],
                          error: BaseException) -> None:
@@ -878,10 +1039,16 @@ class FrontDoor:
         for rid, rep in sorted(reps.items()):
             depth = None
             if rep.state in (ACTIVE, DRAINING):
-                try:
-                    depth = sum(rep.queue_depth(m) for m in self.models)
-                except Exception:
-                    depth = None
+                # per-model tolerant: under placement a replica holds a
+                # subset, so a non-resident model must not zero the sum
+                models = (self.placer.residents(rid)
+                          if self.placer is not None else self.models)
+                depth = 0
+                for m in models:
+                    try:
+                        depth += rep.queue_depth(m)
+                    except Exception:
+                        pass
             out["replicas"][rid] = {
                 "state": rep.state, "kind": rep.kind,
                 "routed": rep.routed, "queueDepth": depth,
@@ -889,11 +1056,16 @@ class FrontDoor:
                           for m, v in rep.probe.p99_ms.items()},
                 "probeFailures": rep.probe.failures,
             }
+            if self.placer is not None:
+                out["replicas"][rid]["resident"] = \
+                    self.placer.residents(rid)
         out["sheds"] = {
             reason: self._series(snap, "tg_serve_shed_total",
                                  reason=reason)
             for reason in ("overload", "deadline", "admission",
-                           "no_replica")}
+                           "no_replica", "placement", "unknown_model")}
+        if self.placer is not None:
+            out["placement"] = self.placer.snapshot()
         return out
 
     def _set_replica_gauges(self) -> None:
@@ -953,7 +1125,8 @@ class FrontDoor:
             "shed": {reason: self._series(snap, "tg_serve_shed_total",
                                           reason=reason)
                      for reason in ("overload", "deadline", "admission",
-                                    "no_replica")},
+                                    "no_replica", "placement",
+                                    "unknown_model")},
             "breaker": {},
             "queueDepth": self.queue_depth(),
             "faults": {"reports": len(self.fault_log.reports),

@@ -66,12 +66,26 @@ def synthetic_rows(model, n: int, seed: int = 0) -> List[Dict[str, Any]]:
     return rows
 
 
+def _weighted_mix(items: List[Any], seed: int):
+    """(names, probabilities, rng) for a weighted ``(name, weight)``
+    list (bare names = equal weights) — the shared tenant/model mix
+    machinery."""
+    pairs = [(t, 1.0) if isinstance(t, str) else (str(t[0]), float(t[1]))
+             for t in items]
+    total_w = sum(w for _, w in pairs) or 1.0
+    names = [t for t, _ in pairs]
+    probs = np.asarray([w / total_w for _, w in pairs])
+    return names, probs, np.random.RandomState(seed)
+
+
 def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
                   seconds: float, rps: float,
                   deadline_ms: Optional[float] = None,
                   drain_timeout: float = 30.0,
                   tenants: Optional[List[Any]] = None,
-                  tenant_seed: int = 0) -> Dict[str, Any]:
+                  tenant_seed: int = 0,
+                  models: Optional[List[Any]] = None,
+                  model_seed: int = 0) -> Dict[str, Any]:
     """Offer ``rps`` requests/sec for ``seconds`` (cycling through
     ``rows``), drain, and return the load report.
 
@@ -81,27 +95,41 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     ``tenant_seed``), submits with ``tenant=...`` so the runtime counts
     the per-tenant twin series the SLO budgets read
     (observability/slo.py), and the report grows a per-tenant
-    ``tenants`` breakdown with the same accounting buckets."""
+    ``tenants`` breakdown with the same accounting buckets.
+
+    ``models`` is the multi-model twin (fleet front doors under
+    placement — serving/placement.py): a weighted list of ``(model
+    name, weight)`` pairs (or bare names). Each arrival draws its model
+    (deterministic under ``model_seed``) and submits with ``model=...``
+    so routing/paging is exercised per request; the report grows a
+    per-model ``models`` breakdown whose buckets sum to the totals —
+    the per-model accounting identity the density bench line and the
+    campaign ``density`` scenario assert."""
     if rps <= 0:
         raise ValueError(f"rps must be > 0, got {rps}")
     tenant_names: List[str] = []
-    tenant_probs = None
-    tenant_rng = None
+    tenant_probs = tenant_rng = None
     if tenants:
-        pairs = [(t, 1.0) if isinstance(t, str) else (str(t[0]), float(t[1]))
-                 for t in tenants]
-        total_w = sum(w for _, w in pairs) or 1.0
-        tenant_names = [t for t, _ in pairs]
-        tenant_probs = np.asarray([w / total_w for _, w in pairs])
-        tenant_rng = np.random.RandomState(tenant_seed)
+        tenant_names, tenant_probs, tenant_rng = _weighted_mix(
+            tenants, tenant_seed)
+    model_names: List[str] = []
+    model_probs = model_rng = None
+    if models:
+        model_names, model_probs, model_rng = _weighted_mix(
+            models, model_seed)
+
+    _BUCKET_KEYS = ("offered", "completed", "quarantined", "shedOverload",
+                    "shedDeadline", "shedDisconnect", "submitErrors",
+                    "failed", "lost")
 
     def _tenant_bucket(t):
-        return per_tenant.setdefault(t, {
-            "offered": 0, "completed": 0, "quarantined": 0,
-            "shedOverload": 0, "shedDeadline": 0, "shedDisconnect": 0,
-            "submitErrors": 0, "failed": 0, "lost": 0})
+        return per_tenant.setdefault(t, {k: 0 for k in _BUCKET_KEYS})
+
+    def _model_bucket(m):
+        return per_model.setdefault(m, {k: 0 for k in _BUCKET_KEYS})
 
     per_tenant: Dict[str, Dict[str, int]] = {}
+    per_model: Dict[str, Dict[str, int]] = {}
     interval = 1.0 / rps
     start = time.monotonic()
     t_end = start + seconds
@@ -122,10 +150,16 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
                 tenant = tenant_names[int(tenant_rng.choice(
                     len(tenant_names), p=tenant_probs))]
                 _tenant_bucket(tenant)["offered"] += 1
+            model = None
+            if model_names:
+                model = model_names[int(model_rng.choice(
+                    len(model_names), p=model_probs))]
+                _model_bucket(model)["offered"] += 1
+            kwargs = {"model": model} if model is not None else {}
             try:
                 fut = runtime.submit(rows[i % len(rows)],
                                      deadline_ms=deadline_ms,
-                                     tenant=tenant)
+                                     tenant=tenant, **kwargs)
                 # the runtime stamps each accepted request's
                 # flight-recorder correlation id on its future
                 # (observability/blackbox.py) — remember it with the
@@ -136,17 +170,23 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
                 fut.add_done_callback(
                     lambda f: _done_at.setdefault(f, time.monotonic()))
                 futures.append((fut, getattr(fut, "tg_corr", None),
-                                time.monotonic(), tenant))
+                                time.monotonic(), tenant, model))
             except OverloadError:
+                # placement refusals subclass OverloadError — a model
+                # too big for every replica sheds here, typed
                 shed_submit += 1
                 if tenant is not None:
                     _tenant_bucket(tenant)["shedOverload"] += 1
+                if model is not None:
+                    _model_bucket(model)["shedOverload"] += 1
             except Exception:
-                # injected serve.enqueue chaos / runtime stopping: counted,
-                # the generator keeps offering load
+                # injected serve.enqueue chaos / runtime stopping /
+                # unknown model: counted, the generator keeps offering
                 submit_errors += 1
                 if tenant is not None:
                     _tenant_bucket(tenant)["submitErrors"] += 1
+                if model is not None:
+                    _model_bucket(model)["submitErrors"] += 1
             offered += 1
             i += 1
             next_at += interval
@@ -159,40 +199,43 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
     shed_noreplica = 0
     slowest: List[Dict[str, Any]] = []
     drain_deadline = time.monotonic() + drain_timeout
-    for fut, corr, submitted_at, tenant in futures:
-        tb = _tenant_bucket(tenant) if tenant is not None else None
+    for fut, corr, submitted_at, tenant, model in futures:
+        buckets = [b for b in (
+            _tenant_bucket(tenant) if tenant is not None else None,
+            _model_bucket(model) if model is not None else None)
+            if b is not None]
         try:
             rec = fut.result(timeout=max(0.1, drain_deadline
                                          - time.monotonic()))
             if SCORE_ERROR_KEY in rec:
                 quarantined += 1
-                if tb:
-                    tb["quarantined"] += 1
+                for b in buckets:
+                    b["quarantined"] += 1
             completed += 1
-            if tb:
-                tb["completed"] += 1
+            for b in buckets:
+                b["completed"] += 1
             slowest.append({"corr": corr, "ms": round(
                 (_done_at.get(fut, time.monotonic())
                  - submitted_at) * 1e3, 3)})
         except DeadlineExceededError:
             shed_deadline += 1
-            if tb:
-                tb["shedDeadline"] += 1
+            for b in buckets:
+                b["shedDeadline"] += 1
         except OverloadError:
             # a fleet front door sheds typed AFTER accept when the
             # failover budget exhausts (replica loss with no survivor)
             # — an accounted shed, distinct from a lost future
             shed_noreplica += 1
-            if tb:
-                tb["shedOverload"] += 1
+            for b in buckets:
+                b["shedOverload"] += 1
         except FuturesTimeoutError:
             lost += 1
-            if tb:
-                tb["lost"] += 1
+            for b in buckets:
+                b["lost"] += 1
         except Exception:
             failed += 1
-            if tb:
-                tb["failed"] += 1
+            for b in buckets:
+                b["failed"] += 1
     # the slowest-K completed requests BY ID: drain-side wall times are
     # an upper bound on the serve latency (the drain loop walks futures in
     # submit order), but the ids are exact — each links to its recorder
@@ -240,6 +283,9 @@ def run_open_loop(runtime: ServingRuntime, rows: List[Dict[str, Any]],
         # without a tenant mix) — the per-tenant-budget tests and the
         # BENCH_MODE=serve tenant line read this
         "tenants": per_tenant or None,
+        # per-model accounting twin (None without a model mix) — buckets
+        # sum to the totals; the density bench line reads this
+        "models": per_model or None,
     }
     # fleet targets: per-replica routing distribution + failover /
     # ejection / kill / scale accounting (docs/serving.md "Replica
@@ -270,6 +316,7 @@ def run_wire_open_loop(host: str, port: int, rows: List[Dict[str, Any]],
                        reconnect_every: int = 0,
                        token: Optional[str] = None,
                        tenant: Optional[str] = None,
+                       model: Optional[str] = None,
                        request_timeout: float = 10.0,
                        batch_rows: int = 1) -> Dict[str, Any]:
     """The real-socket twin of :func:`run_open_loop`: offer ``rps``
@@ -321,7 +368,8 @@ def run_wire_open_loop(host: str, port: int, rows: List[Dict[str, Any]],
 
     def _worker(q: "_queue.Queue", proto: str) -> None:
         cli = WireClient(host, port, protocol=proto, token=token,
-                         tenant=tenant, timeout=request_timeout)
+                         tenant=tenant, model=model,
+                         timeout=request_timeout)
         sent = 0
         try:
             while True:

@@ -26,6 +26,13 @@ Robustness contract:
   authenticates before ``submit(..., tenant=...)``; a per-tenant
   request-rate window (``TG_NET_TENANT_RPS``) sheds abusive tenants at
   the edge (401/429) before they cost a queue slot.
+* **Model routing on the wire.** An optional model id (binary header
+  ``model`` field / HTTP ``X-TG-Model``) selects which registered model
+  scores the rows — forwarded as ``submit(..., model=...)`` when the
+  target routes by model (a fleet front door under placement). An
+  unknown id, or a model id against a target that cannot route, is a
+  typed 404 ``unknown_model`` shed; a placement-refused model is a
+  typed 429 ``placement`` shed.
 * **Deterministic chaos.** Three counter-driven sites —
   ``net.accept``, ``net.read``, ``net.write`` — fault the connection at
   each lifecycle stage; each fires as a typed shed, records its
@@ -59,6 +66,7 @@ from ..robustness.faults import InjectedFaultError, TransientFaultError
 from ..robustness.policy import FaultLog, FaultReport
 from . import netproto
 from .fleet import AdmissionRefusedError
+from .placement import PlacementRefusedError, UnknownModelError
 from .runtime import (DeadlineExceededError, OverloadError,
                       RuntimeStoppedError, ServingError, _env_float,
                       _env_int)
@@ -72,6 +80,8 @@ SHED_STATUS: Dict[str, int] = {
     "bad_frame": 400,      # malformed JSON / frame / header
     "auth": 401,           # unknown or missing tenant token
     "bad_path": 404,       # method/path other than POST /score
+    "unknown_model": 404,  # model id not in the target's registry
+    "placement": 429,      # model refused by the placement budget
     "read_timeout": 408,   # slow-loris: body/frame stalled past deadline
     "oversize": 413,       # payload above TG_NET_MAX_FRAME_BYTES
     "quota": 429,          # per-tenant rate window exceeded at the edge
@@ -181,6 +191,8 @@ class NetEdge:
         self._conn_tasks: "set" = set()
         self._active = 0
         self._closed = False
+        #: does target.submit accept a ``model=`` kwarg? (resolved lazily)
+        self._routes_models: Optional[bool] = None
         #: per-tenant arrival window (loop thread only — no lock)
         self._tenant_window: Dict[str, Deque[float]] = {}
         if auto_start:
@@ -467,7 +479,8 @@ class NetEdge:
             return True
         status, body = await self._score(
             rows, header.get("token"), header.get("tenant"),
-            header.get("deadlineMs"), corr, "binary")
+            header.get("deadlineMs"), corr, "binary",
+            model=header.get("model"))
         ok = await self._respond_binary(writer, corr, status, **body)
         self._observe_request("binary", status, len(rows),
                               time.monotonic() - t0, corr)
@@ -602,7 +615,7 @@ class NetEdge:
             deadline_ms = None
         status, out = await self._score(
             rows, headers.get("x-tg-token"), headers.get("x-tg-tenant"),
-            deadline_ms, corr, "http")
+            deadline_ms, corr, "http", model=headers.get("x-tg-model"))
         ok = await self._respond_http(writer, corr, status, out,
                                       close=not keep)
         self._observe_request("http", status, len(rows),
@@ -647,13 +660,34 @@ class NetEdge:
         win.append(now)
         return True
 
+    def _target_routes_models(self) -> bool:
+        """Whether ``target.submit`` accepts a ``model=`` kwarg (a fleet
+        front door does; a bare runtime does not) — resolved once."""
+        if self._routes_models is None:
+            import inspect
+            try:
+                self._routes_models = "model" in inspect.signature(
+                    self.target.submit).parameters
+            except (TypeError, ValueError):  # builtins / C callables
+                self._routes_models = False
+        return self._routes_models
+
     async def _score(self, rows: List[Dict[str, Any]],
                      token: Optional[str], tenant: Optional[str],
                      deadline_ms: Optional[float], corr: Optional[str],
-                     proto: str) -> Tuple[int, Dict[str, Any]]:
+                     proto: str, model: Optional[Any] = None
+                     ) -> Tuple[int, Dict[str, Any]]:
         """Auth -> quota -> submit -> collect. Returns (status, body).
         Futures submitted before a shed are ALWAYS awaited — the edge
         never abandons a future, whatever the socket does next."""
+        if model is not None:
+            model = str(model)  # untrusted header field
+            if not self._target_routes_models():
+                self._shed("unknown_model", corr, proto=proto,
+                           tenant=tenant)
+                return 404, {"error": "unknown_model",
+                             "message": f"model '{model}' requested but "
+                             "the target does not route by model"}
         if self.tokens is not None:
             mapped = self.tokens.get(token or "")
             if mapped is None:
@@ -666,14 +700,23 @@ class NetEdge:
             return 429, {"error": "quota",
                          "message": f"tenant '{tenant}' above "
                          f"TG_NET_TENANT_RPS={self.config.tenant_rps:g}"}
+        kwargs: Dict[str, Any] = {"deadline_ms": deadline_ms,
+                                  "tenant": tenant}
+        if model is not None:
+            kwargs["model"] = model
         futs: List[Any] = []
         shed: Optional[Tuple[str, int]] = None
         for row in rows:
             try:
-                futs.append(self.target.submit(
-                    row, deadline_ms=deadline_ms, tenant=tenant))
+                futs.append(self.target.submit(row, **kwargs))
+            except UnknownModelError:
+                shed = ("unknown_model", SHED_STATUS["unknown_model"])
+                break
             except AdmissionRefusedError:
                 shed = ("admission", SHED_STATUS["admission"])
+                break
+            except PlacementRefusedError:
+                shed = ("placement", SHED_STATUS["placement"])
                 break
             except OverloadError:
                 shed = ("overload", SHED_STATUS["overload"])

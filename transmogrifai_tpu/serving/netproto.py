@@ -20,8 +20,14 @@ Binary frame layout (all integers big-endian unless noted)::
     frame   := magic(4)="TGB1" | kind(1) | payload_len(u32)| payload
     kind    := 1 request | 2 response | 3 error
     request := header_len(u16) | header(JSON utf-8) | column blocks
-    header  := {"rows": n, "tenant"?, "token"?, "deadlineMs"?,
+    header  := {"rows": n, "tenant"?, "token"?, "deadlineMs"?, "model"?,
                 "columns": [{"name", "kind", "nulls"}...]}
+
+The optional ``model`` field (HTTP twin: ``X-TG-Model``) selects which
+registered model scores the rows — the multi-model placement layer
+(serving/placement.py) routes it to a warm holder or pages it in; an
+unknown id is a typed 404 (``unknown_model``), mirroring the tenant
+plumbing.
 
 Column blocks appear in header order. When ``nulls`` is true the block
 opens with a ``ceil(n/8)``-byte bitmap (bit ``i`` set = row ``i`` is
@@ -160,7 +166,8 @@ def _encode_column(kind: str, vals: List[Any]) -> bytes:
 def encode_binary_request(rows: List[Dict[str, Any]],
                           tenant: Optional[str] = None,
                           token: Optional[str] = None,
-                          deadline_ms: Optional[float] = None) -> bytes:
+                          deadline_ms: Optional[float] = None,
+                          model: Optional[str] = None) -> bytes:
     """One request frame carrying ``rows`` as column blocks."""
     names, cols = columns_from_rows(rows)
     col_meta = []
@@ -178,6 +185,8 @@ def encode_binary_request(rows: List[Dict[str, Any]],
         header["token"] = token
     if deadline_ms is not None:
         header["deadlineMs"] = deadline_ms
+    if model is not None:
+        header["model"] = model
     hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
     payload = _U16.pack(len(hdr)) + hdr + b"".join(blocks)
     return FRAME_HEADER.pack(MAGIC, KIND_REQUEST, len(payload)) + payload
@@ -294,7 +303,8 @@ def encode_http_request(rows: List[Dict[str, Any]],
                         token: Optional[str] = None,
                         deadline_ms: Optional[float] = None,
                         keep_alive: bool = True,
-                        path: str = "/score") -> bytes:
+                        path: str = "/score",
+                        model: Optional[str] = None) -> bytes:
     body = json.dumps({"rows": rows}, separators=(",", ":")).encode("utf-8")
     lines = [f"POST {path} HTTP/1.1", "Host: tg-edge",
              "Content-Type: application/json",
@@ -306,6 +316,8 @@ def encode_http_request(rows: List[Dict[str, Any]],
         lines.append(f"X-TG-Tenant: {tenant}")
     if deadline_ms is not None:
         lines.append(f"X-TG-Deadline-Ms: {deadline_ms:g}")
+    if model is not None:
+        lines.append(f"X-TG-Model: {model}")
     return ("\r\n".join(lines) + "\r\n\r\n").encode("ascii") + body
 
 
@@ -380,11 +392,12 @@ class WireClient:
 
     def __init__(self, host: str, port: int, protocol: str = "http",
                  token: Optional[str] = None, tenant: Optional[str] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, model: Optional[str] = None):
         if protocol not in ("http", "binary"):
             raise ValueError(f"unknown protocol '{protocol}'")
         self.host, self.port, self.protocol = host, int(port), protocol
         self.token, self.tenant = token, tenant
+        self.model = model
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[_SockReader] = None
@@ -419,11 +432,13 @@ class WireClient:
 
     # -- request/response ---------------------------------------------------
     def request(self, rows: List[Dict[str, Any]],
-                deadline_ms: Optional[float] = None) -> WireResult:
+                deadline_ms: Optional[float] = None,
+                model: Optional[str] = None) -> WireResult:
         if self._sock is None:
             self.connect()
         try:
-            return self._exchange(rows, deadline_ms)
+            return self._exchange(rows, deadline_ms,
+                                  self.model if model is None else model)
         except socket.timeout:
             # a late reply would be read as the answer to the *next*
             # request — the keep-alive stream is desynchronized, so the
@@ -437,12 +452,12 @@ class WireClient:
             self.close()
             raise WireDisconnect(f"connection died mid-request: {e}") from e
 
-    def _exchange(self, rows, deadline_ms) -> WireResult:
+    def _exchange(self, rows, deadline_ms, model=None) -> WireResult:
         assert self._sock is not None and self._reader is not None
         if self.protocol == "binary":
             self._sock.sendall(encode_binary_request(
                 rows, tenant=self.tenant, token=self.token,
-                deadline_ms=deadline_ms))
+                deadline_ms=deadline_ms, model=model))
             magic, kind, ln = FRAME_HEADER.unpack(
                 self._reader.read_exact(FRAME_HEADER.size))
             if magic != MAGIC:
@@ -456,7 +471,7 @@ class WireClient:
                               protocol="binary")
         self._sock.sendall(encode_http_request(
             rows, tenant=self.tenant, token=self.token,
-            deadline_ms=deadline_ms))
+            deadline_ms=deadline_ms, model=model))
         status, headers, body = read_http_response(self._reader)
         retry = None
         if "retry-after" in headers:
