@@ -59,6 +59,12 @@ Modes (BENCH_MODE env):
   scenario harnesses; asserts 100% site coverage, zero invariant
   violations, and full serve request accounting, printing the minimized
   one-command reproducer when anything fires.
+- ``sweep``: the tree-family throughput line (docs/trees.md) — a linear
+  (LR) sweep and a tree (RF + GBT) sweep over the same table, one JSON
+  line each (tree LAST), with a pinned tripwire on tree fits/sec as a
+  ratio of the same-run linear line: a drop below the floor means the
+  tree path (histogram engine, forest descent, fused sweep programs)
+  regressed relative to linear, independent of host speed.
 - ``default``: the exact stock default grids (45 configs incl. the
   depth-12 trees, 135 fits) — the path every
   ``BinaryClassificationModelSelector()`` user gets; fixed costs dominate.
@@ -80,7 +86,7 @@ def _models(mode, registry):
         raise SystemExit(f"unknown BENCH_MODE {mode!r}: "
                          "use both | dense | default | linear | "
                          "transform | serve | stream | pressure | "
-                         "campaign")
+                         "campaign | sweep")
     if mode == "linear":
         grid = [{"regParam": r, "elasticNetParam": e}
                 for r in (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.3, 0.5)
@@ -208,6 +214,99 @@ def _run_mode(mode, Xd, yd, n, d, platform, folds, reps):
             **_ledger_phases(lmark),
         },
     }), flush=True)
+
+
+#: BENCH_MODE=sweep tripwire: tree-family fits/sec as a fraction of the
+#: same-run linear (LR) line. Histogram-grown trees are intrinsically
+#: heavier than closed-form linear fits — measured 0.047 on the 1-core
+#: CPU host at the bench shape (engine-routed, round 18); the floor is
+#: measurement ÷ ~4 host-noise margin. A drop below it means the
+#: tree path regressed RELATIVE to linear (histogram engine, forest
+#: descent, or sweep fusion) — the ratio cancels machine speed.
+_SWEEP_TREE_RATIO_FLOOR = 0.01
+
+
+def _run_sweep_line(platform, folds, reps):
+    """BENCH_MODE=sweep: the TREE-family throughput line (docs/trees.md,
+    docs/benchmarks.md round 18). Times a linear (LR) sweep and a tree
+    (RF + GBT) sweep of the same fold count over the same table through
+    ``OpCrossValidation.validate``, prints one JSON line per family class
+    (tree LAST — the headline), and trips if tree fits/sec falls below
+    ``_SWEEP_TREE_RATIO_FLOOR`` of the same-run linear line. Both sweeps
+    ride the fused per-family programs; the tree line is dominated by the
+    histogram engine's ``build_node_hist`` contraction."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.impl.tuning.validators import OpCrossValidation
+    from transmogrifai_tpu.models.api import MODEL_REGISTRY
+
+    n = int(os.environ.get(
+        "BENCH_ROWS", 1_000_000 if platform == "tpu" else 20_000))
+    d = int(os.environ.get("BENCH_FEATURES", 64))
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d).astype(np.float32)
+         + rng.randn(n) > 0).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    lr = [{"regParam": r, "elasticNetParam": e}
+          for r in (0.001, 0.01, 0.1, 0.3) for e in (0.0, 0.5)]       # 8
+    rf = [{"maxDepth": dd, "minInstancesPerNode": mi, "minInfoGain": 0.001,
+           "numTrees": 20, "subsamplingRate": 1.0}
+          for dd in (3, 5) for mi in (5, 100)]                        # 4
+    gbt = [{"maxDepth": dd, "minInstancesPerNode": 10,
+            "minInfoGain": 0.001, "maxIter": 10, "stepSize": ss}
+           for dd in (3, 5) for ss in (0.1, 0.3)]                     # 4
+    lines = [("linear", [(MODEL_REGISTRY["OpLogisticRegression"], lr)]),
+             ("tree", [(MODEL_REGISTRY["OpRandomForestClassifier"], rf),
+                       (MODEL_REGISTRY["OpGBTClassifier"], gbt)])]
+
+    fps = {}
+    for name, models in lines:
+        B = folds * sum(len(g) for _, g in models)
+
+        def sweep():
+            best = OpCrossValidation(num_folds=folds, seed=0).validate(
+                models, Xd, yd, "binary", "AuROC", True, 2)
+            for r in best.results:
+                m = np.asarray(r.fold_metrics)
+                assert np.all(np.isfinite(m))
+
+        lmark = _ledger_mark()
+        t0 = time.perf_counter()
+        sweep()                              # compile warmup
+        cold = time.perf_counter() - t0
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sweep()
+            times.append(time.perf_counter() - t0)
+        dt = float(np.median(times))
+        fps[name] = B / dt
+        doc = {
+            "metric": (f"model_fold_fits_per_sec_{name}_sweep_"
+                       f"{n}rows_{d}feat_{platform}"),
+            "value": round(fps[name], 2),
+            "unit": "fits/sec",
+            "vs_baseline": round(fps[name] / 100.0, 3),
+            "phases": {
+                "compileSecs": round(max(0.0, cold - dt), 3),
+                "executeSecs": round(dt, 3),
+                **_ledger_phases(lmark),
+            },
+        }
+        if name == "tree":
+            ratio = fps["tree"] / max(fps["linear"], 1e-9)
+            # vs the SAME-RUN linear line — the tripwire ratio cancels
+            # host speed, so it travels across machines
+            doc["vs_linear"] = round(ratio, 4)
+            assert ratio >= _SWEEP_TREE_RATIO_FLOOR, (
+                f"tree sweep fits/sec fell to x{ratio:.4f} of the "
+                f"same-run linear line (floor "
+                f"x{_SWEEP_TREE_RATIO_FLOOR}) — the tree path regressed "
+                f"relative to linear: check the histogram engine "
+                f"(histeng/), forest descent, or the fused sweep "
+                f"programs (docs/trees.md)")
+        print(json.dumps(doc), flush=True)
 
 
 def _plan_transfer_sum():
@@ -1605,6 +1704,9 @@ def main():
         return
     if mode == "campaign":
         _run_campaign(platform)
+        return
+    if mode == "sweep":
+        _run_sweep_line(platform, folds, reps)
         return
 
     rng = np.random.RandomState(0)

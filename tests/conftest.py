@@ -236,6 +236,24 @@ def _no_mesh_sharding_leak():
 
 
 @pytest.fixture(autouse=True)
+def _no_hist_engine_leak():
+    """Histogram-engine state must not bleed across tests (mirrors the
+    mesh no-leak fixture): a leaked ``engine_mesh`` context would
+    silently pin the next test's single-device tree traces to a dead
+    mesh's 'data' axis, and the contraction-factory cache must stay
+    bounded. Assert clean on entry and exit via the `oracles` probe;
+    clear the engine's own caches on exit."""
+    from transmogrifai_tpu import histeng as _histeng
+    from transmogrifai_tpu.robustness import oracles as _oracles
+
+    assert _oracles.histeng_violations() == []
+    yield
+    leaks = _oracles.histeng_violations()
+    _histeng.clear_engine_caches()
+    assert leaks == [], f"histogram-engine state leaked: {leaks}"
+
+
+@pytest.fixture(autouse=True)
 def _no_serving_leak():
     """Serving runtimes own a batcher thread, a bounded queue, and breaker
     state — all process-visible. A test that leaks a running runtime would

@@ -48,6 +48,9 @@ def test_documented_dials_match_code():
     assert float(dials["_MESH_RATIO_BOUND"]) == graft._MESH_RATIO_BOUND
     assert float(dials["_MESH_FORCED_RATIO_BOUND"]) \
         == graft._MESH_FORCED_RATIO_BOUND
+    import bench
+    assert float(dials["_SWEEP_TREE_RATIO_FLOOR"]) \
+        == bench._SWEEP_TREE_RATIO_FLOOR
     from transmogrifai_tpu.parallel import mesh as M
     assert int(dials["DEFAULT_MIN_ROWS_PER_CHIP"]) \
         == M.DEFAULT_MIN_ROWS_PER_CHIP

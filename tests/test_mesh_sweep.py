@@ -108,23 +108,38 @@ def test_fused_mesh_nonsliced_bit_exact(force_mesh):
                                   plain.results[0].fold_metrics)
 
 
-def test_fused_mesh_tree_family_close(force_mesh):
-    """Tree growth makes DISCRETE split choices from f32 gain sums, and
-    row-sharding reorders those partial sums (psum) — flipped near-tie
-    splits are inherent to data-parallel tree growth (the reference's Spark
-    RF is nondeterministic the same way). The mesh sweep must still land
-    within metric noise of the single-device sweep."""
-    X, y = _synth(n=400)
-    grid = [{"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001,
-             "numTrees": 5, "subsamplingRate": 1.0}]
-    models = _models(("OpRandomForestClassifier", grid))
+RF_GRID = [{"maxDepth": 3, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+            "numTrees": 5, "subsamplingRate": 1.0},
+           {"maxDepth": 2, "minInstancesPerNode": 5, "minInfoGain": 0.001,
+            "numTrees": 3, "subsamplingRate": 1.0}]
+GBT_GRID = [{"maxDepth": 3, "maxIter": 4, "stepSize": 0.3},
+            {"maxDepth": 2, "maxIter": 3, "stepSize": 0.1}]
+
+
+@pytest.mark.hist
+@pytest.mark.parametrize("n", [400, 333, 257])
+def test_fused_mesh_tree_families_bit_exact(force_mesh, n):
+    """Tree families under the mesh are BIT-identical to single-device —
+    the histogram engine's pinned K-blocked reduction (histeng.kernels)
+    replaces the order-unspecified psum that used to leave mesh trees only
+    'within noise' of the plain sweep. Odd row counts (333, 257) do not
+    divide the 'data' axis: bucket padding plus the engine's sentinel row
+    blocks must keep the pinned combine identical anyway."""
+    X, y = _synth(n=n)
+    models = _models(("OpRandomForestClassifier", RF_GRID),
+                     ("OpGBTClassifier", GBT_GRID))
     plain = OpCrossValidation(num_folds=3, seed=3).validate(
         models, X, y, "binary", "AuROC", True, 2)
     mesh = make_mesh(MeshSpec(data=4, model=2))
     sharded = OpCrossValidation(num_folds=3, seed=3, mesh=mesh).validate(
         models, X, y, "binary", "AuROC", True, 2)
-    np.testing.assert_allclose(sharded.results[0].fold_metrics,
-                               plain.results[0].fold_metrics, atol=0.05)
+    assert sharded.family_name == plain.family_name
+    assert sharded.hyper == plain.hyper
+    assert sharded.metric_value == plain.metric_value
+    for rp, rs in zip(plain.results, sharded.results):
+        np.testing.assert_array_equal(rs.fold_metrics, rp.fold_metrics,
+                                      err_msg=rp.family)
+        np.testing.assert_array_equal(rs.mean_metrics, rp.mean_metrics)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,14 @@
+"""Unified sharded histogram engine — one tree-growth primitive for
+in-core growers (models/trees.py), StreamingGBT (streaming/model.py), and
+the fused mesh sweep (impl/tuning/validators.py). See docs/trees.md."""
+from .engine import (bin_codes_host, build_hist, build_node_hist, chaos_gate,
+                     clear_engine_caches, current_engine_mesh, engine_mesh,
+                     engine_probe, hist_matmul, node_hist_matmul,
+                     node_stat_sums, pinned_row_sum)
+
+__all__ = [
+    "bin_codes_host", "build_hist", "build_node_hist", "chaos_gate",
+    "clear_engine_caches", "current_engine_mesh", "engine_mesh",
+    "engine_probe", "hist_matmul", "node_hist_matmul", "node_stat_sums",
+    "pinned_row_sum",
+]

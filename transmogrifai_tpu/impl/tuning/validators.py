@@ -173,6 +173,17 @@ def _fused_cache_put(key, prog) -> None:
         _obs_ledger.record_eviction(_obs_ledger.cache_key_hash(evicted_key))
 
 
+def _hist_mesh_ctx(family, mesh):
+    """Histogram-engine mesh context for a family's program trace/export:
+    tree families (``uses_hist_engine``) pin their K-blocked contraction's
+    row blocks to the 'data' axis; everything else is a no-op context."""
+    if mesh is not None and getattr(family, "uses_hist_engine", False):
+        from ...histeng import engine_mesh
+        return engine_mesh(mesh)
+    import contextlib
+    return contextlib.nullcontext()
+
+
 def clear_mesh_programs() -> None:
     """Drop mesh-keyed fused programs. Each pins a ``jax.sharding.Mesh``
     plus per-device executable buffers; the test harness asserts none leak
@@ -614,6 +625,12 @@ class OpValidator:
             # crash evidence: a kill past this point happened inside a
             # fused sweep dispatch (run sentinel, docs/robustness.md)
             sentinel_phase("device_sweep")
+            if getattr(family, "uses_hist_engine", False):
+                # chaos site hist.build: a raise quarantines THIS family
+                # (same recovery as validator.family_fit) before any of
+                # its histogram programs build or dispatch
+                from ...histeng import chaos_gate
+                chaos_gate(family.name)
             G = len(grid)
             sliced_f = fold_sliced and getattr(family, "fold_sliced_predict",
                                                True)
@@ -635,17 +652,27 @@ class OpValidator:
                 "grid": _hl.sha256(grid_repr.encode()).hexdigest()[:12],
             }
             aot_fp = None
-            if mesh is None:
-                # AOT program store key: the single-device branch program
-                # is a pure function of the family × fp_doc × row bucket
-                # — process-independent, so one replica's (or one
-                # train run's) export serves every later process. Mesh
-                # programs carry shardings + donation and are
-                # deliberately not stored (transmogrifai_tpu/programstore/).
+            # Mesh storability mirrors _make_fused_program's grid logic:
+            # families that take a traced DONATED grid block (shardable +
+            # traced_grid_ok) are not exportable; everything else — all
+            # single-device programs, and mesh programs with host-constant
+            # grids (the tree families, shardable=False) — is a pure
+            # function of family × fp_doc × row bucket. Mesh fingerprints
+            # additionally pin the axis sizes and device count: an export
+            # from a different topology must never be a hit.
+            mesh_storable = mesh is not None and not (
+                getattr(family, "shardable", True)
+                and getattr(family, "traced_grid_ok", False))
+            if mesh is None or mesh_storable:
                 import json as _json
+                doc = {"family": family.name, **fp_doc}
+                if mesh is not None:
+                    doc["meshAxes"] = {k: int(v)
+                                       for k, v in mesh.shape.items()}
+                    doc["devices"] = int(np.prod(
+                        [int(v) for v in mesh.shape.values()]))
                 aot_fp = "sweep-" + _hl.sha256(
-                    _json.dumps({"family": family.name, **fp_doc},
-                                sort_keys=True).encode()
+                    _json.dumps(doc, sort_keys=True).encode()
                     ).hexdigest()[:16]
             entry = _fused_cache_get(key)
             newly_built = False
@@ -670,7 +697,7 @@ class OpValidator:
                     num_classes, self.exact_sweep_fits, sliced_f,
                     binned_f, mesh=mesh, x_ndim=X.ndim)
                 _fused_cache_put(key, entry)
-                newly_built = mesh is None
+                newly_built = True
                 # compile ledger: one fused program per family branch —
                 # the fingerprint carries every traced dimension, so a
                 # near-miss rebuild names exactly which one changed
@@ -728,18 +755,26 @@ class OpValidator:
                 # usable" warning — expected, not actionable
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
-                m = prog(*args)
+                # the engine mesh context must surround the TRACE — which
+                # happens here, at the program's first call, not at
+                # _make_fused_program (jit is lazy) — so tree histogram
+                # row blocks pin to the 'data' axis (histeng.engine_mesh)
+                with _hist_mesh_ctx(family, mesh):
+                    m = prog(*args)
             _devicemem.sample_measured("sweep")
             if newly_built and aot_fp is not None:
                 # populate: a freshly traced branch program is offered to
                 # the active capture scopes / TG_AOT_STORE so the next
                 # process deserializes instead of tracing (one flag
-                # check when nothing is active)
+                # check when nothing is active). Export re-traces, so the
+                # engine mesh context applies here too.
                 from ...programstore import store as _pstore
-                _pstore.offer_segment(
-                    aot_fp, int(X.shape[0]), prog, tuple(args),
-                    component="sweep",
-                    identity=f"sweep/{family.name}")
+                with _hist_mesh_ctx(family, mesh):
+                    _pstore.offer_segment(
+                        aot_fp, int(X.shape[0]), prog, tuple(args),
+                        component="sweep",
+                        identity=(f"sweep/{family.name}"
+                                  + ("/mesh" if mesh is not None else "")))
             return (family.name, list(grid), m, F * G, G)
 
         # per-candidate quarantine at family granularity: a family's whole

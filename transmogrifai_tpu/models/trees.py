@@ -62,7 +62,7 @@ from ..ops.forest import (
     forest_leaf_sums, forest_leaf_sums_chain, forest_predict,
     forest_predict_chain,
 )
-from ..ops.tree_hist import hist_matmul, node_hist_matmul
+from ..histeng import build_hist, build_node_hist, pinned_row_sum
 from .api import FittedParams, ModelFamily, register_family
 
 N_BINS = 32  # Spark maxBins default (reference DefaultSelectorParams.MaxBin)
@@ -285,7 +285,7 @@ def _grow_tree(codes_s, edges, stats_s, w_s, feat_mask, cfg, *,
         n_oh = (node[:, None]
                 == jnp.arange(m, dtype=jnp.int32)).astype(jnp.bfloat16)
         A = (n_oh[:, :, None] * sw[:, None, :]).reshape(S, m * k)
-        hist = hist_matmul(codes_s, A, n_bins)
+        hist = build_hist(codes_s, A, n_bins)
         hist = hist.reshape(m, k, d, n_bins).transpose(0, 2, 3, 1)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, 0, -1, :]                      # (m, k) node totals
@@ -354,7 +354,7 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
     hist_prev = None
     # depth 0: one root leaf per tree, stats are the plain column sums
     leaf_stats = jnp.stack(
-        [s.sum(axis=0, dtype=jnp.float32) for s in sw_list],
+        [pinned_row_sum(s.astype(jnp.float32), axis=0) for s in sw_list],
         axis=-1)[:, None, :]                                # (Tb, 1, k)
     for level in range(depth):
         m = 2 ** level
@@ -370,14 +370,14 @@ def _grow_forest(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         # histogram matmul FLOPs and the A_cat HBM traffic at every level.
         if level == 0:
             # root: node == 0 everywhere, the one-hot is all-ones
-            hist = node_hist_matmul(codes_s, node, sw_list, 1, n_bins)
-            hist = hist.reshape(k, Tb, d, n_bins).transpose(1, 2, 3, 0)
+            hist = build_node_hist(codes_s, node, sw_list, n_bins, n_nodes=1)
+            hist = hist[:, 0].transpose(1, 2, 3, 0)
         else:
             h = m // 2
             # left children only (heap slot 2j), fused in VMEM
             # (node_hist_matmul stride=2); right = parent − left below
-            hist_l = node_hist_matmul(codes_s, node, sw_list, h, n_bins,
-                                      stride=2)
+            hist_l = build_node_hist(codes_s, node, sw_list, n_bins,
+                                     n_nodes=h, stride=2)
             hist_l = hist_l.reshape(k, h * Tb, d, n_bins
                                     ).transpose(1, 2, 3, 0)          # (h·Tb,…)
             hist_r = hist_prev - hist_l
@@ -503,20 +503,18 @@ def _grow_forest_capped(codes_s, edges, sw_list, fmasks, cfg, *, depth: int,
         Wl = widths[level]
         Wn = widths[level + 1] if level + 1 < depth else min(2 ** depth, W)
         M = Wl * Tb
-        # node-histogram contraction (ops/tree_hist.node_hist_matmul):
+        # node-histogram contraction (histeng.build_node_hist):
         # XLA's pipelined A_cat contraction — a pallas kernel that expanded
         # the operand in VMEM measured slower at every production shape and
         # is retired to docs/experiments/node_hist_pallas.py
         if level == 0 or Wl % 2 or not sibling:
-            hist = node_hist_matmul(codes_s, node, sw_list, Wl, n_bins)
-            hist5 = hist.reshape(k, Wl, Tb, d, n_bins
-                                 ).transpose(1, 2, 3, 4, 0)
+            hist5 = build_node_hist(codes_s, node, sw_list, n_bins,
+                                    n_nodes=Wl).transpose(1, 2, 3, 4, 0)
         else:
             Wh = Wl // 2
-            he = node_hist_matmul(codes_s, node, sw_list, Wh, n_bins,
-                                  stride=2)
-            he5 = he.reshape(k, Wh, Tb, d, n_bins
-                             ).transpose(1, 2, 3, 4, 0)   # slot 2j'
+            he5 = build_node_hist(codes_s, node, sw_list, n_bins,
+                                  n_nodes=Wh, stride=2
+                                  ).transpose(1, 2, 3, 4, 0)   # slot 2j'
             j_src, is_rch = odd_map_prev
             prev_flat = hist5_prev.reshape(
                 hist5_prev.shape[0], Tb, d * n_bins * k)
@@ -641,8 +639,8 @@ def _diag_leaf_hist(node_s: jnp.ndarray, A_cols: jnp.ndarray,
     outs = []
     for lo in range(0, Tp, g):
         blk = A_cols[:, :, lo:lo + g].reshape(S, J * g)     # stat-major rows
-        full = hist_matmul(node_s[:, lo:lo + g], blk, L,
-                           exact=True)                     # (J*g, g*L)
+        full = build_hist(node_s[:, lo:lo + g], blk, L,
+                          exact=True)                      # (J*g, g*L)
         full = full.reshape(J, g, g, L)
         outs.append(full[:, jnp.arange(g), jnp.arange(g)])  # (J, g, L)
     out = jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
@@ -982,8 +980,10 @@ def _fit_gbt_batch(X, y, weights, max_depth, min_inst, min_gain, max_iter,
     # values all come from it (the XGBoost subsample design point); at 65k
     # rows and ≥2^depth≥8 leaves every leaf still averages 1000+ rows
     if task == "regression":
-        f0 = ((weights * y[None, :]).sum(1)
-              / jnp.maximum(weights.sum(1), 1.0))[:, None]  # (B, 1)
+        # pinned row sums: f0 must stay bit-identical when rows shard over
+        # the mesh 'data' axis (docs/trees.md, "Determinism")
+        f0 = (pinned_row_sum(weights * y[None, :], axis=1)
+              / jnp.maximum(pinned_row_sum(weights, axis=1), 1.0))[:, None]
     else:
         f0 = jnp.zeros((B, C), X.dtype)
     F_init = jnp.broadcast_to(f0[:, :, None], (B, C, S))
@@ -1265,6 +1265,10 @@ class _TreeFamilyBase(ModelFamily):
     #: config sweep runs under chunked lax.map (sequential per chip), so the
     #: batch axis cannot shard over the 'model' mesh axis; rows still shard.
     shardable = False
+    #: histogram builds route through the engine's pinned contraction —
+    #: the fused sweep dispatcher arms the ``hist.build`` chaos gate and
+    #: the engine mesh context for these families
+    uses_hist_engine = True
 
     def sweep_fit_batch(self, X, y, weights, grid, num_classes):
         """CV-sweep fits: leaf values come from the split-search sample —

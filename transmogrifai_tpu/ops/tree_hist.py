@@ -1,308 +1,15 @@
-"""Pallas TPU kernel: fused one-hot histogram matmul for tree growth.
+"""Compatibility shim: the histogram contraction kernels moved to the
+histogram-engine subsystem (``transmogrifai_tpu.histeng.kernels``) when the
+engine unified the in-core, streaming, and mesh histogram paths (ISSUE 18,
+docs/trees.md). Import from ``transmogrifai_tpu.histeng`` in new code; this
+module re-exports the full kernel surface so existing importers
+(ops/forest.py helpers, tests, docs/experiments measurement records) keep
+working unchanged."""
+from ..histeng.kernels import (  # noqa: F401
+    _BLK_B, _BLK_S, _HIST_PALLAS_MAX_B, _hist_pallas, _hist_shards,
+    _hist_xla, _hist_xla_pinned, _interpret, _make, _node_hist_xla, _pad_to,
+    _t_pad128, _tile_lanes, _tree_combine, _use_pallas, hist_matmul,
+    node_hist_matmul,
+)
 
-The inner loop of histogram tree building (models/trees.py `_grow_tree`) is
-
-    hist[a, f*nb + b] = sum_s A[s, a] * 1[codes[s, f] == b]
-
-i.e. a matmul of per-row statistics A (S, B) against the bin one-hot matrix
-(S, d*nb). XLA has to *materialize* that one-hot in HBM — 256 MB at the
-65k-row split-search sample with d=64, nb=32 — and stream it back in for
-every tree level of every config in the sweep. This kernel instead reads only
-the int32 bin codes (S, d) — 64x less HBM traffic — and expands the one-hot
-tile-by-tile in VMEM, feeding the MXU directly (the "fuse elementwise into
-matmul" pattern the XLA fusion engine cannot do across a dot operand).
-
-Replaces the JNI/native histogram plumbing of the reference's XGBoost
-dependency (reference: SURVEY §2.9, ml.dmlc:xgboost4j C++ core) with a
-TPU-native kernel.
-
-Layout notes
-- In-kernel the one-hot is built *bin-major* — `oh[s, b*D + f]` — because
-  Mosaic can `pltpu.repeat` along lanes but not reshape (S, d, nb) → (S,
-  d*nb); the cheap bin-major → feature-major permute happens outside on the
-  (B, d*nb) result.
-- Grid is (B blocks, D blocks, S blocks), S innermost: each (b, d) output
-  block accumulates over the whole row axis before moving on.
-- vmap (RF trees, GBT classes, selector configs) flattens the batch into
-  extra A columns via a custom_vmap rule — one wide kernel call per tree
-  level for the entire sweep, which is exactly the MXU-friendly shape.
-
-Fallback: on non-TPU backends (CPU test mesh, virtual-device dry runs) the
-same contraction runs as the plain XLA one-hot einsum.
-
-NOTE: `_use_pallas()` / `_interpret()` read TG_TREE_PALLAS and the backend at
-*trace time* inside jitted tree fits — once a shape is traced, flipping the
-env var has no effect for that shape until the jit caches are cleared
-(`jax.clear_caches()`), which tests that toggle the flag must do.
-"""
-from __future__ import annotations
-
-import math
-import os
-from functools import lru_cache
-
-import jax
-import jax.numpy as jnp
-
-_BLK_S = 1024   # rows per tile
-
-#: beyond this many stat columns the one-hot re-expansion per column block
-#: outweighs the saved HBM traffic — fall back to the XLA contraction
-#: (empirically: RF's 1600-wide flattened tree batch regressed 11%)
-_HIST_PALLAS_MAX_B = 1024
-_BLK_B = 128    # stat columns per tile
-
-
-def _use_pallas() -> bool:
-    env = os.environ.get("TG_TREE_PALLAS", "")
-    if env in ("0", "false"):
-        return False
-    if env in ("1", "true"):
-        return True
-    return jax.default_backend() in ("tpu",)
-
-
-def _interpret() -> bool:
-    """Run the kernels in pallas interpret mode off-TPU (CI coverage of the
-    kernel logic itself; forced via TG_TREE_PALLAS=1 on CPU)."""
-    return jax.default_backend() != "tpu"
-
-
-def _tile_lanes(x, repeats: int):
-    """``[x, x, …]`` concatenated ``repeats`` times along lanes (axis 1).
-
-    Mosaic's RepeatOp — what ``pltpu.repeat`` lowers to ON TPU — tiles the
-    whole vector, and every kernel lane layout here is built on that. But
-    jax 0.4.36+ registers a generic lowering for the same primitive that is
-    ELEMENT-WISE ``jnp.repeat`` — so in interpret mode (CPU CI) the lanes
-    came back permuted and every kernel test silently compared bin-major
-    against feature-major garbage. Keep the hardware op on TPU; emulate the
-    tile semantics with an explicit concatenate everywhere else."""
-    if _interpret():
-        return jnp.concatenate([x] * repeats, axis=1)
-    from jax.experimental.pallas import tpu as pltpu
-    return pltpu.repeat(x, repeats, axis=1)
-
-
-def _pad_to(x: int, m: int) -> int:
-    return -(-x // m) * m
-
-
-def _hist_xla(codes: jnp.ndarray, A: jnp.ndarray, n_bins: int,
-              exact: bool = False) -> jnp.ndarray:
-    """Reference contraction, feature-major (B, d*nb) f32."""
-    S, d = codes.shape
-    dt = jnp.float32 if exact else jnp.bfloat16
-    oh = (codes[:, :, None] == jnp.arange(n_bins, dtype=jnp.int32)
-          ).astype(dt).reshape(S, d * n_bins)
-    # materialize the one-hot: left fusible, XLA lowers the contraction as a
-    # pred-kernel convolution in some surrounding graphs (~6x slower than
-    # the plain einsum on v5e — seen in the tree grower's level loop)
-    oh = jax.lax.optimization_barrier(oh)
-    kw = ({"precision": jax.lax.Precision.HIGHEST} if exact else {})
-    return jnp.einsum("sa,sf->af", A.astype(dt), oh,
-                      preferred_element_type=jnp.float32, **kw)
-
-
-def _hist_pallas(codes: jnp.ndarray, A: jnp.ndarray,
-                 n_bins: int, exact: bool = False) -> jnp.ndarray:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    S, d = codes.shape
-    B = A.shape[1]
-    # feature blocking: either one full-width block (any lane count whose
-    # nb*d_pad is a multiple of 128) or 128-wide feature tiles — Mosaic
-    # requires block dims be 128-divisible or span the whole array axis
-    d_mult = 128 // math.gcd(n_bins, 128)
-    d_pad = _pad_to(d, d_mult)
-    if d_pad > 128:
-        d_pad = _pad_to(d_pad, 128)
-        blk_d = 128
-    else:
-        blk_d = d_pad
-    lanes = n_bins * blk_d
-    # keep the VMEM one-hot tile (blk_s × lanes bf16) around ≤4 MB
-    blk_s = _BLK_S
-    while blk_s > 256 and blk_s * lanes * 2 > (4 << 20):
-        blk_s //= 2
-    s_pad = _pad_to(S, blk_s)
-    b_pad = _pad_to(B, 8)
-    blk_b = min(_BLK_B, b_pad)
-    if b_pad > _BLK_B:
-        b_pad = _pad_to(b_pad, _BLK_B)
-
-    # sentinel bin n_bins never matches a one-hot lane → padded rows/features
-    # contribute exact zeros
-    codes_p = jnp.pad(codes.astype(jnp.int32),
-                      ((0, s_pad - S), (0, d_pad - d)),
-                      constant_values=n_bins)
-    A_p = jnp.pad(A.astype(jnp.float32), ((0, s_pad - S), (0, b_pad - B)))
-
-    def kernel(codes_ref, a_ref, out_ref):
-        s = pl.program_id(2)
-        rep = _tile_lanes(codes_ref[:], n_bins)             # (blk_s, nb*blk_d)
-        b_iota = (jax.lax.broadcasted_iota(jnp.int32, (blk_s, lanes), 1)
-                  // blk_d)
-        if exact:
-            # f32 stat operands, HIGHEST precision: leaf-value reductions
-            # (served predictions) must not round to bf16
-            oh = (rep == b_iota).astype(jnp.float32)
-            part = jnp.dot(a_ref[:].T, oh,
-                           preferred_element_type=jnp.float32,
-                           precision=jax.lax.Precision.HIGHEST)
-        else:
-            oh = (rep == b_iota).astype(jnp.bfloat16)
-            part = jnp.dot(a_ref[:].T.astype(jnp.bfloat16), oh,
-                           preferred_element_type=jnp.float32)
-
-        @pl.when(s == 0)
-        def _():
-            out_ref[:] = part
-
-        @pl.when(s > 0)
-        def _():
-            out_ref[:] += part
-
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b_pad, d_pad * n_bins), jnp.float32),
-        grid=(b_pad // blk_b, d_pad // blk_d, s_pad // blk_s),
-        in_specs=[
-            pl.BlockSpec((blk_s, blk_d), lambda b, f, s: (s, f)),
-            pl.BlockSpec((blk_s, blk_b), lambda b, f, s: (s, b)),
-        ],
-        out_specs=pl.BlockSpec((blk_b, lanes), lambda b, f, s: (b, f)),
-        interpret=_interpret(),
-    )(codes_p, A_p)
-
-    # bin-major blocks → feature-major flat, then strip padding
-    nbd = d_pad // blk_d
-    out = (out.reshape(b_pad, nbd, n_bins, blk_d)
-           .transpose(0, 1, 3, 2)
-           .reshape(b_pad, d_pad * n_bins))
-    return out[:B, :d * n_bins]
-
-
-@lru_cache(maxsize=None)
-def _make(n_bins: int, exact: bool = False):
-    from jax.custom_batching import custom_vmap
-
-    @custom_vmap
-    def hist(codes, A):
-        if _use_pallas() and A.shape[1] <= _HIST_PALLAS_MAX_B:
-            return _hist_pallas(codes, A, n_bins, exact)
-        return _hist_xla(codes, A, n_bins, exact)
-
-    @hist.def_vmap
-    def _rule(axis_size, in_batched, codes, A):
-        codes_b, A_b = in_batched
-        if codes_b:
-            # not a shape this framework produces (codes are shared across
-            # the sweep); keep semantics anyway
-            out = jax.lax.map(lambda ca: hist(ca[0], ca[1]), (codes, A))
-            return out, True
-        S, B = A.shape[1], A.shape[2]
-        flat = A.transpose(1, 0, 2).reshape(S, axis_size * B)
-        out = hist(codes, flat)                     # (V*B, d*nb)
-        return out.reshape(axis_size, B, -1), True
-
-    return hist
-
-
-def hist_matmul(codes: jnp.ndarray, A: jnp.ndarray,
-                n_bins: int, exact: bool = False) -> jnp.ndarray:
-    """hist[a, f*n_bins + b] = Σ_s A[s, a]·1[codes[s, f] == b], f32.
-
-    codes: (S, d) int bin indices in [0, n_bins); values == n_bins are
-    allowed and contribute nothing (sentinel). A: (S, B) per-row statistics.
-    Returns (B, d*n_bins) feature-major. Batches over leading axes of A
-    (vmap) by widening B — the whole sweep becomes one kernel call.
-    ``exact``: keep the stat operands f32 at HIGHEST precision (leaf-value
-    reductions — served predictions must not round to bf16); growth
-    histograms use the default bf16 operands by design.
-    """
-    return _make(n_bins, exact)(codes, A)
-
-
-# ---------------------------------------------------------------------------
-# Fused node-histogram: hist over (stat, slot, tree) lanes WITHOUT ever
-# materializing the (S, k·Wl·T) masked-stat operand in HBM
-# ---------------------------------------------------------------------------
-
-
-
-def _t_pad128(T: int) -> int:
-    """Tree-lane padding the node-hist kernel accepts: 32, 64, or a multiple
-    of 128 (so a 128-lane output block covers whole trees × whole slots)."""
-    if T <= 32:
-        return 32
-    if T <= 64:
-        return 64
-    return _pad_to(T, 128)
-
-
-def _node_hist_xla(codes, node, sws, Wl_eff, n_bins, stride, k, exact=False):
-    """Reference semantics: materialize the masked-stat operand and reuse the
-    plain hist contraction. node: (S, T_pad) int32 (pad -1); sws:
-    (k, S, T_pad) stat-stacked. Returns (k·Wl_eff·T_pad, d·nb)."""
-    S, T_pad = node.shape
-    j = stride * jnp.arange(Wl_eff, dtype=jnp.int32)[None, :, None]
-    n_oh = (node[:, None, :] == j).astype(sws.dtype)      # (S, Wl_eff, T_pad)
-    A = jnp.concatenate(
-        [n_oh * sws[ki][:, None, :] for ki in range(k)],
-        axis=1).reshape(S, k * Wl_eff * T_pad)
-    return _hist_xla(codes, A, n_bins, exact)
-
-
-
-def node_hist_matmul(codes: jnp.ndarray, node: jnp.ndarray,
-                     sw_list, Wl: int, n_bins: int,
-                     stride: int = 1) -> jnp.ndarray:
-    """hist[(k, j, t), f·nb + b] = Σ_s sw_k[s,t] · 1[node[s,t] == stride·j]
-    · 1[codes[s,f] == b] — the tree-growth histogram as one XLA contraction
-    over the masked-stat operand (the (S, k·Wl·T) A_cat is materialized;
-    a pallas kernel that expanded it tile-by-tile in VMEM measured SLOWER
-    at every production shape, sweep and refit alike — retired with its
-    measurement table to docs/experiments/node_hist_pallas.py).
-
-    codes: (S, d) int32 bin codes; node: (S, T) int32 current slot per tree
-    (values < 0 never match); sw_list: k arrays (S, T) of per-tree stats;
-    ``stride``: slot-id multiplier (2 = heap left-children, 1 = chain slots).
-    Returns (k·Wl·T, d·n_bins) f32, lane = (k·Wl + j)·T + t — identical
-    layout to ``hist_matmul(codes, A_cat, n_bins)`` with A_cat built k-major
-    then j-major.
-    """
-    S, d = codes.shape
-    T = node.shape[1]
-    k = len(sw_list)
-    # lane padding to 32/64/128-multiple tree lanes is KEPT on purpose: it
-    # predates the retired pallas kernel's constraints but MEASURES faster
-    # on v5e — removing it dropped the default-grid sweep from ~108 to
-    # ~88 fits/sec (the A_cat expansion + contraction tile better on
-    # 128-aligned minor dims than on T=54-ragged ones, logical-FLOP
-    # savings notwithstanding)
-    T_pad = _t_pad128(T)
-    rep = max(1, 128 // T_pad)
-    Wl_eff = max(Wl, rep)
-    if Wl_eff * T_pad % 128:
-        Wl_eff = -(-Wl_eff // rep) * rep
-    node_p = (jnp.pad(node, ((0, 0), (0, T_pad - T)), constant_values=-1)
-              if T_pad != T else node)
-    sws = jnp.stack(
-        [jnp.pad(sw.astype(jnp.float32), ((0, 0), (0, T_pad - T)))
-         if T_pad != T else sw.astype(jnp.float32) for sw in sw_list])
-    out = _node_hist_xla(codes, node_p, sws, Wl_eff, n_bins, stride, k)
-    if Wl_eff != Wl or T_pad != T:
-        out = (out.reshape(k, Wl_eff, T_pad, d * n_bins)[:, :Wl, :T]
-               .reshape(k * Wl * T, d * n_bins))
-    return out
-
-
-# Routing no longer lives here: the per-level decision-bit contraction
-# (route_matmul) was replaced by the feature-select matmul inside
-# models/trees.py _grow_tree (1/n_bins-th the FLOPs) and by the fused
-# multi-level descent kernel in ops/forest.py for full-data passes.
-
-
-
+__all__ = ["hist_matmul", "node_hist_matmul"]

@@ -255,8 +255,10 @@ class _TrainScenario(_Scenario):
 
 
 class _SweepScenario(_Scenario):
-    """The CV validator alone (2 families): winner + per-family fold
-    metrics compared bit-exactly; quarantines must be accounted."""
+    """The CV validator alone (3 families — two linear plus a small RF so
+    the histogram-engine ``hist.build`` gate is exercised): winner +
+    per-family fold metrics compared bit-exactly; quarantines must be
+    accounted."""
 
     name = "sweep"
 
@@ -265,6 +267,7 @@ class _SweepScenario(_Scenario):
 
         from ..models.api import MODEL_REGISTRY
         import transmogrifai_tpu.models.linear  # noqa: F401 - registry
+        import transmogrifai_tpu.models.trees   # noqa: F401 - registry
         rng = np.random.RandomState(101)
         X = rng.randn(512, 6).astype(np.float32)
         y = (X @ rng.randn(6).astype(np.float32) > 0).astype(np.float32)
@@ -272,8 +275,12 @@ class _SweepScenario(_Scenario):
         lr = [{"regParam": r, "elasticNetParam": e}
               for r in (0.01, 0.1) for e in (0.0, 0.5)]
         svc = [{"regParam": 0.01}, {"regParam": 0.1}]
+        rf = [{"maxDepth": 2, "minInstancesPerNode": 5,
+               "minInfoGain": 0.001, "numTrees": 3,
+               "subsamplingRate": 1.0}]
         self.models = [(MODEL_REGISTRY["OpLogisticRegression"], lr),
-                       (MODEL_REGISTRY["OpLinearSVC"], svc)]
+                       (MODEL_REGISTRY["OpLinearSVC"], svc),
+                       (MODEL_REGISTRY["OpRandomForestClassifier"], rf)]
         self.baseline = self.run(FaultLog())
 
     def run(self, log: FaultLog) -> Dict[str, Any]:

@@ -23,6 +23,10 @@ Injection sites (see docs/robustness.md for the full table):
 site                         fires in
 ===========================  ====================================================
 ``validator.family_fit``     per model family, before its sweep branch dispatches
+``hist.build``               per tree family, before its histogram programs
+                             build or dispatch (histeng/engine.py chaos_gate;
+                             a raise quarantines the family like
+                             ``validator.family_fit``)
 ``validator.fold_metrics``   per family, on the host (F, G) CV metric matrix
                              (``nan`` mode poisons candidate metrics)
 ``selector.refit``           before the winner's full-data refit
@@ -274,6 +278,10 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
     _site("validator.family_fit", "raise", "impl/tuning/validators.py",
           "sweep|train",
           "family quarantined; the other families still race",
+          bit_equal=False),
+    _site("hist.build", "raise", "histeng/engine.py", "sweep|train",
+          "tree family quarantined before its histogram programs "
+          "dispatch; the other families still race",
           bit_equal=False),
     _site("validator.fold_metrics", "nan", "impl/tuning/validators.py",
           "sweep|train",

@@ -240,6 +240,25 @@ def programstore_violations() -> List[str]:
     return out
 
 
+def histeng_violations() -> List[str]:
+    """Histogram-engine state that must not outlive a test or campaign
+    schedule: an active ``engine_mesh`` context (a leak would silently
+    shard the next single-device tree trace's row blocks) and an
+    unbounded contraction-factory cache. The conftest ``hist`` no-leak
+    fixture also clears the factory cache per test."""
+    from .. import histeng
+    out: List[str] = []
+    probe = histeng.engine_probe()
+    if probe["mesh_ctx"] is not None:
+        out.append(f"an engine mesh context leaked: {probe['mesh_ctx']}")
+    # (n_bins, exact) pairs are few; triple digits means something is
+    # generating fingerprints per call
+    if probe["factory_cache"] > 100:
+        out.append(f"histogram contraction factory cache unbounded: "
+                   f"{probe['factory_cache']} entries")
+    return out
+
+
 def plan_cache_violations() -> List[str]:
     """The compiled-plan LRU must stay bounded and no forced
     planner-enable override may linger."""
@@ -371,6 +390,7 @@ def campaign_violations(clean: bool = True,
     if threads:
         out.append(f"worker thread(s) survived: {threads}")
     out.extend(plan_cache_violations())
+    out.extend(histeng_violations())
     out.extend(blackbox_violations())
     out.extend(ledger_violations())
     out.extend(programstore_violations())

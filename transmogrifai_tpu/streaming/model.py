@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..histeng import bin_codes_host, build_node_hist, node_stat_sums
 from ..stages.base import AllowLabelAsInput, Estimator, Transformer
 from ..table import Column, FeatureTable
 from ..types import OPVector, Prediction, RealNN
@@ -152,35 +153,16 @@ class StreamingGBT(AllowLabelAsInput, Estimator):
                 def extract_level(table: FeatureTable, feat_lv=feat_lv,
                                   thr_lv=thr_lv, n_nodes=n_nodes):
                     X, y = self._xy(table)
-                    n = X.shape[0]
                     r = (y.astype(np.float64)
                          - _ensemble_raw(X, f0, lr, trees))
                     node = _descend(X, feat_lv, thr_lv)
-                    # one flat (node, feature, bin) index for every cell,
-                    # then THREE bincounts total — the column-strided
-                    # per-feature variant costs ~2× (cache-hostile reads
-                    # and 3·d small bincounts)
-                    # f64 rows keep the bin comparison bit-consistent with
-                    # the f64 thresholds _descend routes by
-                    Xt = np.ascontiguousarray(X.T, dtype=np.float64)
-                    flat = np.empty((d, n), dtype=np.int64)
-                    base = node * (d * nb)
-                    for j in range(d):
-                        code = np.searchsorted(edges[j], Xt[j],
-                                               side="left")
-                        np.add(base, j * nb + code, out=flat[j])
-                    size = n_nodes * d * nb
-                    fl = flat.ravel()
-                    shape = (n_nodes, d, nb)
-                    parts = {
-                        "cnt": np.bincount(fl, minlength=size)
-                        .astype(np.float64).reshape(shape),
-                        "sum": np.bincount(fl, weights=np.tile(r, d),
-                                           minlength=size).reshape(shape),
-                        "sumsq": np.bincount(fl, weights=np.tile(r * r, d),
-                                             minlength=size).reshape(shape),
-                    }
-                    return (parts,)
+                    # histogram-engine host backend: the same flat-bincount
+                    # arithmetic this trainer used to carry inline, bit for
+                    # bit (tests/test_histeng.py pins the equality)
+                    codes = bin_codes_host(X, edges)
+                    cnt, s, sq = build_node_hist(
+                        codes, node, [None, r, r * r], nb, n_nodes=n_nodes)
+                    return ({"cnt": cnt, "sum": s, "sumsq": sq},)
 
                 st = run.fold(f"t{t}.l{lv}", fold, extract_level)
                 feat, thr = self._best_splits(st, edges)
@@ -197,12 +179,8 @@ class StreamingGBT(AllowLabelAsInput, Estimator):
                 r = (y.astype(np.float64)
                      - _ensemble_raw(X, f0, lr, trees))
                 node = _descend(X, feat_lv, thr_lv)
-                return ({
-                    "cnt": np.bincount(node, minlength=leaf_nodes).astype(
-                        np.float64),
-                    "sum": np.bincount(node, weights=r,
-                                       minlength=leaf_nodes),
-                },)
+                cnt, s = node_stat_sums(node, [None, r], leaf_nodes)
+                return ({"cnt": cnt, "sum": s},)
 
             st = run.fold(f"t{t}.leaf", leaf_fold, extract_leaf)
             leaf = np.where(st["cnt"] > 0, st["sum"]
