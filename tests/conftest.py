@@ -271,8 +271,11 @@ def _no_serving_leak():
     leaked = oracles.close_leaked_serving()
     assert not leaked, (
         f"a test leaked running serving runtime(s): {leaked}")
+    # "tg-serve" prefix-matches the batcher (tg-serve[<model>]) AND the
+    # pipelined completer (tg-serve-completer[<model>]): a completer that
+    # outlives its runtime fails the leaking test here
     stray = oracles.leaked_threads(("tg-serve",))
-    assert not stray, f"serving batcher thread(s) survived a test: {stray}"
+    assert not stray, f"serving thread(s) survived a test: {stray}"
 
 
 @pytest.fixture(autouse=True)

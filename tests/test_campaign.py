@@ -328,7 +328,11 @@ def test_seeded_campaign_full_coverage_zero_violations():
         assert doc["coveragePct"] == 100.0
         acct = doc["accounting"]
         assert acct["lost"] == 0 and acct["failed"] == 0
-        assert acct["submitted"] == acct["completed"] + acct["shed"]
+        # caller-cancelled requests are a TYPED shed bucket, part of the
+        # identity — never silently vanished (serve scenarios cancel one)
+        assert acct["submitted"] == (acct["completed"] + acct["shed"]
+                                     + acct["cancelled"])
+        assert acct["cancelled"] > 0
         # outcome taxonomy: every schedule either completed or raised a
         # documented typed error (the typed-error-discipline oracle
         # would have flagged anything else)

@@ -209,7 +209,8 @@ def test_e2e_shift_degrades_refits_and_hot_swaps(saved, tmp_path,
         assert hook_calls == [("m", DEGRADED)]
         assert new_rt is not old_rt, "registry entry did not hot-swap"
         # zero request loss across the whole run, swap included
-        assert old_rt.summary()["shed"] == {"overload": 0.0, "deadline": 0.0}
+        assert old_rt.summary()["shed"] == {"overload": 0.0, "deadline": 0.0,
+                                            "cancelled": 0.0}
         assert old_rt.summary()["drift"]["verdict"] == DEGRADED
         health = reg.health()
         assert health["refits"] == [{"model": "m", "ok": True,

@@ -1,0 +1,311 @@
+"""Pipelined serving dataplane (serving/runtime.py "pipelined dataplane";
+docs/serving.md): pipelined ≡ serial bit-equality across depths {1, 2, 4}
+including mixed buckets and quarantined rows, chaos at ``serve.flush`` /
+``serve.dispatch`` / ``serve.complete`` / ``oom.serve`` with depth 2
+(full accounting, breaker counts, no leaked completer threads — enforced
+by the conftest serving no-leak fixture), the cancelled-future typed
+shed, per-stage histograms, and replica kill with an in-flight pipeline
+depth > 1 → zero lost futures through the FrontDoor."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.impl.selector.factories import (
+    BinaryClassificationModelSelector,
+)
+from transmogrifai_tpu.local import micro_batch_score_function, score_function
+from transmogrifai_tpu.local.scoring import SCORE_ERROR_KEY
+from transmogrifai_tpu.robustness import faults
+from transmogrifai_tpu.robustness.faults import ALL_SITES
+from transmogrifai_tpu.serving import (
+    CircuitBreaker, FleetConfig, FrontDoor, ServeConfig, ServingRuntime,
+)
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.serve
+
+
+def _train_model(n=300, seed=7):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.randn(n), rng.randn(n)
+    y = ((x1 + 0.5 * x2) > 0).astype(float)
+    df = pd.DataFrame({"x1": x1, "x2": x2, "y": y})
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(c).extract_field().as_predictor()
+             for c in ("x1", "x2")]
+    checked = tg.transmogrify(feats).sanity_check(label)
+    pred = (BinaryClassificationModelSelector.with_cross_validation(
+        seed=seed,
+        models=[("OpLogisticRegression",
+                 [{"regParam": 0.01, "elasticNetParam": 0.0}])])
+        .set_input(label, checked).get_output())
+    return (OpWorkflow().set_input_dataset(df)
+            .set_result_features(pred).train())
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _train_model()
+
+
+def _rows(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"x1": float(rng.randn()), "x2": float(rng.randn())}
+            for _ in range(n)]
+
+
+def _cfg(depth, **kw):
+    base = dict(max_batch=8, max_queue=128, max_wait_ms=2.0,
+                pipeline_depth=depth)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Site registry
+# ---------------------------------------------------------------------------
+
+def test_serve_complete_site_registered():
+    spec = ALL_SITES["serve.complete"]
+    assert spec.module == "serving/runtime.py"
+    assert "serve" in spec.scenarios
+    assert spec.modes == ("raise",)
+    assert spec.bit_equal  # eager degrade is bit-equal
+
+
+# ---------------------------------------------------------------------------
+# Bit-equality: pipelined ≡ serial across depths, mixed buckets,
+# quarantined rows
+# ---------------------------------------------------------------------------
+
+def test_pipelined_bit_equal_across_depths(model):
+    """Depths 1 (serial), 2, and 4 must produce byte-identical records —
+    across multiple flushes (20 rows / max_batch 8 → mixed flush sizes)
+    and with quarantined rows in the mix (a string where a Real belongs
+    quarantines that row, scores the rest)."""
+    rows = _rows(18, seed=11)
+    rows.insert(5, {"x1": "not-a-number", "x2": 0.25})
+    rows.insert(13, {"x1": 0.5, "x2": "also-bad"})
+    by_depth = {}
+    for depth in (1, 2, 4):
+        with ServingRuntime(model, f"eq{depth}", _cfg(depth)) as rt:
+            futs = [rt.submit(r) for r in rows]
+            by_depth[depth] = [f.result(timeout=30) for f in futs]
+            assert rt.summary()["pipeline"]["depth"] == depth
+        snap = rt.metrics.snapshot()
+        assert snap["tg_serve_rows_total"][f"model=eq{depth}"] == 20.0
+        stages = {k for k in snap.get("tg_serve_stage_seconds", {})}
+        if depth == 1:
+            assert stages == {f"model=eq{depth},stage=serial"}
+        else:
+            # every pipelined stage was measured at least once
+            assert f"model=eq{depth},stage=complete" in stages
+    assert by_depth[2] == by_depth[1]
+    assert by_depth[4] == by_depth[1]
+    # the quarantined rows are quarantined identically at every depth
+    for recs in by_depth.values():
+        assert SCORE_ERROR_KEY in recs[5] and SCORE_ERROR_KEY in recs[13]
+        clean = [r for r in recs if SCORE_ERROR_KEY not in r]
+        assert len(clean) == 18
+
+
+def test_completer_thread_lifecycle(model):
+    """Depth > 1 spawns tg-serve-completer[<name>]; depth 1 does not;
+    close() retires it (the conftest no-leak fixture asserts nothing
+    survives the test either way)."""
+    def completers():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("tg-serve-completer")]
+
+    with ServingRuntime(model, "lc1", _cfg(1)) as rt:
+        rt.score(_rows(1)[0], timeout=30)
+        assert completers() == []
+    with ServingRuntime(model, "lc2", _cfg(2)) as rt:
+        rt.score(_rows(1)[0], timeout=30)
+        assert completers() == ["tg-serve-completer[lc2]"]
+    assert completers() == []
+
+
+def test_pipeline_depth_env_knob(monkeypatch):
+    monkeypatch.setenv("TG_SERVE_PIPELINE", "1")
+    assert ServeConfig.from_env().pipeline_depth == 1
+    monkeypatch.setenv("TG_SERVE_PIPELINE", "4")
+    assert ServeConfig.from_env().pipeline_depth == 4
+    monkeypatch.setenv("TG_SERVE_PIPELINE", "0")  # floor: serial
+    assert ServeConfig.from_env().pipeline_depth == 1
+
+
+# ---------------------------------------------------------------------------
+# Chaos at depth 2: serve.flush / serve.dispatch / serve.complete /
+# oom.serve — full accounting, breaker counts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_complete_chaos_counts_against_dispatching_flush(model):
+    """serve.complete chaos (the completion side of the pipeline): the
+    failure surfaces in the completer but feeds the breaker exactly like
+    a dispatch failure, and the flush degrades to bit-equal eager
+    records — requests never fail."""
+    row = {"x1": 0.4, "x2": -0.2}
+    eager = score_function(model)(row)
+    with faults.injected({"serve.complete": {
+            "mode": "raise", "nth": 1, "count": 2, "transient": True}}):
+        with ServingRuntime(model, "cc", _cfg(2, max_wait_ms=1.0)) as rt:
+            r1 = rt.score(row, timeout=30)   # completion fault 1
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 1
+            r2 = rt.score(row, timeout=30)   # completion fault 2
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 2
+            r3 = rt.score(row, timeout=30)   # clean: resets the streak
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 0
+    assert r1 == eager and r2 == eager and r3 == eager
+    degraded = rt.fault_log.of_kind("breaker_degraded")
+    assert {r.site for r in degraded} == {"serve.complete"}
+    assert rt.summary()["degradedRows"] == 2.0
+    assert rt.summary()["rowsScored"] == 3.0
+
+
+@pytest.mark.chaos
+def test_flush_and_dispatch_chaos_at_depth_2(model):
+    """serve.flush / serve.dispatch keep their serial meaning on the
+    pipelined path: a flush fault degrades WITHOUT touching the breaker,
+    a dispatch fault counts against it; both serve bit-equal eager
+    records with full accounting."""
+    row = {"x1": 0.5, "x2": 0.3}
+    eager = score_function(model)(row)
+    with faults.injected({
+            "serve.flush": {"mode": "raise", "nth": 1, "count": 1,
+                            "transient": True},
+            "serve.dispatch": {"mode": "raise", "nth": 1, "count": 1,
+                               "transient": True}}):
+        with ServingRuntime(model, "fd2", _cfg(2, max_wait_ms=1.0)) as rt:
+            r1 = rt.score(row, timeout=30)   # flush fault: no breaker hit
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 0
+            r2 = rt.score(row, timeout=30)   # dispatch fault: breaker hit
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 1
+            r3 = rt.score(row, timeout=30)   # clean
+    assert r1 == eager and r2 == eager and r3 == eager
+    sites = [r.site for r in rt.fault_log.of_kind("breaker_degraded")]
+    assert sorted(sites) == ["serve.dispatch", "serve.flush"]
+    assert rt.summary()["rowsScored"] == 3.0
+    assert rt.summary()["degradedRows"] == 2.0
+
+
+@pytest.mark.chaos
+def test_oom_downshift_drains_pipeline_and_recovers(model):
+    """oom.serve at depth 2: the exhausted launch runs the adaptive
+    downshift ladder in the completer (split halves, bit-equal), flips
+    the runtime into serial backoff, and one clean serial flush restores
+    the pipelined path. Resource faults never feed the breaker."""
+    rows = _rows(8, seed=21)
+    baseline = micro_batch_score_function(model)(list(rows))
+    with faults.injected({"oom.serve": {"mode": "oom", "nth": 1,
+                                        "count": 1}}):
+        rt = ServingRuntime(model, "oo2", _cfg(2), auto_start=False)
+        try:
+            futs = [rt.submit(r) for r in rows]
+            rt.start()
+            recs = [f.result(timeout=30) for f in futs]
+            assert recs == baseline
+            assert rt.summary()["faults"]["oomDownshifts"] == 1
+            assert rt.breaker.snapshot()["consecutiveFailures"] == 0
+            # backoff cleared by the next (clean, serial) flush; the one
+            # after runs pipelined again — all bit-equal
+            again = [rt.score(r, timeout=30) for r in rows[:2]]
+            assert again == baseline[:2]
+            assert not rt._oom_serial
+        finally:
+            rt.close()
+    assert rt.summary()["rowsScored"] == 10.0
+    assert rt.summary()["degradedRows"] == 0.0
+
+
+@pytest.mark.chaos
+def test_breaker_open_drains_pipeline_and_serves_serially(model):
+    """Three dispatch faults open the breaker at depth 2; while open the
+    batcher drains the pipe and serves serially through the existing
+    eager path (bit-equal), and the half-open probe still closes it —
+    the probe's allow_device() is consumed exactly once."""
+    clk = [0.0]
+    br = CircuitBreaker(name="bo2", failure_threshold=2, reset_after=10.0,
+                        clock=lambda: clk[0])
+    row = {"x1": 0.4, "x2": -0.2}
+    eager = score_function(model)(row)
+    with faults.injected({"serve.dispatch": {
+            "mode": "raise", "nth": 1, "count": 2, "transient": True}}):
+        with ServingRuntime(model, "bo2", _cfg(2, max_wait_ms=1.0),
+                            breaker=br) as rt:
+            r1 = rt.score(row, timeout=30)   # fault 1 (pipelined)
+            r2 = rt.score(row, timeout=30)   # fault 2: opens
+            assert br.state == "open"
+            r3 = rt.score(row, timeout=30)   # open: serial eager path
+            assert br.state == "open"
+            clk[0] = 20.0                    # past reset_after
+            r4 = rt.score(row, timeout=30)   # half-open probe: closes
+            assert br.state == "closed"
+    assert r1 == eager and r2 == eager and r3 == eager and r4 == eager
+    assert rt.summary()["rowsScored"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# Cancelled futures: typed shed, never a silent drop
+# ---------------------------------------------------------------------------
+
+def test_cancelled_future_is_typed_shed_not_silent_drop(model):
+    """A future cancelled after enqueue must land in the typed
+    ``cancelled`` shed bucket (summary + tg_serve_shed_total) so the
+    accounting identity submitted = completed + typed sheds holds."""
+    rt = ServingRuntime(model, "cx", _cfg(2), auto_start=False)
+    try:
+        futs = [rt.submit(r) for r in _rows(3, seed=9)]
+        assert futs[1].cancel()
+        rt.start()
+        assert futs[0].result(timeout=30) is not None
+        assert futs[2].result(timeout=30) is not None
+        deadline = time.monotonic() + 5.0
+        while (rt.summary()["shed"]["cancelled"] < 1.0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        summ = rt.summary()
+        assert summ["shed"]["cancelled"] == 1.0
+        assert summ["rowsScored"] == 2.0
+        snap = rt.metrics.snapshot()
+        assert snap["tg_serve_shed_total"][
+            "model=cx,reason=cancelled"] == 1.0
+    finally:
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# Replica kill with in-flight pipeline depth > 1: zero lost futures
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_with_pipelined_replicas_zero_lost(model):
+    """A replica dies while its pipelined dataplane (depth 3) holds
+    queued + in-flight work: every accepted future still resolves exactly
+    once with a record bit-equal to the fault-free run — in-flight
+    flushes complete during the kill's close, queued requests fail over
+    through the FrontDoor."""
+    rows = [{"x1": float(i) * 0.11 - 1.0, "x2": 0.4 - float(i) * 0.07}
+            for i in range(24)]
+    baseline = micro_batch_score_function(model)(list(rows))
+    cfg = ServeConfig(max_batch=4, max_queue=256, max_wait_ms=30.0,
+                      pipeline_depth=3)
+    fc = FleetConfig(min_replicas=1, max_replicas=4,
+                     probe_interval_ms=0.0, probe_failures=3,
+                     readmit_probes=2, max_failovers=2, autoscale=False)
+    with FrontDoor({"m": model}, replicas=2, config=cfg,
+                   fleet_config=fc) as fd:
+        futs = [fd.submit(r) for r in rows]
+        fd.kill_replica("r0")
+        recs = [f.result(timeout=30) for f in futs]  # zero lost
+        assert recs == baseline
+        assert fd.fleet_snapshot()["kills"] == 1
+        kinds = {r.kind for r in fd.fault_log.reports}
+        assert "replica_lost" in kinds
+        # exactly-once: completed rows across the fleet == submitted
+        assert fd.summary()["rowsScored"] == 24.0

@@ -128,6 +128,13 @@ def test_deadline_expiry_sheds_before_dispatch(model, monkeypatch):
     DeadlineExceededError and never reach the compiled scorer."""
     rt = ServingRuntime(model, "dl", _cfg(), auto_start=False)
     dispatched = []
+    # count rows entering the gather stage (the pipelined compiled path);
+    # also wrap the monolithic scorer so a serial (depth-1) run or a
+    # fallback path is counted identically
+    real_gather = rt._stages.gather
+    monkeypatch.setattr(
+        rt._stages, "gather", lambda rows: dispatched.append(len(rows))
+        or real_gather(rows))
     real_scorer = rt._scorer
     monkeypatch.setattr(
         rt, "_scorer", lambda rows: dispatched.append(len(rows))

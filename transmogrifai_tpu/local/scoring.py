@@ -251,6 +251,74 @@ def serve_record_builder(model) -> Callable[[FeatureTable, int], List[Dict[str, 
     return records
 
 
+class ServeStages:
+    """Staged decomposition of :func:`micro_batch_score_function` for the
+    pipelined serving dataplane (serving/runtime.py; docs/serving.md
+    "Pipelined dataplane"). The monolithic scorer runs
+    build → compile → flatten back-to-back on one thread; the pipeline
+    needs the same three steps as separable stages so batch formation,
+    device dispatch, and result resolution can overlap across flushes:
+
+    * :meth:`gather` — request rows → FeatureTable, one columnar sweep
+      per raw feature through **pooled per-bucket scratch blocks**: the
+      per-flush gather list (``[r.get(field) for r in rows]``) is
+      replaced by an object-dtype scratch array reused across flushes
+      (grown to the enclosing power-of-two bucket, mirroring the plan
+      padding buckets), so the steady state allocates nothing per flush.
+      ``column_of_scalars`` reads the scratch through a numpy view and
+      materializes fresh output arrays, so reuse is invisible; any
+      non-homogeneous column falls back to the full
+      :func:`serve_table_builder` path — byte-identical by construction.
+    * :meth:`dispatch` — launch the compiled program. JAX dispatch is
+      asynchronous: the returned table holds device arrays whose math may
+      still be running, so the caller can start gathering the next flush.
+    * :meth:`flatten` — block on the device results and produce exactly
+      the records :func:`serve_record_builder` emits.
+
+    Failure semantics stay with the caller: the serving runtime reproduces
+    the monolithic scorer's quarantine fallback by re-scoring a failed
+    flush through ``micro_batch_score_function`` itself, so pipelined
+    records are bit-equal to serial ones on every path."""
+
+    def __init__(self, model):
+        from ..readers.readers import _field_name_of
+        self._build = serve_table_builder(model)
+        self.dispatch = compiled_score_function(model)
+        self.flatten = serve_record_builder(model)
+        self._extractors = [(f, _field_name_of(f.origin_stage.extract_fn))
+                            for f in model.raw_features]
+        #: per-feature pooled scratch (object dtype; single-thread use —
+        #: the batcher owns the gather stage)
+        self._scratch: Dict[str, np.ndarray] = {}
+
+    def gather(self, rows: Sequence[Dict[str, Any]]) -> FeatureTable:
+        from ..table import column_of_scalars
+        n = len(rows)
+        if not n or not all(isinstance(r, dict) for r in rows):
+            return self._build(rows)
+        cols: Dict[str, Column] = {}
+        for f, field in self._extractors:
+            col = None
+            if field is not None:
+                buf = self._scratch.get(f.name)
+                if buf is None or buf.shape[0] < n:
+                    # grow to the enclosing bucket so one block serves
+                    # every flush size up to max_batch
+                    cap = max(64, 1 << (n - 1).bit_length())
+                    buf = np.empty(cap, dtype=object)
+                    self._scratch[f.name] = buf
+                for i, r in enumerate(rows):
+                    buf[i] = r.get(field)
+                col = column_of_scalars(f.feature_type, buf[:n])
+            if col is None:
+                # a wrapper/None/string (or a custom extractor) broke the
+                # fast sweep: rebuild the WHOLE table through the original
+                # path so the result is identical to the serial builder
+                return self._build(rows)
+            cols[f.name] = col
+        return FeatureTable(cols, n)
+
+
 def micro_batch_score_function(model) -> Callable[[Sequence[Dict[str, Any]]], List[Dict[str, Any]]]:
     """Micro-batch scorer: builds a FeatureTable from a list of raw rows and
     runs the columnar/jitted DAG pass — the serving path that keeps the TPU

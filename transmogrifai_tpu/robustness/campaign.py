@@ -80,6 +80,7 @@ Schedule = Dict[str, Any]
 ACCOUNT_KINDS = {
     "serve.flush": "breaker_degraded",
     "serve.dispatch": "breaker_degraded",
+    "serve.complete": "breaker_degraded",
     "oom.serve": "oom_downshift",
     "drift.fold": "drift_fold_failed",
     "drift.verdict": "drift_verdict_failed",
@@ -327,6 +328,9 @@ class _ServeScenario(_Scenario):
         from ..serving.runtime import ServeConfig, ServingRuntime
         monitor = DriftMonitor(DriftBaseline.from_model(self.model),
                                DriftConfig(min_rows=4, every_rows=4))
+        # default pipeline_depth (2) so the staged gather/dispatch/
+        # complete path is what the campaign hammers (and serve.complete
+        # is coverable); depth 1 re-runs are one env knob away
         cfg = ServeConfig(max_batch=16, max_queue=16, max_wait_ms=10.0)
         rt = ServingRuntime(self.model, "campaign", cfg, fault_log=log,
                             drift_monitor=monitor, auto_start=False)
@@ -334,6 +338,8 @@ class _ServeScenario(_Scenario):
         shed: Dict[int, str] = {}
         failed: Dict[int, str] = {}
         lost: List[int] = []
+        cancelled: List[int] = []
+        shed_counters: Dict[str, float] = {}
         try:
             pending = []
             for i, row in enumerate(self.rows):
@@ -344,9 +350,18 @@ class _ServeScenario(_Scenario):
                         shed[i] = type(e).__name__
                     else:
                         raise  # untyped submit failure = discipline breach
+            if pending:
+                # one caller walks away before the batcher starts: the
+                # runtime must shed the cancelled future TYPED
+                # (reason="cancelled"), never silently vanish it
+                ci, cfut = pending[-1]
+                if cfut.cancel():
+                    cancelled.append(ci)
             rt.start()
             deadline = time.monotonic() + self.engine.collect_timeout
             for i, fut in pending:
+                if fut.cancelled():
+                    continue  # accounted in the cancelled bucket
                 try:
                     completed[i] = fut.result(
                         timeout=max(0.05, deadline - time.monotonic()))
@@ -354,14 +369,25 @@ class _ServeScenario(_Scenario):
                     lost.append(i)
                 except Exception as e:
                     failed[i] = f"{type(e).__name__}: {e}"
+            if cancelled:
+                # the cancelled request is counted when its flush runs
+                # (_shed_expired), which can trail the other futures'
+                # resolution by one batcher iteration
+                until = time.monotonic() + 2.0
+                while (rt.summary()["shed"].get("cancelled", 0.0)
+                       < len(cancelled) and time.monotonic() < until):
+                    time.sleep(0.01)
+            shed_counters = rt.summary()["shed"]
         finally:
             rt.close(drain=False)
         return {"completed": completed, "shed": shed, "failed": failed,
-                "lost": lost,
+                "lost": lost, "cancelled": cancelled,
+                "shedCounters": shed_counters,
                 "accounting": {"submitted": len(self.rows),
                                "completed": len(completed),
                                "shed": len(shed), "failed": len(failed),
-                               "lost": len(lost)}}
+                               "lost": len(lost),
+                               "cancelled": len(cancelled)}}
 
     def violations(self, result, fired, log) -> List[str]:
         out: List[str] = []
@@ -373,10 +399,18 @@ class _ServeScenario(_Scenario):
             out.append(f"serve: request future(s) failed (requests must "
                        f"degrade, never fail): {result['failed']}")
         total = (len(result["completed"]) + len(result["shed"])
-                 + len(result["failed"]) + len(result["lost"]))
+                 + len(result["failed"]) + len(result["lost"])
+                 + len(result["cancelled"]))
         if total != n:
             out.append(f"serve: request accounting broken: "
                        f"{total} accounted of {n} submitted")
+        if result["cancelled"]:
+            got = result["shedCounters"].get("cancelled", 0.0)
+            if got < len(result["cancelled"]):
+                out.append(
+                    f"serve: {len(result['cancelled'])} caller-cancelled "
+                    f"request(s) but the runtime shed counter saw only "
+                    f"{got} (silent cancelled-future drop)")
         mismatched = [i for i, rec in result["completed"].items()
                       if rec != self.baseline[i]]
         if mismatched:
@@ -1236,7 +1270,7 @@ class ChaosCampaign:
         report = CampaignReport(
             seed=self.seed, coverage={s: 0 for s in ALL_SITES})
         acct = {"submitted": 0, "completed": 0, "shed": 0, "failed": 0,
-                "lost": 0}
+                "lost": 0, "cancelled": 0}
         for idx, sch in enumerate(schedules):
             res = self.run_schedule(sch)
             res["index"] = idx

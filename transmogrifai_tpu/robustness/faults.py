@@ -50,6 +50,11 @@ site                         fires in
                              degrades the batch to the eager path; like
                              ``plan.*``, ``serve.*`` sites do NOT disable
                              the transform planner)
+``serve.complete``           in the pipelined completer, before flattening
+                             a device result (fires only with
+                             ``TG_SERVE_PIPELINE`` > 1; the failure counts
+                             against the *dispatching* flush and the batch
+                             degrades to the eager path)
 ``stream.read``              in the chunk-feed producer thread, before each
                              chunk is pulled from the ChunkSource
                              (streaming/feed.py; errors — preemption
@@ -310,6 +315,10 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
           "batch degrades to the eager per-row path, bit-equal"),
     _site("serve.dispatch", "raise", "serving/runtime.py", "serve",
           "breaker counts the failure; batch degrades eager, bit-equal"),
+    _site("serve.complete", "raise", "serving/runtime.py", "serve",
+          "pipelined completion-side failure: the breaker counts it "
+          "against the dispatching flush; batch degrades eager, "
+          "bit-equal (fires only with TG_SERVE_PIPELINE > 1)"),
     _site("stream.read", "raise|preempt", "streaming/feed.py", "stream",
           "error forwards through the queue; preemption resumes "
           "bit-exactly from the last committed chunk"),

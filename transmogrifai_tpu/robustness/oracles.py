@@ -27,7 +27,10 @@ import threading
 from typing import Iterable, List, Optional
 
 #: thread-name prefixes owned by framework worker threads; anything alive
-#: with one of these names after a close/teardown is a leak
+#: with one of these names after a close/teardown is a leak. ``tg-serve``
+#: prefix-matches both the batcher (``tg-serve[<model>]``) and the
+#: pipelined completer (``tg-serve-completer[<model>]``), so the no-leak
+#: sweep covers the whole serving dataplane automatically.
 THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog",
                    "tg-sampler", "tg-fleet", "tg-net")
 

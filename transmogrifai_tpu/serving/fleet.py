@@ -241,7 +241,11 @@ class Replica:
     def kill(self) -> None:
         """Simulate a replica crash: no drain — every queued request's
         future fails (RuntimeStoppedError), which the front door
-        classifies as replica loss and fails over."""
+        classifies as replica loss and fails over. Flushes already in
+        the pipelined dataplane (dispatched, awaiting completion) still
+        resolve with real records via the completer drain — so with
+        ``TG_SERVE_PIPELINE`` > 1 a kill loses zero futures either way:
+        in-flight work completes, queued work fails over."""
         self._dead = True
         self.state = DEAD
         self.registry.close(drain=False)

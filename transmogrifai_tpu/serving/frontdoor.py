@@ -394,7 +394,10 @@ class FrontDoor:
                     "error": (f"{type(error).__name__}: {error}"[:200]
                               if error else None)})
         # closing without drain fails every queued future — each failure
-        # re-enters _on_inner_done and fails over to a survivor
+        # re-enters _on_inner_done and fails over to a survivor; flushes
+        # already in the dead replica's pipelined dataplane complete with
+        # real records during the close (completer drain), so depth > 1
+        # adds no lost futures
         rep.kill()
         self._set_replica_gauges()
 

@@ -24,6 +24,13 @@ report fields appear when the target exposes them: ``shedNoReplica``
 failover budget exhausted / no healthy replica; part of the accounting
 identity) and ``fleet`` (per-replica routing distribution, failovers,
 ejections, kills, scale events).
+
+Allocation rate matters at high RPS: the wire driver's per-connection
+``WireClient`` reuses one growable encode scratch per connection
+(``netproto.encode_binary_request(scratch=...)``), so steady-state TGB1
+framing allocates nothing on the send side — the buffer grows once to
+the largest frame and stays. A generator that mallocs a fresh frame per
+request at 10k rps measures its own allocator, not the server.
 """
 from __future__ import annotations
 

@@ -167,8 +167,20 @@ def encode_binary_request(rows: List[Dict[str, Any]],
                           tenant: Optional[str] = None,
                           token: Optional[str] = None,
                           deadline_ms: Optional[float] = None,
-                          model: Optional[str] = None) -> bytes:
-    """One request frame carrying ``rows`` as column blocks."""
+                          model: Optional[str] = None,
+                          scratch: Optional[bytearray] = None) -> bytes:
+    """One request frame carrying ``rows`` as column blocks.
+
+    ``scratch`` is an optional growable reuse buffer: the frame is
+    assembled in place (header reserved up front, then packed over) and
+    the *same bytearray* is returned, so a steady-state connection stops
+    allocating a fresh frame per request — the buffer grows to the
+    largest frame the connection ever sent and stays there. The returned
+    buffer is only valid until the next encode into the same scratch;
+    ``WireClient`` keeps one per connection and hands it straight to
+    ``sendall`` (which takes any buffer), never holding it across
+    requests. Without ``scratch`` the function returns immutable
+    ``bytes`` as before."""
     names, cols = columns_from_rows(rows)
     col_meta = []
     blocks = []
@@ -188,8 +200,16 @@ def encode_binary_request(rows: List[Dict[str, Any]],
     if model is not None:
         header["model"] = model
     hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    payload = _U16.pack(len(hdr)) + hdr + b"".join(blocks)
-    return FRAME_HEADER.pack(MAGIC, KIND_REQUEST, len(payload)) + payload
+    buf = bytearray() if scratch is None else scratch
+    del buf[:]  # drop the previous frame, keep the capacity
+    buf += b"\x00" * FRAME_HEADER.size
+    buf += _U16.pack(len(hdr))
+    buf += hdr
+    for block in blocks:
+        buf += block
+    FRAME_HEADER.pack_into(buf, 0, MAGIC, KIND_REQUEST,
+                           len(buf) - FRAME_HEADER.size)
+    return buf if scratch is not None else bytes(buf)
 
 
 def encode_binary_response(status: int, obj: Dict[str, Any]) -> bytes:
@@ -401,6 +421,9 @@ class WireClient:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[_SockReader] = None
+        # per-connection encode scratch: binary frames are assembled in
+        # this growable buffer instead of allocating bytes per request
+        self._scratch = bytearray()
 
     # -- lifecycle ----------------------------------------------------------
     def connect(self) -> "WireClient":
@@ -457,7 +480,8 @@ class WireClient:
         if self.protocol == "binary":
             self._sock.sendall(encode_binary_request(
                 rows, tenant=self.tenant, token=self.token,
-                deadline_ms=deadline_ms, model=model))
+                deadline_ms=deadline_ms, model=model,
+                scratch=self._scratch))
             magic, kind, ln = FRAME_HEADER.unpack(
                 self._reader.read_exact(FRAME_HEADER.size))
             if magic != MAGIC:

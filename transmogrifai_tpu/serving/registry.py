@@ -318,6 +318,10 @@ class ModelRegistry:
 
     # -- lifecycle -----------------------------------------------------------
     def close(self, drain: bool = True) -> None:
+        # runtime.close drains the pipelined dataplane too: the batcher
+        # retires first (failing still-queued futures typed when
+        # drain=False), then the completer resolves every in-flight
+        # flush before its join — zero lost futures at any depth
         with self._lock:
             rts = list(self._runtimes.values())
             self._runtimes.clear()

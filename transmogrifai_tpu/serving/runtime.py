@@ -44,10 +44,27 @@ for free, rebuilt for the serving tier):
   breaker so the *next* batches degrade instead of queueing behind the
   wedge. ``close()`` likewise refuses to silently discard a batcher that
   outlives its join timeout — the leak is recorded the same way.
+* **pipelined dataplane** — with ``TG_SERVE_PIPELINE`` > 1 (default 2)
+  the per-model loop splits into three overlapped stages: the batcher
+  *gathers* (take-batch, deadline shed, one pooled columnar gather per
+  flush — local/scoring.ServeStages) and *dispatches* (launches the
+  compiled program via JAX async dispatch, no blocking), then hands the
+  in-flight device result to a ``tg-serve-completer[<name>]`` thread
+  that *completes* flushes strictly in flush order: block on device
+  results, vectorized record flattening, ``_finish`` accounting + future
+  resolution, drift fold — all off the batcher's critical path. Depth 1
+  is byte-for-byte today's serial loop (selectable for A/B); records
+  are bit-equal across depths because per-row results are independent
+  of batching. Failures surface at completion but count against the
+  dispatching flush; breaker-open and ``oom.serve`` downshift ladders
+  drain the pipeline and run serially. Per-stage
+  ``tg_serve_stage_seconds{stage}`` histograms attribute which stage
+  bounds throughput (docs/serving.md "Pipelined dataplane").
 
 Failure injection: the ``serve.enqueue`` / ``serve.flush`` /
-``serve.dispatch`` / ``oom.serve`` chaos sites (robustness/faults.py)
-make every one of those paths deterministically testable.
+``serve.dispatch`` / ``serve.complete`` / ``oom.serve`` chaos sites
+(robustness/faults.py) make every one of those paths deterministically
+testable.
 
 Metrics: every instrument is kept in a **serve-local**
 ``MetricsRegistry`` (always on — health/SLO snapshots must work with
@@ -68,7 +85,8 @@ from dataclasses import dataclass
 from typing import Any, Deque, Dict, List, Optional, Sequence
 
 from ..local.scoring import (
-    SCORE_ERROR_KEY, micro_batch_score_function, score_function,
+    SCORE_ERROR_KEY, ScoreSchemaError, ServeStages,
+    micro_batch_score_function, score_function,
 )
 from ..observability import blackbox as _blackbox
 from ..observability import ledger as _obs_ledger
@@ -127,7 +145,11 @@ class ServeConfig:
 
     ``max_batch`` defaults to the plan compiler's minimum padding bucket
     (utils/padding.py: 256): every flush of up to ``max_batch`` rows pads
-    to the same bucket, so ONE compiled program serves all of them."""
+    to the same bucket, so ONE compiled program serves all of them.
+
+    ``pipeline_depth`` bounds how many flushes may be in flight at once
+    (``TG_SERVE_PIPELINE``): 1 runs today's serial loop; >= 2 enables the
+    gather/dispatch/complete pipeline with a completer thread."""
     max_batch: int = 256
     max_queue: int = 1024
     max_wait_ms: float = 2.0
@@ -135,6 +157,7 @@ class ServeConfig:
     breaker_failures: int = 3
     breaker_reset_ms: float = 500.0
     drain_on_close: bool = True
+    pipeline_depth: int = 2
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -146,6 +169,7 @@ class ServeConfig:
             breaker_failures=_env_int("TG_SERVE_BREAKER_FAILURES", 3),
             breaker_reset_ms=_env_float(
                 "TG_SERVE_BREAKER_RESET_MS", 500.0) or 500.0,
+            pipeline_depth=max(1, _env_int("TG_SERVE_PIPELINE", 2)),
         )
 
 
@@ -164,6 +188,34 @@ class _Request:
     #: feed per-tenant SLO budgets (observability/slo.py); flows through
     #: the TG_METRICS_MAX_LABELS cardinality bound like any label
     tenant: Optional[str] = None
+
+
+@dataclass
+class _Flush:
+    """One in-flight flush handed from the batcher to the completer.
+
+    ``kind`` names which completion path applies:
+
+    * ``device`` — the compiled program was launched; ``scored`` holds
+      the (possibly still computing) device-result table to block on.
+    * ``eager`` — the flush already degraded in the batcher
+      (``serve.flush`` fault); the completer scores it per-row.
+    * ``quarantine`` — gather/dispatch raised the micro-batch quarantine
+      family (ScoreSchemaError/TypeError/ValueError); the completer
+      re-scores through the monolithic scorer so quarantined records are
+      bit-equal to the serial path's.
+    * ``oom`` — the launch exhausted memory; the completer runs the
+      adaptive downshift ladder (splits re-fire ``oom.serve`` exactly
+      like the serial recursion).
+    * ``error`` — a non-resource dispatch failure; the completer counts
+      it against the breaker (the dispatching flush) and degrades.
+    """
+    reqs: List[_Request]
+    kind: str
+    scored: Any = None
+    rows: Optional[List[Dict[str, Any]]] = None
+    err: Optional[BaseException] = None
+    site: str = "serve.dispatch"
 
 
 #: live (started, not yet closed) runtimes — the conftest no-leak fixture
@@ -200,6 +252,12 @@ class ServingRuntime:
         self.config = config or ServeConfig.from_env()
         #: serve-local instruments — always on (see module docstring)
         self.metrics = metrics_registry or _obs_metrics.MetricsRegistry()
+        #: memoized (serve-local, global-mirror) instrument handles — the
+        #: hot-path counters/histograms skip the registry's per-call
+        #: lock + dict resolution (keyed (kind, name, labels); entries
+        #: revalidate against the live global registry so metrics.reset()
+        #: or set_registry() can never leave a stale mirror bound)
+        self._metric_cache: Dict[Any, Any] = {}
         #: serve-scoped fault accounting (ring-bounded; TG_FAULTS_MAX)
         self.fault_log = fault_log or FaultLog()
         #: online distribution monitor (serving/drift.py); every scored
@@ -220,6 +278,20 @@ class ServingRuntime:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._heart = None  # watchdog heartbeat (set in start())
+        #: pipelined dataplane state (module docstring "pipelined
+        #: dataplane"); depth 1 = serial, no completer thread
+        self.pipeline_depth = max(1, int(self.config.pipeline_depth))
+        self._stages = ServeStages(model)
+        self._pipe: Deque[_Flush] = deque()
+        self._pipe_cond = threading.Condition()
+        self._pipe_busy = 0          # flushes popped but still completing
+        self._producer_done = False  # batcher exited; completer may drain
+        self._completer: Optional[threading.Thread] = None
+        self._completer_heart = None
+        #: memory-pressure backoff: after any resource exhaustion the next
+        #: flush drains the pipeline and runs serially (one clean serial
+        #: flush clears it — the pipelined analog of a half-open probe)
+        self._oom_serial = False
         #: windowed time-series source over the serve-local registry
         #: (None when TG_SAMPLER=0; set in start(), detached in close())
         self.sampler: Optional[_timeseries.MetricsSampler] = None
@@ -264,6 +336,17 @@ class ServingRuntime:
                                 runtime=self)
                 for spec in _slo.specs_for(self.name)]
             self.sampler.on_sample.append(self._evaluate_slo)
+        if self.pipeline_depth > 1 and self._completer is None:
+            # the completer gets its own heart: a wedged device wait
+            # (stage complete blocks on results) must surface exactly
+            # like a wedged batcher dispatch
+            self._completer_heart = _watchdog.register(
+                f"tg-serve-completer[{self.name}]", kind="serve.completer",
+                on_stall=self._on_watchdog_stall, fault_log=self.fault_log)
+            self._completer = threading.Thread(
+                target=self._completer_loop,
+                name=f"tg-serve-completer[{self.name}]", daemon=True)
+            self._completer.start()
         self._thread = threading.Thread(
             target=self._loop, name=f"tg-serve[{self.name}]", daemon=True)
         self._thread.start()
@@ -288,21 +371,28 @@ class ServingRuntime:
                         f"runtime '{self.name}' closed before dispatch"))
                 self._set_gauge("tg_serve_queue_depth", 0.0)
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            if self._thread.is_alive():
-                # never discard a still-alive batcher silently: record the
+        for t in (self._thread, self._completer):
+            if t is None:
+                continue
+            # the batcher joins first: its exit marks the pipe done, which
+            # is what lets the completer drain every in-flight flush
+            # (zero lost futures) and retire
+            t.join(timeout=30)
+            if t.is_alive():
+                # never discard a still-alive worker silently: record the
                 # stall (serve-local counter + FaultLog + global series)
                 self.metrics.counter(
                     "tg_watchdog_stalls_total",
                     "thread stalls (docs/robustness.md)",
                     model=self.name, site="serve.close").inc()
                 _watchdog.report_thread_stalled(
-                    site="serve.close", thread_name=self._thread.name,
+                    site="serve.close", thread_name=t.name,
                     waited_s=30.0, fault_log=self.fault_log,
                     model=self.name)
         if self._heart is not None:
             self._heart.close()
+        if self._completer_heart is not None:
+            self._completer_heart.close()
         _timeseries.detach(self.sampler)
         self.sampler = None
         with self._cond:
@@ -414,18 +504,38 @@ class ServingRuntime:
             model=self.name, site="serve.batcher").inc()
 
     def _loop(self) -> None:
-        while True:
-            self._beat()
-            batch = self._take_batch()
-            if batch is None:
-                return
-            if not batch:
-                continue
-            try:
-                self._flush(batch)
-            except Exception as e:  # belt-and-braces: never kill the loop
-                for r in batch:
-                    self._fail_future(r.future, e)
+        try:
+            while True:
+                self._beat()
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                if not batch:
+                    continue
+                try:
+                    if (self.pipeline_depth > 1 and not self._oom_serial
+                            and self.breaker.state == CLOSED):
+                        self._flush_pipelined(batch)
+                    else:
+                        # breaker not closed (open / half-open probe) or
+                        # memory-pressure backoff: drain the in-flight
+                        # pipeline, then run this flush serially — the
+                        # degraded ladders keep their exact serial shape
+                        was_backoff = self._oom_serial
+                        self._drain_pipe()
+                        self._flush(batch)
+                        if was_backoff:
+                            self._oom_serial = False
+                except Exception as e:  # belt-and-braces: never kill the loop
+                    for r in batch:
+                        self._fail_future(r.future, e)
+        finally:
+            # unblock the completer: it drains whatever is still in the
+            # pipe (in flush order) and retires — no future is ever
+            # dropped by shutdown
+            with self._pipe_cond:
+                self._producer_done = True
+                self._pipe_cond.notify_all()
 
     def _take_batch(self) -> Optional[List[_Request]]:
         """Block until a batch is ready: a full ``max_batch``, the oldest
@@ -451,6 +561,53 @@ class ServingRuntime:
             return batch
 
     def _flush(self, batch: List[_Request]) -> None:
+        # stage attribution twin of the pipelined histograms: one serial
+        # flush is gather+dispatch+complete fused, recorded as
+        # stage="serial" so the bench A/B can compare like with like
+        t0 = time.perf_counter()
+        try:
+            with _obs_span("serve.flush", cat="serve", model=self.name,
+                           rows=len(batch)):
+                _blackbox.record("serve.flush", model=self.name,
+                                 rows=len(batch),
+                                 queueDepth=self.queue_depth())
+                alive = self._shed_expired(batch)
+                if not alive:
+                    return
+                try:
+                    # chaos: a fault assembling the batch (the batching
+                    # layer itself failing) — requests degrade, they do
+                    # not fail
+                    faults.inject("serve.flush", key=self.name)
+                except Exception as e:
+                    self._record_degraded("serve.flush", len(alive),
+                                          error=e)
+                    self._finish(alive, self._eager_records(alive),
+                                 degraded=True)
+                    return
+                self._dispatch(alive)
+        finally:
+            self._observe_stage("serial", time.perf_counter() - t0)
+
+    # -- pipelined dataplane --------------------------------------------------
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        self._observe("tg_serve_stage_seconds", seconds,
+                      help="per-pipeline-stage wall time (gather / "
+                      "dispatch / complete; stage=serial is one whole "
+                      "serial flush — docs/observability.md)", stage=stage)
+
+    def _flush_pipelined(self, batch: List[_Request]) -> None:
+        """Stages gather + dispatch on the batcher thread and hands the
+        in-flight flush to the completer. Mirrors ``_flush``/``_dispatch``
+        step for step — spans, blackbox records, chaos sites, exception
+        classification — except nothing here blocks on device results:
+        the compiled launch is asynchronous, so the batcher turns around
+        and forms the next flush while the device computes this one."""
+        # bound the in-flight depth: slots count queued + still-completing
+        with self._pipe_cond:
+            while len(self._pipe) + self._pipe_busy >= self.pipeline_depth:
+                self._beat()
+                self._pipe_cond.wait(0.05)
         with _obs_span("serve.flush", cat="serve", model=self.name,
                        rows=len(batch)):
             _blackbox.record("serve.flush", model=self.name,
@@ -460,15 +617,252 @@ class ServingRuntime:
             if not alive:
                 return
             try:
-                # chaos: a fault assembling the batch (the batching layer
-                # itself failing) — requests degrade, they do not fail
                 faults.inject("serve.flush", key=self.name)
             except Exception as e:
+                # same meaning as serial: the batching layer failed, the
+                # requests degrade (counted here, against this flush) —
+                # the completer only scores them eagerly, in flush order
                 self._record_degraded("serve.flush", len(alive), error=e)
-                self._finish(alive, self._eager_records(alive),
+                self._pipe_push(_Flush(alive, "eager", err=e,
+                                       site="serve.flush"))
+                return
+            rows = [r.row for r in alive]
+            with _obs_span("serve.dispatch", cat="serve",
+                           model=self.name, rows=len(rows)), \
+                    _obs_ledger.subsystem_scope("serve"), \
+                    _blackbox.correlated(alive[0].corr):
+                _blackbox.record("serve.dispatch", model=self.name,
+                                 rows=len(rows))
+                try:
+                    # chaos order matches the serial path exactly:
+                    # serve.dispatch, then oom.serve (which the serial
+                    # _score_adaptive fires before its scorer call; the
+                    # downshift halves re-fire it in the completer's
+                    # ladder, so injection call counts are identical)
+                    faults.inject("serve.dispatch", key=self.name)
+                    faults.inject("oom.serve", key=self.name)
+                except Exception as e:
+                    if resources.classify_exhaustion(e) is not None:
+                        # memory pressure: flushes after this one run
+                        # serially until a clean serial flush clears the
+                        # backoff (the pipelined half-open analog)
+                        self._oom_serial = True
+                        self._pipe_push(_Flush(alive, "oom", rows=rows,
+                                               err=e))
+                    else:
+                        self._pipe_push(_Flush(alive, "error", rows=rows,
+                                               err=e,
+                                               site="serve.dispatch"))
+                    return
+                try:
+                    t0 = time.perf_counter()
+                    table = self._stages.gather(rows)
+                    t1 = time.perf_counter()
+                    scored = self._stages.dispatch(table)
+                    t2 = time.perf_counter()
+                except (ScoreSchemaError, TypeError, ValueError) as e:
+                    # the monolithic scorer's quarantine family: the
+                    # completer re-scores through it so quarantined
+                    # records stay bit-equal to serial
+                    self._pipe_push(_Flush(alive, "quarantine",
+                                           rows=rows, err=e))
+                    return
+                except Exception as e:
+                    if resources.classify_exhaustion(e) is not None:
+                        self._oom_serial = True
+                        self._pipe_push(_Flush(alive, "oom", rows=rows,
+                                               err=e))
+                    else:
+                        self._pipe_push(_Flush(alive, "error", rows=rows,
+                                               err=e,
+                                               site="serve.dispatch"))
+                    return
+            self._observe_stage("gather", t1 - t0)
+            self._observe_stage("dispatch", t2 - t1)
+            self._pipe_push(_Flush(alive, "device", scored=scored,
+                                   rows=rows))
+
+    def _pipe_push(self, fl: _Flush) -> None:
+        with self._pipe_cond:
+            self._pipe.append(fl)
+            self._pipe_cond.notify_all()
+
+    def _pipe_pop(self) -> Optional[_Flush]:
+        """Completer side: next flush in flush order, or None when the
+        batcher has retired and the pipe is fully drained."""
+        with self._pipe_cond:
+            while not self._pipe and not self._producer_done:
+                h = self._completer_heart
+                if h is not None:
+                    h.beat()
+                self._pipe_cond.wait(0.05)
+            if not self._pipe:
+                return None
+            fl = self._pipe.popleft()
+            self._pipe_busy += 1
+            self._pipe_cond.notify_all()
+            return fl
+
+    def _drain_pipe(self) -> None:
+        """Batcher side: block until every in-flight flush has fully
+        completed. The serial fallbacks (breaker open / half-open probe,
+        memory backoff, belt-and-braces) must observe a quiet pipe so
+        flush-order resolution and the breaker's single-probe discipline
+        hold; with depth 1 the pipe is always empty and this is a no-op."""
+        with self._pipe_cond:
+            while self._pipe or self._pipe_busy:
+                self._beat()
+                self._pipe_cond.wait(0.05)
+
+    def _completer_loop(self) -> None:
+        while True:
+            h = self._completer_heart
+            if h is not None:
+                h.beat()
+            fl = self._pipe_pop()
+            if fl is None:
+                return
+            try:
+                self._complete(fl)
+            except Exception as e:  # belt-and-braces: never drop futures
+                for r in fl.reqs:
+                    self._fail_future(r.future, e)
+            finally:
+                with self._pipe_cond:
+                    self._pipe_busy -= 1
+                    self._pipe_cond.notify_all()
+
+    def _complete(self, fl: _Flush) -> None:
+        """Stage complete (completer thread): resolve one flush exactly
+        as the serial path would — breaker accounting charged to the
+        dispatching flush, ``_finish`` counting before resolving, drift
+        fold — all off the batcher's critical path."""
+        reqs = fl.reqs
+        rows = fl.rows if fl.rows is not None else [r.row for r in reqs]
+        if fl.kind == "eager":
+            # _record_degraded already ran in the batcher (serve.flush)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        if fl.kind == "error":
+            # a non-resource dispatch failure surfaces here but counts
+            # against the dispatching flush — same breaker arithmetic,
+            # same degraded accounting, as the serial _dispatch handler
+            self.breaker.record_failure(error=fl.err)
+            self._record_degraded(fl.site, len(reqs), error=fl.err)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        if fl.kind == "oom":
+            self._complete_oom(reqs, rows, fl.err)
+            return
+        if fl.kind == "quarantine":
+            self._complete_quarantine(reqs, rows)
+            return
+        # kind == "device": block on the async result and flatten
+        t0 = time.perf_counter()
+        try:
+            # chaos: a fault here models completion-side failure (a
+            # poisoned device result, a transfer error while blocking)
+            faults.inject("serve.complete", key=self.name)
+        except Exception as e:
+            if resources.classify_exhaustion(e) is not None:
+                self._oom_serial = True
+                self._complete_oom(reqs, rows, e)
+                return
+            self.breaker.record_failure(error=e)
+            self._record_degraded("serve.complete", len(reqs), error=e)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        try:
+            with _obs_ledger.subsystem_scope("serve"), \
+                    _blackbox.correlated(reqs[0].corr):
+                recs = self._stages.flatten(fl.scored, len(reqs))
+        except (ScoreSchemaError, TypeError, ValueError):
+            self._complete_quarantine(reqs, rows)
+            return
+        except Exception as e:
+            if resources.classify_exhaustion(e) is not None:
+                self._oom_serial = True
+                self._complete_oom(reqs, rows, e)
+                return
+            self.breaker.record_failure(error=e)
+            self._record_degraded("serve.complete", len(reqs), error=e)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        self._observe_stage("complete", time.perf_counter() - t0)
+        self.breaker.record_success()
+        self._finish(reqs, recs, degraded=False)
+
+    def _complete_quarantine(self, reqs: List[_Request],
+                             rows: List[Dict[str, Any]]) -> None:
+        """A pipelined flush hit the quarantine family
+        (ScoreSchemaError/TypeError/ValueError): re-score through the
+        monolithic micro-batch scorer, whose per-row isolation produces
+        exactly the records the serial path would have — valid rows score,
+        offenders come back quarantined under ``__score_error__``."""
+        try:
+            with _obs_ledger.subsystem_scope("serve"), \
+                    _blackbox.correlated(reqs[0].corr):
+                recs = self._scorer(rows)
+        except Exception as e:
+            # terminal fallback, mirroring _dispatch's handlers
+            if resources.classify_exhaustion(e) is not None:
+                self._record_degraded("oom.serve", len(rows), error=e)
+            else:
+                self.breaker.record_failure(error=e)
+                self._record_degraded("serve.dispatch", len(rows),
+                                      error=e)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        self.breaker.record_success()
+        self._finish(reqs, recs, degraded=False)
+
+    def _complete_oom(self, reqs: List[_Request],
+                      rows: List[Dict[str, Any]],
+                      err: Optional[BaseException]) -> None:
+        """The adaptive downshift ladder for a pipelined flush whose
+        launch (or completion) exhausted memory: identical reports,
+        counters, and split shape to the serial ``_score_adaptive``
+        recursion — the halves go back through ``_score_adaptive``
+        itself, so they re-fire ``oom.serve`` exactly like serial
+        retries, and resource faults still never feed the breaker."""
+        n = len(rows)
+        try:
+            with _obs_ledger.subsystem_scope("serve"), \
+                    _blackbox.correlated(reqs[0].corr):
+                if n <= 1:
+                    raise err  # a singleton still exhausts → eager
+                mid = n // 2
+                self.fault_log.add(FaultReport(
+                    site="oom.serve", kind="oom_downshift",
+                    detail={"model": self.name, "rows": n,
+                            "splitRows": [mid, n - mid],
+                            "error": f"{type(err).__name__}: {err}"[:200]}))
+                self._count("tg_oom_total", site="oom.serve",
+                            help="resource-exhaustion events by site "
+                            "(docs/robustness.md)")
+                self._count("tg_oom_downshift_total",
+                            help="adaptive downshifts after resource "
+                            "exhaustion (docs/robustness.md)")
+                _postmortem.trigger(
+                    "oom_downshift", fault_log=self.fault_log,
+                    metrics=self.metrics,
+                    detail={"site": "oom.serve", "model": self.name,
+                            "rows": n,
+                            "error": f"{type(err).__name__}: {err}"[:200]})
+                recs = (self._score_adaptive(rows[:mid])
+                        + self._score_adaptive(rows[mid:]))
+        except Exception as e:
+            if resources.classify_exhaustion(e) is not None:
+                self._record_degraded("oom.serve", n, error=e)
+                self._finish(reqs, self._eager_records(reqs),
                              degraded=True)
                 return
-            self._dispatch(alive)
+            self.breaker.record_failure(error=e)
+            self._record_degraded("serve.dispatch", n, error=e)
+            self._finish(reqs, self._eager_records(reqs), degraded=True)
+            return
+        self.breaker.record_success()
+        self._finish(reqs, recs, degraded=False)
 
     def _shed_expired(self, batch: List[_Request]) -> List[_Request]:
         """Deadline enforcement happens HERE, after dequeue and before any
@@ -489,6 +883,16 @@ class ServingRuntime:
                     f"{(now - r.enqueued) * 1000:.1f}ms in queue "
                     f"(model '{self.name}'); shed before dispatch"))
             elif r.future.cancelled():
+                # a caller cancelled after enqueue: without a typed
+                # bucket the request would silently vanish from
+                # submitted = completed + typed sheds
+                self._count("tg_serve_shed_total", reason="cancelled",
+                            help="requests shed (docs/serving.md)")
+                if r.tenant is not None:
+                    self._count_tenant("tg_serve_tenant_shed_total",
+                                       r.tenant)
+                _blackbox.record("serve.shed", corr=r.corr,
+                                 model=self.name, reason="cancelled")
                 continue
             else:
                 alive.append(r)
@@ -646,9 +1050,10 @@ class ServingRuntime:
                 r.future.set_result(rec)
             except InvalidStateError:
                 continue  # cancelled while in flight
-        # drift fold AFTER every future resolved: still on the batcher
-        # thread (off the request hot path), post-quarantine, and fenced —
-        # nothing past this line can affect a response
+        # drift fold AFTER every future resolved: still off the request
+        # hot path (the batcher thread when serial, the completer when
+        # pipelined), post-quarantine, and fenced — nothing past this
+        # line can affect a response
         self._drift_observe(reqs, recs)
 
     def _drift_observe(self, reqs: Sequence[_Request],
@@ -703,10 +1108,40 @@ class ServingRuntime:
                 detail={"model": self.name, "state": state,
                         "queueDepth": self.queue_depth()})
 
+    def _instruments(self, kind: str, name: str, help: str,
+                     labels: Dict[str, str]):
+        """Memoized ``(serve-local, global-mirror)`` instrument pair for
+        the hot-path helpers below: the registry's per-call lock + label
+        resolution runs once per (kind, name, labels) instead of once per
+        request. Entries revalidate against the *live* global registry
+        (and the enabled switch) by identity, so ``metrics.reset()`` /
+        ``set_registry()`` / ``enable_metrics()`` can never leave a stale
+        mirror bound — disabled metrics still mean zero global writes."""
+        key = (kind, name, tuple(sorted(labels.items())))
+        greg = (_obs_metrics.registry()
+                if _obs_metrics.metrics_enabled() else None)
+        ent = self._metric_cache.get(key)
+        if ent is not None and ent[1] is greg:
+            return ent[0], ent[2]
+        if len(self._metric_cache) > 4096:
+            # the registries already bound label cardinality
+            # (TG_METRICS_MAX_LABELS → __other__); this is only a backstop
+            # against unbounded memoization across registry swaps
+            self._metric_cache.clear()
+        local = getattr(self.metrics, kind)(
+            name, help, model=self.name, **labels)
+        mirror = (None if greg is None else
+                  getattr(greg, kind)(name, help, model=self.name,
+                                      **labels))
+        self._metric_cache[key] = (local, greg, mirror)
+        return local, mirror
+
     def _count(self, name: str, n: float = 1.0, help: str = "",
                **labels: str) -> None:
-        self.metrics.counter(name, help, model=self.name, **labels).inc(n)
-        _obs_metrics.inc_counter(name, n, help, model=self.name, **labels)
+        local, mirror = self._instruments("counter", name, help, labels)
+        local.inc(n)
+        if mirror is not None:
+            mirror.inc(n)
 
     def _count_tenant(self, name: str, tenant: str, n: float = 1.0) -> None:
         """Per-tenant twin counter (serve-local + gated global mirror);
@@ -755,14 +1190,19 @@ class ServingRuntime:
         return tenants or None
 
     def _observe(self, name: str, v: float, help: str = "",
-                 exemplar: Any = None) -> None:
-        self.metrics.histogram(name, help, model=self.name).observe(
-            v, exemplar=exemplar)
-        _obs_metrics.observe(name, v, help, model=self.name)
+                 exemplar: Any = None, **labels: str) -> None:
+        local, mirror = self._instruments("histogram", name, help, labels)
+        # exemplars live on the serve-local series only (as before)
+        local.observe(v, exemplar=exemplar)
+        if mirror is not None:
+            mirror.observe(v)
 
-    def _set_gauge(self, name: str, v: float, help: str = "") -> None:
-        self.metrics.gauge(name, help, model=self.name).set(v)
-        _obs_metrics.set_gauge(name, v, help, model=self.name)
+    def _set_gauge(self, name: str, v: float, help: str = "",
+                   **labels: str) -> None:
+        local, mirror = self._instruments("gauge", name, help, labels)
+        local.set(v)
+        if mirror is not None:
+            mirror.set(v)
 
     @staticmethod
     def _fail_future(fut: Future, exc: BaseException) -> None:
@@ -805,7 +1245,13 @@ class ServingRuntime:
                                          reason="overload"),
                 "deadline": self._series(snap, "tg_serve_shed_total",
                                          reason="deadline"),
+                "cancelled": self._series(snap, "tg_serve_shed_total",
+                                          reason="cancelled"),
             },
+            # pipelined dataplane state: configured depth and the flushes
+            # currently between dispatch and completion (0 when serial)
+            "pipeline": {"depth": self.pipeline_depth,
+                         "inFlight": len(self._pipe) + self._pipe_busy},
             "faults": {"reports": len(self.fault_log.reports),
                        "dropped": self.fault_log.dropped,
                        # adaptive flush splits under memory pressure and

@@ -17,7 +17,11 @@ two halves:
   flush of up to ``max_batch`` rows lands in — so the first real request
   is served from warm caches. The recorded fingerprint is verified
   against the loaded model's (a mismatch means the plan cache would miss
-  — reported in the health snapshot, never fatal).
+  — reported in the health snapshot, never fatal). One warm pass covers
+  BOTH serve paths: the serial monolithic scorer and the pipelined
+  gather/dispatch stages (``local/scoring.ServeStages``) build
+  byte-identical tables, so they key the same fingerprinted plan-cache
+  entry — the pipelined first flush is warm too, no second trace.
 """
 from __future__ import annotations
 
