@@ -1292,13 +1292,24 @@ def _run_serve(platform):
 
 
 def _run_stream(platform):
-    """BENCH_MODE=stream: the out-of-core line (docs/streaming.md). Trains
-    vectorize → sanity-check → streaming-GBT over a BENCH_STREAM_ROWS ×
-    BENCH_STREAM_FEATURES synthetic chunk source (default 10M × 64 —
-    ~2.5 GB of feature data, regenerated deterministically per pass, never
-    materialized) and reports end-to-end rows/sec, uploaded bytes, the
-    peak device-resident bytes (the O(chunk) bound — asserted), and the
-    transfer/compute overlap fraction from the double-buffered feed."""
+    """BENCH_MODE=stream: out-of-core input-engine A/B (docs/streaming.md).
+    Three arms train the SAME vectorize → sanity-check → streaming-GBT
+    pipeline (num_trees=2, max_depth=3 → 11 prep/grow passes over a
+    BENCH_STREAM_ROWS × BENCH_STREAM_FEATURES synthetic source, default
+    1M × 64, regenerated deterministically per pass, never materialized):
+
+      serial          TG_STREAM_WORKERS=1, prefetch 1, cache off
+      parallel        worker pool (4), prefetch 4, cache off
+      parallel+cache  worker pool + host transformed-chunk cache sized to
+                      hold the working set (passes ≥2 replay from RAM)
+
+    Per arm: rows/sec, read/transform/upload stage seconds, overlap
+    fraction, uploaded bytes, cache hit rate, and the O(chunk) residency
+    bound asserted at that arm's prefetch. Across arms: the fitted models
+    must score bit-identically (the engine is an optimization, not a
+    semantic change), and on ≥2 cores the pinned tripwires hold —
+    parallel ≥ serial throughput, cached-arm upload bytes cut ≥3×."""
+    import numpy as np
     import transmogrifai_tpu as tg
     from transmogrifai_tpu.features import FeatureBuilder
     from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
@@ -1306,51 +1317,124 @@ def _run_stream(platform):
         SyntheticChunkSource, StreamingGBT, env_chunk_rows)
     from transmogrifai_tpu.workflow import OpWorkflow
 
-    n = int(os.environ.get("BENCH_STREAM_ROWS", 10_000_000))
+    n = int(os.environ.get("BENCH_STREAM_ROWS", 1_000_000))
     d = int(os.environ.get("BENCH_STREAM_FEATURES", 64))
     chunk_rows = env_chunk_rows()
     source = SyntheticChunkSource(n, d, chunk_rows=chunk_rows, seed=0,
                                   problem="binary")
-    label = FeatureBuilder.RealNN("y").extract_field().as_response()
-    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
-             for i in range(d)]
-    checked = label.transform_with(SanityChecker(seed=1),
-                                   tg.transmogrify(feats))
-    pred = (StreamingGBT(problem="binary", num_trees=1, max_depth=3,
-                         n_bins=32, learning_rate=1.0)
-            .set_input(label, checked).get_output())
-    wf = OpWorkflow().set_result_features(pred)
-    smark = _ledger_mark()
-    t0 = time.perf_counter()
-    model = wf.train(stream=source)
-    wall = time.perf_counter() - t0
-    stats = model.summary()["streaming"]
-    # the O(chunk)-not-O(dataset) claim, enforced: at most prefetch+1
-    # (transformed) chunks device-resident, and the peak is a vanishing
-    # fraction of the raw dataset bytes
-    assert stats["peakDeviceBytes"] <= 2 * stats["maxChunkBytes"], stats
-    assert stats["peakDeviceBytes"] <= (n * d * 4) / 10, stats
-    passes = stats["rows"] / max(n, 1)
+    probe = source.read_chunk(0).table
+    # cache sized to hold every transformed chunk (raw + vectorized +
+    # masks ≈ a few × raw float bytes) so passes ≥2 are pure host replays
+    cache_fit_bytes = max(1 << 28, 6 * n * d * 4)
+    arms = [
+        ("serial", {"TG_STREAM_WORKERS": "1", "TG_STREAM_PREFETCH": "1",
+                    "TG_STREAM_CACHE_BYTES": "0"}, 1),
+        ("parallel", {"TG_STREAM_WORKERS": "4", "TG_STREAM_PREFETCH": "4",
+                      "TG_STREAM_CACHE_BYTES": "0"}, 4),
+        ("parallel_cache",
+         {"TG_STREAM_WORKERS": "4", "TG_STREAM_PREFETCH": "4",
+          "TG_STREAM_CACHE_BYTES": str(cache_fit_bytes)}, 4),
+    ]
+    results = {}
+    keys = ("TG_STREAM_WORKERS", "TG_STREAM_PREFETCH",
+            "TG_STREAM_CACHE_BYTES")
+    saved = {k: os.environ.get(k) for k in keys}
+    try:
+        for arm, env, prefetch in arms:
+            os.environ.update(env)
+            label = FeatureBuilder.RealNN("y").extract_field().as_response()
+            feats = [FeatureBuilder.Real(f"x{i}").extract_field()
+                     .as_predictor() for i in range(d)]
+            checked = label.transform_with(SanityChecker(seed=1),
+                                           tg.transmogrify(feats))
+            pred = (StreamingGBT(problem="binary", num_trees=2, max_depth=3,
+                                 n_bins=32, learning_rate=1.0)
+                    .set_input(label, checked).get_output())
+            wf = OpWorkflow().set_result_features(pred)
+            smark = _ledger_mark()
+            t0 = time.perf_counter()
+            model = wf.train(stream=source)
+            wall = time.perf_counter() - t0
+            stats = model.summary()["streaming"]
+            pf = [f for f in model.result_features][0]
+            scored = np.asarray(model.score(table=probe)[pf.name].values)
+            # the O(chunk)-not-O(dataset) claim at THIS arm's prefetch:
+            # at most prefetch+1 transformed chunks resident at once
+            assert (stats["peakDeviceBytes"]
+                    <= (prefetch + 1) * stats["maxChunkBytes"]), (arm, stats)
+            assert stats["peakResidentChunks"] <= prefetch + 1, (arm, stats)
+            if n * d * 4 >= 40 * stats["maxChunkBytes"]:
+                # ...and a vanishing fraction of the raw dataset bytes
+                # (meaningless at toy sizes where one chunk ≈ the dataset)
+                assert stats["peakDeviceBytes"] <= (n * d * 4) / 4, (arm,
+                                                                    stats)
+            results[arm] = {"wall": wall, "stats": stats, "smark": smark,
+                            "scored": scored.tobytes()}
+            print(json.dumps({
+                "metric": f"stream_train_rows_per_sec_{arm}_{n}rows_"
+                          f"{d}feat_{platform}",
+                "value": round(n / wall, 1),
+                "unit": "rows/sec",
+                # vs in-core is not meaningful (in-core cannot hold the
+                # table); report the read/transform↔upload overlap instead
+                "vs_baseline": round(stats["overlapFraction"], 3),
+                "phases": {
+                    "wallSecs": round(wall, 2),
+                    "passes": round(stats["rows"] / max(n, 1), 2),
+                    "chunks": stats["chunks"],
+                    "chunkRows": chunk_rows,
+                    "uploadBytes": stats["uploadBytes"],
+                    **_ledger_phases(smark),
+                    "maxChunkBytes": stats["maxChunkBytes"],
+                    "peakDeviceBytes": stats["peakDeviceBytes"],
+                    "peakResidentChunks": stats["peakResidentChunks"],
+                    "overlapFraction": stats["overlapFraction"],
+                    "readSeconds": stats["readSeconds"],
+                    "transformSeconds": stats["transformSeconds"],
+                    "uploadSeconds": stats["uploadSeconds"],
+                    "waitSeconds": stats["waitSeconds"],
+                    "cacheHitRate": stats.get("cache", {}).get("hitRate", 0.0),
+                },
+            }), flush=True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # bit-equality across arms — always, at any core count: the pool and
+    # the cache must not change a single scored byte
+    assert results["parallel"]["scored"] == results["serial"]["scored"]
+    assert results["parallel_cache"]["scored"] == results["serial"]["scored"]
+    cached = results["parallel_cache"]["stats"]
+    # the cache really absorbed passes ≥2: hits ≥ all chunks after pass 1
+    assert cached["cacheHits"] > 0, cached
+    assert cached["uploadBytes"] < results["parallel"]["stats"]["uploadBytes"]
+    cores = os.cpu_count() or 1
+    if cores >= 2:
+        # pinned tripwires (multicore only — a 1-core host serializes the
+        # pool and proves nothing about overlap)
+        assert results["parallel"]["wall"] <= results["serial"]["wall"] * 1.05, \
+            {a: round(r["wall"], 2) for a, r in results.items()}
+        assert (cached["uploadBytes"] * 3
+                <= results["parallel"]["stats"]["uploadBytes"]), cached
     print(json.dumps({
-        "metric": f"stream_train_rows_per_sec_{n}rows_{d}feat_{platform}",
-        "value": round(n / wall, 1),
-        "unit": "rows/sec",
-        # vs in-core is not meaningful (in-core cannot hold the table);
-        # report against the feed's pure upload throughput instead
-        "vs_baseline": round(stats["overlapFraction"], 3),
+        "metric": f"stream_ab_speedup_{n}rows_{d}feat_{platform}",
+        "value": round(results["serial"]["wall"]
+                       / max(results["parallel_cache"]["wall"], 1e-9), 3),
+        "unit": "x_serial_wall",
+        "vs_baseline": round(results["serial"]["wall"]
+                             / max(results["parallel"]["wall"], 1e-9), 3),
         "phases": {
-            "wallSecs": round(wall, 2),
-            "passes": round(passes, 2),
-            "chunks": stats["chunks"],
-            "chunkRows": chunk_rows,
-            "uploadBytes": stats["uploadBytes"],
-            **_ledger_phases(smark),
-            "maxChunkBytes": stats["maxChunkBytes"],
-            "peakDeviceBytes": stats["peakDeviceBytes"],
-            "peakResidentChunks": stats["peakResidentChunks"],
-            "overlapFraction": stats["overlapFraction"],
-            "uploadSeconds": stats["uploadSeconds"],
-            "waitSeconds": stats["waitSeconds"],
+            "serialWallSecs": round(results["serial"]["wall"], 2),
+            "parallelWallSecs": round(results["parallel"]["wall"], 2),
+            "cachedWallSecs": round(results["parallel_cache"]["wall"], 2),
+            "uploadBytesSerial": results["serial"]["stats"]["uploadBytes"],
+            "uploadBytesParallel":
+                results["parallel"]["stats"]["uploadBytes"],
+            "uploadBytesCached": cached["uploadBytes"],
+            "cacheHitRate": cached.get("cache", {}).get("hitRate", 0.0),
+            "cores": cores,
         },
     }), flush=True)
 

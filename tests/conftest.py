@@ -367,12 +367,15 @@ def _no_drift_leak():
 
 @pytest.fixture(autouse=True)
 def _no_stream_leak():
-    """The streaming device feed owns a producer thread and up to
-    prefetch+1 host/device-resident chunk buffers. A leaked feed would
-    keep reading + uploading chunks (and counting transfer bytes into the
-    metrics registry) underneath later tests; a leaked tg-stream thread
-    pins its chunk source alive for the session. Mirrors the serving
-    no-leak fixture: assert clean entry, force-close + fail on exit."""
+    """The streaming input engine owns an ordered committer thread
+    (``tg-stream-feed``), a pool of producer workers
+    (``tg-stream-w<i>``), and up to prefetch+1 host/device-resident
+    chunk buffers. A leaked feed would keep reading + uploading chunks
+    (and counting transfer bytes into the metrics registry) underneath
+    later tests; a leaked tg-stream thread — committer OR worker — pins
+    its chunk source alive for the session. Mirrors the serving no-leak
+    fixture: assert clean entry, force-close + fail on exit; the
+    ``tg-stream`` prefix sweep covers the whole worker pool."""
     from transmogrifai_tpu.robustness import oracles
 
     assert not oracles.leaked_stream_feeds(), (

@@ -1,0 +1,462 @@
+"""Streaming input engine (transmogrifai_tpu/streaming/feed.py + cache.py,
+docs/streaming.md "Input engine"): parallel chunk preparation is bit-equal
+to the serial feed at any worker count, the transformed-chunk cache replays
+byte-equal blocks (and degrades to a typed recompute on corruption or the
+``stream.cache`` chaos site — never wrong data), kill/resume stays
+bit-exact through cached and parallel passes, and the O(prefetch + 1)
+device-residency bound holds under a full worker pool."""
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu as tg
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.impl.feature.vectorizers import RealVectorizer
+from transmogrifai_tpu.impl.preparators.sanity_checker import SanityChecker
+from transmogrifai_tpu.robustness import faults
+from transmogrifai_tpu.robustness.faults import SimulatedPreemption
+from transmogrifai_tpu.robustness.policy import FaultLog
+from transmogrifai_tpu.robustness.watchdog import WatchdogStallError
+from transmogrifai_tpu.streaming import (
+    ChunkCache, DeviceFeed, StreamingGBT, TableChunkSource, pack_table,
+)
+from transmogrifai_tpu.streaming import feed as feed_mod
+from transmogrifai_tpu.streaming.cache import transform_identity
+from transmogrifai_tpu.streaming.trainer import fit_dag_streaming
+from transmogrifai_tpu.table import Column, FeatureTable
+from transmogrifai_tpu.types import OPVector, Real, RealNN
+from transmogrifai_tpu.workflow import OpWorkflow
+
+pytestmark = pytest.mark.stream
+
+
+# ---------------------------------------------------------------------------
+# helpers (mirror tests/test_streaming.py)
+# ---------------------------------------------------------------------------
+
+def _table(n=2000, d=6, seed=0, missing=0.05):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    mask = rng.rand(n, d) >= missing
+    y = (np.where(mask, X, 0.0)[:, 0] > 0.3).astype(np.float32)
+    cols = {f"x{i}": Column(Real, X[:, i], mask[:, i]) for i in range(d)}
+    cols["y"] = Column(RealNN, y, None)
+    return FeatureTable(cols, n), X, mask, y
+
+
+def _pipeline(d=6, num_trees=1, depth=2, seed=1):
+    label = FeatureBuilder.RealNN("y").extract_field().as_response()
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(d)]
+    checked = label.transform_with(SanityChecker(seed=seed),
+                                   tg.transmogrify(feats))
+    return (StreamingGBT(problem="binary", num_trees=num_trees,
+                         max_depth=depth, n_bins=8, learning_rate=1.0)
+            .set_input(label, checked).get_output())
+
+
+def _gbt_of(model):
+    return [s for s in model.stages
+            if type(s).__name__ == "StreamingGBTModel"][0]
+
+
+def _rv_of(model):
+    return [s for s in model.stages
+            if type(s).__name__ == "RealVectorizerModel"][0]
+
+
+def _trees_equal(a, b):
+    ta, tb = a.trees, b.trees
+    if len(ta) != len(tb) or a.f0 != b.f0:
+        return False
+    for x, y in zip(ta, tb):
+        if not all((p == q).all() for p, q in zip(x["feat_lv"], y["feat_lv"])):
+            return False
+        if not all(np.array_equal(p, q, equal_nan=True)
+                   for p, q in zip(x["thr_lv"], y["thr_lv"])):
+            return False
+        if not (x["leaf"] == y["leaf"]).all():
+            return False
+    return True
+
+
+def _col_bytes(table):
+    """Column name → raw value/mask bytes, the byte-equality probe."""
+    out = {}
+    for name in table.column_names:
+        col = table[name]
+        out[name] = (np.ascontiguousarray(np.asarray(col.values)).tobytes(),
+                     None if col.mask is None else
+                     np.ascontiguousarray(np.asarray(col.mask)).tobytes())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# env plumbing
+# ---------------------------------------------------------------------------
+
+def test_env_workers_parsing(monkeypatch):
+    assert feed_mod.env_workers(3) == 3
+    assert feed_mod.env_workers(0) == 1          # floor
+    monkeypatch.setenv("TG_STREAM_WORKERS", "7")
+    assert feed_mod.env_workers() == 7
+    monkeypatch.setenv("TG_STREAM_WORKERS", "")
+    assert feed_mod.env_workers() == max(1, min(4, os.cpu_count() or 1))
+
+
+def test_device_bytes_charges_full_mask_elements():
+    """Satellite fix: an (n, d) validity mask pins n*d bytes while the
+    chunk is resident, not n (the old shape[0] undercount)."""
+    n, d = 100, 3
+    col = Column(OPVector, np.zeros((n, d), np.float32),
+                 np.ones((n, d), bool))
+    t = FeatureTable({"v": col}, n)
+    assert feed_mod.device_bytes(t) == n * d * 4 + n * d
+
+
+# ---------------------------------------------------------------------------
+# parallel preparation: bit-equality + ordering + residency
+# ---------------------------------------------------------------------------
+
+def test_delivery_order_and_content_under_parallel_workers():
+    table, _, _, _ = _table(2048, 4, seed=7)
+    src = TableChunkSource(table, chunk_rows=128)      # 16 chunks
+    with DeviceFeed(src, prefetch=4, workers=4, to_device=False):
+        pass  # close() of an unconsumed pooled feed must drain cleanly
+    with DeviceFeed(src, prefetch=1, workers=1, to_device=False) as f1:
+        ref = [(c.index, _col_bytes(c.table)) for c in f1]
+    with DeviceFeed(src, prefetch=4, workers=4, to_device=False) as f4:
+        got = [(c.index, _col_bytes(c.table)) for c in f4]
+    assert [i for i, _ in got] == list(range(16))       # schedule order
+    assert got == ref                                   # byte-equal content
+    assert not feed_mod.live_feeds()
+
+
+def test_residency_bound_holds_under_worker_pool():
+    """Residency stays O(prefetch + 1) chunks no matter how many workers
+    race: slots gate claims, so 4 workers over prefetch=2 never hold more
+    than 2 queued + 1 consumed chunks."""
+    table, _, _, _ = _table(4096, 4, seed=5)
+    src = TableChunkSource(table, chunk_rows=256)
+    with DeviceFeed(src, prefetch=2, workers=4) as feed:
+        for _ in feed:
+            time.sleep(0.002)    # slow consumer → pool saturates its slots
+    st = feed.stats
+    assert st.chunks == 16
+    assert st.peak_resident_chunks <= 3
+    assert st.peak_device_bytes <= 3 * st.max_chunk_bytes
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_streamed_train_bit_equal_at_any_worker_count(workers, monkeypatch):
+    table, _, _, _ = _table(1500, 5, seed=11)
+    monkeypatch.setenv("TG_STREAM_PREFETCH", "4")
+    monkeypatch.setenv("TG_STREAM_WORKERS", "1")
+    ref = (OpWorkflow().set_result_features(_pipeline(d=5))
+           .train(stream=TableChunkSource(table, chunk_rows=250)))
+    monkeypatch.setenv("TG_STREAM_WORKERS", str(workers))
+    got = (OpWorkflow().set_result_features(_pipeline(d=5))
+           .train(stream=TableChunkSource(table, chunk_rows=250)))
+    assert np.asarray(_rv_of(ref).fills).tobytes() == \
+        np.asarray(_rv_of(got).fills).tobytes()
+    assert _trees_equal(_gbt_of(ref), _gbt_of(got))
+
+
+def test_stage_seconds_split_and_summary_surface():
+    table, _, _, _ = _table(1200, 4, seed=3)
+    m = (OpWorkflow().set_result_features(_pipeline(d=4))
+         .train(stream=TableChunkSource(table, chunk_rows=300)))
+    st = m.summary()["streaming"]
+    # the satellite split: lumped upload_seconds is now three stages
+    for key in ("readSeconds", "transformSeconds", "uploadSeconds",
+                "cacheHits", "cacheMisses", "overlapFraction"):
+        assert key in st, key
+    assert st["readSeconds"] + st["transformSeconds"] > 0
+    assert st["cacheHits"] + st["cacheMisses"] == st["chunks"]
+    cache = st["cache"]
+    assert cache["stores"] > 0 and 0.0 <= cache["hitRate"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# transformed-chunk cache: hits, byte-equality, eviction, disk tier
+# ---------------------------------------------------------------------------
+
+def test_cache_hit_pass_is_byte_equal_with_zero_upload():
+    table, _, _, _ = _table(1024, 4, seed=13)
+    src = TableChunkSource(table, chunk_rows=256)
+    cache = ChunkCache(max_bytes=64 << 20)
+    with DeviceFeed(src, cache=cache, cache_ident="t0") as f1:
+        first = [_col_bytes(c.table) for c in f1]
+    assert f1.stats.cache_misses == 4 and f1.stats.cache_hits == 0
+    assert f1.stats.upload_bytes > 0
+    assert cache.stats.stores == 4
+    with DeviceFeed(src, cache=cache, cache_ident="t0") as f2:
+        second = [_col_bytes(c.table) for c in f2]
+    assert f2.stats.cache_hits == 4 and f2.stats.cache_misses == 0
+    assert f2.stats.upload_bytes == 0      # hits never cross the h2d link
+    assert second == first                 # byte-equal replay
+    # a different fitted-transform identity must never hit
+    with DeviceFeed(src, cache=cache, cache_ident="OTHER") as f3:
+        list(f3)
+    assert f3.stats.cache_hits == 0 and f3.stats.cache_misses == 4
+
+
+def test_pack_unpack_roundtrip_is_byte_equal():
+    table, _, _, _ = _table(512, 3, seed=17)
+    packed = pack_table(table)
+    assert packed is not None
+    assert packed.content_sha() == pack_table(table).content_sha()
+    un = packed.unpack()
+    assert un.num_rows == table.num_rows
+    assert _col_bytes(un) == _col_bytes(table)
+    for name in table.column_names:
+        assert un[name].feature_type is table[name].feature_type
+    # object-dtype columns make the chunk uncacheable, never half-cached
+    from transmogrifai_tpu.types import Text
+    bad = FeatureTable({"t": Column(
+        Text, np.array(["a", "b"], dtype=object), None)}, 2)
+    assert pack_table(bad) is None
+
+
+def test_host_tier_lru_eviction_stays_under_budget():
+    table, _, _, _ = _table(2048, 4, seed=19)
+    src = TableChunkSource(table, chunk_rows=256)      # 8 chunks
+    one = pack_table(next(iter(src.chunks())).table).nbytes
+    cache = ChunkCache(max_bytes=3 * one + one // 2)   # fits 3 of 8
+    with DeviceFeed(src, cache=cache, cache_ident="t") as f1:
+        first = [_col_bytes(c.table) for c in f1]
+    assert cache.stats.evictions > 0
+    assert cache.stats.host_bytes <= cache.max_bytes
+    # a sequential scan over an LRU smaller than the working set thrashes
+    # (each miss re-stores and evicts the next chunk in line) — evicted
+    # entries must RECOMPUTE byte-equally, never deliver wrong data
+    with DeviceFeed(src, cache=cache, cache_ident="t") as f2:
+        second = [_col_bytes(c.table) for c in f2]
+    assert second == first
+    assert f2.stats.cache_hits + f2.stats.cache_misses == 8
+    assert f2.stats.cache_misses > 0       # eviction really cost replays
+    assert cache.stats.host_bytes <= cache.max_bytes
+
+
+def test_disk_tier_sha_verified_roundtrip_and_corruption(tmp_path):
+    table, _, _, _ = _table(600, 4, seed=23)
+    src = TableChunkSource(table, chunk_rows=200)      # 3 chunks
+    d = str(tmp_path / "stream_cache")
+    c1 = ChunkCache(max_bytes=0, disk_dir=d)           # disk tier only
+    with DeviceFeed(src, cache=c1, cache_ident="t") as f1:
+        first = [_col_bytes(c.table) for c in f1]
+    files = [f for f in os.listdir(d) if f.endswith(".npz")]
+    assert len(files) == 3
+    # a FRESH cache (new process's view) replays from disk, sha-verified
+    c2 = ChunkCache(max_bytes=0, disk_dir=d)
+    with DeviceFeed(src, cache=c2, cache_ident="t") as f2:
+        second = [_col_bytes(c.table) for c in f2]
+    assert second == first
+    assert c2.stats.disk_hits == 3
+    # flip bytes in one entry: sha mismatch → typed fallback → recompute
+    victim = os.path.join(d, sorted(files)[0])
+    with open(victim, "r+b") as fh:
+        fh.seek(100)
+        fh.write(b"\xff\xff\xff\xff")
+    log = FaultLog()
+    c3 = ChunkCache(max_bytes=0, disk_dir=d)
+    with log.activate():
+        with DeviceFeed(src, cache=c3, cache_ident="t") as f3:
+            third = [_col_bytes(c.table) for c in f3]
+    assert third == first                  # NEVER wrong data
+    assert c3.stats.fallbacks == 1
+    kinds = {r.kind for r in log.reports}
+    assert "stream_cache_fallback" in kinds
+    # corrupt entry was evicted, then the recompute repaired it in place:
+    # a fourth fresh cache reads all 3 entries clean again
+    c4 = ChunkCache(max_bytes=0, disk_dir=d)
+    with DeviceFeed(src, cache=c4, cache_ident="t") as f4:
+        fourth = [_col_bytes(c.table) for c in f4]
+    assert fourth == first
+    assert c4.stats.disk_hits == 3
+    assert c4.stats.fallbacks == 0
+
+
+def test_chaos_stream_cache_raise_degrades_to_recompute():
+    table, _, _, _ = _table(768, 4, seed=29)
+    src = TableChunkSource(table, chunk_rows=256)
+    cache = ChunkCache(max_bytes=64 << 20)
+    with DeviceFeed(src, cache=cache, cache_ident="t") as f1:
+        first = [_col_bytes(c.table) for c in f1]
+    log = FaultLog()
+    with log.activate():
+        with faults.injected(
+                {"stream.cache": {"mode": "raise", "nth": 2, "count": 1}}):
+            with DeviceFeed(src, cache=cache, cache_ident="t") as f2:
+                second = [_col_bytes(c.table) for c in f2]
+    assert second == first
+    assert f2.stats.cache_hits == 2 and f2.stats.cache_misses == 1
+    assert cache.stats.fallbacks == 1
+    assert any(r.kind == "stream_cache_fallback" for r in log.reports)
+
+
+# ---------------------------------------------------------------------------
+# kill/resume: at stream.cache, and mid-parallel-pass
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_kill_at_stream_cache_resumes_bit_equal(tmp_path, monkeypatch):
+    """A preemption inside a cache lookup (mid-cached-pass) must resume
+    bit-exactly — the BaseException escapes the cache's Exception-only
+    fallback and dies like any other kill, checkpoints intact."""
+    monkeypatch.setenv("TG_STREAM_CACHE_DIR", str(tmp_path / "cache"))
+    table, _, _, _ = _table(1400, 5, seed=31)
+    src = TableChunkSource(table, chunk_rows=200)
+    ref = _gbt_of(OpWorkflow().set_result_features(_pipeline(d=5))
+                  .train(stream=src))
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(_pipeline(d=5))
+              .with_checkpoint_dir(ck))
+        # nth=25 lands in a GBT pass — i.e. while replaying cached chunks
+        with pytest.raises(SimulatedPreemption):
+            with faults.injected(
+                    {"stream.cache": {"mode": "preempt", "nth": 25}}):
+                wf.train(stream=src)
+        assert not feed_mod.live_feeds()
+        resumed = wf.train(resume=True, stream=src)
+        assert _trees_equal(ref, _gbt_of(resumed))
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+
+
+@pytest.mark.chaos
+def test_kill_mid_parallel_pass_resumes_bit_equal(monkeypatch):
+    monkeypatch.setenv("TG_STREAM_WORKERS", "4")
+    monkeypatch.setenv("TG_STREAM_PREFETCH", "4")
+    table, _, _, _ = _table(1800, 5, seed=37)
+    src = TableChunkSource(table, chunk_rows=200)
+    ref = _gbt_of(OpWorkflow().set_result_features(_pipeline(d=5))
+                  .train(stream=src))
+    ck = tempfile.mkdtemp()
+    try:
+        wf = (OpWorkflow().set_result_features(_pipeline(d=5))
+              .with_checkpoint_dir(ck))
+        with pytest.raises(SimulatedPreemption):
+            with faults.injected(
+                    {"stream.read": {"mode": "preempt", "nth": 7}}):
+                wf.train(stream=src)
+        assert not feed_mod.live_feeds()
+        resumed = wf.train(resume=True, stream=src)
+        assert _trees_equal(ref, _gbt_of(resumed))
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# watchdog: stall abort wakes a consumer on a FULL queue (satellite fix)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_stall_abort_survives_full_queue():
+    """The stall callback must wake a consumer even against a FULL queue
+    (the old bare put_nowait dropped the typed error there). Normal flow
+    can't fill the queue — the slot semaphore bounds committed chunks to
+    prefetch < maxsize — so wedge the pool and fill it by hand, exactly
+    the state a misbehaving consumer/producer mix could leave behind."""
+    table, _, _, _ = _table(512, 3, seed=41)
+    src = TableChunkSource(table, chunk_rows=256)      # 2 chunks
+    release = threading.Event()
+
+    class Wedge:
+        def transform(self, t):
+            release.wait(timeout=20)     # wedged until the test releases
+            return t
+
+    feed = DeviceFeed(src, transforms=[Wedge()], prefetch=2, workers=1,
+                      to_device=False)
+    try:
+        time.sleep(0.1)                  # worker enters the wedge
+        while not feed._q.full():
+            feed._q.put_nowait(("pad", 0))
+        assert feed._q.full()
+        feed._on_watchdog_stall(feed._heart, 99.0)
+        release.set()                    # unwedge so close() joins cleanly
+        with pytest.raises(WatchdogStallError, match="stalled"):
+            next(feed)
+    finally:
+        release.set()
+        feed.close()
+    assert not feed_mod.live_feeds()
+
+
+def test_wedged_producer_unblocks_consumer():
+    """A transform wedged mid-chunk: the stall callback aborts the feed
+    and the consumer gets the typed error instead of blocking forever."""
+    table, _, _, _ = _table(512, 3, seed=43)
+    src = TableChunkSource(table, chunk_rows=128)
+    release = threading.Event()
+
+    class Wedge:
+        def transform(self, t):
+            release.wait(timeout=20)     # wedged until the test releases
+            return t
+
+    feed = DeviceFeed(src, transforms=[Wedge()], prefetch=1, workers=1,
+                      to_device=False)
+    try:
+        time.sleep(0.1)                  # worker enters the wedge
+        feed._on_watchdog_stall(feed._heart, 99.0)
+        with pytest.raises(WatchdogStallError):
+            next(feed)
+    finally:
+        release.set()                    # unwedge so close() joins cleanly
+        feed.close()
+    assert not feed_mod.live_feeds()
+
+
+# ---------------------------------------------------------------------------
+# fused independent prep passes (TG_STREAM_FUSE)
+# ---------------------------------------------------------------------------
+
+def test_fused_prep_passes_one_sweep_same_fills(monkeypatch):
+    table, _, _, _ = _table(1600, 6, seed=47)
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(6)]
+
+    def build():
+        rv_a, rv_b = RealVectorizer(), RealVectorizer()
+        rv_a.set_input(*feats[:3])
+        rv_a.get_output()
+        rv_b.set_input(*feats[3:])
+        rv_b.get_output()
+        return rv_a, rv_b
+
+    src = TableChunkSource(table, chunk_rows=200)      # 8 chunks
+    rv_a, rv_b = build()
+    fitted, _, stats = fit_dag_streaming(
+        src, [[(rv_a, None), (rv_b, None)]])
+    assert stats.chunks == src.num_chunks              # ONE fused sweep
+    monkeypatch.setenv("TG_STREAM_FUSE", "0")
+    rv_a2, rv_b2 = build()
+    fitted2, _, stats2 = fit_dag_streaming(
+        src, [[(rv_a2, None), (rv_b2, None)]])
+    assert stats2.chunks == 2 * src.num_chunks         # one sweep per stage
+    assert fitted[rv_a.uid].fills == fitted2[rv_a2.uid].fills
+    assert fitted[rv_b.uid].fills == fitted2[rv_b2.uid].fills
+
+
+def test_transform_identity_distinguishes_fitted_state():
+    table, _, _, _ = _table(400, 3, seed=53)
+    feats = [FeatureBuilder.Real(f"x{i}").extract_field().as_predictor()
+             for i in range(3)]
+    rv = RealVectorizer()
+    rv.set_input(*feats)
+    rv.get_output()
+    m1 = rv.fit(table)
+    ident1 = transform_identity([m1])
+    assert ident1 == transform_identity([m1])          # stable
+    m2 = rv.fit(table.take(np.arange(200)))            # different fills
+    assert transform_identity([m2]) != ident1
+    # unserializable models degrade to a guaranteed miss, never a hit
+    a, b = object(), object()
+    assert transform_identity([a]) != transform_identity([b])

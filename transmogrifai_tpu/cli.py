@@ -1093,6 +1093,41 @@ def run_doctor(bundle: str, as_json: bool = False,
                 if isinstance(v, dict):
                     v = f"count={v.get('count')}"
                 print(f"   {fname}{{{key}}}: {v}")
+    # streaming (docs/streaming.md "The input engine") — was an
+    # out-of-core train running, where its time went per stage
+    # (read/transform/upload), how well the producer pool overlapped the
+    # device link, and whether the transformed-chunk cache was earning
+    # its RAM — from the tg_stream_* series the bundle snapshotted
+    stream_series = {n: s for n, s in metrics.items()
+                     if n.startswith("tg_stream_")}
+    if stream_series:
+        print("-- streaming --")
+        stages = stream_series.get("tg_stream_stage_seconds") or {}
+        for key, v in sorted(stages.items()):
+            if isinstance(v, dict):
+                lat = {q: v.get(q) for q in ("p50", "p95", "p99")
+                       if v.get(q) is not None}
+                print(f"   stage{{{key}}}: count={v.get('count')} {lat}")
+        hits = miss = 0.0
+        for key, v in (stream_series.get(
+                "tg_stream_cache_hits_total") or {}).items():
+            hits += float(v) if not isinstance(v, dict) else 0.0
+        for key, v in (stream_series.get(
+                "tg_stream_cache_misses_total") or {}).items():
+            miss += float(v) if not isinstance(v, dict) else 0.0
+        if hits or miss:
+            rate = hits / max(hits + miss, 1.0)
+            print(f"   cache: hits={hits:.0f} misses={miss:.0f} "
+                  f"hitRate={rate:.3f}")
+        for fname, series in sorted(stream_series.items()):
+            if fname in ("tg_stream_stage_seconds",
+                         "tg_stream_cache_hits_total",
+                         "tg_stream_cache_misses_total"):
+                continue
+            for key, v in sorted(series.items()):
+                if isinstance(v, dict):
+                    v = f"count={v.get('count')}"
+                print(f"   {fname}{{{key}}}: {v}")
     # SLO & budgets (bundle schema v3; docs/observability.md "SLOs,
     # budgets & burn rates") — was the budget already burning before
     # this incident, and what would the autoscaler have done?

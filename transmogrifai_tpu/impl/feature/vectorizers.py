@@ -143,16 +143,13 @@ class RealVectorizer(Estimator):
         return self._finalize_model(model)
 
     # -- streaming fit (OpWorkflow.train(stream=...), docs/streaming.md) -----
-    def fit_streaming(self, run) -> Transformer:
-        """Mean fills as one chunked col-stats fold: per-column (count, Σx)
-        accumulate in exact f64 exactly like the in-core f64 host path, so
-        the streamed fills agree with in-core fills to the last float
-        rounding of the identical sum/count division."""
+    def fit_streaming_prep(self, run):
+        """Single-pass prep spec ``(pass_id, fold, extract, finish)`` —
+        the trainer fuses independent specs from one DAG layer into one
+        chunk sweep (streaming/trainer.py). ``None`` when constant fills
+        need no pass at all."""
         if not self.fill_with_mean:
-            model = RealVectorizerModel(
-                fills=[self.fill_value] * len(self.input_features),
-                track_nulls=self.track_nulls)
-            return self._finalize_model(model)
+            return None
         from ...streaming.folds import ColStatsFold
         k = len(self.input_features)
         fold = ColStatsFold(k)
@@ -164,11 +161,29 @@ class RealVectorizer(Estimator):
             mask = np.stack([c.valid_mask() for c in cols], axis=1)
             return X, mask
 
-        res = fold.finalize(run.fold("fills", fold, extract))
-        fills = [float(res.mean[i]) if res.count[i] > 0 else self.fill_value
-                 for i in range(k)]
-        model = RealVectorizerModel(fills=fills, track_nulls=self.track_nulls)
-        return self._finalize_model(model)
+        def finish(state) -> Transformer:
+            res = fold.finalize(state)
+            fills = [float(res.mean[i]) if res.count[i] > 0
+                     else self.fill_value for i in range(k)]
+            model = RealVectorizerModel(fills=fills,
+                                        track_nulls=self.track_nulls)
+            return self._finalize_model(model)
+
+        return "fills", fold, extract, finish
+
+    def fit_streaming(self, run) -> Transformer:
+        """Mean fills as one chunked col-stats fold: per-column (count, Σx)
+        accumulate in exact f64 exactly like the in-core f64 host path, so
+        the streamed fills agree with in-core fills to the last float
+        rounding of the identical sum/count division."""
+        spec = self.fit_streaming_prep(run)
+        if spec is None:
+            model = RealVectorizerModel(
+                fills=[self.fill_value] * len(self.input_features),
+                track_nulls=self.track_nulls)
+            return self._finalize_model(model)
+        pass_id, fold, extract, finish = spec
+        return finish(run.fold(pass_id, fold, extract))
 
 
 def _device_fill_blocks(input_features, fills, track_nulls, env):

@@ -252,16 +252,11 @@ class SanityChecker(AllowLabelAsInput, Estimator):
         return PendingFit(dev, finish)
 
     # -- streaming fit (OpWorkflow.train(stream=...), docs/streaming.md) -----
-    def fit_streaming(self, run) -> Transformer:
-        """One chunked pass of monoid folds — the out-of-core dual of the
-        device stats pass: col moments, label correlations (co-moment
-        merge), optional full correlation matrix, and contingency counts
-        all accumulate in exact-f64 host folds and feed the SAME
-        ``_finish_from_host`` decision logic the in-core fit uses. Two
-        documented deviations: no sampling (the stream folds every row —
-        ``check_sample``/limits describe the in-core reservoir) and no
-        Spearman (exact streaming ranks need a sort over the full
-        dataset)."""
+    def fit_streaming_prep(self, run):
+        """Single-pass prep spec ``(pass_id, fold, extract, finish)`` for
+        the trainer's fused layer sweep (streaming/trainer.py) — the
+        sanity stats were already one composite pass, so the spec just
+        exposes its pieces."""
         from ...streaming.folds import (
             ColStatsFold, CompositeFold, ContingencyFold, CorrelationFold,
         )
@@ -303,27 +298,43 @@ class SanityChecker(AllowLabelAsInput, Estimator):
                 parts["cont"] = (X[:, all_idx], y)
             return (parts,)
 
-        state = run.fold("sanity", composite, extract)
-        res = composite.finalize(state)
-        stats = res["stats"]
-        host: Dict[str, np.ndarray] = {
-            "count": stats.count, "mean": stats.mean,
-            "variance": stats.variance, "min": stats.min, "max": stats.max,
-            "corr": res["corr"],
-        }
-        if folds["corr"].full:
-            host["feature_corr"] = folds["corr"].finalize_matrix(
-                state["corr"])
-        n_sample = int(state["corr"]["n"])
-        if groups:
-            counts = res["cont"]
-            if counts is None:
-                # labels were not binary-like: same branch as in-core
-                groups = []
-            else:
-                host["counts"] = counts.astype(np.float64)
-        return self._finish_from_host(host, d=d, vm=vm, groups=groups,
-                                      n_sample=n_sample)
+        def finish(state) -> Transformer:
+            grps = groups
+            res = composite.finalize(state)
+            stats = res["stats"]
+            host: Dict[str, np.ndarray] = {
+                "count": stats.count, "mean": stats.mean,
+                "variance": stats.variance, "min": stats.min,
+                "max": stats.max, "corr": res["corr"],
+            }
+            if folds["corr"].full:
+                host["feature_corr"] = folds["corr"].finalize_matrix(
+                    state["corr"])
+            n_sample = int(state["corr"]["n"])
+            if grps:
+                counts = res["cont"]
+                if counts is None:
+                    # labels were not binary-like: same branch as in-core
+                    grps = []
+                else:
+                    host["counts"] = counts.astype(np.float64)
+            return self._finish_from_host(host, d=d, vm=vm, groups=grps,
+                                          n_sample=n_sample)
+
+        return "sanity", composite, extract, finish
+
+    def fit_streaming(self, run) -> Transformer:
+        """One chunked pass of monoid folds — the out-of-core dual of the
+        device stats pass: col moments, label correlations (co-moment
+        merge), optional full correlation matrix, and contingency counts
+        all accumulate in exact-f64 host folds and feed the SAME
+        ``_finish_from_host`` decision logic the in-core fit uses. Two
+        documented deviations: no sampling (the stream folds every row —
+        ``check_sample``/limits describe the in-core reservoir) and no
+        Spearman (exact streaming ranks need a sort over the full
+        dataset)."""
+        pass_id, fold, extract, finish = self.fit_streaming_prep(run)
+        return finish(run.fold(pass_id, fold, extract))
 
     def _finish_from_host(self, host: Dict[str, np.ndarray], *, d: int,
                           vm: Optional[VectorMetadata], groups: List[Any],

@@ -62,6 +62,12 @@ site                         fires in
                              and re-raise in the consumer)
 ``stream.upload``            in the producer, before the chunk's packed
                              host→device upload (``to_device``)
+``stream.cache``             in a producer worker, on every transformed-
+                             chunk cache lookup (streaming/cache.py) — a
+                             raise models a corrupt/evicted entry and
+                             degrades to the typed recompute fallback
+                             (bit-equal, never wrong data); preemption
+                             kills mid-lookup and resumes bit-exactly
 ``stream.fold``              in the consumer, before a chunk folds into the
                              estimator's monoid state (key = pass id);
                              ``mode: "preempt"`` here is the canonical
@@ -324,6 +330,9 @@ ALL_SITES: Dict[str, SiteSpec] = {s.name: s for s in (
           "bit-exactly from the last committed chunk"),
     _site("stream.upload", "raise|preempt", "streaming/feed.py", "stream",
           "error forwards through the queue; resume bit-exact"),
+    _site("stream.cache", "raise|preempt", "streaming/cache.py", "stream",
+          "corrupt/evicted entry falls back to a typed bit-equal "
+          "recompute from source; preemption resumes bit-exactly"),
     _site("stream.fold", "raise|preempt", "streaming/trainer.py", "stream",
           "fold retried/resumed from the committed state, bit-exact"),
     _site("drift.fold", "raise", "serving/drift.py", "serve|serve_heal",

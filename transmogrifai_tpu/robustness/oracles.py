@@ -30,7 +30,10 @@ from typing import Iterable, List, Optional
 #: with one of these names after a close/teardown is a leak. ``tg-serve``
 #: prefix-matches both the batcher (``tg-serve[<model>]``) and the
 #: pipelined completer (``tg-serve-completer[<model>]``), so the no-leak
-#: sweep covers the whole serving dataplane automatically.
+#: sweep covers the whole serving dataplane automatically. ``tg-stream``
+#: prefix-matches the input engine's ordered committer
+#: (``tg-stream-feed``) and every producer worker (``tg-stream-w<i>``) —
+#: a feed that fails to drain its pool on close shows up here.
 THREAD_PREFIXES = ("tg-serve", "tg-stream", "tg-drift-refit", "tg-watchdog",
                    "tg-sampler", "tg-fleet", "tg-net")
 

@@ -153,6 +153,15 @@ class FaultLog:
         _emit_fault_observability(report)
 
     @staticmethod
+    def current() -> Optional["FaultLog"]:
+        """The ambient (activated) log of THIS thread, or None. Worker
+        threads never see the consumer's ambient log (contextvars are
+        per-thread) — components that record from their own threads
+        capture this on the owning thread and ``add()`` directly (the
+        serving batcher, the stream input engine's chunk cache)."""
+        return _CURRENT_LOG.get()
+
+    @staticmethod
     def record(report: FaultReport) -> None:
         log = _CURRENT_LOG.get()
         if log is not None:

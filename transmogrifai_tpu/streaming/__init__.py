@@ -2,14 +2,24 @@
 
 Datasets larger than device (and host) memory train as chunked monoid
 folds: a :class:`ChunkSource` yields fixed-row-budget FeatureTable chunks,
-a double-buffered :class:`DeviceFeed` packs + uploads chunk N+1 while
-chunk N folds, estimator fits run as accumulate/merge/finalize monoids
-(:mod:`.folds`), and per-chunk checkpoints through the PR 2 manifest make
-a kill at any ``stream.*`` chaos site resume bit-exactly. Entry point:
+the :class:`DeviceFeed` input engine prepares them behind the consumer —
+a ``TG_STREAM_WORKERS`` pool runs read+transform per claimed index while
+one ordered committer packs + uploads in schedule order, and a bounded
+:class:`ChunkCache` (host LRU + sha256-verified disk tier) replays
+transformed chunks on repeat passes — estimator fits run as
+accumulate/merge/finalize monoids (:mod:`.folds`), and per-chunk
+checkpoints through the PR 2 manifest make a kill at any ``stream.*``
+chaos site resume bit-exactly. Entry point:
 ``OpWorkflow.train(stream=source)``.
 """
+from .cache import (  # noqa: F401
+    ChunkCache, PackedChunk, chunk_cache_key, pack_table,
+    transform_identity,
+)
 from .checkpoint import StreamCheckpoint  # noqa: F401
-from .feed import DeviceFeed, FeedStats, device_bytes, live_feeds  # noqa: F401
+from .feed import (  # noqa: F401
+    DeviceFeed, FeedStats, device_bytes, env_workers, live_feeds,
+)
 from .folds import (  # noqa: F401
     ArraySumFold, ColStatsFold, CompositeFold, ContingencyFold,
     CorrelationFold, HistogramFold, MonoidFold,

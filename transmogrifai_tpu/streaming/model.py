@@ -111,6 +111,13 @@ class StreamingGBT(AllowLabelAsInput, Estimator):
         return X, y
 
     def fit_streaming(self, run) -> Transformer:
+        # NOTE (round 20): pass ids ("edges", "t{t}.l{lv}", "t{t}.leaf")
+        # are a persistence contract — stream checkpoint keys embed them,
+        # so renaming one orphans committed fold states on resume. The
+        # input-engine cache keys by (source × upstream identity × chunk
+        # rows), NOT by pass id: all 1 + trees×(depth+1) passes here share
+        # one upstream stack, which is exactly why passes ≥ 2 replay
+        # cached transformed chunks instead of re-preparing them.
         probe = self.get_probe_width(run)
         d = probe
         nb = max(2, self.n_bins)

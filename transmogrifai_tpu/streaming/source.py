@@ -61,6 +61,13 @@ class ChunkSource(abc.ABC):
 
     chunk_rows: int = DEFAULT_CHUNK_ROWS
 
+    #: True when ``read_chunk(i)`` is O(chunk) for ANY i — the input
+    #: engine then lets its producer workers read claimed indices in
+    #: parallel (streaming/feed.py). Sequential-only sources (Avro's
+    #: record stream) keep False: reads serialize under the claim lock,
+    #: transforms still parallelize.
+    random_access: bool = False
+
     @abc.abstractmethod
     def fingerprint(self) -> str:
         """Stable hex digest of (dataset identity, chunk schedule)."""
@@ -91,6 +98,17 @@ class ChunkSource(abc.ABC):
     def chunk_id(self, index: int) -> str:
         return f"{self.fingerprint()[:16]}:{index:06d}"
 
+    def read_chunk(self, index: int) -> Chunk:
+        """Chunk ``index`` of the fixed schedule, in isolation. The
+        default derives it from ``chunks(start=index)`` — correct for
+        every source but O(prefix) for sequential ones; sources that set
+        ``random_access = True`` make this O(chunk)."""
+        chunk = next(iter(self.chunks(start=index)), None)
+        if chunk is None or chunk.index != index:
+            raise IndexError(f"chunk {index} is past the schedule "
+                             f"({self.num_chunks} chunks)")
+        return chunk
+
 
 class TableChunkSource(ChunkSource):
     """Chunks over an in-memory FeatureTable (slices are views/cheap takes).
@@ -99,6 +117,8 @@ class TableChunkSource(ChunkSource):
     over ``TableChunkSource(t, chunk_rows=len(t))`` IS the in-core fit, so
     equivalence tests compare the two paths on identical arithmetic.
     """
+
+    random_access = True  # chunk i is one O(chunk) take() slice
 
     def __init__(self, table: FeatureTable, chunk_rows: Optional[int] = None):
         self.table = table
@@ -209,6 +229,8 @@ class SyntheticChunkSource(ChunkSource):
     linear model — binary 0/1 by default, continuous for
     ``problem='regression'``.
     """
+
+    random_access = True  # chunk i is a pure function of (seed, i)
 
     def __init__(self, num_rows: int, num_features: int,
                  chunk_rows: Optional[int] = None, seed: int = 0,

@@ -16,10 +16,26 @@ chaos site, observability spans, and the O(chunk) memory bound all live.
 Estimators without the hook fail the train with a descriptive error
 (docs/streaming.md "What can stream") — a streamed fit must never silently
 materialize the dataset.
+
+Two round-20 input-engine hooks ride on the same contract:
+
+* every ``StreamRun`` carries the pass-aware transformed-chunk cache
+  handle (streaming/cache.py) plus its fitted-upstream identity digest,
+  so repeat passes of the SAME stage (the GBT's ``1 + trees×(depth+1)``
+  passes) replay cached prep instead of redoing read+transform+upload;
+* estimators whose whole streaming fit is ONE fold pass may additionally
+  expose ``fit_streaming_prep(run) -> (pass_id, fold, extract, finish)``
+  (or ``None`` when no pass is needed); when a DAG layer holds two or
+  more such stages with no data dependency between them, the trainer
+  FUSES their passes into a single chunk sweep via the existing
+  ``CompositeFold`` — one read of the stream fits them all. Fused fold
+  states checkpoint under the joined uid, so kill/resume stays
+  bit-exact; ``TG_STREAM_FUSE=0`` disables fusion for A/B.
 """
 from __future__ import annotations
 
 import logging
+import os
 
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -32,10 +48,17 @@ from ..robustness import faults, resources
 from ..robustness.policy import FaultLog, FaultReport
 from ..stages.base import Estimator, Transformer
 from ..table import FeatureTable
+from .cache import ChunkCache, transform_identity
 from .checkpoint import PASS_COMPLETE, StreamCheckpoint
 from .feed import DeviceFeed, FeedStats
 from .folds import MonoidFold
 from .source import ChunkSource
+
+FUSE_ENV = "TG_STREAM_FUSE"
+
+
+def env_fuse() -> bool:
+    return os.environ.get(FUSE_ENV, "1").lower() not in ("0", "false", "no")
 
 logger = logging.getLogger(__name__)
 
@@ -52,14 +75,28 @@ class StreamRun:
     def __init__(self, source: ChunkSource, upstream: List[Transformer],
                  stage_uid: str, checkpoint: Optional[StreamCheckpoint] = None,
                  prefetch: Optional[int] = None,
-                 stats: Optional[FeedStats] = None):
+                 stats: Optional[FeedStats] = None,
+                 cache: Optional[ChunkCache] = None,
+                 workers: Optional[int] = None):
         self.source = source
         self.upstream = list(upstream)
         self.stage_uid = stage_uid
         self.checkpoint = checkpoint
         self.prefetch = prefetch
         self.stats = stats if stats is not None else FeedStats()
+        self.cache = cache
+        self.workers = workers
         self._probe: Optional[FeatureTable] = None
+        self._cache_ident: Optional[str] = None
+
+    @property
+    def cache_ident(self) -> str:
+        """Fitted-transform identity of this run's upstream stack — the
+        third axis of the transformed-chunk cache key (a chunk prepped
+        under different upstream models must never be replayed here)."""
+        if self._cache_ident is None:
+            self._cache_ident = transform_identity(self.upstream)
+        return self._cache_ident
 
     @property
     def num_chunks(self) -> int:
@@ -137,9 +174,12 @@ class StreamRun:
                                uid=self.stage_uid, passId=pass_id,
                                fromChunk=start,
                                chunkRows=src.chunk_rows), \
-                        DeviceFeed(src.chunks(start),
+                        DeviceFeed(src, start=start,
                                    transforms=self.upstream,
-                                   prefetch=self.prefetch) as feed:
+                                   prefetch=self.prefetch,
+                                   workers=self.workers,
+                                   cache=self.cache,
+                                   cache_ident=self.cache_ident) as feed:
                     try:
                         for chunk in feed:
                             faults.inject("stream.fold", key=pass_id)
@@ -235,12 +275,67 @@ class StreamRun:
         return new_src, start
 
 
+def _fit_layer_fused(candidates, source, upstream, *, stream_checkpoint,
+                     prefetch, workers, cache, stats, retry_policy,
+                     layer_index) -> Dict[str, Transformer]:
+    """Fuse the independent one-pass prep fits of one DAG layer into a
+    single chunk sweep (they share the same upstream, so they have no
+    data dependency on each other). Each stage's fold becomes one arm of
+    a ``CompositeFold`` keyed by its uid; the fused state checkpoints
+    under the joined uid, so a mid-pass kill resumes the joint fold
+    bit-exactly. Returns ``{uid → fitted model}`` for the stages whose
+    prep participated (a stage whose ``fit_streaming_prep`` returns
+    ``None`` needs no pass and falls back to its solo fit)."""
+    from .folds import CompositeFold
+    runs = {s.uid: StreamRun(source, upstream, s.uid, checkpoint=None,
+                             prefetch=prefetch, stats=stats,
+                             cache=cache, workers=workers)
+            for s in candidates}
+    specs = {}
+    for s in candidates:
+        spec = s.fit_streaming_prep(runs[s.uid])
+        if spec is not None:
+            specs[s.uid] = spec
+    if len(specs) < 2:
+        return {}
+    stages = [s for s in candidates if s.uid in specs]
+    fused_uid = "+".join(s.uid for s in stages)
+    pass_id = "+".join(specs[s.uid][0] for s in stages)
+
+    def _fit() -> Dict[str, Transformer]:
+        for s in stages:
+            faults.inject("preempt.stage_fit", key=s.uid)
+        composite = CompositeFold({uid: spec[1]
+                                   for uid, spec in specs.items()})
+        extractors = {uid: spec[2] for uid, spec in specs.items()}
+
+        def extract_all(table: FeatureTable) -> Tuple:
+            return ({uid: ex(table) for uid, ex in extractors.items()},)
+
+        fused_run = StreamRun(source, upstream, fused_uid,
+                              checkpoint=stream_checkpoint,
+                              prefetch=prefetch, stats=stats,
+                              cache=cache, workers=workers)
+        with _obs_span("stream.fit_fused", cat="train", uid=fused_uid,
+                       layer=layer_index, fusedPasses=len(stages),
+                       chunks=source.num_chunks):
+            state = fused_run.fold(pass_id, composite, extract_all)
+        return {uid: specs[uid][3](state[uid]) for uid in specs}
+
+    if retry_policy is not None:
+        return retry_policy.execute(
+            _fit, site=f"stream.stage_fit[{fused_uid}]")
+    return _fit()
+
+
 def fit_dag_streaming(source: ChunkSource, layers, *,
                       checkpoint: Optional[Callable] = None,
                       stream_checkpoint: Optional[StreamCheckpoint] = None,
                       preloaded: Optional[Dict[str, Any]] = None,
                       retry_policy: Optional[Any] = None,
                       prefetch: Optional[int] = None,
+                      cache: Optional[ChunkCache] = None,
+                      workers: Optional[int] = None,
                       ) -> Tuple[Dict[str, Any], List[Transformer], FeedStats]:
     """Fit every estimator in the layered DAG as streaming folds.
 
@@ -248,7 +343,10 @@ def fit_dag_streaming(source: ChunkSource, layers, *,
     aggregate feed stats)``. Mirrors ``dag.fit_and_transform_dag``'s
     checkpoint/preload/retry contract (docs/robustness.md) — ``preloaded``
     stages restore instead of refitting, ``checkpoint(model)`` commits each
-    fitted stage, transient errors retry under ``retry_policy``.
+    fitted stage, transient errors retry under ``retry_policy``. ``cache``
+    is the run-wide transformed-chunk cache handle (shared across every
+    pass and stage so repeat sweeps replay prepped chunks); ``workers``
+    sizes the input-engine producer pool (None → TG_STREAM_WORKERS).
     """
     pre = preloaded or {}
     fitted: Dict[str, Any] = {}
@@ -256,6 +354,16 @@ def fit_dag_streaming(source: ChunkSource, layers, *,
     stats = FeedStats()
     for li, layer in enumerate(layers):
         models: List[Transformer] = []
+        fused: Dict[str, Transformer] = {}
+        fusable = [stage for stage, _ in layer
+                   if isinstance(stage, Estimator) and stage.uid not in pre
+                   and hasattr(stage, "fit_streaming_prep")]
+        if env_fuse() and len(fusable) >= 2:
+            fused = _fit_layer_fused(
+                fusable, source, upstream,
+                stream_checkpoint=stream_checkpoint, prefetch=prefetch,
+                workers=workers, cache=cache, stats=stats,
+                retry_policy=retry_policy, layer_index=li)
         for stage, _ in layer:
             if isinstance(stage, Estimator):
                 if stage.uid in pre:
@@ -266,12 +374,17 @@ def fit_dag_streaming(source: ChunkSource, layers, *,
                         site="dag.stage_fit", kind="restored",
                         detail={"uid": stage.uid,
                                 "stage": type(stage).__name__}))
+                elif stage.uid in fused:
+                    model = fused[stage.uid]
+                    if checkpoint is not None:
+                        checkpoint(model)
                 elif hasattr(stage, "fit_streaming"):
                     def _fit(stage=stage, li=li):
                         faults.inject("preempt.stage_fit", key=stage.uid)
                         run = StreamRun(source, upstream, stage.uid,
                                         checkpoint=stream_checkpoint,
-                                        prefetch=prefetch, stats=stats)
+                                        prefetch=prefetch, stats=stats,
+                                        cache=cache, workers=workers)
                         with _obs_span("stream.fit", cat="train",
                                        uid=stage.uid,
                                        stage=type(stage).__name__,
@@ -303,5 +416,11 @@ def fit_dag_streaming(source: ChunkSource, layers, *,
             else:
                 raise TypeError(
                     f"unexpected stage kind {type(stage).__name__}")
+        if (fused and checkpoint is not None
+                and stream_checkpoint is not None):
+            # every fused stage's full checkpoint committed above — the
+            # joint fold state under the joined uid is now redundant
+            stream_checkpoint.manifest.drop_streams("+".join(fused))
+            stream_checkpoint.manifest.save()
         upstream.extend(models)
     return fitted, upstream, stats

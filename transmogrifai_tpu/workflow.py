@@ -337,6 +337,14 @@ class OpWorkflow(_WorkflowCore):
                 model, ckpt_dir, manifest)
             stream_ckpt = StreamCheckpoint(ckpt_dir, manifest,
                                            source.fingerprint())
+        # transformed-chunk cache: one handle for the whole train, shared
+        # by every stage and pass so repeat sweeps replay prepped chunks
+        # (host LRU under TG_STREAM_CACHE_BYTES; sha256-verified disk
+        # tier under TG_STREAM_CACHE_DIR — point it at
+        # <checkpoint dir>/stream_cache so cached prep survives a kill
+        # next to the fold states it matches)
+        from .streaming.cache import ChunkCache
+        stream_cache = ChunkCache.from_env()
         from .manifest import active_sentinel
         sentinel = _open_run_sentinel(ckpt_dir, resume)
         with active_sentinel(sentinel):
@@ -344,7 +352,8 @@ class OpWorkflow(_WorkflowCore):
                 source, layers,
                 checkpoint=checkpoint, stream_checkpoint=stream_ckpt,
                 preloaded=preloaded,
-                retry_policy=getattr(self, "_fault_policy", None))
+                retry_policy=getattr(self, "_fault_policy", None),
+                cache=stream_cache)
         if sentinel is not None:
             sentinel.clear()
         new_results = tuple(
@@ -367,6 +376,8 @@ class OpWorkflow(_WorkflowCore):
             probe = m.transform(probe)
         model.train_table = probe
         model._stream_stats = stats
+        model._stream_cache_stats = (stream_cache.stats
+                                     if stream_cache is not None else None)
         model._fitted_stage_uids = sorted(fitted)
         model._resume_requested = resume
         model._layers = compute_dag(new_results)
@@ -736,6 +747,9 @@ class OpWorkflowModel(_WorkflowCore):
         stream_stats = getattr(self, "_stream_stats", None)
         if stream_stats is not None:
             out["streaming"] = stream_stats.to_json()
+            cache_stats = getattr(self, "_stream_cache_stats", None)
+            if cache_stats is not None:
+                out["streaming"]["cache"] = cache_stats.to_json()
         return out
 
     def summary_json(self) -> str:
